@@ -1,0 +1,59 @@
+"""apex_tpu — TPU-native training-acceleration framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of apex
+(kexinyu/apex, a fork of NVIDIA/apex):
+
+- ``apex_tpu.amp``          — mixed-precision policies O0–O3 + functional
+  dynamic loss scaling (reference: apex/amp/* (U)).
+- ``apex_tpu.multi_tensor`` — flat-buffer pytree packing, the TPU analogue of
+  apex's multi_tensor_apply + apex_C flatten/unflatten (U).
+- ``apex_tpu.kernels``      — Pallas TPU kernels: fused LayerNorm/RMSNorm,
+  scaled-masked softmax, flash attention, fused dense/MLP, Welford stats,
+  fused optimizer sweeps (reference: csrc/* (U)).
+- ``apex_tpu.optimizers``   — FusedAdam/FusedLAMB/FusedSGD/FusedNovoGrad/
+  FusedAdagrad, LARC, ZeRO-style DistributedFusedAdam
+  (reference: apex/optimizers/*, apex/contrib/optimizers/* (U)).
+- ``apex_tpu.parallel``     — data-parallel runtime + SyncBatchNorm
+  (reference: apex/parallel/* (U)).
+- ``apex_tpu.transformer``  — tensor/sequence/pipeline parallelism over a
+  device mesh (reference: apex/transformer/* (U)).
+- ``apex_tpu.mesh``         — the single first-class communication backend:
+  mesh axes over ICI/DCN + XLA collectives, replacing NCCL process groups.
+
+Citation convention: ``(U)`` paths refer to the upstream apex layout as
+documented in SURVEY.md (the reference mount was empty at survey time).
+"""
+
+__version__ = "0.1.0"
+
+from apex_tpu import mesh  # noqa: F401
+
+__all__ = [
+    "mesh",
+    "amp",
+    "multi_tensor",
+    "kernels",
+    "optimizers",
+    "parallel",
+    "transformer",
+    "contrib",
+    "fp16_utils",
+    "models",
+    "testing",
+    "__version__",
+]
+
+
+def __getattr__(name):
+    # Lazy subpackage imports keep `import apex_tpu` light and avoid
+    # touching jax backends at import time.
+    if name in __all__:
+        import importlib
+
+        try:
+            return importlib.import_module(f"apex_tpu.{name}")
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                f"module 'apex_tpu' has no attribute {name!r} ({e})"
+            ) from e
+    raise AttributeError(f"module 'apex_tpu' has no attribute {name!r}")

@@ -1,0 +1,54 @@
+"""Device-mesh topology + collectives: the communication backend.
+
+This package is the TPU-native replacement for everything apex builds on
+``torch.distributed`` NCCL process groups (reference: apex/parallel/
+distributed.py (U), apex/transformer/parallel_state.py (U), apex/contrib/
+{peer_memory,nccl_p2p} (U)): a single mesh of devices with named axes
+(``dp``/``pp``/``tp``, with Megatron-style sequence parallelism sharing the
+``tp`` axis), and XLA collectives (`psum`/`all_gather`/`psum_scatter`/
+`ppermute`) that ride ICI within a slice and DCN across slices.
+"""
+
+from apex_tpu.mesh.topology import (
+    AXIS_DP,
+    AXIS_EP,
+    AXIS_PP,
+    AXIS_TP,
+    MeshConfig,
+    build_mesh,
+    mesh_shape_of,
+)
+from apex_tpu.mesh.collectives import (
+    all_gather,
+    all_to_all,
+    axis_index,
+    axis_size,
+    pbroadcast_from,
+    pmean,
+    ppermute,
+    ppermute_shift,
+    psum,
+    psum_scatter,
+    reduce_scatter,
+)
+
+__all__ = [
+    "AXIS_DP",
+    "AXIS_EP",
+    "AXIS_PP",
+    "AXIS_TP",
+    "MeshConfig",
+    "build_mesh",
+    "mesh_shape_of",
+    "all_gather",
+    "all_to_all",
+    "axis_index",
+    "axis_size",
+    "pbroadcast_from",
+    "pmean",
+    "ppermute",
+    "ppermute_shift",
+    "psum",
+    "psum_scatter",
+    "reduce_scatter",
+]

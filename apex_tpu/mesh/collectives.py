@@ -1,0 +1,98 @@
+"""Thin, named-axis collective wrappers over XLA primitives.
+
+These are the TPU-native replacement for every NCCL call site in apex:
+``dist.all_reduce`` → :func:`psum`, ``_reduce_scatter_base`` →
+:func:`reduce_scatter`, ``_all_gather_base`` → :func:`all_gather`, batched
+P2P ``isend/irecv`` (apex/transformer/pipeline_parallel/p2p_communication.py
+(U)) → :func:`ppermute_shift`. All of them are valid only inside a
+``shard_map``/``pmap`` region over a mesh axis; XLA lowers them to ICI/DCN
+collectives and overlaps them with compute via its latency-hiding scheduler
+(replacing apex's manual comm-stream management in apex/parallel/
+distributed.py (U)).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisName = Union[str, Sequence[str]]
+
+
+def axis_index(axis: AxisName):
+    """Rank within ``axis`` — apex's ``get_*_parallel_rank()`` (U)."""
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: AxisName):
+    """World size of ``axis`` — apex's ``get_*_parallel_world_size()`` (U)."""
+    return lax.axis_size(axis)
+
+
+def psum(x, axis: AxisName):
+    """All-reduce(sum) over ``axis`` — NCCL allreduce equivalent."""
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: AxisName):
+    """All-reduce(mean) — apex DDP's ``gradient_average=True`` path (U)."""
+    return lax.pmean(x, axis)
+
+
+def all_gather(x, axis: AxisName, *, gather_axis: int = 0, tiled: bool = True):
+    """All-gather shards along array dim ``gather_axis``.
+
+    ``tiled=True`` concatenates (NCCL ``all_gather_base`` semantics, what
+    apex's sequence-parallel gather uses); ``tiled=False`` stacks a new
+    leading axis.
+    """
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def psum_scatter(x, axis: AxisName, *, scatter_axis: int = 0, tiled: bool = True):
+    """Reduce-scatter: sum over ``axis`` then keep this rank's shard."""
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=tiled)
+
+
+# NCCL nomenclature alias: apex calls this op reduce_scatter throughout.
+reduce_scatter = psum_scatter
+
+
+def ppermute(x, axis: AxisName, perm):
+    """Point-to-point permutation — the pipeline-stage transfer primitive."""
+    return lax.ppermute(x, axis, perm)
+
+
+def ppermute_shift(x, axis: AxisName, shift: int = 1, *, wrap: bool = True):
+    """Shift values ``shift`` ranks forward along ``axis``.
+
+    Replaces apex's ``send_forward``/``recv_forward`` pairs (U): rank i's
+    value arrives at rank i+shift. With ``wrap=False`` the first ranks
+    receive zeros (pipeline edge behaviour); with ``wrap=True`` it is a ring
+    rotation (halo exchange / ring collectives).
+    """
+    n = lax.axis_size(axis)
+    if wrap:
+        perm = [(i, (i + shift) % n) for i in range(n)]
+    else:
+        perm = [(i, i + shift) for i in range(n) if 0 <= i + shift < n]
+    return lax.ppermute(x, axis, perm)
+
+
+def all_to_all(x, axis: AxisName, *, split_axis: int, concat_axis: int, tiled: bool = True):
+    """All-to-all — the sequence↔head reshard (Ulysses-style) primitive."""
+    return lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled)
+
+
+def pbroadcast_from(x, axis: AxisName, src_index: int = 0):
+    """Broadcast rank ``src_index``'s value to all ranks of ``axis``.
+
+    Replaces apex's ``broadcast_data`` root-rank broadcast
+    (apex/transformer/tensor_parallel/data.py (U)).
+    """
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == src_index, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis)

@@ -1,0 +1,111 @@
+"""Mesh construction: map {dp, pp, tp} parallelism axes onto TPU devices.
+
+TPU-native analogue of ``apex.transformer.parallel_state.
+initialize_model_parallel`` (U) group math: instead of carving the world
+into NCCL process groups, we build one ``jax.sharding.Mesh`` whose named
+axes are the parallelism dimensions. Axis order is chosen for the
+interconnect:
+
+- ``tp`` is the innermost (fastest-varying) axis so tensor-parallel
+  collectives land on physically adjacent chips and ride ICI.
+- ``dp`` is next; gradient all-reduce is per-step but overlappable.
+- ``pp`` is outermost; pipeline transfer is point-to-point and per
+  microbatch, the most DCN-tolerant traffic.
+
+Megatron-style sequence parallelism (SP) deliberately has no axis of its
+own: as in apex (`sequence_parallel_enabled` in apex/transformer/
+tensor_parallel/layers.py (U)), SP shards activations over the *same* ranks
+as TP, so it reuses the ``tp`` axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis names. EP (expert parallelism) is reserved — absent in the
+# reference (SURVEY.md §2.5) but kept in the namespace so MoE can slot in.
+AXIS_DP = "dp"
+AXIS_PP = "pp"
+AXIS_TP = "tp"
+AXIS_EP = "ep"
+
+#: Default axis order, outermost → innermost.
+DEFAULT_AXIS_ORDER = (AXIS_PP, AXIS_DP, AXIS_TP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Declarative mesh shape.
+
+    ``dp=None`` infers data parallelism as ``n_devices // (tp * pp)`` — the
+    same world-size factorisation apex's ``initialize_model_parallel`` does.
+    """
+
+    tp: int = 1
+    pp: int = 1
+    dp: Optional[int] = None
+    axis_order: Sequence[str] = DEFAULT_AXIS_ORDER
+
+    def resolve_dp(self, n_devices: int) -> int:
+        if self.tp < 1 or self.pp < 1:
+            raise ValueError(f"tp and pp must be >= 1, got tp={self.tp} pp={self.pp}")
+        model_parallel = self.tp * self.pp
+        if self.dp is not None:
+            total = model_parallel * self.dp
+            if total != n_devices:
+                raise ValueError(
+                    f"tp*pp*dp = {total} != device count {n_devices}"
+                )
+            return self.dp
+        if n_devices % model_parallel != 0:
+            raise ValueError(
+                f"device count {n_devices} not divisible by tp*pp={model_parallel}"
+            )
+        return n_devices // model_parallel
+
+
+def build_mesh(
+    tp: int = 1,
+    pp: int = 1,
+    dp: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_order: Sequence[str] = DEFAULT_AXIS_ORDER,
+) -> Mesh:
+    """Build a ``Mesh`` with named {pp, dp, tp} axes over ``devices``.
+
+    Drop-in conceptual replacement for ``initialize_model_parallel(tp, pp)``
+    (U): every apex "process group" becomes a mesh axis; rank queries become
+    ``jax.lax.axis_index(axis)`` inside ``shard_map`` or
+    ``mesh.devices``-coordinate math outside it.
+    """
+    explicit_devices = devices is not None
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    cfg = MeshConfig(tp=tp, pp=pp, dp=dp, axis_order=tuple(axis_order))
+    dp_size = cfg.resolve_dp(n)
+    sizes = {AXIS_DP: dp_size, AXIS_PP: pp, AXIS_TP: tp}
+    unknown = set(cfg.axis_order) - set(sizes)
+    if unknown:
+        raise ValueError(f"unknown axis names in axis_order: {sorted(unknown)}")
+    shape = tuple(sizes[a] for a in cfg.axis_order)
+    if math.prod(shape) != n:
+        raise ValueError(f"mesh shape {shape} does not cover {n} devices")
+    if not explicit_devices:
+        # jax.make_mesh does topology-aware placement (maps the innermost
+        # mesh axis onto physically adjacent chips of the ICI torus) —
+        # a naive reshape of enumeration order cannot guarantee that.
+        return jax.make_mesh(shape, tuple(cfg.axis_order))
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(cfg.axis_order))
+
+
+def mesh_shape_of(mesh: Mesh) -> dict:
+    """Axis-name → size mapping of a mesh."""
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

@@ -1,0 +1,35 @@
+"""Test backbone: simulate an 8-device mesh on CPU.
+
+Apex emulates multi-node topology by spawning one NCCL process per local GPU
+(apex/transformer/testing/distributed_test_base.py (U)). On the XLA side we
+do strictly better (SURVEY.md §4): force the host platform to expose 8
+virtual CPU devices and run every distributed test single-process on a real
+``jax.sharding.Mesh``. Must run before any jax backend is initialised.
+"""
+
+import os
+
+import re
+
+_flags = os.environ.get("XLA_FLAGS", "")
+_m = re.search(r"--xla_force_host_platform_device_count=(\d+)", _flags)
+if _m is None or int(_m.group(1)) < 8:
+    if _m is not None:
+        _flags = _flags.replace(_m.group(0), "")
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, (
+        "tests require 8 simulated devices; conftest must run before backend init"
+    )
+    return devs[:8]
