@@ -1,0 +1,150 @@
+"""apex_tpu.amp — mixed precision with dynamic loss scaling.
+
+TPU-native re-design of ``apex.amp`` (apex/amp/* (U)). The apex entry point
+
+.. code-block:: python
+
+    model, optimizer = amp.initialize(model, optimizer, opt_level="O2")
+    with amp.scale_loss(loss, optimizer) as scaled_loss:
+        scaled_loss.backward()
+
+becomes, functionally:
+
+.. code-block:: python
+
+    amp_ctx, apply_fn = amp.initialize(model_apply, opt_level="O2")
+    scaler = amp_ctx.init_scaler_state()
+    value, grads, finite = amp_ctx.value_and_grad(loss_fn)(params, scaler_state=scaler)
+    scaler = amp_ctx.update_scaler(scaler, finite)
+    params = amp.apply_if_finite(new_params, params, finite)
+
+Everything is a pytree or a pure function, so the whole train step —
+including the overflow skip — compiles into one XLA program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax.numpy as jnp
+
+from apex_tpu.amp.policy import HALF_DTYPES, Policy, get_policy
+from apex_tpu.amp.scaler import (
+    ScalerConfig,
+    ScalerState,
+    all_finite,
+    apply_if_finite,
+    scale_loss,
+    unscale,
+    update,
+    value_and_scaled_grad,
+)
+
+__all__ = [
+    "Policy",
+    "get_policy",
+    "ScalerConfig",
+    "ScalerState",
+    "all_finite",
+    "apply_if_finite",
+    "scale_loss",
+    "unscale",
+    "update",
+    "value_and_scaled_grad",
+    "Amp",
+    "initialize",
+    "HALF_DTYPES",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Amp:
+    """Bundle of precision policy + scaler config returned by
+    :func:`initialize` — the functional analogue of apex's patched
+    (model, optimizer) pair plus ``_amp_state`` (U)."""
+
+    policy: Policy
+    scaler: ScalerConfig
+
+    # -- scaler lifecycle ---------------------------------------------------
+    def init_scaler_state(self) -> ScalerState:
+        return self.scaler.init()
+
+    def value_and_grad(self, fun: Callable, **kw):
+        return value_and_scaled_grad(fun, self.scaler, **kw)
+
+    def update_scaler(self, state: ScalerState, grads_finite) -> ScalerState:
+        return update(self.scaler, state, grads_finite)
+
+    # -- checkpointing: apex amp.state_dict()/load_state_dict() (U) ---------
+    @staticmethod
+    def state_dict(state: ScalerState) -> dict:
+        return {
+            "loss_scale": float(state.loss_scale),
+            "growth_count": int(state.growth_count),
+            "hysteresis_left": int(state.hysteresis_left),
+        }
+
+    @staticmethod
+    def load_state_dict(d: dict) -> ScalerState:
+        return ScalerState(
+            loss_scale=jnp.float32(d["loss_scale"]),
+            growth_count=jnp.int32(d["growth_count"]),
+            hysteresis_left=jnp.int32(d["hysteresis_left"]),
+        )
+
+
+def initialize(
+    apply_fn: Optional[Callable] = None,
+    opt_level: str = "O1",
+    *,
+    half_dtype=jnp.bfloat16,
+    loss_scale: Union[str, float, None] = "policy",
+    **policy_overrides,
+) -> Tuple[Amp, Optional[Callable]]:
+    """Configure mixed precision — parity with ``amp.initialize`` (U).
+
+    Args:
+      apply_fn: optional model apply function ``f(params, *args)``; if given,
+        a wrapped version is returned that casts params+inputs to the compute
+        dtype and the result to the output dtype (the structural form of
+        O1's op patching / O2's ``model.half()``).
+      opt_level: ``"O0" | "O1" | "O2" | "O3"``.
+      half_dtype: ``bfloat16`` (TPU default, no scaling) or ``float16``.
+      loss_scale: ``"policy"`` (follow the opt level), ``"dynamic"``, a
+        static float, or ``None`` to disable.
+      **policy_overrides: keyword overrides onto the :class:`Policy`, like
+        apex's ``amp.initialize(..., keep_batchnorm_fp32=True)``.
+
+    Returns ``(amp_ctx, wrapped_apply_or_None)``.
+    """
+    policy = get_policy(opt_level, half_dtype)
+    if policy_overrides:
+        policy = policy.with_(**policy_overrides)
+
+    if loss_scale == "policy":
+        loss_scale = policy.loss_scale
+    if loss_scale is None:
+        cfg = ScalerConfig(enabled=False)
+    elif loss_scale == "dynamic":
+        cfg = ScalerConfig(enabled=True)
+    else:
+        ls = float(loss_scale)
+        # Static scale: never grow, never back off (apex static mode (U)).
+        cfg = ScalerConfig(
+            init_scale=ls, growth_factor=1.0, backoff_factor=1.0,
+            min_scale=ls, max_scale=ls, enabled=True,
+        )
+
+    ctx = Amp(policy=policy, scaler=cfg)
+
+    wrapped = None
+    if apply_fn is not None:
+        def wrapped(params, *args, **kwargs):
+            params = policy.cast_to_compute(params)
+            args = policy.cast_to_compute(args)
+            out = apply_fn(params, *args, **kwargs)
+            return policy.cast_to_output(out)
+
+    return ctx, wrapped

@@ -1,0 +1,126 @@
+"""Mixed-precision policies — the TPU-native form of apex amp opt levels.
+
+Apex amp (apex/amp/frontend.py (U)) configures mixed precision with opt
+levels O0–O3, each a bundle of ``Properties`` (cast_model_type,
+patch_torch_functions, keep_batchnorm_fp32, master_weights, loss_scale).
+On TPU there is no op-patching machinery to install — JAX programs are
+traced, so precision is a property of the *values* flowing through the
+program. A :class:`Policy` therefore carries three dtypes (params, compute,
+output) plus the norm-precision and master-weight flags, and the layers in
+``apex_tpu`` (and any user model) apply it at op boundaries via
+``cast_to_compute`` — the same decision the O1 whitelist made per-op, made
+structurally instead.
+
+The TPU-native default is **bfloat16**, which needs no loss scaling (same
+exponent range as fp32). ``float16`` policies are provided for parity and
+for the rare model that wants fp16's extra mantissa bit; they default to
+dynamic loss scaling exactly like apex.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+HALF_DTYPES = (jnp.float16, jnp.bfloat16)
+
+
+def _cast_floating(tree: Any, dtype) -> Any:
+    """Cast only floating-point leaves; ints/bools pass through."""
+    if dtype is None:
+        return tree
+
+    def cast(x):
+        x = jnp.asarray(x)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(cast, tree)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """A precision policy: what dtype params live in, compute runs in, and
+    outputs are returned in.
+
+    Mirrors apex amp ``Properties`` (U):
+
+    - ``param_dtype``      ≈ ``cast_model_type``
+    - ``compute_dtype``    ≈ the O1 whitelist cast target
+    - ``output_dtype``     ≈ loss/output dtype
+    - ``keep_norms_fp32``  ≈ ``keep_batchnorm_fp32`` (we extend it to all
+      normalization statistics, the numerically fragile part on TPU)
+    - ``master_weights``   ≈ O2 fp32 master params
+    - ``loss_scale``       ≈ ``loss_scale`` ("dynamic", a float, or None)
+    """
+
+    name: str
+    param_dtype: Any
+    compute_dtype: Any
+    output_dtype: Any
+    keep_norms_fp32: bool = True
+    master_weights: bool = False
+    loss_scale: Union[str, float, None] = None
+
+    # -- tree casts ---------------------------------------------------------
+    def cast_to_compute(self, tree):
+        return _cast_floating(tree, self.compute_dtype)
+
+    def cast_to_param(self, tree):
+        return _cast_floating(tree, self.param_dtype)
+
+    def cast_to_output(self, tree):
+        return _cast_floating(tree, self.output_dtype)
+
+    def cast_norms(self, tree):
+        """Dtype for normalization math: fp32 if ``keep_norms_fp32``."""
+        return _cast_floating(tree, jnp.float32 if self.keep_norms_fp32 else self.compute_dtype)
+
+    @property
+    def requires_loss_scaling(self) -> bool:
+        return self.loss_scale is not None
+
+    def with_(self, **overrides) -> "Policy":
+        """Keyword overrides, like ``amp.initialize(..., keyword=...)`` (U)."""
+        return dataclasses.replace(self, **overrides)
+
+
+def get_policy(opt_level: str = "O1", half_dtype=jnp.bfloat16) -> Policy:
+    """Build the policy for an apex opt level (apex/amp/frontend.py (U)).
+
+    ============ ===========================================================
+    ``O0``       fp32 everywhere (debugging baseline).
+    ``O1``       params fp32, compute in ``half_dtype`` at op boundaries,
+                 norms fp32 — the "patch" opt level, done structurally.
+    ``O2``       params in ``half_dtype`` with fp32 master weights in the
+                 optimizer, compute half, norms fp32 — "almost fp16".
+    ``O3``       pure half, no masters, no fp32 norms (speed ceiling).
+    ============ ===========================================================
+
+    With ``half_dtype=float16`` the O1–O3 policies enable dynamic loss
+    scaling (apex's default); with bfloat16 (TPU default) no scaling is
+    needed and ``loss_scale`` stays ``None``.
+    """
+    half_dtype = jnp.dtype(half_dtype)
+    if half_dtype not in (jnp.dtype(jnp.float16), jnp.dtype(jnp.bfloat16)):
+        raise ValueError(f"half_dtype must be float16 or bfloat16, got {half_dtype}")
+    needs_scale = half_dtype == jnp.dtype(jnp.float16)
+    scale: Union[str, None] = "dynamic" if needs_scale else None
+    lvl = opt_level.upper()
+    if lvl == "O0":
+        return Policy("O0", jnp.float32, jnp.float32, jnp.float32,
+                      keep_norms_fp32=True, master_weights=False, loss_scale=None)
+    if lvl == "O1":
+        return Policy("O1", jnp.float32, half_dtype, jnp.float32,
+                      keep_norms_fp32=True, master_weights=False, loss_scale=scale)
+    if lvl == "O2":
+        return Policy("O2", half_dtype, half_dtype, jnp.float32,
+                      keep_norms_fp32=True, master_weights=True, loss_scale=scale)
+    if lvl == "O3":
+        return Policy("O3", half_dtype, half_dtype, half_dtype,
+                      keep_norms_fp32=False, master_weights=False, loss_scale=scale)
+    raise ValueError(f"unknown opt_level {opt_level!r}; expected O0/O1/O2/O3")

@@ -1,0 +1,184 @@
+"""Functional dynamic loss scaling.
+
+The TPU-native re-design of apex's ``LossScaler`` (apex/amp/scaler.py (U))
+and the on-device hysteresis scale update (csrc/update_scale_hysteresis.cu
+(U), [era]). Apex mutates a Python-side scaler object and decides on the
+host whether to skip ``optimizer.step()``; under ``jit`` that round-trip is
+forbidden, so here the scaler is a tiny pytree of device scalars and every
+decision — unscale, overflow check, skip-step, grow/backoff — is expressed
+with ``jnp.where`` so one compiled program handles both the clean-step and
+overflow-step paths (SURVEY.md §7 "hard parts").
+
+Semantics match apex defaults: init scale 2^16, ×2 growth every 2000
+consecutive finite steps, ×0.5 backoff on inf/nan, optional hysteresis
+(backoff only after N consecutive overflow steps).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalerConfig:
+    """Static scaler configuration (apex ``LossScaler.__init__`` args (U))."""
+
+    init_scale: float = 2.0 ** 16
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+    hysteresis: int = 1
+    min_scale: float = 1.0
+    max_scale: float = 2.0 ** 24
+    #: False → identity scaler (bf16/fp32 policies); keeps one code path.
+    enabled: bool = True
+
+    def init(self) -> "ScalerState":
+        return ScalerState(
+            loss_scale=jnp.float32(self.init_scale if self.enabled else 1.0),
+            growth_count=jnp.int32(0),
+            hysteresis_left=jnp.int32(self.hysteresis),
+        )
+
+
+class ScalerState(NamedTuple):
+    """Device-resident scaler state — a pytree, checkpointable like apex's
+    ``amp.state_dict()`` (U)."""
+
+    loss_scale: jnp.ndarray      # f32 scalar
+    growth_count: jnp.ndarray    # i32 scalar: consecutive finite steps
+    hysteresis_left: jnp.ndarray # i32 scalar: overflow tolerance remaining
+
+
+def scale_loss(loss, state: ScalerState):
+    """``loss * scale`` — the body of apex's ``scale_loss`` ctx manager (U).
+
+    Computed in fp32: the default scale 2^16 is not representable in
+    float16 (max 65504), so scaling a half-precision loss in its own dtype
+    would produce inf every step.
+    """
+    return jax.tree.map(
+        lambda l: jnp.asarray(l, jnp.float32) * state.loss_scale, loss)
+
+
+def all_finite(tree: Any) -> jnp.ndarray:
+    """Fused all-finite reduction over a pytree (bool scalar).
+
+    The analogue of the inf/nan check ``multi_tensor_scale`` folds into the
+    unscale sweep (csrc/multi_tensor_scale_kernel.cu (U) ``overflow_buf``).
+    XLA fuses the per-leaf reductions into the surrounding elementwise work.
+    """
+    leaves = [x for x in jax.tree.leaves(tree)
+              if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)]
+    if not leaves:
+        return jnp.bool_(True)
+    finite = [jnp.isfinite(x).all() for x in leaves]
+    return jnp.stack(finite).all()
+
+
+def unscale(grads: Any, state: ScalerState) -> Any:
+    """``grad * 1/scale`` on every floating leaf.
+
+    Half-precision grads are unscaled **into fp32** (apex's
+    ``multi_tensor_scale`` writes fp32 master grads (U)): dividing by 2^16
+    inside float16 would flush exactly the small gradient components loss
+    scaling exists to preserve.
+    """
+    inv = 1.0 / state.loss_scale
+
+    def un(g):
+        g = jnp.asarray(g)
+        if jnp.issubdtype(g.dtype, jnp.floating):
+            return g.astype(jnp.float32) * inv
+        return g
+
+    return jax.tree.map(un, grads)
+
+
+def update(cfg: ScalerConfig, state: ScalerState, grads_finite) -> ScalerState:
+    """Post-step scale update — apex ``update_scale`` + hysteresis (U).
+
+    Branch-free (``jnp.where`` on scalars) so it compiles into the train
+    step with no host sync.
+    """
+    if not cfg.enabled:
+        return state
+    finite = jnp.asarray(grads_finite)
+    scale, count, hyst = state.loss_scale, state.growth_count, state.hysteresis_left
+
+    # Clean step: bump counter; on hitting growth_interval, grow and reset.
+    new_count = count + 1
+    should_grow = finite & (new_count >= cfg.growth_interval)
+    grown = jnp.clip(scale * cfg.growth_factor, cfg.min_scale, cfg.max_scale)
+    scale_clean = jnp.where(should_grow, grown, scale)
+    count_clean = jnp.where(should_grow, 0, new_count)
+
+    # Overflow step: spend hysteresis; back off only when exhausted.
+    hyst_spent = hyst - 1
+    should_backoff = hyst_spent <= 0
+    backed = jnp.clip(scale * cfg.backoff_factor, cfg.min_scale, cfg.max_scale)
+    scale_over = jnp.where(should_backoff, backed, scale)
+    hyst_over = jnp.where(should_backoff, cfg.hysteresis, hyst_spent)
+
+    return ScalerState(
+        loss_scale=jnp.where(finite, scale_clean, scale_over),
+        growth_count=jnp.where(finite, count_clean, 0).astype(jnp.int32),
+        hysteresis_left=jnp.where(finite, cfg.hysteresis, hyst_over).astype(jnp.int32),
+    )
+
+
+def apply_if_finite(new_tree: Any, old_tree: Any, grads_finite) -> Any:
+    """Select updated vs previous values — the jit-safe form of apex's
+    "skip ``optimizer.step()`` on overflow" (U). Works on params and
+    optimizer state alike."""
+    finite = jnp.asarray(grads_finite)
+    return jax.tree.map(lambda n, o: jnp.where(finite, n, o), new_tree, old_tree)
+
+
+def value_and_scaled_grad(
+    fun: Callable,
+    cfg: ScalerConfig,
+    *,
+    has_aux: bool = False,
+    argnums: int = 0,
+):
+    """Differentiate ``fun`` under loss scaling; return unscaled grads.
+
+    The one-call equivalent of apex's
+
+    .. code-block:: python
+
+        with amp.scale_loss(loss, optimizer) as scaled_loss:
+            scaled_loss.backward()
+
+    Returns ``wrapped(params, scaler_state, *args) ->
+    (value[, aux], grads, grads_finite)`` where ``grads`` are already
+    unscaled and ``grads_finite`` is the fused overflow flag the caller
+    feeds to :func:`update` / :func:`apply_if_finite`.
+    """
+
+    def wrapped(*args, scaler_state: ScalerState):
+        def scaled_fun(*inner):
+            out = fun(*inner)
+            if has_aux:
+                loss, aux = out
+                return scale_loss(loss, scaler_state), aux
+            return scale_loss(out, scaler_state)
+
+        grad_fn = jax.value_and_grad(scaled_fun, argnums=argnums, has_aux=has_aux)
+        if has_aux:
+            (scaled_value, aux), grads = grad_fn(*args)
+        else:
+            scaled_value, grads = grad_fn(*args)
+        grads = unscale(grads, scaler_state)
+        finite = all_finite(grads)
+        value = jnp.asarray(scaled_value, jnp.float32) / scaler_state.loss_scale
+        if has_aux:
+            return (value, aux), grads, finite
+        return value, grads, finite
+
+    return wrapped

@@ -1,0 +1,5 @@
+"""Optional subsystems (apex/contrib/* (U) parity)."""
+
+from apex_tpu.contrib.clip_grad import clip_grad_norm_
+
+__all__ = ["clip_grad_norm_"]

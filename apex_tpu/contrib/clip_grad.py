@@ -1,0 +1,29 @@
+"""Fused gradient clipping — apex/contrib/clip_grad/clip_grad.py (U).
+
+One Pallas pass for the global norm (``multi_tensor_l2norm``) and one for
+the conditional rescale (``multi_tensor_scale``), over flat buffers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+
+from apex_tpu import multi_tensor as mt
+from apex_tpu.kernels.flat_ops import l2norm_flat, scale_flat
+
+
+def clip_grad_norm_(grads: Any, max_norm: float, *, eps: float = 1e-6
+                    ) -> Tuple[Any, jnp.ndarray]:
+    """Clip a grad pytree to global L2 norm ``max_norm``.
+
+    Returns ``(clipped_grads, total_norm)`` — functional, unlike the
+    in-place torch original. The clip coefficient is clamped to 1 so small
+    gradients pass through untouched.
+    """
+    bufs, layout = mt.pack(grads)
+    total = l2norm_flat(bufs)
+    coeff = jnp.minimum(1.0, jnp.asarray(max_norm, jnp.float32) / (total + eps))
+    out_bufs, _ = scale_flat(bufs, coeff)
+    return mt.unpack(out_bufs, layout), total

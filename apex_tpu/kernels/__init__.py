@@ -1,0 +1,27 @@
+"""Pallas TPU kernels — the ``csrc/`` equivalent (SURVEY.md §2.3).
+
+Every CUDA extension in the reference maps to a Pallas kernel here (TPU's
+native kernel path); kernels fall back to the Pallas interpreter off-TPU so
+the CPU test backbone exercises identical semantics.
+"""
+
+from apex_tpu.kernels.layer_norm import layer_norm, rms_norm
+from apex_tpu.kernels.flat_ops import (
+    adagrad_flat,
+    adam_flat,
+    axpby_flat,
+    l2norm_flat,
+    scale_flat,
+    sgd_flat,
+)
+
+__all__ = [
+    "layer_norm",
+    "rms_norm",
+    "adagrad_flat",
+    "adam_flat",
+    "axpby_flat",
+    "l2norm_flat",
+    "scale_flat",
+    "sgd_flat",
+]

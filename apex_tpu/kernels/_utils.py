@@ -1,0 +1,46 @@
+"""Shared kernel helpers: interpret-mode fallback, tiling math."""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+
+from apex_tpu.multi_tensor.packing import LANE  # single source of truth
+
+SUBLANE_F32 = 8
+
+
+@functools.cache
+def use_interpret() -> bool:
+    """Run Pallas kernels in interpreter mode off-TPU.
+
+    The CPU test backbone (tests/conftest.py) has no Mosaic backend; the
+    interpreter executes identical kernel semantics. On TPU this returns
+    False and kernels compile natively. ``APEX_TPU_FORCE_INTERPRET=1``
+    forces interpretation everywhere (debugging).
+    """
+    if os.environ.get("APEX_TPU_FORCE_INTERPRET") == "1":
+        return True
+    return jax.default_backend() != "tpu"
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up(n: int, multiple: int) -> int:
+    return cdiv(n, multiple) * multiple
+
+
+def pick_block_rows(hidden_padded: int, *, bytes_per_el: int = 4,
+                    n_buffers: int = 6, vmem_budget: int = 8 * 1024 * 1024,
+                    max_rows: int = 256) -> int:
+    """Largest power-of-two row-block ≤ max_rows whose working set fits VMEM."""
+    rows = max_rows
+    while rows > SUBLANE_F32:
+        if rows * hidden_padded * bytes_per_el * n_buffers <= vmem_budget:
+            break
+        rows //= 2
+    return max(rows, SUBLANE_F32)

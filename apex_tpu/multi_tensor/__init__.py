@@ -1,0 +1,38 @@
+"""Flat-buffer pytree packing — the multi-tensor machinery.
+
+Apex accelerates "apply op to hundreds of small tensors" two ways:
+``apex_C`` flatten/unflatten (csrc/flatten_unflatten.cpp (U)) builds flat
+bucket buffers for DDP, and ``multi_tensor_apply`` (apex/multi_tensor_apply/
+multi_tensor_apply.py (U) + csrc/multi_tensor_apply.cuh (U)) chunks tensor
+lists so one CUDA kernel sweeps them all.
+
+On TPU the idiomatic equivalent is static packing: concatenate a pytree's
+leaves (grouped by dtype) into one padded 1-D buffer per dtype **at trace
+time**, run one Pallas kernel over each buffer, and slice the tree back
+out. XLA sees static offsets, so pack/unpack lower to cheap contiguous
+copies that fuse with neighbours, and the optimizer kernel sees a single
+contiguous view — apex's "flatten trick, but once, statically"
+(SURVEY.md §7 hard parts).
+"""
+
+from apex_tpu.multi_tensor.packing import (
+    LANE,
+    FlatLayout,
+    flatten_dense_tensors,
+    pack,
+    pack_cast,
+    pad_to,
+    unflatten_dense_tensors,
+    unpack,
+)
+
+__all__ = [
+    "LANE",
+    "FlatLayout",
+    "flatten_dense_tensors",
+    "pack",
+    "pack_cast",
+    "pad_to",
+    "unflatten_dense_tensors",
+    "unpack",
+]

@@ -1,0 +1,174 @@
+"""Static pytree → flat per-dtype buffer packing."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: TPU lane width; flat buffers are padded so kernels can view them as
+#: (rows, LANE) tiles with no remainder handling.
+LANE = 128
+
+#: Pad granularity: 8 sublanes × 128 lanes covers the fp32 min tile; it also
+#: divides the bf16 (16, 128) tile when rows are even, which padding to a
+#: multiple of 2048 guarantees.
+_PAD_MULTIPLE = 16 * LANE
+
+
+def pad_to(n: int, multiple: int = _PAD_MULTIPLE) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+@dataclasses.dataclass(frozen=True)
+class _LeafMeta:
+    shape: Tuple[int, ...]
+    dtype: Any
+    group: int      # index into the per-dtype buffer list
+    offset: int     # element offset within the group buffer
+    size: int
+
+
+@dataclasses.dataclass(frozen=True)
+class FlatLayout:
+    """Static description of how a pytree maps into flat buffers.
+
+    Hashable/static so it can close over jitted functions; only the buffer
+    *values* are traced.
+    """
+
+    treedef: Any
+    leaves: Tuple[_LeafMeta, ...]
+    group_dtypes: Tuple[Any, ...]
+    group_sizes: Tuple[int, ...]        # padded sizes, multiples of LANE
+    group_used: Tuple[int, ...]         # unpadded element counts
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.group_dtypes)
+
+
+def _layout_of(tree: Any) -> FlatLayout:
+    leaves, treedef = jax.tree.flatten(tree)
+    group_index: Dict[Any, int] = {}
+    group_cursor: List[int] = []
+    group_dtypes: List[Any] = []
+    metas: List[_LeafMeta] = []
+    for leaf in leaves:
+        leaf = jnp.asarray(leaf)
+        dt = jnp.dtype(leaf.dtype)
+        if dt not in group_index:
+            group_index[dt] = len(group_dtypes)
+            group_dtypes.append(dt)
+            group_cursor.append(0)
+        g = group_index[dt]
+        size = int(np.prod(leaf.shape)) if leaf.shape else 1
+        metas.append(_LeafMeta(tuple(leaf.shape), dt, g, group_cursor[g], size))
+        group_cursor[g] += size
+    return FlatLayout(
+        treedef=treedef,
+        leaves=tuple(metas),
+        group_dtypes=tuple(group_dtypes),
+        group_sizes=tuple(pad_to(c) for c in group_cursor),
+        group_used=tuple(group_cursor),
+    )
+
+
+def pack(tree: Any, layout: FlatLayout | None = None) -> Tuple[List[jnp.ndarray], FlatLayout]:
+    """Pack a pytree into one padded 1-D buffer per dtype.
+
+    The analogue of ``apex_C.flatten`` (U). ``layout`` may be passed to
+    reuse a previously computed layout (it is validated against the tree);
+    gradients packed with the params' layout land at matching offsets, which
+    is what lets one optimizer kernel process (param, grad, m, v) quads.
+    """
+    if layout is None:
+        layout = _layout_of(tree)
+    leaves = jax.tree.leaves(tree)
+    if len(leaves) != len(layout.leaves):
+        raise ValueError("tree does not match layout (leaf count differs)")
+    parts: List[List[jnp.ndarray]] = [[] for _ in range(layout.num_groups)]
+    for leaf, meta in zip(leaves, layout.leaves):
+        leaf = jnp.asarray(leaf)
+        if tuple(leaf.shape) != meta.shape or jnp.dtype(leaf.dtype) != meta.dtype:
+            raise ValueError(
+                f"leaf mismatch: got {leaf.shape}/{leaf.dtype}, layout has "
+                f"{meta.shape}/{meta.dtype}"
+            )
+        parts[meta.group].append(leaf.reshape(-1))
+    buffers = []
+    for g in range(layout.num_groups):
+        used = layout.group_used[g]
+        padded = layout.group_sizes[g]
+        buf = jnp.concatenate(parts[g]) if parts[g] else jnp.zeros((0,), layout.group_dtypes[g])
+        if padded > used:
+            buf = jnp.concatenate([buf, jnp.zeros((padded - used,), buf.dtype)])
+        buffers.append(buf)
+    return buffers, layout
+
+
+def unpack(buffers: Sequence[jnp.ndarray], layout: FlatLayout) -> Any:
+    """Slice flat buffers back into the original pytree
+    (``apex_C.unflatten`` (U))."""
+    leaves = []
+    for meta in layout.leaves:
+        flat = jax.lax.dynamic_slice_in_dim(buffers[meta.group], meta.offset, meta.size)
+        leaves.append(flat.reshape(meta.shape))
+    return jax.tree.unflatten(layout.treedef, leaves)
+
+
+def pack_cast(tree: Any, layout: FlatLayout, dtype=jnp.float32) -> List[jnp.ndarray]:
+    """Pack a pytree into ``layout``'s grouping/offsets, but with every
+    buffer cast to ``dtype``.
+
+    This is the master-grad path: gradients are packed fp32 at the *params'*
+    offsets so (param, grad, moment) buffers zip positionally, without
+    downcasting still-scaled fp32 grads into a half dtype (which could
+    overflow before the kernel's fused unscale).
+    """
+    leaves = jax.tree.leaves(tree)
+    if len(leaves) != len(layout.leaves):
+        raise ValueError("tree does not match layout (leaf count differs)")
+    parts: List[List[jnp.ndarray]] = [[] for _ in range(layout.num_groups)]
+    for leaf, meta in zip(leaves, layout.leaves):
+        leaf = jnp.asarray(leaf)
+        if tuple(leaf.shape) != meta.shape:
+            raise ValueError(
+                f"leaf shape mismatch: got {leaf.shape}, layout has {meta.shape}")
+        parts[meta.group].append(leaf.astype(dtype).reshape(-1))
+    buffers = []
+    for g in range(layout.num_groups):
+        used = layout.group_used[g]
+        padded = layout.group_sizes[g]
+        buf = jnp.concatenate(parts[g]) if parts[g] else jnp.zeros((0,), dtype)
+        if padded > used:
+            buf = jnp.concatenate([buf, jnp.zeros((padded - used,), dtype)])
+        buffers.append(buf)
+    return buffers
+
+
+# -- list-of-arrays convenience, exact apex_C call-shape parity -------------
+
+def flatten_dense_tensors(tensors: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Flatten same-dtype arrays into one 1-D buffer (unpadded), parity with
+    ``apex_C.flatten`` / torch ``_flatten_dense_tensors`` (U)."""
+    tensors = [jnp.asarray(t) for t in tensors]
+    if not tensors:
+        raise ValueError("need at least one tensor")
+    dt = tensors[0].dtype
+    if any(t.dtype != dt for t in tensors):
+        raise ValueError("flatten_dense_tensors requires a single dtype")
+    return jnp.concatenate([t.reshape(-1) for t in tensors])
+
+
+def unflatten_dense_tensors(flat: jnp.ndarray, like: Sequence[jnp.ndarray]) -> List[jnp.ndarray]:
+    """Split a flat buffer back to the shapes of ``like`` (U)."""
+    out, offset = [], 0
+    for t in like:
+        size = int(np.prod(t.shape)) if t.shape else 1
+        out.append(jax.lax.dynamic_slice_in_dim(flat, offset, size).reshape(t.shape))
+        offset += size
+    return out

@@ -1,0 +1,31 @@
+"""Fused optimizers (apex/optimizers/* (U)) as flat-buffer Pallas sweeps.
+
+All transforms are optax-duck-typed (``init``/``update``) with an extra
+fully-fused ``step`` that writes new params in-kernel (the apex call
+shape). ``grad_scale`` folds amp's unscale into the sweep.
+"""
+
+from apex_tpu.optimizers._base import FusedOptimizer
+from apex_tpu.optimizers.fused_adam import FusedAdamState, fused_adam
+from apex_tpu.optimizers.fused_adagrad import FusedAdagradState, fused_adagrad
+from apex_tpu.optimizers.fused_lamb import FusedLAMBState, fused_lamb
+from apex_tpu.optimizers.fused_novograd import FusedNovoGradState, fused_novograd
+from apex_tpu.optimizers.fused_sgd import FusedSGDState, fused_sgd
+from apex_tpu.optimizers.larc import larc_transform
+
+# apex class-name aliases
+FusedAdam = fused_adam
+FusedLAMB = fused_lamb
+FusedSGD = fused_sgd
+FusedNovoGrad = fused_novograd
+FusedAdagrad = fused_adagrad
+
+__all__ = [
+    "FusedOptimizer",
+    "fused_adam", "FusedAdam", "FusedAdamState",
+    "fused_lamb", "FusedLAMB", "FusedLAMBState",
+    "fused_sgd", "FusedSGD", "FusedSGDState",
+    "fused_novograd", "FusedNovoGrad", "FusedNovoGradState",
+    "fused_adagrad", "FusedAdagrad", "FusedAdagradState",
+    "larc_transform",
+]

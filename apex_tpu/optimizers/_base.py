@@ -1,0 +1,80 @@
+"""Shared plumbing for fused optimizers."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import multi_tensor as mt
+
+Schedule = Union[float, Callable[[jnp.ndarray], Any]]
+
+
+class FusedOptimizer(NamedTuple):
+    """optax-duck-typed transform with an extra fully-fused ``step``.
+
+    - ``init(params) -> state``
+    - ``update(grads, state, params) -> (updates, state)`` — optax contract;
+      apply with ``optax.apply_updates``.
+    - ``step(grads, state, params) -> (new_params, state)`` — the apex
+      call shape (``FusedAdam.step()`` (U)): the kernel writes new params
+      directly, saving one elementwise pass and, for half params, one
+      rounding.
+
+    Both entry points accept ``grad_scale`` so amp's unscale fuses into the
+    sweep (SURVEY.md §3.2).
+    """
+
+    init: Callable
+    update: Callable
+    step: Callable
+
+
+def resolve_lr(learning_rate: Schedule, count) -> jnp.ndarray:
+    if callable(learning_rate):
+        return jnp.asarray(learning_rate(count), jnp.float32)
+    return jnp.asarray(learning_rate, jnp.float32)
+
+
+def pack_pair(params, grads):
+    """Pack params in their own dtypes and grads as fp32 master grads at the
+    params' offsets — never downcasting possibly-still-scaled grads into a
+    half dtype."""
+    pbufs, layout = mt.pack(params)
+    gbufs = mt.pack_cast(grads, layout, jnp.float32)
+    return pbufs, gbufs, layout
+
+
+def zeros_like_group_f32(layout: mt.FlatLayout):
+    return tuple(jnp.zeros((s,), jnp.float32) for s in layout.group_sizes)
+
+
+def per_leaf_norms(tree) -> list:
+    """Per-tensor L2 norms (fp32) — the per-tensor half of
+    ``multi_tensor_l2norm`` (U), used by LAMB trust ratios and NovoGrad."""
+    return [
+        jnp.linalg.norm(jnp.asarray(x).astype(jnp.float32).reshape(-1))
+        for x in jax.tree.leaves(tree)
+    ]
+
+
+def broadcast_per_leaf(values, layout: mt.FlatLayout):
+    """Expand one scalar per leaf into flat per-dtype buffers matching
+    ``layout`` (padding gets 1.0 so it is multiplication-neutral)."""
+    parts = [[] for _ in range(layout.num_groups)]
+    for val, meta in zip(values, layout.leaves):
+        parts[meta.group].append(
+            jnp.broadcast_to(jnp.asarray(val, jnp.float32), (meta.size,))
+        )
+    bufs = []
+    for g in range(layout.num_groups):
+        used = layout.group_used[g]
+        padded = layout.group_sizes[g]
+        buf = (jnp.concatenate(parts[g]) if parts[g]
+               else jnp.zeros((0,), jnp.float32))
+        if padded > used:
+            buf = jnp.concatenate([buf, jnp.ones((padded - used,), jnp.float32)])
+        bufs.append(buf)
+    return bufs

@@ -1,0 +1,58 @@
+"""FusedAdagrad — apex/optimizers/fused_adagrad.py (U) over
+csrc/multi_tensor_adagrad.cu (U)."""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+from apex_tpu import multi_tensor as mt
+from apex_tpu.kernels.flat_ops import adagrad_flat
+from apex_tpu.optimizers._base import (
+    FusedOptimizer,
+    Schedule,
+    pack_pair,
+    resolve_lr,
+    zeros_like_group_f32,
+)
+
+
+class FusedAdagradState(NamedTuple):
+    count: jnp.ndarray
+    sum_sq: Tuple[jnp.ndarray, ...]
+
+
+def fused_adagrad(
+    learning_rate: Schedule = 1e-2,
+    eps: float = 1e-10,
+    weight_decay: float = 0.0,
+) -> FusedOptimizer:
+    def init(params) -> FusedAdagradState:
+        _, layout = mt.pack(params)
+        return FusedAdagradState(
+            count=jnp.zeros((), jnp.int32),
+            sum_sq=zeros_like_group_f32(layout),
+        )
+
+    def _sweep(grads, state, params, grad_scale, out_is_delta):
+        if params is None:
+            raise ValueError("fused_adagrad requires params")
+        pbufs, gbufs, layout = pack_pair(params, grads)
+        count = state.count + 1
+        out_bufs, new_h = adagrad_flat(
+            pbufs, gbufs, list(state.sum_sq),
+            lr=resolve_lr(learning_rate, count), eps=eps,
+            weight_decay=weight_decay,
+            grad_scale=1.0 if grad_scale is None else grad_scale,
+            out_is_delta=out_is_delta,
+        )
+        return mt.unpack(out_bufs, layout), FusedAdagradState(count, tuple(new_h))
+
+    def update(grads, state, params=None, *, grad_scale=None):
+        return _sweep(grads, state, params, grad_scale, out_is_delta=True)
+
+    def step(grads, state, params, *, grad_scale=None):
+        return _sweep(grads, state, params, grad_scale, out_is_delta=False)
+
+    return FusedOptimizer(init=init, update=update, step=step)

@@ -1,0 +1,126 @@
+"""FusedLAMB — apex/optimizers/fused_lamb.py (U) over
+csrc/multi_tensor_lamb*.cu (U).
+
+Two-phase NVLAMB, same structure as the CUDA stage1/stage2 split:
+
+- optional global grad-norm clip (``multi_tensor_l2norm`` → fold the clip
+  coefficient into ``grad_scale`` so it costs nothing extra),
+- phase 1: one Pallas sweep producing the Adam-style update ``u`` and new
+  moments (the stage-1 kernel),
+- per-tensor ‖p‖/‖u‖ trust ratios (the per-tensor half of
+  ``multi_tensor_l2norm``; small XLA reductions per leaf),
+- phase 2: ``p ← p − lr·ratio·u`` — pure elementwise over the flat
+  buffers, which XLA fuses into a single pass (the stage-2 kernel).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+
+from apex_tpu import multi_tensor as mt
+from apex_tpu.kernels.flat_ops import adam_flat, l2norm_flat
+from apex_tpu.optimizers._base import (
+    FusedOptimizer,
+    Schedule,
+    broadcast_per_leaf,
+    pack_pair,
+    per_leaf_norms,
+    resolve_lr,
+    zeros_like_group_f32,
+)
+
+
+class FusedLAMBState(NamedTuple):
+    count: jnp.ndarray
+    m: Tuple[jnp.ndarray, ...]
+    v: Tuple[jnp.ndarray, ...]
+
+
+def fused_lamb(
+    learning_rate: Schedule = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-6,
+    weight_decay: float = 0.01,
+    bias_correction: bool = True,
+    max_grad_norm: Optional[float] = 1.0,
+    always_adapt: bool = False,
+) -> FusedOptimizer:
+    """apex FusedLAMB defaults: eps=1e-6, wd=0.01, global clip at 1.0.
+
+    ``always_adapt`` follows apex's ``use_nvlamb``: with ``False``, the
+    trust ratio is only applied when weight decay is active (apex skips
+    adaptation for wd=0 param groups); with ``True`` it is always applied.
+    Degenerate tensors (zero ‖p‖ or ‖u‖) always fall back to ratio 1.
+    """
+
+    def init(params) -> FusedLAMBState:
+        _, layout = mt.pack(params)
+        return FusedLAMBState(
+            count=jnp.zeros((), jnp.int32),
+            m=zeros_like_group_f32(layout),
+            v=zeros_like_group_f32(layout),
+        )
+
+    def _sweep(grads, state, params, grad_scale, out_is_delta):
+        if params is None:
+            raise ValueError("fused_lamb requires params")
+        pbufs, gbufs, layout = pack_pair(params, grads)
+        count = state.count + 1
+        gscale = jnp.float32(1.0 if grad_scale is None else grad_scale)
+
+        if max_grad_norm is not None:
+            gnorm = l2norm_flat(gbufs) * gscale
+            clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
+            gscale = gscale * clip
+
+        if bias_correction:
+            c = count.astype(jnp.float32)
+            bc1 = 1.0 - jnp.float32(b1) ** c
+            bc2 = 1.0 - jnp.float32(b2) ** c
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+
+        # Phase 1 (stage-1 kernel): u = mhat/(sqrt(vhat)+eps) + wd*p, via
+        # the adam sweep with lr=1 emitting a delta (u = -delta).
+        delta_bufs, new_m, new_v = adam_flat(
+            pbufs, gbufs, list(state.m), list(state.v),
+            lr=1.0, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
+            bias_correction1=bc1, bias_correction2=bc2, grad_scale=gscale,
+            adam_w_mode=True, out_is_delta=True, out_dtype=jnp.float32,
+        )
+        u_bufs = [-d for d in delta_bufs]
+
+        # Per-tensor trust ratios from the unpacked views.
+        if always_adapt or weight_decay != 0.0:
+            p_norms = per_leaf_norms(params)
+            u_norms = per_leaf_norms(mt.unpack(u_bufs, layout))
+            ratios = []
+            for pn, un in zip(p_norms, u_norms):
+                ok = (pn > 0.0) & (un > 0.0)
+                ratios.append(jnp.where(ok, pn / jnp.where(un > 0.0, un, 1.0), 1.0))
+            ratio_bufs = broadcast_per_leaf(ratios, layout)
+        else:
+            # use_nvlamb=False + wd=0: apex applies no trust adaptation.
+            ratio_bufs = [jnp.ones((), jnp.float32)] * len(pbufs)
+
+        # Phase 2 (stage-2): elementwise, XLA-fused over the flat buffers.
+        lr = resolve_lr(learning_rate, count)
+        if out_is_delta:
+            out_bufs = [(-lr * r * u).astype(p.dtype)
+                        for p, r, u in zip(pbufs, ratio_bufs, u_bufs)]
+        else:
+            out_bufs = [(p.astype(jnp.float32) - lr * r * u).astype(p.dtype)
+                        for p, r, u in zip(pbufs, ratio_bufs, u_bufs)]
+        new_state = FusedLAMBState(count, tuple(new_m), tuple(new_v))
+        return mt.unpack(out_bufs, layout), new_state
+
+    def update(grads, state, params=None, *, grad_scale=None):
+        return _sweep(grads, state, params, grad_scale, out_is_delta=True)
+
+    def step(grads, state, params, *, grad_scale=None):
+        return _sweep(grads, state, params, grad_scale, out_is_delta=False)
+
+    return FusedOptimizer(init=init, update=update, step=step)

@@ -1,0 +1,86 @@
+"""FusedNovoGrad — apex/optimizers/fused_novograd.py (U) over
+csrc/multi_tensor_novograd.cu (U).
+
+NovoGrad keeps one second-moment scalar **per tensor** (layer-wise), so the
+state is (flat momentum buffers, a vector of per-leaf v). The normalised
+gradient step is elementwise over the flat buffers and XLA-fused.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+from apex_tpu import multi_tensor as mt
+from apex_tpu.optimizers._base import (
+    FusedOptimizer,
+    Schedule,
+    broadcast_per_leaf,
+    pack_pair,
+    per_leaf_norms,
+    resolve_lr,
+    zeros_like_group_f32,
+)
+
+
+class FusedNovoGradState(NamedTuple):
+    count: jnp.ndarray
+    m: Tuple[jnp.ndarray, ...]
+    v: jnp.ndarray  # (n_leaves,) fp32 per-tensor second moments
+
+
+def fused_novograd(
+    learning_rate: Schedule = 1e-3,
+    b1: float = 0.95,
+    b2: float = 0.98,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    grad_averaging: bool = True,
+) -> FusedOptimizer:
+    def init(params) -> FusedNovoGradState:
+        _, layout = mt.pack(params)
+        n_leaves = len(layout.leaves)
+        return FusedNovoGradState(
+            count=jnp.zeros((), jnp.int32),
+            m=zeros_like_group_f32(layout),
+            v=jnp.zeros((n_leaves,), jnp.float32),
+        )
+
+    def _sweep(grads, state, params, grad_scale, out_is_delta):
+        if params is None:
+            raise ValueError("fused_novograd requires params")
+        pbufs, gbufs, layout = pack_pair(params, grads)
+        count = state.count + 1
+        gscale = jnp.float32(1.0 if grad_scale is None else grad_scale)
+
+        g_norms = jnp.stack(per_leaf_norms(grads)) * gscale
+        gsq = g_norms ** 2
+        # apex initialises v to the first grad-norm² rather than decaying
+        # from zero.
+        new_v = jnp.where(state.count == 0, gsq, b2 * state.v + (1.0 - b2) * gsq)
+        denom_bufs = broadcast_per_leaf(
+            list(jnp.sqrt(new_v) + eps), layout)
+
+        coeff = (1.0 - b1) if grad_averaging else 1.0
+        lr = resolve_lr(learning_rate, count)
+        out_bufs, new_m = [], []
+        for pb, gb, mb, db in zip(pbufs, gbufs, state.m, denom_bufs):
+            p32 = pb.astype(jnp.float32)
+            g32 = gb.astype(jnp.float32) * gscale
+            m = b1 * mb + coeff * (g32 / db + weight_decay * p32)
+            new_m.append(m)
+            if out_is_delta:
+                out_bufs.append((-lr * m).astype(pb.dtype))
+            else:
+                out_bufs.append((p32 - lr * m).astype(pb.dtype))
+        new_state = FusedNovoGradState(count, tuple(new_m), new_v)
+        return mt.unpack(out_bufs, layout), new_state
+
+    def update(grads, state, params=None, *, grad_scale=None):
+        return _sweep(grads, state, params, grad_scale, out_is_delta=True)
+
+    def step(grads, state, params, *, grad_scale=None):
+        return _sweep(grads, state, params, grad_scale, out_is_delta=False)
+
+    return FusedOptimizer(init=init, update=update, step=step)

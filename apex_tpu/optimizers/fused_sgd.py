@@ -1,0 +1,66 @@
+"""FusedSGD — apex/optimizers/fused_sgd.py (U) over
+csrc/multi_tensor_sgd_kernel.cu (U), as one Pallas sweep."""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+from apex_tpu import multi_tensor as mt
+from apex_tpu.kernels.flat_ops import sgd_flat
+from apex_tpu.optimizers._base import (
+    FusedOptimizer,
+    Schedule,
+    pack_pair,
+    resolve_lr,
+    zeros_like_group_f32,
+)
+
+
+class FusedSGDState(NamedTuple):
+    count: jnp.ndarray
+    momentum: Tuple[jnp.ndarray, ...]
+
+
+def fused_sgd(
+    learning_rate: Schedule = 1e-3,
+    momentum: float = 0.0,
+    dampening: float = 0.0,
+    weight_decay: float = 0.0,
+    nesterov: bool = False,
+) -> FusedOptimizer:
+    if nesterov and (momentum <= 0 or dampening != 0):
+        raise ValueError("nesterov requires momentum > 0 and dampening = 0")
+
+    def init(params) -> FusedSGDState:
+        _, layout = mt.pack(params)
+        return FusedSGDState(
+            count=jnp.zeros((), jnp.int32),
+            momentum=zeros_like_group_f32(layout),
+        )
+
+    def _sweep(grads, state, params, grad_scale, out_is_delta):
+        if params is None:
+            raise ValueError("fused_sgd requires params")
+        pbufs, gbufs, layout = pack_pair(params, grads)
+        count = state.count + 1
+        # torch/apex first-step semantics: momentum buffer = raw grad, which
+        # with m=0 equals zero dampening on step 0 (traced, no recompile).
+        damp_eff = jnp.where(state.count == 0, 0.0, dampening)
+        out_bufs, new_m = sgd_flat(
+            pbufs, gbufs, list(state.momentum),
+            lr=resolve_lr(learning_rate, count), momentum=momentum,
+            dampening=damp_eff, weight_decay=weight_decay,
+            grad_scale=1.0 if grad_scale is None else grad_scale,
+            nesterov=nesterov, out_is_delta=out_is_delta,
+        )
+        return mt.unpack(out_bufs, layout), FusedSGDState(count, tuple(new_m))
+
+    def update(grads, state, params=None, *, grad_scale=None):
+        return _sweep(grads, state, params, grad_scale, out_is_delta=True)
+
+    def step(grads, state, params, *, grad_scale=None):
+        return _sweep(grads, state, params, grad_scale, out_is_delta=False)
+
+    return FusedOptimizer(init=init, update=update, step=step)

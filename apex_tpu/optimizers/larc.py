@@ -1,0 +1,52 @@
+"""LARC — layer-wise adaptive rate clipping (apex/parallel/LARC.py (U)).
+
+Apex implements LARC as an optimizer wrapper that rescales each param's
+gradient in place before the wrapped ``step()``. Functionally that is a
+gradient transformation applied before any optimizer, so here it is one:
+
+.. code-block:: python
+
+    tx = fused_sgd(lr)
+    grads = larc_transform(grads, params, learning_rate=lr)
+    new_p, state = tx.step(grads, state, params)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def larc_transform(
+    grads,
+    params,
+    *,
+    learning_rate,
+    trust_coefficient: float = 0.02,
+    clip: bool = True,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+):
+    """Rescale grads per-tensor by the LARC adaptive rate.
+
+    ``clip=True`` is apex's clipping mode: the effective rate is
+    ``min(adaptive_lr / lr, 1)`` so LARC only ever *reduces* the step;
+    ``clip=False`` is LARS-style scaling.
+    """
+    lr = jnp.asarray(learning_rate, jnp.float32)
+
+    def one(g, p):
+        g32 = jnp.asarray(g, jnp.float32)
+        p32 = jnp.asarray(p, jnp.float32)
+        p_norm = jnp.linalg.norm(p32.reshape(-1))
+        g_norm = jnp.linalg.norm(g32.reshape(-1))
+        adaptive = trust_coefficient * p_norm / (g_norm + weight_decay * p_norm + eps)
+        ok = (p_norm > 0.0) & (g_norm > 0.0)
+        if clip:
+            rate = jnp.where(ok, jnp.minimum(adaptive / lr, 1.0), 1.0)
+        else:
+            rate = jnp.where(ok, adaptive, 1.0)
+        out = (g32 + weight_decay * p32) * rate
+        return out.astype(jnp.asarray(g).dtype)
+
+    return jax.tree.map(one, grads, params)

@@ -1,0 +1,226 @@
+"""amp tests — parity model: apex tests/L0/run_amp/* (U).
+
+Covers policy casting per opt level (test_basic_casts.py analogue), dynamic
+scaler growth/backoff/hysteresis, jit-safe overflow skip, and scaler
+checkpoint round-trip (test_checkpointing.py analogue).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+
+
+def tree_dtypes(tree):
+    return [jnp.asarray(x).dtype for x in jax.tree.leaves(tree)]
+
+
+class TestPolicy:
+    def test_opt_levels(self):
+        o0 = amp.get_policy("O0")
+        o1 = amp.get_policy("O1")
+        o2 = amp.get_policy("O2")
+        o3 = amp.get_policy("O3")
+        assert o0.compute_dtype == jnp.float32 and o0.loss_scale is None
+        assert o1.compute_dtype == jnp.bfloat16 and o1.param_dtype == jnp.float32
+        assert o2.param_dtype == jnp.bfloat16 and o2.master_weights
+        assert o3.keep_norms_fp32 is False
+
+    def test_fp16_enables_dynamic_scaling(self):
+        for lvl in ("O1", "O2", "O3"):
+            assert amp.get_policy(lvl, jnp.float16).loss_scale == "dynamic"
+            assert amp.get_policy(lvl, jnp.bfloat16).loss_scale is None
+
+    def test_cast_preserves_integers(self):
+        p = amp.get_policy("O1")
+        tree = {"w": jnp.ones((2, 2)), "step": jnp.int32(3), "mask": jnp.array([True])}
+        out = p.cast_to_compute(tree)
+        assert out["w"].dtype == jnp.bfloat16
+        assert out["step"].dtype == jnp.int32
+        assert out["mask"].dtype == jnp.bool_
+
+    def test_unknown_level_raises(self):
+        with pytest.raises(ValueError):
+            amp.get_policy("O4")
+        with pytest.raises(ValueError):
+            amp.get_policy("O1", jnp.float64)
+
+
+class TestScaler:
+    def cfg(self, **kw):
+        kw.setdefault("init_scale", 8.0)
+        kw.setdefault("growth_interval", 3)
+        return amp.ScalerConfig(**kw)
+
+    def test_growth_after_interval(self):
+        cfg = self.cfg()
+        st = cfg.init()
+        for _ in range(2):
+            st = amp.update(cfg, st, True)
+            assert float(st.loss_scale) == 8.0
+        st = amp.update(cfg, st, True)  # 3rd clean step → grow
+        assert float(st.loss_scale) == 16.0
+        assert int(st.growth_count) == 0
+
+    def test_backoff_on_overflow_and_counter_reset(self):
+        cfg = self.cfg()
+        st = cfg.init()
+        st = amp.update(cfg, st, True)
+        st = amp.update(cfg, st, False)
+        assert float(st.loss_scale) == 4.0
+        assert int(st.growth_count) == 0
+
+    def test_hysteresis_delays_backoff(self):
+        cfg = self.cfg(hysteresis=2)
+        st = cfg.init()
+        st = amp.update(cfg, st, False)
+        assert float(st.loss_scale) == 8.0  # first overflow tolerated
+        st = amp.update(cfg, st, False)
+        assert float(st.loss_scale) == 4.0  # second backs off
+        st = amp.update(cfg, st, True)
+        assert int(st.hysteresis_left) == 2  # clean step restores tolerance
+
+    def test_min_max_clamp(self):
+        cfg = self.cfg(init_scale=1.0, min_scale=1.0)
+        st = cfg.init()
+        st = amp.update(cfg, st, False)
+        assert float(st.loss_scale) == 1.0
+        cfg = self.cfg(init_scale=2.0 ** 24, max_scale=2.0 ** 24, growth_interval=1)
+        st = cfg.init()
+        st = amp.update(cfg, st, True)
+        assert float(st.loss_scale) == 2.0 ** 24
+
+    def test_update_is_jittable(self):
+        cfg = self.cfg()
+        upd = jax.jit(lambda s, f: amp.update(cfg, s, f))
+        st = upd(cfg.init(), jnp.bool_(False))
+        assert float(st.loss_scale) == 4.0
+
+    def test_all_finite(self):
+        good = {"a": jnp.ones(3), "i": jnp.arange(3)}
+        assert bool(amp.all_finite(good))
+        bad = {"a": jnp.array([1.0, jnp.inf]), "b": jnp.ones(2)}
+        assert not bool(amp.all_finite(bad))
+        nan = {"a": jnp.array([jnp.nan])}
+        assert not bool(amp.all_finite(nan))
+
+    def test_state_dict_roundtrip(self):
+        cfg = self.cfg()
+        st = amp.update(cfg, cfg.init(), False)
+        d = amp.Amp.state_dict(st)
+        st2 = amp.Amp.load_state_dict(d)
+        assert float(st2.loss_scale) == float(st.loss_scale)
+        assert int(st2.growth_count) == int(st.growth_count)
+
+
+class TestScaledGrad:
+    def test_grads_unscaled_and_finite_flag(self):
+        ctx, _ = amp.initialize(opt_level="O1", half_dtype=jnp.float16)
+        st = ctx.init_scaler_state()
+        assert float(st.loss_scale) == 2.0 ** 16
+
+        def loss_fn(w):
+            return jnp.sum(w ** 2)
+
+        w = jnp.array([1.0, 2.0])
+        value, grads, finite = jax.jit(
+            lambda w, s: ctx.value_and_grad(loss_fn)(w, scaler_state=s)
+        )(w, st)
+        np.testing.assert_allclose(np.asarray(grads), [2.0, 4.0], rtol=1e-6)
+        np.testing.assert_allclose(float(value), 5.0, rtol=1e-6)
+        assert bool(finite)
+
+    def test_overflow_detected_and_step_skipped(self):
+        ctx, _ = amp.initialize(opt_level="O1", half_dtype=jnp.float16)
+        st = ctx.init_scaler_state()
+
+        def bad_loss(w):
+            return jnp.sum(w * jnp.float32(jnp.inf))
+
+        w = jnp.array([1.0])
+        _, grads, finite = ctx.value_and_grad(bad_loss)(w, scaler_state=st)
+        assert not bool(finite)
+        new_w = amp.apply_if_finite(w - 123.0, w, finite)
+        np.testing.assert_allclose(np.asarray(new_w), np.asarray(w))
+        st2 = ctx.update_scaler(st, finite)
+        assert float(st2.loss_scale) == 2.0 ** 15
+
+    def test_has_aux(self):
+        ctx, _ = amp.initialize(opt_level="O1", half_dtype=jnp.float16)
+        st = ctx.init_scaler_state()
+
+        def loss_fn(w):
+            return jnp.sum(w), {"n": w.shape[0]}
+
+        (value, aux), grads, finite = ctx.value_and_grad(loss_fn, has_aux=True)(
+            jnp.ones(4), scaler_state=st
+        )
+        assert aux["n"] == 4 and bool(finite)
+        np.testing.assert_allclose(np.asarray(grads), np.ones(4))
+
+    def test_static_scale_never_moves(self):
+        ctx, _ = amp.initialize(opt_level="O1", half_dtype=jnp.float16, loss_scale=128.0)
+        st = ctx.init_scaler_state()
+        st = ctx.update_scaler(st, False)
+        assert float(st.loss_scale) == 128.0
+        st = ctx.update_scaler(st, True)
+        assert float(st.loss_scale) == 128.0
+
+    def test_fp16_loss_scaled_in_fp32(self):
+        """Scale 2^16 > float16 max: scaling must happen in fp32 (O3 path)."""
+        ctx, _ = amp.initialize(opt_level="O3", half_dtype=jnp.float16)
+        st = ctx.init_scaler_state()
+        scaled = amp.scale_loss(jnp.float16(2.0), st)
+        assert np.isfinite(float(scaled))
+        np.testing.assert_allclose(float(scaled), 2.0 * 2.0 ** 16)
+
+    def test_fp16_grads_unscaled_to_fp32(self):
+        """Unscale writes fp32 master grads — small components survive."""
+        st = amp.ScalerConfig(init_scale=2.0 ** 16).init()
+        tiny = jnp.float16(0.5)  # scaled grad; unscaled value 0.5/65536 ≈ 7.6e-6
+        out = amp.unscale({"g": tiny}, st)["g"]
+        assert out.dtype == jnp.float32
+        np.testing.assert_allclose(float(out), 0.5 / 2.0 ** 16, rtol=1e-6)
+
+    def test_bf16_policy_scaler_disabled(self):
+        ctx, _ = amp.initialize(opt_level="O1")
+        st = ctx.init_scaler_state()
+        assert float(st.loss_scale) == 1.0
+        st = ctx.update_scaler(st, False)
+        assert float(st.loss_scale) == 1.0
+
+
+class TestEndToEnd:
+    def test_fp16_training_converges_with_dynamic_scaling(self):
+        """L1-style: tiny regression trained under O1-fp16; loss decreases and
+        scaler survives (apex tests/L1 cross-product pattern, minimal)."""
+        ctx, apply_fn = amp.initialize(
+            lambda w, x: x @ w, opt_level="O1", half_dtype=jnp.float16
+        )
+        key = jax.random.PRNGKey(0)
+        x = jax.random.normal(key, (64, 8))
+        true_w = jnp.arange(8.0).reshape(8, 1)
+        y = x @ true_w
+        w = jnp.zeros((8, 1))
+
+        def loss_fn(w, x, y):
+            pred = apply_fn(w, x)
+            return jnp.mean((pred - y) ** 2)
+
+        st = ctx.init_scaler_state()
+
+        @jax.jit
+        def step(w, st, x, y):
+            value, grads, finite = ctx.value_and_grad(loss_fn)(w, x, y, scaler_state=st)
+            new_w = amp.apply_if_finite(w - 0.01 * grads, w, finite)
+            return value, new_w, ctx.update_scaler(st, finite)
+
+        first = None
+        for _ in range(200):
+            value, w, st = step(w, st, x, y)
+            if first is None:
+                first = float(value)
+        assert float(value) < first * 0.05
+        assert np.isfinite(float(st.loss_scale))

@@ -1,0 +1,126 @@
+"""Fused LayerNorm/RMSNorm kernel tests.
+
+Oracle pattern per apex tests/L0/run_fused_layer_norm (U): compare the
+fused kernel against an unfused jax.numpy reference at fp32, over a shape
+grid and dtypes, with per-dtype tolerances.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.kernels import layer_norm, rms_norm
+
+TOL = {
+    jnp.float32: dict(rtol=1e-5, atol=1e-5),
+    jnp.bfloat16: dict(rtol=2e-2, atol=2e-2),
+    jnp.float16: dict(rtol=2e-3, atol=2e-3),
+}
+
+
+def ref_layer_norm(x, w, b, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(-1, keepdims=True)
+    var = ((x32 - mean) ** 2).mean(-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (y * w.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+
+
+def ref_rms_norm(x, w, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    ms = (x32 ** 2).mean(-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(ms + eps) * w.astype(jnp.float32)).astype(x.dtype)
+
+
+SHAPES = [(4, 96), (3, 7, 128), (16, 1024), (2, 513)]
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_layer_norm_forward(shape, dtype):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    h = shape[-1]
+    x = jax.random.normal(k1, shape, dtype)
+    w = jax.random.normal(k2, (h,), jnp.float32)
+    b = jax.random.normal(k3, (h,), jnp.float32)
+    got = layer_norm(x, w, b)
+    want = ref_layer_norm(x, w, b)
+    assert got.dtype == x.dtype
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), **TOL[dtype])
+
+
+@pytest.mark.parametrize("shape", [(4, 96), (16, 1024)])
+def test_layer_norm_grads_match_reference(shape):
+    k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(1), 4)
+    h = shape[-1]
+    x = jax.random.normal(k1, shape)
+    w = jax.random.normal(k2, (h,))
+    b = jax.random.normal(k3, (h,))
+    dy = jax.random.normal(k4, shape)
+
+    def fused(x, w, b):
+        return jnp.vdot(layer_norm(x, w, b), dy)
+
+    def ref(x, w, b):
+        return jnp.vdot(ref_layer_norm(x, w, b), dy)
+
+    gx, gw, gb = jax.grad(fused, argnums=(0, 1, 2))(x, w, b)
+    rx, rw, rb = jax.grad(ref, argnums=(0, 1, 2))(x, w, b)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(rb), rtol=1e-4, atol=1e-4)
+
+
+def test_layer_norm_bf16_io_fp32_params():
+    """MixedFusedLayerNorm (U): half I/O, fp32 affine params."""
+    x = jax.random.normal(jax.random.PRNGKey(2), (8, 256), jnp.bfloat16)
+    w = jnp.ones((256,), jnp.float32) * 1.5
+    b = jnp.zeros((256,), jnp.float32)
+    y = layer_norm(x, w, b)
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(ref_layer_norm(x, w, b), np.float32),
+        rtol=2e-2, atol=2e-2)
+
+
+def test_layer_norm_no_affine_default():
+    x = jax.random.normal(jax.random.PRNGKey(3), (4, 64))
+    got = layer_norm(x)
+    want = ref_layer_norm(x, jnp.ones(64), jnp.zeros(64))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("shape", [(4, 96), (2, 5, 384)])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_rms_norm_forward(shape, dtype):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(4))
+    h = shape[-1]
+    x = jax.random.normal(k1, shape, dtype)
+    w = jax.random.normal(k2, (h,), jnp.float32)
+    got = rms_norm(x, w)
+    want = ref_rms_norm(x, w)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), **TOL[dtype])
+
+
+def test_rms_norm_grads_match_reference():
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(5), 3)
+    x = jax.random.normal(k1, (8, 192))
+    w = jax.random.normal(k2, (192,))
+    dy = jax.random.normal(k3, (8, 192))
+
+    gx, gw = jax.grad(lambda x, w: jnp.vdot(rms_norm(x, w), dy), argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(lambda x, w: jnp.vdot(ref_rms_norm(x, w), dy), argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), rtol=1e-4, atol=1e-4)
+
+
+def test_layer_norm_under_jit_and_vmap():
+    x = jax.random.normal(jax.random.PRNGKey(6), (3, 8, 128))
+    w = jnp.ones(128)
+    b = jnp.zeros(128)
+    got = jax.jit(jax.vmap(lambda xi: layer_norm(xi, w, b)))(x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref_layer_norm(x, w, b)), rtol=1e-5, atol=1e-5)

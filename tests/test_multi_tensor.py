@@ -1,0 +1,81 @@
+"""Flat-buffer packing tests (apex_C flatten/unflatten parity (U))."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import multi_tensor as mt
+
+
+def make_tree():
+    k = jax.random.PRNGKey(0)
+    return {
+        "w1": jax.random.normal(k, (17, 9)),
+        "b1": jnp.arange(9.0),
+        "emb": jax.random.normal(k, (5, 3)).astype(jnp.bfloat16),
+        "scalar": jnp.float32(3.0),
+        "step": jnp.int32(7),
+    }
+
+
+def test_pack_unpack_roundtrip():
+    tree = make_tree()
+    bufs, layout = mt.pack(tree)
+    out = mt.unpack(bufs, layout)
+    assert jax.tree.structure(out) == jax.tree.structure(tree)
+    for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(out)):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_buffers_grouped_by_dtype_and_padded():
+    bufs, layout = mt.pack(make_tree())
+    assert len(bufs) == 3  # f32, bf16, i32
+    for buf, size, used in zip(bufs, layout.group_sizes, layout.group_used):
+        assert buf.shape == (size,)
+        assert size % mt.LANE == 0 and size >= used
+        # padding is zero
+        np.testing.assert_array_equal(np.asarray(buf[used:]), 0)
+
+
+def test_layout_reuse_aligns_grads_with_params():
+    params = {"a": jnp.ones((4, 4)), "b": jnp.ones(3)}
+    grads = jax.tree.map(lambda p: p * 2, params)
+    pbufs, layout = mt.pack(params)
+    gbufs, _ = mt.pack(grads, layout)
+    np.testing.assert_allclose(np.asarray(gbufs[0]), 2 * np.asarray(pbufs[0]))
+
+
+def test_layout_mismatch_raises():
+    params = {"a": jnp.ones((4, 4))}
+    _, layout = mt.pack(params)
+    with pytest.raises(ValueError):
+        mt.pack({"a": jnp.ones((2, 2))}, layout)
+    with pytest.raises(ValueError):
+        mt.pack({"a": jnp.ones((4, 4)), "b": jnp.ones(1)}, layout)
+
+
+def test_pack_is_jittable():
+    params = {"a": jnp.ones((4, 4)), "b": jnp.full((3,), 2.0)}
+    _, layout = mt.pack(params)
+
+    @jax.jit
+    def f(tree):
+        bufs, _ = mt.pack(tree, layout)
+        return mt.unpack([b * 10 for b in bufs], layout)
+
+    out = f(params)
+    np.testing.assert_allclose(np.asarray(out["a"]), 10 * np.ones((4, 4)))
+    np.testing.assert_allclose(np.asarray(out["b"]), 20 * np.ones(3))
+
+
+def test_flatten_dense_tensors_parity():
+    ts = [jnp.ones((2, 3)), jnp.arange(4.0)]
+    flat = mt.flatten_dense_tensors(ts)
+    assert flat.shape == (10,)
+    back = mt.unflatten_dense_tensors(flat * 2, ts)
+    np.testing.assert_allclose(np.asarray(back[0]), 2 * np.ones((2, 3)))
+    np.testing.assert_allclose(np.asarray(back[1]), 2 * np.arange(4.0))
+    with pytest.raises(ValueError):
+        mt.flatten_dense_tensors([jnp.ones(2), jnp.ones(2, jnp.bfloat16)])

@@ -1,0 +1,304 @@
+"""Fused optimizer tests.
+
+Oracle pattern per apex tests/L0/run_optimizers (U): run the fused
+optimizer and a reference implementation (torch.optim on CPU — the same
+oracle apex compares against) over random params/grads for several steps
+and compare trajectories with per-dtype tolerances.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu import optimizers as opt
+from apex_tpu.contrib import clip_grad_norm_
+
+
+def make_tree(key, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "w": jax.random.normal(k1, (7, 13), dtype),
+        "b": jax.random.normal(k2, (13,), dtype),
+        "emb": jax.random.normal(k3, (3, 5), dtype),
+    }
+
+
+def tree_to_torch(tree):
+    return [torch.tensor(np.asarray(v, np.float32), requires_grad=True)
+            for v in jax.tree.leaves(tree)]
+
+
+def assert_trees_close(jtree, torch_params, rtol=1e-5, atol=1e-5):
+    for jv, tv in zip(jax.tree.leaves(jtree), torch_params):
+        np.testing.assert_allclose(
+            np.asarray(jv, np.float32), tv.detach().numpy(), rtol=rtol, atol=atol)
+
+
+def run_both(tx, torch_opt_fn, n_steps=5, seed=0):
+    key = jax.random.PRNGKey(seed)
+    params = make_tree(key)
+    tparams = tree_to_torch(params)
+    topt = torch_opt_fn(tparams)
+    state = tx.init(params)
+    step = jax.jit(lambda g, s, p: tx.step(g, s, p))
+    for i in range(n_steps):
+        gkey = jax.random.fold_in(key, i)
+        grads = jax.tree.map(
+            lambda p, k=gkey: jax.random.normal(k, p.shape, p.dtype), params)
+        params, state = step(grads, state, params)
+        for tp, gv in zip(tparams, jax.tree.leaves(grads)):
+            tp.grad = torch.tensor(np.asarray(gv, np.float32))
+        topt.step()
+    return params, tparams
+
+
+class TestFusedAdam:
+    def test_matches_torch_adamw(self):
+        tx = opt.fused_adam(1e-2, b1=0.9, b2=0.999, eps=1e-8,
+                            weight_decay=0.1, adam_w_mode=True)
+        params, tparams = run_both(
+            tx, lambda ps: torch.optim.AdamW(ps, lr=1e-2, betas=(0.9, 0.999),
+                                             eps=1e-8, weight_decay=0.1))
+        assert_trees_close(params, tparams, rtol=1e-4, atol=1e-5)
+
+    def test_matches_torch_adam_l2_mode(self):
+        tx = opt.fused_adam(3e-3, weight_decay=0.05, adam_w_mode=False)
+        params, tparams = run_both(
+            tx, lambda ps: torch.optim.Adam(ps, lr=3e-3, weight_decay=0.05))
+        assert_trees_close(params, tparams, rtol=1e-4, atol=1e-5)
+
+    def test_update_plus_apply_equals_step(self):
+        key = jax.random.PRNGKey(1)
+        params = make_tree(key)
+        grads = jax.tree.map(lambda p: p * 0.1, params)
+        tx = opt.fused_adam(1e-2, weight_decay=0.01)
+        state = tx.init(params)
+        upd, s1 = tx.update(grads, state, params)
+        applied = jax.tree.map(lambda p, u: p + u, params, upd)
+        stepped, s2 = tx.step(grads, state, params)
+        assert_trees_close(applied, tree_to_torch(stepped), rtol=1e-6, atol=1e-6)
+        for a, b in zip(jax.tree.leaves(s1.m), jax.tree.leaves(s2.m)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+    def test_grad_scale_folds_unscale(self):
+        """step(grads*S, grad_scale=1/S) == step(grads) — the amp pipeline."""
+        key = jax.random.PRNGKey(2)
+        params = make_tree(key)
+        grads = jax.tree.map(lambda p: p * 0.3, params)
+        tx = opt.fused_adam(1e-2)
+        state = tx.init(params)
+        a, _ = tx.step(grads, state, params)
+        scaled = jax.tree.map(lambda g: g * 1024.0, grads)
+        b, _ = tx.step(scaled, state, params, grad_scale=1.0 / 1024.0)
+        assert_trees_close(a, tree_to_torch(b), rtol=1e-6, atol=1e-6)
+
+    def test_lr_schedule_traced(self):
+        sched = lambda count: 1e-2 / count.astype(jnp.float32)
+        tx = opt.fused_adam(sched)
+        params = make_tree(jax.random.PRNGKey(3))
+        grads = jax.tree.map(jnp.ones_like, params)
+        state = tx.init(params)
+        step = jax.jit(lambda g, s, p: tx.step(g, s, p))
+        p1, state = step(grads, state, params)
+        p2, state = step(grads, state, p1)
+        # lr halves on the second step; moves must differ
+        d1 = np.abs(np.asarray(p1["b"]) - np.asarray(params["b"])).mean()
+        d2 = np.abs(np.asarray(p2["b"]) - np.asarray(p1["b"])).mean()
+        assert d2 < d1
+
+    def test_mixed_dtype_params(self):
+        key = jax.random.PRNGKey(4)
+        params = {
+            "f32": jax.random.normal(key, (9, 4)),
+            "bf16": jax.random.normal(key, (5, 5), jnp.bfloat16),
+        }
+        tx = opt.fused_adam(1e-2)
+        state = tx.init(params)
+        grads = jax.tree.map(lambda p: jnp.ones_like(p) * 0.5, params)
+        new_p, _ = jax.jit(lambda g, s, p: tx.step(g, s, p))(grads, state, params)
+        assert new_p["bf16"].dtype == jnp.bfloat16
+        assert new_p["f32"].dtype == jnp.float32
+        assert not np.allclose(np.asarray(new_p["f32"]), np.asarray(params["f32"]))
+
+
+class TestFusedSGD:
+    @pytest.mark.parametrize("momentum,nesterov,wd", [
+        (0.0, False, 0.0), (0.9, False, 1e-4), (0.9, True, 0.0)])
+    def test_matches_torch_sgd(self, momentum, nesterov, wd):
+        tx = opt.fused_sgd(1e-2, momentum=momentum, nesterov=nesterov,
+                           weight_decay=wd)
+        params, tparams = run_both(
+            tx, lambda ps: torch.optim.SGD(ps, lr=1e-2, momentum=momentum,
+                                           nesterov=nesterov, weight_decay=wd))
+        assert_trees_close(params, tparams, rtol=1e-5, atol=1e-6)
+
+    def test_dampening_first_step_matches_torch(self):
+        tx = opt.fused_sgd(1e-1, momentum=0.9, dampening=0.3)
+        params, tparams = run_both(
+            tx, lambda ps: torch.optim.SGD(ps, lr=1e-1, momentum=0.9,
+                                           dampening=0.3), n_steps=3)
+        assert_trees_close(params, tparams, rtol=1e-5, atol=1e-6)
+
+
+class TestFusedAdagrad:
+    def test_matches_torch_adagrad(self):
+        tx = opt.fused_adagrad(5e-2, eps=1e-10, weight_decay=0.01)
+        params, tparams = run_both(
+            tx, lambda ps: torch.optim.Adagrad(ps, lr=5e-2, eps=1e-10,
+                                               weight_decay=0.01))
+        assert_trees_close(params, tparams, rtol=1e-5, atol=1e-6)
+
+
+def ref_lamb_step(params, grads, m, v, count, *, lr, b1, b2, eps, wd,
+                  max_grad_norm):
+    """Hand-written NVLAMB reference (apex FusedLAMB semantics)."""
+    leaves = jax.tree.leaves(params)
+    gleaves = jax.tree.leaves(grads)
+    gnorm = float(np.sqrt(sum(float((np.asarray(g, np.float64) ** 2).sum())
+                              for g in gleaves)))
+    clip = min(1.0, max_grad_norm / (gnorm + 1e-6))
+    new_p, new_m, new_v = [], [], []
+    bc1 = 1 - b1 ** count
+    bc2 = 1 - b2 ** count
+    for p, g, mi, vi in zip(leaves, gleaves, m, v):
+        p = np.asarray(p, np.float64)
+        g = np.asarray(g, np.float64) * clip
+        mi = b1 * mi + (1 - b1) * g
+        vi = b2 * vi + (1 - b2) * g * g
+        u = (mi / bc1) / (np.sqrt(vi / bc2) + eps) + wd * p
+        pn = np.linalg.norm(p)
+        un = np.linalg.norm(u)
+        ratio = pn / un if (pn > 0 and un > 0) else 1.0
+        new_p.append(p - lr * ratio * u)
+        new_m.append(mi)
+        new_v.append(vi)
+    return new_p, new_m, new_v
+
+
+class TestFusedLAMB:
+    def test_matches_reference(self):
+        key = jax.random.PRNGKey(5)
+        params = make_tree(key)
+        tx = opt.fused_lamb(1e-2, weight_decay=0.01, max_grad_norm=1.0)
+        state = tx.init(params)
+        leaves = jax.tree.leaves(params)
+        m = [np.zeros(np.asarray(l).shape) for l in leaves]
+        v = [np.zeros(np.asarray(l).shape) for l in leaves]
+        ref_p = [np.asarray(l, np.float64) for l in leaves]
+        step = jax.jit(lambda g, s, p: tx.step(g, s, p))
+        for i in range(3):
+            gkey = jax.random.fold_in(key, 100 + i)
+            grads = jax.tree.map(
+                lambda p, k=gkey: jax.random.normal(k, p.shape, p.dtype), params)
+            params, state = step(grads, state, params)
+            ref_tree = jax.tree.unflatten(jax.tree.structure(grads), ref_p)
+            ref_p, m, v = ref_lamb_step(
+                ref_tree, grads, m, v, i + 1,
+                lr=1e-2, b1=0.9, b2=0.999, eps=1e-6, wd=0.01, max_grad_norm=1.0)
+        for got, want in zip(jax.tree.leaves(params), ref_p):
+            np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-5)
+
+
+class TestFusedNovoGrad:
+    def test_runs_and_descends(self):
+        key = jax.random.PRNGKey(6)
+        x = jax.random.normal(key, (32, 4))
+        w_true = jnp.array([[1.0], [2.0], [-1.0], [0.5]])
+        y = x @ w_true
+        params = {"w": jnp.zeros((4, 1))}
+        tx = opt.fused_novograd(1e-1, weight_decay=0.0)
+        state = tx.init(params)
+
+        def loss_fn(p):
+            return jnp.mean((x @ p["w"] - y) ** 2)
+
+        @jax.jit
+        def step(p, s):
+            l, g = jax.value_and_grad(loss_fn)(p)
+            p, s = tx.step(g, s, p)
+            return l, p, s
+
+        losses = []
+        for _ in range(150):
+            l, params, state = step(params, state)
+            losses.append(float(l))
+        assert losses[-1] < losses[0] * 0.1
+
+    def test_per_tensor_second_moment_shape(self):
+        params = make_tree(jax.random.PRNGKey(7))
+        tx = opt.fused_novograd(1e-2)
+        state = tx.init(params)
+        assert state.v.shape == (3,)
+
+
+class TestLARC:
+    def test_clip_mode_never_amplifies(self):
+        params = {"w": jnp.ones((4, 4)) * 2.0}
+        grads = {"w": jnp.ones((4, 4)) * 1e-6}
+        out = opt.larc_transform(grads, params, learning_rate=0.1,
+                                 trust_coefficient=0.02, clip=True)
+        # tiny grads → adaptive rate clips at 1 → grads unchanged
+        np.testing.assert_allclose(np.asarray(out["w"]), np.asarray(grads["w"]),
+                                   rtol=1e-6)
+
+    def test_scales_large_grads_down(self):
+        params = {"w": jnp.ones((4, 4)) * 0.1}
+        grads = {"w": jnp.ones((4, 4)) * 100.0}
+        out = opt.larc_transform(grads, params, learning_rate=0.1,
+                                 trust_coefficient=0.02, clip=True)
+        assert np.abs(np.asarray(out["w"])).max() < 100.0
+
+    def test_zero_param_passthrough(self):
+        params = {"w": jnp.zeros((4,))}
+        grads = {"w": jnp.ones((4,))}
+        out = opt.larc_transform(grads, params, learning_rate=0.1)
+        np.testing.assert_allclose(np.asarray(out["w"]), np.asarray(grads["w"]))
+
+
+class TestClipGrad:
+    def test_clips_to_max_norm(self):
+        grads = {"a": jnp.full((8,), 3.0), "b": jnp.full((4, 4), -2.0)}
+        clipped, total = clip_grad_norm_(grads, 1.0)
+        want_total = float(np.sqrt(8 * 9 + 16 * 4))
+        np.testing.assert_allclose(float(total), want_total, rtol=1e-5)
+        new_norm = float(np.sqrt(sum(
+            (np.asarray(v, np.float64) ** 2).sum()
+            for v in jax.tree.leaves(clipped))))
+        np.testing.assert_allclose(new_norm, 1.0, rtol=1e-4)
+
+    def test_small_grads_untouched(self):
+        grads = {"a": jnp.full((8,), 1e-3)}
+        clipped, _ = clip_grad_norm_(grads, 1.0)
+        np.testing.assert_allclose(np.asarray(clipped["a"]),
+                                   np.asarray(grads["a"]), rtol=1e-6)
+
+
+class TestFlatOps:
+    def test_scale_detects_overflow(self):
+        from apex_tpu import multi_tensor as mt
+        from apex_tpu.kernels.flat_ops import scale_flat
+        good, _ = mt.pack({"a": jnp.ones((300,))})
+        _, flag = scale_flat(good, 2.0)
+        assert not bool(flag)
+        bad, _ = mt.pack({"a": jnp.array([1.0, np.inf] * 150)})
+        outs, flag = scale_flat(bad, 0.5)
+        assert bool(flag)
+
+    def test_axpby(self):
+        from apex_tpu import multi_tensor as mt
+        from apex_tpu.kernels.flat_ops import axpby_flat
+        xb, layout = mt.pack({"a": jnp.full((200,), 2.0)})
+        yb, _ = mt.pack({"a": jnp.full((200,), 3.0)})
+        outs, flag = axpby_flat(2.0, xb, -1.0, yb)
+        tree = mt.unpack(outs, layout)
+        np.testing.assert_allclose(np.asarray(tree["a"]), np.ones(200))
+        assert not bool(flag)
+
+    def test_l2norm(self):
+        from apex_tpu import multi_tensor as mt
+        from apex_tpu.kernels.flat_ops import l2norm_flat
+        bufs, _ = mt.pack({"a": jnp.full((100,), 2.0), "b": jnp.ones((44,))})
+        got = float(l2norm_flat(bufs))
+        np.testing.assert_allclose(got, np.sqrt(400 + 44), rtol=1e-6)
