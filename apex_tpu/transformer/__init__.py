@@ -1,0 +1,38 @@
+"""apex_tpu.transformer — tensor/sequence/pipeline parallelism over a mesh.
+
+TPU-native re-design of ``apex.transformer`` (apex/transformer/* (U), the
+Megatron-core vendored into apex). NCCL process groups become named mesh
+axes; the collective autograd Functions become ``jax.custom_vjp`` wrappers
+over XLA collectives; RNG state tracking becomes functional PRNG-key
+folding; pipeline schedules become compiled ``shard_map`` programs.
+"""
+
+from apex_tpu.transformer import parallel_state  # noqa: F401
+from apex_tpu.transformer import tensor_parallel  # noqa: F401
+from apex_tpu.transformer.enums import AttnMaskType, LayerType, ModelType  # noqa: F401
+from apex_tpu.transformer.microbatches import (  # noqa: F401
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+    build_num_microbatches_calculator,
+)
+
+__all__ = [
+    "parallel_state",
+    "tensor_parallel",
+    "pipeline_parallel",
+    "functional",
+    "AttnMaskType",
+    "LayerType",
+    "ModelType",
+    "ConstantNumMicroBatches",
+    "RampupBatchsizeNumMicroBatches",
+    "build_num_microbatches_calculator",
+]
+
+
+def __getattr__(name):
+    if name in ("pipeline_parallel", "functional", "layers", "testing"):
+        import importlib
+
+        return importlib.import_module(f"apex_tpu.transformer.{name}")
+    raise AttributeError(f"module 'apex_tpu.transformer' has no attribute {name!r}")

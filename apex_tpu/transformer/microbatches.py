@@ -1,0 +1,118 @@
+"""Microbatch bookkeeping — apex/transformer/microbatches.py (U).
+
+Host-side (never traced): maps global batch size to number of microbatches
+given micro-batch size and data-parallel size, with optional linear
+batch-size ramp-up over consumed samples (``RampupBatchsizeNumMicroBatches``
+(U), the Megatron LM ramp-up recipe).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Optional, Sequence
+
+
+class NumMicroBatchesCalculator(ABC):
+    num_micro_batches: int
+    current_global_batch_size: int
+
+    def get(self) -> int:
+        return self.num_micro_batches
+
+    def get_current_global_batch_size(self) -> int:
+        return self.current_global_batch_size
+
+    @abstractmethod
+    def update(self, consumed_samples: int, consistency_check: bool) -> None: ...
+
+
+class ConstantNumMicroBatches(NumMicroBatchesCalculator):
+    def __init__(self, global_batch_size: int, micro_batch_size: int, data_parallel_size: int):
+        per_step = micro_batch_size * data_parallel_size
+        if global_batch_size % per_step != 0:
+            raise ValueError(
+                f"global batch size {global_batch_size} not divisible by "
+                f"micro batch size {micro_batch_size} * dp {data_parallel_size}"
+            )
+        self.num_micro_batches = global_batch_size // per_step
+        self.current_global_batch_size = global_batch_size
+        self.micro_batch_size = micro_batch_size
+
+    def update(self, consumed_samples: int, consistency_check: bool) -> None:
+        pass
+
+
+class RampupBatchsizeNumMicroBatches(NumMicroBatchesCalculator):
+    """Linear global-batch ramp from ``start_batch_size`` to
+    ``global_batch_size`` over ``ramup_samples`` consumed samples."""
+
+    def __init__(
+        self,
+        start_batch_size: int,
+        batch_size_increment: int,
+        ramup_samples: int,
+        global_batch_size: int,
+        micro_batch_size: int,
+        data_parallel_size: int,
+    ):
+        if batch_size_increment <= 0 or ramup_samples < 0:
+            raise ValueError("batch_size_increment must be > 0, ramup_samples >= 0")
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_size = data_parallel_size
+        self.start_batch_size = start_batch_size
+        self.batch_size_increment = batch_size_increment
+        self.ramup_samples = ramup_samples
+        self.global_batch_size = global_batch_size
+        self.micro_batch_times_data_parallel = micro_batch_size * data_parallel_size
+
+        diff = global_batch_size - start_batch_size
+        if diff < 0 or diff % batch_size_increment != 0:
+            raise ValueError(
+                f"global batch {global_batch_size} - start {start_batch_size} "
+                f"must be a non-negative multiple of increment {batch_size_increment}"
+            )
+        num_increments = diff // batch_size_increment
+        self.rampup_samples_per_increment = (
+            ramup_samples / num_increments if num_increments > 0 else 0
+        )
+        self.update(0, False)
+
+    def update(self, consumed_samples: int, consistency_check: bool = False) -> None:
+        if consumed_samples > self.ramup_samples or self.rampup_samples_per_increment == 0:
+            gbs = self.global_batch_size
+        else:
+            steps = int(consumed_samples / self.rampup_samples_per_increment)
+            gbs = self.start_batch_size + steps * self.batch_size_increment
+            gbs = min(gbs, self.global_batch_size)
+        if consistency_check and gbs % self.micro_batch_times_data_parallel != 0:
+            raise ValueError(
+                f"current global batch {gbs} not divisible by micro*dp "
+                f"{self.micro_batch_times_data_parallel}"
+            )
+        # round down to a whole number of microbatch sweeps
+        self.current_global_batch_size = max(
+            (gbs // self.micro_batch_times_data_parallel)
+            * self.micro_batch_times_data_parallel,
+            self.micro_batch_times_data_parallel,
+        )
+        self.num_micro_batches = (
+            self.current_global_batch_size // self.micro_batch_times_data_parallel
+        )
+
+
+def build_num_microbatches_calculator(
+    rampup_batch_size: Optional[Sequence[int]],
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int,
+) -> NumMicroBatchesCalculator:
+    """apex's ``setup_microbatch_calculator`` factory (minus the global
+    singleton — callers own the instance)."""
+    if rampup_batch_size is None:
+        return ConstantNumMicroBatches(
+            global_batch_size, micro_batch_size, data_parallel_size
+        )
+    start, increment, samples = rampup_batch_size
+    return RampupBatchsizeNumMicroBatches(
+        start, increment, samples, global_batch_size, micro_batch_size, data_parallel_size
+    )

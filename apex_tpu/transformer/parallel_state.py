@@ -1,0 +1,172 @@
+"""Model-parallel topology state over a device mesh.
+
+TPU-native analogue of ``apex.transformer.parallel_state`` (U). Apex builds
+~10 NCCL process groups (data / tensor / pipeline / embedding, plus virtual
+PP bookkeeping) and every component queries module-level globals. Here the
+entire topology is one ``jax.sharding.Mesh`` with named ``{pp, dp, tp}``
+axes (built by :mod:`apex_tpu.mesh.topology`), and "groups" are just axis
+names:
+
+- ``get_tensor_model_parallel_group()`` → the ``"tp"`` axis name
+- ``get_*_world_size()`` → static mesh-axis size
+- ``get_*_rank()`` → ``lax.axis_index(axis)`` (valid inside ``shard_map``)
+
+A module-level current state mirrors apex's global-initialisation API shape
+(``initialize_model_parallel`` / ``destroy_model_parallel``) so reference
+call sites map 1:1, but everything is also available functionally via the
+returned :class:`ParallelState`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+from jax import lax
+from jax.sharding import Mesh
+
+from apex_tpu.mesh.topology import AXIS_DP, AXIS_PP, AXIS_TP, build_mesh, mesh_shape_of
+
+_STATE: Optional["ParallelState"] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelState:
+    """Immutable topology descriptor: the mesh plus virtual-PP bookkeeping."""
+
+    mesh: Mesh
+    virtual_pipeline_model_parallel_size: Optional[int] = None
+
+    # -- static sizes ------------------------------------------------------
+    @property
+    def tensor_model_parallel_size(self) -> int:
+        return mesh_shape_of(self.mesh).get(AXIS_TP, 1)
+
+    @property
+    def pipeline_model_parallel_size(self) -> int:
+        return mesh_shape_of(self.mesh).get(AXIS_PP, 1)
+
+    @property
+    def data_parallel_size(self) -> int:
+        return mesh_shape_of(self.mesh).get(AXIS_DP, 1)
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.devices.size
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> ParallelState:
+    """Build the mesh and install it as the current topology.
+
+    Mirrors ``parallel_state.initialize_model_parallel(tp, pp, vpp)`` (U).
+    The apex rank-enumeration loops building per-dimension NCCL groups are
+    replaced by one topology-aware mesh construction.
+    """
+    global _STATE
+    if virtual_pipeline_model_parallel_size is not None:
+        if pipeline_model_parallel_size < 2:
+            raise ValueError(
+                "virtual pipeline parallelism requires pipeline_model_parallel_size >= 2"
+            )
+    mesh = build_mesh(
+        tp=tensor_model_parallel_size,
+        pp=pipeline_model_parallel_size,
+        devices=devices,
+    )
+    _STATE = ParallelState(mesh, virtual_pipeline_model_parallel_size)
+    return _STATE
+
+
+def set_state(state: ParallelState) -> None:
+    global _STATE
+    _STATE = state
+
+
+def model_parallel_is_initialized() -> bool:
+    return _STATE is not None
+
+
+def destroy_model_parallel() -> None:
+    global _STATE
+    _STATE = None
+
+
+def get_state() -> ParallelState:
+    if _STATE is None:
+        raise RuntimeError(
+            "model parallel topology is not initialized; call "
+            "initialize_model_parallel() first"
+        )
+    return _STATE
+
+
+def get_mesh() -> Mesh:
+    return get_state().mesh
+
+
+# -- group handles (axis names) -------------------------------------------
+def get_tensor_model_parallel_group() -> str:
+    return AXIS_TP
+
+
+def get_pipeline_model_parallel_group() -> str:
+    return AXIS_PP
+
+
+def get_data_parallel_group() -> str:
+    return AXIS_DP
+
+
+# -- world sizes (static) --------------------------------------------------
+def get_tensor_model_parallel_world_size() -> int:
+    return get_state().tensor_model_parallel_size
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return get_state().pipeline_model_parallel_size
+
+
+def get_data_parallel_world_size() -> int:
+    return get_state().data_parallel_size
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return get_state().virtual_pipeline_model_parallel_size
+
+
+# -- ranks (traced; valid inside shard_map over the mesh) ------------------
+def get_tensor_model_parallel_rank():
+    return lax.axis_index(AXIS_TP)
+
+
+def get_pipeline_model_parallel_rank():
+    return lax.axis_index(AXIS_PP)
+
+
+def get_data_parallel_rank():
+    return lax.axis_index(AXIS_DP)
+
+
+def is_pipeline_first_stage(rank=None):
+    """True on pipeline stage 0. ``rank`` may be passed for host-side math;
+    inside ``shard_map`` it is read from the mesh."""
+    r = get_pipeline_model_parallel_rank() if rank is None else rank
+    return r == 0
+
+
+def is_pipeline_last_stage(rank=None):
+    r = get_pipeline_model_parallel_rank() if rank is None else rank
+    return r == get_pipeline_model_parallel_world_size() - 1
+
+
+def get_tensor_model_parallel_src_rank() -> int:
+    """Index 0 along the tp axis — apex's broadcast source for tokenizer
+    output (apex/transformer/tensor_parallel/data.py (U))."""
+    return 0

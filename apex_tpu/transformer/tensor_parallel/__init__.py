@@ -1,0 +1,67 @@
+"""Tensor-parallel building blocks (apex/transformer/tensor_parallel/* (U))."""
+
+from apex_tpu.transformer.tensor_parallel.mappings import (  # noqa: F401
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.random import (  # noqa: F401
+    RNGStatesTracker,
+    checkpoint,
+    get_rng_tracker,
+    model_parallel_rng_key,
+    model_parallel_seed_keys,
+)
+from apex_tpu.transformer.tensor_parallel.utils import (  # noqa: F401
+    VocabUtility,
+    divide,
+    split_tensor_along_last_dim,
+)
+
+__all__ = [
+    "copy_to_tensor_model_parallel_region",
+    "reduce_from_tensor_model_parallel_region",
+    "scatter_to_tensor_model_parallel_region",
+    "gather_from_tensor_model_parallel_region",
+    "scatter_to_sequence_parallel_region",
+    "gather_from_sequence_parallel_region",
+    "reduce_scatter_to_sequence_parallel_region",
+    "RNGStatesTracker",
+    "get_rng_tracker",
+    "model_parallel_rng_key",
+    "model_parallel_seed_keys",
+    "checkpoint",
+    "divide",
+    "split_tensor_along_last_dim",
+    "VocabUtility",
+    # provided by layers / cross_entropy submodules
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "VocabParallelEmbedding",
+    "vocab_parallel_cross_entropy",
+]
+
+
+def __getattr__(name):
+    if name in (
+        "ColumnParallelLinear",
+        "RowParallelLinear",
+        "VocabParallelEmbedding",
+        "column_parallel_linear",
+        "row_parallel_linear",
+        "vocab_parallel_embedding",
+    ):
+        from apex_tpu.transformer.tensor_parallel import layers
+
+        return getattr(layers, name)
+    if name == "vocab_parallel_cross_entropy":
+        from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+            vocab_parallel_cross_entropy,
+        )
+
+        return vocab_parallel_cross_entropy
+    raise AttributeError(name)

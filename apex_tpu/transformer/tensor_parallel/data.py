@@ -1,0 +1,42 @@
+"""Data broadcast across the tensor-parallel group.
+
+Parity with apex/transformer/tensor_parallel/data.py (U): apex's
+``broadcast_data(keys, data, datatype)`` sends tokenizer output from TP
+rank 0 to the other TP ranks (flatten → broadcast sizes → broadcast one
+concatenated buffer → unpack). Under single-controller JAX SPMD, host data
+is already identical on every shard, so the broadcast is only needed when a
+computation deliberately diverges per rank first; we expose the collective
+form for that case and keep the packing contract for parity.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.mesh.topology import AXIS_TP
+
+
+def broadcast_from_src(x, axis: str = AXIS_TP, src: int = 0):
+    """Value of ``x`` on rank ``src`` of ``axis``, on every rank. Inside
+    ``shard_map``. This is the NCCL-broadcast replacement."""
+    size = lax.axis_size(axis)
+    mask = (lax.axis_index(axis) == src).astype(x.dtype)
+    del size
+    return lax.psum(x * mask, axis)
+
+
+def broadcast_data(
+    keys: Sequence[str], data: Dict[str, jnp.ndarray], datatype=jnp.int32, axis: str = AXIS_TP
+) -> Dict[str, jnp.ndarray]:
+    """apex call shape: broadcast ``data[k] for k in keys`` from TP rank 0.
+
+    Values are cast to ``datatype`` (the reference asserts dtype instead;
+    casting is the functional equivalent of its pack-into-one-int64-buffer
+    step). Shapes must match across ranks — guaranteed by SPMD tracing.
+    """
+    return {
+        k: broadcast_from_src(jnp.asarray(data[k], datatype), axis=axis) for k in keys
+    }

@@ -1,0 +1,249 @@
+"""Tensor-parallel layers: Column/Row-parallel linear, vocab-parallel embedding.
+
+TPU-native re-design of apex/transformer/tensor_parallel/layers.py (U).
+Apex's layers are ``nn.Module``s owning pre-sharded ``Parameter``s plus the
+mapping autograd Functions; here each layer is a pure function over *local
+shards*, called inside ``shard_map`` over the ``tp`` mesh axis, plus a thin
+config class that initialises full (global) weights and reports the
+``PartitionSpec`` that shards them. Differences by design:
+
+- ``gradient_accumulation_fusion`` (fp32 main-grad accumulated in-place by
+  ``fused_weight_gradient_mlp_cuda`` (U)) is unnecessary: master-grad dtype
+  is a property of the amp policy + optimizer packing
+  (:mod:`apex_tpu.optimizers`), and XLA fuses the wgrad accumulate.
+- ``async_tensor_model_parallel_allreduce`` overlap is XLA's latency-hiding
+  scheduler's job, not manual stream management.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.mesh.topology import AXIS_TP
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.utils import VocabUtility, divide
+
+
+def init_method_normal(sigma: float) -> Callable:
+    def init(key, shape, dtype=jnp.float32):
+        return sigma * jax.random.normal(key, shape, dtype)
+
+    return init
+
+
+def scaled_init_method_normal(sigma: float, num_layers: int) -> Callable:
+    """Megatron's output-layer init: sigma / sqrt(2 * num_layers)."""
+    return init_method_normal(sigma / (2.0 * num_layers) ** 0.5)
+
+
+# -- functional cores (local-shard semantics, inside shard_map) ------------
+def column_parallel_linear(
+    x,
+    kernel,
+    bias=None,
+    *,
+    axis: str = AXIS_TP,
+    gather_output: bool = False,
+    sequence_parallel: bool = False,
+):
+    """Y = X·A with A column-sharded: ``kernel`` is the local ``[in,
+    out/tp]`` shard (``ColumnParallelLinear.forward`` (U)).
+
+    ``sequence_parallel`` expects ``x`` sharded on dim 0 (seq) and
+    all-gathers it forward / reduce-scatters its grad backward; otherwise
+    ``x`` is replicated and the backward all-reduce comes from the copy
+    mapping.
+    """
+    if sequence_parallel:
+        x = gather_from_sequence_parallel_region(x, axis, True)
+    else:
+        x = copy_to_tensor_model_parallel_region(x, axis)
+    y = jnp.matmul(x, kernel)
+    if bias is not None:
+        y = y + bias
+    if gather_output:
+        if sequence_parallel:
+            raise ValueError("gather_output is incompatible with sequence_parallel")
+        y = gather_from_tensor_model_parallel_region(y, axis)
+    return y
+
+
+def row_parallel_linear(
+    x,
+    kernel,
+    bias=None,
+    *,
+    axis: str = AXIS_TP,
+    input_is_parallel: bool = True,
+    sequence_parallel: bool = False,
+):
+    """Y = X·A with A row-sharded: ``kernel`` is the local ``[in/tp, out]``
+    shard; partial products are summed across the axis
+    (``RowParallelLinear.forward`` (U)).
+
+    With ``sequence_parallel`` the reduction is a reduce-scatter leaving the
+    output sharded on the seq dim. ``bias`` (replicated) is added after the
+    reduction, matching the reference.
+    """
+    if not input_is_parallel:
+        if sequence_parallel:
+            raise ValueError(
+                "sequence_parallel requires input_is_parallel (U: same assert)"
+            )
+        x = scatter_to_tensor_model_parallel_region(x, axis)
+    y = jnp.matmul(x, kernel)
+    if sequence_parallel:
+        y = reduce_scatter_to_sequence_parallel_region(y, axis)
+    else:
+        y = reduce_from_tensor_model_parallel_region(y, axis)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def vocab_parallel_embedding(ids, table, *, axis: str = AXIS_TP):
+    """Vocab-sharded embedding lookup: ``table`` is the local
+    ``[vocab/tp, hidden]`` shard; out-of-range ids contribute zero and the
+    partial lookups are all-reduced (``VocabParallelEmbedding.forward``
+    (U): masked lookup + allreduce)."""
+    per_partition = table.shape[0]
+    start, end = VocabUtility.vocab_range_from_per_partition_vocab_size(
+        per_partition, lax.axis_index(axis), lax.axis_size(axis)
+    )
+    mask = (ids >= start) & (ids < end)
+    local_ids = jnp.where(mask, ids - start, 0)
+    out = jnp.take(table, local_ids, axis=0)
+    out = out * mask[..., None].astype(out.dtype)
+    return reduce_from_tensor_model_parallel_region(out, axis)
+
+
+# -- config classes (init full weights + report shardings) -----------------
+@dataclasses.dataclass(frozen=True)
+class ColumnParallelLinear:
+    """Config/init wrapper; ``apply`` runs inside shard_map on local shards.
+
+    ``init`` returns *global* params; shard them into shard_map with
+    ``kernel_spec``/``bias_spec``.
+    """
+
+    in_features: int
+    out_features: int
+    bias: bool = True
+    gather_output: bool = False
+    sequence_parallel: bool = False
+    axis: str = AXIS_TP
+    param_dtype: jnp.dtype = jnp.float32
+    init_method: Optional[Callable] = None
+
+    def init(self, key):
+        init = self.init_method or init_method_normal(0.02)
+        kernel = init(key, (self.in_features, self.out_features), self.param_dtype)
+        if not self.bias:
+            return {"kernel": kernel}
+        return {
+            "kernel": kernel,
+            "bias": jnp.zeros((self.out_features,), self.param_dtype),
+        }
+
+    @property
+    def specs(self):
+        s = {"kernel": P(None, self.axis)}
+        if self.bias:
+            s["bias"] = P(self.axis)
+        return s
+
+    def apply(self, params, x):
+        return column_parallel_linear(
+            x,
+            params["kernel"],
+            params.get("bias"),
+            axis=self.axis,
+            gather_output=self.gather_output,
+            sequence_parallel=self.sequence_parallel,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class RowParallelLinear:
+    in_features: int
+    out_features: int
+    bias: bool = True
+    input_is_parallel: bool = True
+    sequence_parallel: bool = False
+    axis: str = AXIS_TP
+    param_dtype: jnp.dtype = jnp.float32
+    init_method: Optional[Callable] = None
+
+    def init(self, key):
+        init = self.init_method or init_method_normal(0.02)
+        kernel = init(key, (self.in_features, self.out_features), self.param_dtype)
+        if not self.bias:
+            return {"kernel": kernel}
+        return {
+            "kernel": kernel,
+            "bias": jnp.zeros((self.out_features,), self.param_dtype),
+        }
+
+    @property
+    def specs(self):
+        s = {"kernel": P(self.axis, None)}
+        if self.bias:
+            s["bias"] = P()  # replicated; added after the reduction
+        return s
+
+    def apply(self, params, x):
+        return row_parallel_linear(
+            x,
+            params["kernel"],
+            params.get("bias"),
+            axis=self.axis,
+            input_is_parallel=self.input_is_parallel,
+            sequence_parallel=self.sequence_parallel,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class VocabParallelEmbedding:
+    num_embeddings: int
+    embedding_dim: int
+    axis: str = AXIS_TP
+    param_dtype: jnp.dtype = jnp.float32
+    init_method: Optional[Callable] = None
+
+    def init(self, key):
+        init = self.init_method or init_method_normal(0.02)
+        return {
+            "table": init(
+                key, (self.num_embeddings, self.embedding_dim), self.param_dtype
+            )
+        }
+
+    @property
+    def specs(self):
+        return {"table": P(self.axis, None)}
+
+    def apply(self, params, ids):
+        return vocab_parallel_embedding(ids, params["table"], axis=self.axis)
+
+
+def param_is_tensor_parallel(spec: P) -> bool:
+    """apex's ``param_is_not_tensor_parallel_duplicate`` inverted: a param is
+    TP-sharded iff its PartitionSpec mentions the tp axis."""
+    return any(
+        a == AXIS_TP or (isinstance(a, (tuple, list)) and AXIS_TP in a)
+        for a in spec
+        if a is not None
+    )

@@ -1,0 +1,194 @@
+"""The collective autograd mappings tensor parallelism is built from.
+
+TPU-native re-design of apex/transformer/tensor_parallel/mappings.py (U).
+Apex implements seven ``torch.autograd.Function`` pairs over NCCL; here each
+is a ``jax.custom_vjp`` over an XLA collective, valid inside ``shard_map``
+over the ``tp`` mesh axis. Forward/backward pairs (identical to the
+reference semantics):
+
+====================================  ==================  ==================
+mapping                               forward             backward
+====================================  ==================  ==================
+copy_to_tensor_model_parallel_region  identity            all-reduce
+reduce_from_tensor_model_parallel…    all-reduce          identity
+scatter_to_tensor_model_parallel…     split last dim      all-gather last
+gather_from_tensor_model_parallel…    all-gather last     split last dim
+scatter_to_sequence_parallel_region   split seq dim       all-gather seq
+gather_from_sequence_parallel_region  all-gather seq      reduce-scatter seq
+reduce_scatter_to_sequence_parallel…  reduce-scatter seq  all-gather seq
+====================================  ==================  ==================
+
+The sequence dimension is dim 0 ([s, b, h] layout, as in Megatron).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax
+
+from apex_tpu.mesh.topology import AXIS_TP
+
+_SEQ_DIM = 0
+_LAST_DIM = -1
+
+
+def _local_chunk(x, axis: str, dim: int):
+    """This rank's slice of ``x`` along ``dim`` — apex's ``split_tensor_
+    along_last_dim + rank indexing`` done with a dynamic slice."""
+    size = lax.axis_size(axis)
+    dim = dim % x.ndim
+    if x.shape[dim] % size != 0:
+        raise ValueError(
+            f"dim {dim} of shape {x.shape} not divisible by axis {axis!r} size {size}"
+        )
+    chunk = x.shape[dim] // size
+    start = lax.axis_index(axis) * chunk
+    return lax.dynamic_slice_in_dim(x, start, chunk, axis=dim)
+
+
+def _all_gather(x, axis: str, dim: int):
+    return lax.all_gather(x, axis, axis=dim % x.ndim, tiled=True)
+
+
+def _reduce_scatter(x, axis: str, dim: int):
+    return lax.psum_scatter(x, axis, scatter_dimension=dim % x.ndim, tiled=True)
+
+
+# -- copy: identity fwd / all-reduce bwd -----------------------------------
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tensor_model_parallel_region(x, axis: str = AXIS_TP):
+    """Enter a TP region with a replicated activation: identity forward,
+    all-reduce backward (``_CopyToModelParallelRegion`` (U))."""
+    return x
+
+
+def _copy_fwd(x, axis):
+    return x, None
+
+
+def _copy_bwd(axis, _, g):
+    return (lax.psum(g, axis),)
+
+
+copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+# -- reduce: all-reduce fwd / identity bwd ---------------------------------
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tensor_model_parallel_region(x, axis: str = AXIS_TP):
+    """Leave a TP region: all-reduce forward, identity backward
+    (``_ReduceFromModelParallelRegion`` (U))."""
+    return lax.psum(x, axis)
+
+
+def _reduce_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _reduce_bwd(axis, _, g):
+    return (g,)
+
+
+reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+# -- scatter/gather along the hidden (last) dim ----------------------------
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_tensor_model_parallel_region(x, axis: str = AXIS_TP):
+    """Split the last dim, keep the local chunk; all-gather on backward
+    (``_ScatterToModelParallelRegion`` (U))."""
+    return _local_chunk(x, axis, _LAST_DIM)
+
+
+def _scatter_fwd(x, axis):
+    return _local_chunk(x, axis, _LAST_DIM), None
+
+
+def _scatter_bwd(axis, _, g):
+    return (_all_gather(g, axis, _LAST_DIM),)
+
+
+scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_from_tensor_model_parallel_region(x, axis: str = AXIS_TP):
+    """All-gather chunks along the last dim; local split on backward
+    (``_GatherFromModelParallelRegion`` (U))."""
+    return _all_gather(x, axis, _LAST_DIM)
+
+
+def _gather_fwd(x, axis):
+    return _all_gather(x, axis, _LAST_DIM), None
+
+
+def _gather_bwd(axis, _, g):
+    return (_local_chunk(g, axis, _LAST_DIM),)
+
+
+gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+
+
+# -- sequence-parallel mappings along the seq (first) dim ------------------
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_sequence_parallel_region(x, axis: str = AXIS_TP):
+    """Shard the sequence dim across the TP ranks (SP entry;
+    ``_ScatterToSequenceParallelRegion`` (U))."""
+    return _local_chunk(x, axis, _SEQ_DIM)
+
+
+def _seq_scatter_fwd(x, axis):
+    return _local_chunk(x, axis, _SEQ_DIM), None
+
+
+def _seq_scatter_bwd(axis, _, g):
+    return (_all_gather(g, axis, _SEQ_DIM),)
+
+
+scatter_to_sequence_parallel_region.defvjp(_seq_scatter_fwd, _seq_scatter_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_sequence_parallel_region(
+    x, axis: str = AXIS_TP, tensor_parallel_output_grad: bool = True
+):
+    """All-gather the sequence dim before a ColumnParallelLinear.
+
+    Backward is a reduce-scatter when the consumer is tensor-parallel (each
+    rank contributes a partial grad for the full sequence — the SP core
+    trick), else a plain split (``_GatherFromSequenceParallelRegion`` (U)).
+    """
+    return _all_gather(x, axis, _SEQ_DIM)
+
+
+def _seq_gather_fwd(x, axis, tp_grad):
+    return _all_gather(x, axis, _SEQ_DIM), None
+
+
+def _seq_gather_bwd(axis, tp_grad, _, g):
+    if tp_grad:
+        return (_reduce_scatter(g, axis, _SEQ_DIM),)
+    return (_local_chunk(g, axis, _SEQ_DIM),)
+
+
+gather_from_sequence_parallel_region.defvjp(_seq_gather_fwd, _seq_gather_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_scatter_to_sequence_parallel_region(x, axis: str = AXIS_TP):
+    """Reduce partial sums and shard the sequence dim after a
+    RowParallelLinear (``_ReduceScatterToSequenceParallelRegion`` (U))."""
+    return _reduce_scatter(x, axis, _SEQ_DIM)
+
+
+def _seq_rs_fwd(x, axis):
+    return _reduce_scatter(x, axis, _SEQ_DIM), None
+
+
+def _seq_rs_bwd(axis, _, g):
+    return (_all_gather(g, axis, _SEQ_DIM),)
+
+
+reduce_scatter_to_sequence_parallel_region.defvjp(_seq_rs_fwd, _seq_rs_bwd)

@@ -1,0 +1,136 @@
+"""RNG state tracking + activation recompute.
+
+TPU-native re-design of apex/transformer/tensor_parallel/random.py (U).
+Apex needs ~400 lines of CUDA RNG state juggling (``CudaRNGStatesTracker``,
+fork/restore inside ``CheckpointFunction``) because torch RNG is stateful
+and device-global. JAX PRNG is functional, so the same guarantees reduce to
+key folding:
+
+- "model-parallel seed" (different dropout per TP rank) =
+  ``fold_in(key, tp_rank)``;
+- "same seed across TP" (replicated dropout) = use the key unchanged;
+- checkpoint RNG fork/restore = free — ``jax.checkpoint`` replays the same
+  keys on recompute by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+from jax import lax
+
+from apex_tpu.mesh.topology import AXIS_TP
+
+# Matches apex's _MODEL_PARALLEL_RNG_TRACKER_NAME offset convention: the
+# model-parallel stream is derived from the base seed with a fixed offset.
+_MODEL_PARALLEL_FOLD = 2718
+
+
+def model_parallel_rng_key(key, axis: str = AXIS_TP):
+    """Per-TP-rank key — distinct dropout on each tensor-parallel shard
+    (the ``model-parallel-rng`` tracker stream (U)). Inside shard_map."""
+    return jax.random.fold_in(
+        jax.random.fold_in(key, _MODEL_PARALLEL_FOLD), lax.axis_index(axis)
+    )
+
+
+def model_parallel_seed_keys(seed: int, axis: str = AXIS_TP):
+    """(replicated_key, per_rank_key) from an int seed — the functional
+    analogue of ``model_parallel_cuda_manual_seed(seed)`` (U)."""
+    base = jax.random.PRNGKey(seed)
+    return base, model_parallel_rng_key(base, axis)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class RNGStatesTracker:
+    """Named PRNG streams, functional: ``fork`` returns (key, new_tracker).
+
+    API shape mirrors ``CudaRNGStatesTracker`` (U) — ``add``/``fork``/
+    ``get_states``/``set_states`` — but states are just keys and every
+    operation is pure, so it is jit/checkpoint-safe by construction.
+    """
+
+    states: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def add(self, name: str, seed_or_key) -> "RNGStatesTracker":
+        if name in self.states:
+            raise ValueError(f"rng stream {name!r} already exists")
+        key = (
+            jax.random.PRNGKey(seed_or_key)
+            if isinstance(seed_or_key, int)
+            else seed_or_key
+        )
+        return RNGStatesTracker({**self.states, name: key})
+
+    def fork(self, name: str = "model-parallel-rng") -> Tuple[Any, "RNGStatesTracker"]:
+        if name not in self.states:
+            raise ValueError(f"unknown rng stream {name!r}")
+        sub, nxt = jax.random.split(self.states[name])
+        return sub, RNGStatesTracker({**self.states, name: nxt})
+
+    def get_states(self) -> Dict[str, Any]:
+        return dict(self.states)
+
+    def set_states(self, states: Dict[str, Any]) -> "RNGStatesTracker":
+        return RNGStatesTracker(dict(states))
+
+    def tree_flatten(self):
+        names = tuple(sorted(self.states))
+        return tuple(self.states[n] for n in names), names
+
+    @classmethod
+    def tree_unflatten(cls, names, keys):
+        return cls(dict(zip(names, keys)))
+
+
+def get_rng_tracker(seed: int = 0, axis: str = AXIS_TP) -> RNGStatesTracker:
+    """Tracker with apex's two default streams (replicated + model-parallel)."""
+    base, per_rank = model_parallel_seed_keys(seed, axis)
+    return RNGStatesTracker({"default": base, "model-parallel-rng": per_rank})
+
+
+def checkpoint(
+    fn: Optional[Callable] = None,
+    *,
+    policy: Optional[Callable] = None,
+    prevent_cse: bool = True,
+    static_argnums: Tuple[int, ...] = (),
+):
+    """Activation recompute — ``tensor_parallel.checkpoint(fn, *args)`` (U).
+
+    Thin wrapper over ``jax.checkpoint``: recompute in backward instead of
+    storing activations. The reference's RNG fork/restore bookkeeping is
+    unnecessary — recomputation replays identical PRNG keys. ``policy``
+    takes ``jax.checkpoint_policies.*`` (e.g. ``dots_saveable``) for
+    selective-save, which the reference cannot express at all.
+
+    Usable as decorator or apex-style direct call::
+
+        y = checkpoint(block_fn, policy=...) (x)   # decorator form
+        y = checkpoint(block_fn, x)                # apex call form
+    """
+    if fn is not None and not callable(fn):
+        raise TypeError("checkpoint: first argument must be callable")
+
+    def wrap(f):
+        return jax.checkpoint(
+            f, policy=policy, prevent_cse=prevent_cse, static_argnums=static_argnums
+        )
+
+    if fn is None:
+        return wrap
+    return wrap(fn)
+
+
+def checkpoint_call(fn: Callable, *args, policy: Optional[Callable] = None):
+    """Exact apex call shape: ``checkpoint(run_function, *args)`` (U)."""
+    return checkpoint(fn, policy=policy)(*args)
+
+
+# Common selective-recompute policies re-exported for discoverability.
+save_dots = jax.checkpoint_policies.dots_saveable
+save_nothing = jax.checkpoint_policies.nothing_saveable
+save_everything = jax.checkpoint_policies.everything_saveable

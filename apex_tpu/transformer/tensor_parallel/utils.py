@@ -1,0 +1,45 @@
+"""Shard math helpers — apex/transformer/tensor_parallel/utils.py (U)."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    if numerator % denominator != 0:
+        raise ValueError(f"{numerator} is not divisible by {denominator}")
+
+
+def divide(numerator: int, denominator: int) -> int:
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(x, num_partitions: int) -> Sequence[jnp.ndarray]:
+    """Static split along the last dim (apex returns contiguous chunks;
+    jnp.split views are already fine under XLA)."""
+    divide(x.shape[-1], num_partitions)
+    return jnp.split(x, num_partitions, axis=-1)
+
+
+class VocabUtility:
+    """Vocab shard range math for VocabParallelEmbedding / cross entropy
+    (identical contract to the reference class)."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(
+        per_partition_vocab_size: int, rank, world_size: int
+    ) -> Tuple:
+        first = rank * per_partition_vocab_size
+        return first, first + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(
+        global_vocab_size: int, rank, world_size: int
+    ) -> Tuple:
+        per_partition = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per_partition, rank, world_size
+        )

@@ -1,0 +1,305 @@
+"""Tensor-parallel core tests.
+
+Parity model: apex tests/L0/run_transformer/{test_parallel_state,
+test_mapping, test_layers, test_cross_entropy, test_random,
+test_microbatches}.py (U), rebuilt on the CPU-simulated 8-device mesh.
+Oracle: unsharded jax.numpy reference at fp32.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import mesh as mx
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer import microbatches as mb
+from apex_tpu.transformer.tensor_parallel import (
+    cross_entropy as ce,
+    layers as tpl,
+    mappings as mp,
+    random as tpr,
+)
+
+
+@pytest.fixture()
+def tp4(devices8):
+    m = mx.build_mesh(tp=4, devices=devices8[:4])
+    yield m
+
+
+def smap(f, mesh, in_specs, out_specs):
+    return jax.jit(
+        jax.shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=False)
+    )
+
+
+# -- parallel_state --------------------------------------------------------
+def test_parallel_state_sizes(devices8):
+    st = ps.initialize_model_parallel(2, 2, devices=devices8)
+    assert ps.model_parallel_is_initialized()
+    assert ps.get_tensor_model_parallel_world_size() == 2
+    assert ps.get_pipeline_model_parallel_world_size() == 2
+    assert ps.get_data_parallel_world_size() == 2
+    assert st.world_size == 8
+    ps.destroy_model_parallel()
+    assert not ps.model_parallel_is_initialized()
+    with pytest.raises(RuntimeError):
+        ps.get_mesh()
+
+
+def test_parallel_state_vpp_requires_pp(devices8):
+    with pytest.raises(ValueError):
+        ps.initialize_model_parallel(1, 1, 2, devices=devices8)
+
+
+# -- mappings: forward + backward semantics --------------------------------
+def test_copy_and_reduce_mappings(tp4):
+    x = jnp.ones((2, 3))
+
+    def f(x):
+        # copy: identity fwd; grads of a per-rank-weighted sum must be
+        # all-reduced, i.e. sum of rank weights everywhere.
+        r = mp.lax.axis_index("tp").astype(jnp.float32)
+        loss = jnp.sum(mp.copy_to_tensor_model_parallel_region(x) * (r + 1.0))
+        return loss
+
+    def g_of(x):
+        # per-rank loss summed → total = sum_r (r+1) * sum(x); dx = 10
+        return jax.grad(f)(x)
+
+    # concatenate per-rank grads along dim 0: every rank must hold 10s
+    g = smap(g_of, tp4, P(), P("tp", None))(x)
+    g = np.asarray(g).reshape(4, 2, 3)
+    np.testing.assert_allclose(g, 10.0 * np.ones((4, 2, 3)))
+
+    def h(x):
+        r = mp.lax.axis_index("tp").astype(jnp.float32)
+        y = mp.reduce_from_tensor_model_parallel_region(x * (r + 1.0))
+        return y
+
+    y = smap(h, tp4, P(), P("tp", None))(x)
+    y = np.asarray(y).reshape(4, 2, 3)
+    np.testing.assert_allclose(y[0], 10.0 * np.ones((2, 3)))
+    # reduce bwd = identity: each rank's grad is just upstream grad
+    def h2(x):
+        return jnp.sum(mp.reduce_from_tensor_model_parallel_region(x))
+
+    g2 = smap(jax.grad(h2), tp4, P(), P("tp", None))(x)
+    np.testing.assert_allclose(np.asarray(g2).reshape(4, 2, 3)[1], 1.0)
+
+
+def test_scatter_gather_roundtrip_and_grads(tp4):
+    x = jnp.arange(2 * 8, dtype=jnp.float32).reshape(2, 8)
+
+    def f(x):
+        local = mp.scatter_to_tensor_model_parallel_region(x)  # [2, 2]
+        return mp.gather_from_tensor_model_parallel_region(local)
+
+    y = smap(f, tp4, P(), P())(x)
+    np.testing.assert_allclose(y, x)
+
+    # grad of sum through scatter→gather is ones (each element used once)
+    g = smap(jax.grad(lambda x: jnp.sum(f(x))), tp4, P(), P())(x)
+    np.testing.assert_allclose(g, np.ones_like(x))
+
+
+def test_sequence_parallel_mappings(tp4):
+    x = jnp.arange(8 * 3, dtype=jnp.float32).reshape(8, 3)  # [s, h]
+
+    def f(xs):
+        full = mp.gather_from_sequence_parallel_region(xs, "tp", True)
+        return mp.reduce_scatter_to_sequence_parallel_region(full, "tp")
+
+    # input sharded on seq dim; reduce-scatter of 4 identical gathers = 4x
+    y = smap(f, tp4, P("tp", None), P("tp", None))(x)
+    np.testing.assert_allclose(y, 4.0 * x)
+
+    def g(xs):
+        return jnp.sum(mp.scatter_to_sequence_parallel_region(xs, "tp") ** 2)
+
+    # scatter from replicated: grads all-gathered back to full shape
+    grad = smap(jax.grad(g), tp4, P(), P())(x)
+    np.testing.assert_allclose(grad, 2.0 * x)
+
+
+# -- layers vs unsharded reference -----------------------------------------
+def _ref_linear(x, k, b):
+    return x @ k + b
+
+
+def test_column_parallel_matches_dense(tp4):
+    key = jax.random.PRNGKey(0)
+    lyr = tpl.ColumnParallelLinear(6, 8, gather_output=True)
+    params = lyr.init(key)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 6))
+
+    y = smap(
+        lambda p, x: lyr.apply(p, x), tp4, (lyr.specs, P()), P()
+    )(params, x)
+    ref = _ref_linear(x, params["kernel"], params["bias"])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    # gradient parity vs dense reference
+    def loss_sharded(p, x):
+        return jnp.sum(lyr.apply(p, x) ** 2)
+
+    def loss_ref(p, x):
+        return jnp.sum(_ref_linear(x, p["kernel"], p["bias"]) ** 2)
+
+    g = smap(jax.grad(loss_sharded), tp4, (lyr.specs, P()), lyr.specs)(params, x)
+    gref = jax.grad(loss_ref)(params, x)
+    np.testing.assert_allclose(np.asarray(g["kernel"]), np.asarray(gref["kernel"]),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(g["bias"]), np.asarray(gref["bias"]),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_row_parallel_matches_dense(tp4):
+    key = jax.random.PRNGKey(2)
+    lyr = tpl.RowParallelLinear(8, 6, input_is_parallel=False)
+    params = lyr.init(key)
+    x = jax.random.normal(jax.random.PRNGKey(3), (4, 8))
+
+    y = smap(lambda p, x: lyr.apply(p, x), tp4, (lyr.specs, P()), P())(params, x)
+    ref = _ref_linear(x, params["kernel"], params["bias"])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_column_row_sequence_parallel_pair(tp4):
+    """SP sandwich: seq-sharded in → Column(SP) → Row(SP) → seq-sharded out
+    equals the dense computation (apex test_layers.py SP cases (U))."""
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(4), 3)
+    col = tpl.ColumnParallelLinear(6, 12, sequence_parallel=True)
+    row = tpl.RowParallelLinear(12, 6, sequence_parallel=True)
+    pc, pr = col.init(k1), row.init(k2)
+    x = jax.random.normal(k3, (8, 2, 6))  # [s, b, h]
+
+    def f(pc, pr, xs):
+        h = col.apply(pc, xs)
+        h = jax.nn.gelu(h)
+        return row.apply(pr, h)
+
+    y = smap(f, tp4, (col.specs, row.specs, P("tp", None, None)),
+             P("tp", None, None))(pc, pr, x)
+    ref = _ref_linear(jax.nn.gelu(_ref_linear(x, pc["kernel"], pc["bias"])),
+                      pr["kernel"], pr["bias"])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_vocab_parallel_embedding(tp4):
+    emb = tpl.VocabParallelEmbedding(16, 8)
+    params = emb.init(jax.random.PRNGKey(5))
+    ids = jnp.array([[0, 3, 7, 15], [8, 9, 1, 2]])
+
+    y = smap(lambda p, i: emb.apply(p, i), tp4, (emb.specs, P()), P())(params, ids)
+    ref = jnp.take(params["table"], ids, axis=0)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    # grad wrt table matches dense embedding grad
+    def loss(p, i):
+        return jnp.sum(emb.apply(p, i) ** 2)
+
+    g = smap(jax.grad(loss), tp4, (emb.specs, P()), emb.specs)(params, ids)
+    gref = jax.grad(lambda p, i: jnp.sum(jnp.take(p["table"], i, 0) ** 2))(params, ids)
+    np.testing.assert_allclose(np.asarray(g["table"]), np.asarray(gref["table"]),
+                               rtol=1e-4, atol=1e-4)
+
+
+# -- vocab-parallel cross entropy ------------------------------------------
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_vocab_parallel_cross_entropy(tp4, smoothing):
+    s, b, v = 4, 2, 16
+    logits = jax.random.normal(jax.random.PRNGKey(6), (s, b, v)) * 3.0
+    target = jax.random.randint(jax.random.PRNGKey(7), (s, b), 0, v)
+
+    def sharded(logits, target):
+        return ce.vocab_parallel_cross_entropy(logits, target, smoothing)
+
+    loss = smap(sharded, tp4, (P(None, None, "tp"), P()), P())(logits, target)
+
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, target[..., None], axis=-1)[..., 0]
+    ref = (1 - smoothing) * nll - smoothing * jnp.mean(logp, axis=-1)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    # gradient parity
+    def sum_sharded(logits, target):
+        return jnp.sum(ce.vocab_parallel_cross_entropy(logits, target, smoothing))
+
+    g = smap(jax.grad(sum_sharded), tp4, (P(None, None, "tp"), P()),
+             P(None, None, "tp"))(logits, target)
+
+    def sum_ref(logits):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, target[..., None], axis=-1)[..., 0]
+        return jnp.sum((1 - smoothing) * nll - smoothing * jnp.mean(logp, axis=-1))
+
+    gref = jax.grad(sum_ref)(logits)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gref), rtol=1e-4, atol=1e-5)
+
+
+# -- RNG tracker -----------------------------------------------------------
+def test_model_parallel_rng_distinct_per_rank(tp4):
+    key = jax.random.PRNGKey(8)
+
+    def f(_):
+        k = tpr.model_parallel_rng_key(key)
+        return jax.random.uniform(k, (4,))
+
+    outs = smap(f, tp4, P("tp"), P("tp"))(jnp.zeros((4,)))
+    outs = np.asarray(outs).reshape(4, 4)
+    # every rank draws a different stream
+    for i in range(4):
+        for j in range(i + 1, 4):
+            assert not np.allclose(outs[i], outs[j])
+
+
+def test_rng_tracker_fork_is_functional():
+    tr = tpr.RNGStatesTracker().add("a", 0)
+    k1, tr2 = tr.fork("a")
+    k2, _ = tr.fork("a")  # same source state → same key (pure)
+    assert np.array_equal(np.asarray(k1), np.asarray(k2))
+    k3, _ = tr2.fork("a")
+    assert not np.array_equal(np.asarray(k1), np.asarray(k3))
+    with pytest.raises(ValueError):
+        tr.add("a", 1)
+    with pytest.raises(ValueError):
+        tr.fork("missing")
+    leaves, treedef = jax.tree.flatten(tr2)
+    assert jax.tree.unflatten(treedef, leaves).get_states().keys() == {"a"}
+
+
+def test_checkpoint_matches_uncheckpointed():
+    def block(x):
+        return jnp.sum(jnp.tanh(x) ** 2)
+
+    x = jax.random.normal(jax.random.PRNGKey(9), (8,))
+    g1 = jax.grad(block)(x)
+    g2 = jax.grad(tpr.checkpoint(block))(x)
+    g3 = jax.grad(lambda x: tpr.checkpoint_call(block, x))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g3), rtol=1e-6)
+
+
+# -- microbatches ----------------------------------------------------------
+def test_constant_microbatches():
+    c = mb.ConstantNumMicroBatches(64, 4, 2)
+    assert c.get() == 8
+    c.update(10_000, True)
+    assert c.get() == 8
+    with pytest.raises(ValueError):
+        mb.ConstantNumMicroBatches(65, 4, 2)
+
+
+def test_rampup_microbatches():
+    r = mb.build_num_microbatches_calculator((16, 16, 96), 64, 4, 2)
+    assert r.get_current_global_batch_size() == 16
+    assert r.get() == 2
+    r.update(48, False)
+    assert r.get_current_global_batch_size() == 32
+    r.update(1_000, False)
+    assert r.get_current_global_batch_size() == 64
+    assert r.get() == 8
