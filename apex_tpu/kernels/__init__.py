@@ -6,6 +6,11 @@ the CPU test backbone exercises identical semantics.
 """
 
 from apex_tpu.kernels.layer_norm import layer_norm, rms_norm
+from apex_tpu.kernels.softmax import (
+    scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.kernels.xentropy import softmax_cross_entropy
 from apex_tpu.kernels.flat_ops import (
     adagrad_flat,
     adam_flat,
@@ -18,6 +23,9 @@ from apex_tpu.kernels.flat_ops import (
 __all__ = [
     "layer_norm",
     "rms_norm",
+    "scaled_masked_softmax",
+    "scaled_upper_triang_masked_softmax",
+    "softmax_cross_entropy",
     "adagrad_flat",
     "adam_flat",
     "axpby_flat",

@@ -1,0 +1,13 @@
+"""Fused functional wrappers (apex/transformer/functional/* (U))."""
+
+from apex_tpu.transformer.functional.fused_softmax import (  # noqa: F401
+    FusedScaleMaskSoftmax,
+    ScaledMaskedSoftmax,
+    ScaledUpperTriangMaskedSoftmax,
+)
+
+__all__ = [
+    "FusedScaleMaskSoftmax",
+    "ScaledMaskedSoftmax",
+    "ScaledUpperTriangMaskedSoftmax",
+]

@@ -1,0 +1,66 @@
+"""FusedScaleMaskSoftmax — apex/transformer/functional/fused_softmax.py (U).
+
+The reference wraps two CUDA extensions behind an eligibility check (dtype
+fp16/bf16, 16 < sk <= 2048, sq % 4 == 0 …) and falls back to unfused torch
+softmax otherwise. The Pallas kernels have no seq-len templates, so the
+eligibility surface shrinks to "fusion enabled?"; the fallback path is kept
+for parity and for debugging against pure jnp.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.kernels.softmax import (
+    scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.transformer.enums import AttnMaskType
+
+# Direct kernel aliases matching the reference's autograd.Function names.
+ScaledMaskedSoftmax = scaled_masked_softmax
+ScaledUpperTriangMaskedSoftmax = scaled_upper_triang_masked_softmax
+
+
+def _default_mask_func(scores, mask):
+    return jnp.where(mask.astype(bool), -10000.0, scores)
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedScaleMaskSoftmax:
+    """``softmax(scale * mask(x))`` dispatcher.
+
+    Args mirror the reference constructor; ``input_in_fp16/bf16`` become a
+    single ``softmax_in_fp32`` knob (the kernels always reduce in fp32).
+    """
+
+    attn_mask_type: AttnMaskType = AttnMaskType.padding
+    scaled_masked_softmax_fusion: bool = True
+    mask_func: Optional[Callable] = None
+    softmax_in_fp32: bool = True
+    scale: Optional[float] = None
+
+    def __call__(self, scores, mask=None):
+        scale = 1.0 if self.scale is None else self.scale
+        if self.scaled_masked_softmax_fusion:
+            if self.attn_mask_type == AttnMaskType.causal:
+                if mask is not None:
+                    return scaled_masked_softmax(scores, mask, scale=scale)
+                return scaled_upper_triang_masked_softmax(scores, scale=scale)
+            return scaled_masked_softmax(scores, mask, scale=scale)
+        # unfused fallback (reference: forward_torch_softmax)
+        x = scores.astype(jnp.float32) if self.softmax_in_fp32 else scores
+        x = x * scale
+        if self.attn_mask_type == AttnMaskType.causal:
+            sq, sk = x.shape[-2], x.shape[-1]
+            causal = jnp.tril(jnp.ones((sq, sk), bool))
+            x = jnp.where(causal, x, -10000.0)
+        if mask is not None:
+            mask_func = self.mask_func or _default_mask_func
+            x = mask_func(x, mask)
+        probs = jnp.asarray(jnp.exp(x - jnp.max(x, -1, keepdims=True)))
+        probs = probs / jnp.sum(probs, -1, keepdims=True)
+        return probs.astype(scores.dtype)

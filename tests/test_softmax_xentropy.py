@@ -1,0 +1,155 @@
+"""Scaled-masked softmax + fused cross-entropy kernel tests.
+
+Oracle pattern (SURVEY.md §4): Pallas kernel vs unfused jnp reference at
+fp32, per-dtype tolerances — the apex L0 compare-vs-PyTorch model.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.kernels import (
+    scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax,
+    softmax_cross_entropy,
+)
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.functional import FusedScaleMaskSoftmax
+
+TOL = {jnp.float32: dict(rtol=1e-5, atol=1e-6),
+       jnp.bfloat16: dict(rtol=2e-2, atol=2e-3)}
+
+
+def _ref_softmax(x, mask, scale, causal):
+    x = x.astype(jnp.float32) * scale
+    if causal:
+        sq, sk = x.shape[-2:]
+        x = jnp.where(jnp.tril(jnp.ones((sq, sk), bool)), x, -1e30)
+    if mask is not None:
+        x = jnp.where(mask.astype(bool), -1e30, x)
+    return jax.nn.softmax(x, axis=-1)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_scaled_masked_softmax_fwd_bwd(dtype):
+    b, h, sq, sk = 2, 3, 8, 20
+    x = (jax.random.normal(jax.random.PRNGKey(0), (b, h, sq, sk)) * 2).astype(dtype)
+    mask = jax.random.bernoulli(jax.random.PRNGKey(1), 0.3, (b, 1, sq, sk))
+    # keep at least one unmasked key per row
+    mask = mask.at[..., 0].set(False)
+
+    y = scaled_masked_softmax(x, mask, scale=0.7)
+    ref = _ref_softmax(x, mask, 0.7, False)
+    np.testing.assert_allclose(np.asarray(y, np.float32), np.asarray(ref),
+                               **TOL[dtype])
+
+    def loss(x):
+        return jnp.sum(scaled_masked_softmax(x, mask, scale=0.7).astype(jnp.float32) ** 2)
+
+    def loss_ref(x):
+        return jnp.sum(_ref_softmax(x, mask, 0.7, False) ** 2)
+
+    g = jax.grad(loss)(x)
+    gref = jax.grad(loss_ref)(x)
+    np.testing.assert_allclose(np.asarray(g, np.float32),
+                               np.asarray(gref, np.float32),
+                               rtol=5e-2 if dtype == jnp.bfloat16 else 1e-4,
+                               atol=5e-3 if dtype == jnp.bfloat16 else 1e-5)
+
+
+def test_fully_masked_row_yields_zeros():
+    x = jnp.ones((1, 1, 4, 8))
+    mask = jnp.ones((1, 1, 4, 8), bool)
+    y = scaled_masked_softmax(x, mask)
+    assert np.isfinite(np.asarray(y)).all()
+    np.testing.assert_allclose(np.asarray(y), 0.0)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_causal_softmax(dtype):
+    b, h, s = 2, 2, 16
+    x = (jax.random.normal(jax.random.PRNGKey(2), (b, h, s, s)) * 2).astype(dtype)
+    y = scaled_upper_triang_masked_softmax(x, scale=1.3)
+    ref = _ref_softmax(x, None, 1.3, True)
+    np.testing.assert_allclose(np.asarray(y, np.float32), np.asarray(ref),
+                               **TOL[dtype])
+    # strictly-upper-triangular entries are exactly zero
+    upper = np.triu(np.ones((s, s), bool), 1)
+    assert (np.asarray(y, np.float32)[..., upper] == 0).all()
+
+    g = jax.grad(lambda x: jnp.sum(
+        scaled_upper_triang_masked_softmax(x, scale=1.3).astype(jnp.float32) ** 2))(x)
+    gref = jax.grad(lambda x: jnp.sum(_ref_softmax(x, None, 1.3, True) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g, np.float32),
+                               np.asarray(gref, np.float32),
+                               rtol=5e-2 if dtype == jnp.bfloat16 else 1e-4,
+                               atol=5e-3 if dtype == jnp.bfloat16 else 1e-5)
+
+
+def test_causal_requires_square():
+    with pytest.raises(ValueError):
+        scaled_upper_triang_masked_softmax(jnp.ones((1, 1, 4, 8)))
+
+
+def test_fused_scale_mask_softmax_dispatch():
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 2, 8, 8))
+    mask = jax.random.bernoulli(jax.random.PRNGKey(4), 0.2, (2, 1, 8, 8))
+    mask = mask.at[..., 0].set(False)
+
+    fused = FusedScaleMaskSoftmax(attn_mask_type=AttnMaskType.padding, scale=0.5)
+    unfused = FusedScaleMaskSoftmax(attn_mask_type=AttnMaskType.padding,
+                                    scaled_masked_softmax_fusion=False, scale=0.5)
+    np.testing.assert_allclose(np.asarray(fused(x, mask)),
+                               np.asarray(unfused(x, mask)), rtol=1e-4, atol=1e-5)
+
+    fc = FusedScaleMaskSoftmax(attn_mask_type=AttnMaskType.causal)
+    uc = FusedScaleMaskSoftmax(attn_mask_type=AttnMaskType.causal,
+                               scaled_masked_softmax_fusion=False)
+    np.testing.assert_allclose(np.asarray(fc(x)), np.asarray(uc(x)),
+                               rtol=1e-4, atol=1e-5)
+
+
+# -- fused cross entropy ---------------------------------------------------
+def _ref_xent(logits, target, smoothing, ignore_index=-100):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    nll = -jnp.take_along_axis(logp, jnp.clip(target, 0)[..., None], -1)[..., 0]
+    loss = (1 - smoothing) * nll - smoothing * jnp.mean(logp, -1)
+    return jnp.where(target == ignore_index, 0.0, loss)
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_softmax_cross_entropy(dtype, smoothing):
+    b, s, v = 2, 6, 40
+    logits = (jax.random.normal(jax.random.PRNGKey(5), (b, s, v)) * 3).astype(dtype)
+    target = jax.random.randint(jax.random.PRNGKey(6), (b, s), 0, v)
+    target = target.at[0, 0].set(-100)  # ignored token
+
+    loss = softmax_cross_entropy(logits, target, smoothing)
+    ref = _ref_xent(logits, target, smoothing)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref),
+                               rtol=2e-2 if dtype == jnp.bfloat16 else 1e-5,
+                               atol=2e-2 if dtype == jnp.bfloat16 else 1e-5)
+    assert float(loss[0, 0]) == 0.0
+
+    g = jax.grad(lambda l: jnp.sum(softmax_cross_entropy(l, target, smoothing)))(logits)
+    gref = jax.grad(lambda l: jnp.sum(_ref_xent(l, target, smoothing)))(logits)
+    np.testing.assert_allclose(np.asarray(g, np.float32),
+                               np.asarray(gref, np.float32),
+                               rtol=5e-2 if dtype == jnp.bfloat16 else 1e-4,
+                               atol=5e-3 if dtype == jnp.bfloat16 else 1e-5)
+    np.testing.assert_allclose(np.asarray(g, np.float32)[0, 0], 0.0)
+
+
+def test_xentropy_matches_smoothing_formula():
+    # reference smoothed form: lse - (1-eps)x_t - eps*mean(x)
+    v = 16
+    logits = jax.random.normal(jax.random.PRNGKey(7), (5, v))
+    target = jax.random.randint(jax.random.PRNGKey(8), (5,), 0, v)
+    eps = 0.2
+    loss = softmax_cross_entropy(logits, target, eps)
+    lse = jax.scipy.special.logsumexp(logits, -1)
+    xt = jnp.take_along_axis(logits, target[:, None], -1)[:, 0]
+    manual = lse - (1 - eps) * xt - eps * jnp.mean(logits, -1)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(manual), rtol=1e-5)
