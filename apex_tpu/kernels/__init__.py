@@ -11,6 +11,7 @@ from apex_tpu.kernels.softmax import (
     scaled_upper_triang_masked_softmax,
 )
 from apex_tpu.kernels.xentropy import softmax_cross_entropy
+from apex_tpu.kernels.flash_attention import flash_attention, mha
 from apex_tpu.kernels.flat_ops import (
     adagrad_flat,
     adam_flat,
@@ -26,6 +27,8 @@ __all__ = [
     "scaled_masked_softmax",
     "scaled_upper_triang_masked_softmax",
     "softmax_cross_entropy",
+    "flash_attention",
+    "mha",
     "adagrad_flat",
     "adam_flat",
     "axpby_flat",

@@ -1,0 +1,417 @@
+"""Blockwise (flash) attention Pallas kernels — forward + backward.
+
+TPU-native replacement for apex's attention extensions: contrib fmha
+(CUTLASS fixed-seqlen ≤512, apex/contrib/csrc/fmha/* (U)) and
+fast_multihead_attn (apex/contrib/csrc/multihead_attn/* (U)). Instead of
+per-seqlen templates, one online-softmax blockwise kernel:
+
+- forward: streams K/V blocks through VMEM, keeping running (max, sum,
+  accumulator) per Q block — O(sq·d) memory, any sequence length;
+- backward: recomputes P = exp(S - lse) per block from the saved per-row
+  log-sum-exp (no sq×sk materialisation), in two sweeps (dQ; dK/dV) so
+  every accumulation is a sequential-grid reduction, never a race.
+
+Supports causal masking and per-batch key-padding lengths (the capability
+behind fmha's var-seqlen batch packing). Softmax statistics are always
+fp32; matmuls run in the input dtype on the MXU with fp32 accumulation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.kernels._utils import LANE, cdiv, round_up, use_interpret
+
+_NEG = -1e30
+_LANES = 128  # stat scratch lane width
+
+
+def _row_ids(bq: int, width: int, i):
+    return lax.broadcasted_iota(jnp.int32, (bq, width), 0) + i * bq
+
+
+def _col_ids(bq: int, bk: int, j):
+    return lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, bq, bk, sk, sq):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal: K blocks entirely above the diagonal contribute nothing
+    compute = (j * bk < (i + 1) * bq) if causal else True
+
+    @pl.when(compute)
+    def _block():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, bk)
+        col = _col_ids(bq, bk, j)
+        valid = col < sk
+        if len_ref is not None:
+            valid = valid & (col < len_ref[0, 0])
+        if causal:
+            valid = valid & (col <= _row_ids(bq, bk, i))
+        s = jnp.where(valid, s, _NEG)
+
+        m_prev = m_ref[:, :1]                              # (bq, 1)
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(valid, p, 0.0)                       # kill all-masked rows
+        l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:] + jnp.log(jnp.maximum(l, 1e-30))
+
+
+# ---------------------------------------------------------------------------
+# backward: dQ sweep (grid over k blocks innermost), then dK/dV sweep
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, acc_ref, *, scale, causal, bq, bk, sk):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    compute = (j * bk < (i + 1) * bq) if causal else True
+
+    @pl.when(compute)
+    def _block():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        col = _col_ids(bq, bk, j)
+        valid = col < sk
+        if len_ref is not None:
+            valid = valid & (col < len_ref[0, 0])
+        if causal:
+            valid = valid & (col <= _row_ids(bq, bk, i))
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk, sk):
+    j = pl.program_id(1)   # k block
+    i = pl.program_id(2)   # q block (innermost sweep)
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    compute = (j * bk < (i + 1) * bq) if causal else True
+
+    @pl.when(compute)
+    def _block():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        col = _col_ids(bq, bk, j)
+        valid = col < sk
+        if len_ref is not None:
+            valid = valid & (col < len_ref[0, 0])
+        if causal:
+            valid = valid & (col <= _row_ids(bq, bk, i))
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bk, d)
+
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# host-side plumbing
+# ---------------------------------------------------------------------------
+
+def _pad_qkv(x, sp, dp):
+    b, s, d = x.shape
+    if s == sp and d == dp:
+        return x
+    return jnp.pad(x, ((0, 0), (0, sp - s), (0, dp - d)))
+
+
+def _blocks(sq, sk, d, *, max_block=128):
+    bq = min(max_block, round_up(sq, 8))
+    bk = min(max_block, round_up(sk, 8))
+    dp = round_up(d, LANE)
+    return bq, bk, dp
+
+
+def _stat_spec(bq):
+    return pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _len_spec():
+    return pl.BlockSpec((1, 1), lambda b, i, j: (b, 0),
+                        memory_space=pltpu.SMEM)
+
+
+def _run_fwd(q, k, v, lengths, scale, causal):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq, bk, dp = _blocks(sq, sk, d)
+    sqp, skp = round_up(sq, bq), round_up(sk, bk)
+    qp = _pad_qkv(q, sqp, dp)
+    kp = _pad_qkv(k, skp, dp)
+    vp = _pad_qkv(v, skp, dp)
+    grid = (bh, sqp // bq, skp // bk)
+    qspec = pl.BlockSpec((1, bq, dp), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM)
+    kspec = pl.BlockSpec((1, bk, dp), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM)
+    in_specs = [qspec, kspec, kspec]
+    operands = [qp, kp, vp]
+    if lengths is not None:
+        in_specs = [_len_spec()] + in_specs
+        operands = [lengths.reshape(bh, 1).astype(jnp.int32)] + operands
+        kernel = _fwd_kernel
+    else:
+        kernel = functools.partial(_drop_len, _fwd_kernel)
+    out, lse = pl.pallas_call(
+        functools.partial(kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, sk=sk, sq=sq),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[qspec, _stat_spec(bq)],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sqp, dp), q.dtype),
+            jax.ShapeDtypeStruct((bh, sqp, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, dp), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(*operands)
+    return out[:, :sq, :d], lse[:, :sq, :1]
+
+
+def _drop_len(kernel, *refs, **kw):
+    return kernel(None, *refs, **kw)
+
+
+def _run_bwd(q, k, v, do, lse, delta, lengths, scale, causal):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq, bk, dp = _blocks(sq, sk, d)
+    sqp, skp = round_up(sq, bq), round_up(sk, bk)
+    qp, dop = _pad_qkv(q, sqp, dp), _pad_qkv(do, sqp, dp)
+    kp, vp = _pad_qkv(k, skp, dp), _pad_qkv(v, skp, dp)
+    # stats: (bh, sqp, LANES), lane-replicated; padded rows get lse=0,
+    # delta=0 → p rows are harmless (their ds lands in padded dq rows)
+    lsep = jnp.pad(lse, ((0, 0), (0, sqp - sq), (0, 0)))
+    lsep = jnp.broadcast_to(lsep, (bh, sqp, _LANES))
+    deltap = jnp.pad(delta, ((0, 0), (0, sqp - sq), (0, 0)))
+    deltap = jnp.broadcast_to(deltap, (bh, sqp, _LANES))
+
+    qspec = pl.BlockSpec((1, bq, dp), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM)
+    kspec = pl.BlockSpec((1, bk, dp), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM)
+    sspec = _stat_spec(bq)
+    lens = None
+    if lengths is not None:
+        lens = lengths.reshape(bh, 1).astype(jnp.int32)
+
+    # --- dQ sweep: grid (bh, nq, nk) -------------------------------------
+    in_specs = [qspec, kspec, kspec, qspec, sspec, sspec]
+    operands = [qp, kp, vp, dop, lsep, deltap]
+    if lens is not None:
+        in_specs = [_len_spec()] + in_specs
+        operands = [lens] + operands
+        dq_kernel = _dq_kernel
+    else:
+        dq_kernel = functools.partial(_drop_len, _dq_kernel)
+    dq = pl.pallas_call(
+        functools.partial(dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, sk=sk),
+        grid=(bh, sqp // bq, skp // bk),
+        in_specs=in_specs,
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, sqp, dp), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bq, dp), jnp.float32)],
+        interpret=use_interpret(),
+    )(*operands)
+
+    # --- dK/dV sweep: grid (bh, nk, nq) ----------------------------------
+    qspec2 = pl.BlockSpec((1, bq, dp), lambda b, j, i: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    kspec2 = pl.BlockSpec((1, bk, dp), lambda b, j, i: (b, j, 0),
+                          memory_space=pltpu.VMEM)
+    sspec2 = pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    in_specs2 = [qspec2, kspec2, kspec2, qspec2, sspec2, sspec2]
+    operands2 = [qp, kp, vp, dop, lsep, deltap]
+    if lens is not None:
+        in_specs2 = [pl.BlockSpec((1, 1), lambda b, j, i: (b, 0),
+                                  memory_space=pltpu.SMEM)] + in_specs2
+        operands2 = [lens] + operands2
+        dkv_kernel = _dkv_kernel
+    else:
+        dkv_kernel = functools.partial(_drop_len, _dkv_kernel)
+    dk, dv = pl.pallas_call(
+        functools.partial(dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, sk=sk),
+        grid=(bh, skp // bk, sqp // bq),
+        in_specs=in_specs2,
+        out_specs=[kspec2, kspec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, skp, dp), jnp.float32),
+            jax.ShapeDtypeStruct((bh, skp, dp), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, dp), jnp.float32),
+            pltpu.VMEM((bk, dp), jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(*operands2)
+    return (dq[:, :sq, :d].astype(q.dtype),
+            dk[:, :sk, :d].astype(k.dtype),
+            dv[:, :sk, :d].astype(v.dtype))
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash(q3, k3, v3, lengths, scale, causal):
+    out, _ = _run_fwd(q3, k3, v3, lengths, scale, causal)
+    return out
+
+
+def _flash_fwd(q3, k3, v3, lengths, scale, causal):
+    out, lse = _run_fwd(q3, k3, v3, lengths, scale, causal)
+    return out, (q3, k3, v3, out, lse, lengths)
+
+
+def _flash_bwd(scale, causal, res, do):
+    q3, k3, v3, out, lse, lengths = res
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    dq, dk, dv = _run_bwd(q3, k3, v3, do, lse, delta, lengths, scale, causal)
+    dlen = None
+    if lengths is not None:
+        import numpy as np
+
+        dlen = np.zeros(lengths.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dlen
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q, k, v, *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    kv_lengths: Optional[jnp.ndarray] = None,
+):
+    """Blockwise attention over ``[batch, heads, seq, head_dim]`` inputs.
+
+    - ``causal``: upper-triangular masking (decoder self-attention).
+    - ``scale``: softmax temperature; default ``1/sqrt(head_dim)``.
+    - ``kv_lengths``: optional ``[batch]`` int — keys/values beyond the
+      per-example length are masked (fmha var-seqlen capability (U)).
+
+    Returns attention output of the same shape/dtype as ``q``.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected [b, h, s, d], got {q.shape}")
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if causal and sq != sk:
+        raise ValueError("causal attention requires sq == sk")
+    s = float(scale) if scale is not None else 1.0 / d ** 0.5
+    q3 = q.reshape(b * h, sq, d)
+    k3 = k.reshape(b * h, sk, d)
+    v3 = v.reshape(b * h, sk, d)
+    lens = None
+    if kv_lengths is not None:
+        lens = jnp.repeat(jnp.asarray(kv_lengths, jnp.int32), h)
+    out = _flash(q3, k3, v3, lens, s, causal)
+    return out.reshape(b, h, sq, d)
+
+
+def mha(q, k, v, *, causal=False, scale=None, kv_lengths=None):
+    """[b, s, h, d] layout convenience wrapper (fast_multihead_attn's
+    self-attn data layout (U))."""
+    out = flash_attention(
+        jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2),
+        causal=causal, scale=scale, kv_lengths=kv_lengths)
+    return jnp.swapaxes(out, 1, 2)
