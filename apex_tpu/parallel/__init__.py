@@ -1,0 +1,34 @@
+"""apex_tpu.parallel — data-parallel runtime (apex/parallel/* (U)).
+
+``DistributedDataParallel``'s machinery (grad hooks, flat buckets, comm
+streams) collapses on TPU to: grads live sharded on the ``dp`` mesh axis
+and one ``psum``/``pmean`` inside the compiled step reduces them, with
+XLA's latency-hiding scheduler providing the backward/collective overlap
+apex hand-builds. What remains API-worthy is policy — average vs sum,
+fp32-reduction, deferred sync for gradient accumulation, bucketed flat
+calls — which this package preserves.
+"""
+
+from apex_tpu.parallel.distributed import (  # noqa: F401
+    DistributedDataParallel,
+    Reducer,
+    allreduce_gradients,
+    flat_dist_call,
+)
+from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
+    SyncBatchNorm,
+    sync_batch_norm,
+)
+from apex_tpu.optimizers.larc import larc_transform as LARC  # noqa: F401  (apex/parallel/LARC.py (U))
+from apex_tpu.parallel.multiproc import initialize_distributed  # noqa: F401
+
+__all__ = [
+    "DistributedDataParallel",
+    "Reducer",
+    "allreduce_gradients",
+    "flat_dist_call",
+    "SyncBatchNorm",
+    "sync_batch_norm",
+    "LARC",
+    "initialize_distributed",
+]

@@ -1,0 +1,156 @@
+"""Data-parallel gradient reduction — apex/parallel/distributed.py (U).
+
+The reference implements: per-param backward hooks discovering grad-ready
+order → flat ~10 MB bucket buffers (``apex_C.flatten``) → async NCCL
+allreduce on side streams overlapped with backward → unflatten → scale by
+1/world_size. Under XLA the overlap and the scheduling are the compiler's
+job; the semantic content (when and how grads are reduced) is preserved:
+
+- :func:`allreduce_gradients` — one-call tree reduction with
+  ``gradient_average`` and ``allreduce_always_fp32`` (U) policies;
+- :func:`flat_dist_call` — the flat-buffer collective (one collective per
+  dtype group instead of per tensor), for host-side uses like initial
+  param broadcast where call count matters;
+- :class:`DistributedDataParallel` — wraps a grad function; supports
+  ``delay_allreduce`` (apex) / ``no_sync`` (torch DDP) for gradient
+  accumulation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu import multi_tensor as mt
+from apex_tpu.mesh.topology import AXIS_DP
+
+
+def allreduce_gradients(
+    grads: Any,
+    axis: str = AXIS_DP,
+    *,
+    gradient_average: bool = True,
+    allreduce_always_fp32: bool = False,
+):
+    """Reduce a grad pytree over the data-parallel axis (inside shard_map).
+
+    ``gradient_average=True`` divides by world size (apex default);
+    ``allreduce_always_fp32`` upcasts half grads for the reduction and
+    casts back (the reference's option of the same name, guarding against
+    fp16 overflow in large rings).
+    """
+
+    def red(g):
+        dtype = g.dtype
+        if allreduce_always_fp32 and dtype in (jnp.float16, jnp.bfloat16):
+            g = g.astype(jnp.float32)
+        g = lax.pmean(g, axis) if gradient_average else lax.psum(g, axis)
+        return g.astype(dtype)
+
+    return jax.tree.map(red, grads)
+
+
+def flat_dist_call(
+    tree: Any,
+    axis: str = AXIS_DP,
+    *,
+    op: str = "pmean",
+    src: int = 0,
+):
+    """Flatten the tree into one buffer per (dtype, group), run ONE
+    collective per buffer, unflatten — ``flat_dist_call``/
+    ``apply_flat_dist_call`` (U).
+
+    ``op``: ``"pmean"`` | ``"psum"`` | ``"broadcast"`` (from rank ``src``
+    of ``axis`` — the reference's initial-parameter sync in DDP.__init__).
+    """
+    bufs, layout = mt.pack(tree)
+    outs = []
+    for b in bufs:
+        if op == "psum":
+            outs.append(lax.psum(b, axis))
+        elif op == "pmean":
+            outs.append(lax.pmean(b, axis))
+        elif op == "broadcast":
+            mask = (lax.axis_index(axis) == src).astype(b.dtype)
+            outs.append(lax.psum(b * mask, axis))
+        else:
+            raise ValueError(f"unknown op {op!r}")
+    return mt.unpack(outs, layout)
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedDataParallel:
+    """Wrap a grad function so its output grads are reduced over ``axis``.
+
+    Functional analogue of ``apex.parallel.DistributedDataParallel`` (U)::
+
+        ddp = DistributedDataParallel(gradient_average=True)
+        grad_fn = ddp.wrap_grad_fn(jax.grad(loss_fn))   # inside shard_map
+        grads = grad_fn(params, batch_shard)            # reduced grads
+        # gradient accumulation (delay_allreduce/no_sync):
+        g1 = ddp.no_sync(jax.grad(loss_fn))(params, shard_a)
+        g  = grad_fn(params, shard_b, accumulated=g1)
+
+    Options map 1:1: ``gradient_average``, ``allreduce_always_fp32``;
+    ``message_size``/bucketing has no XLA equivalent (the compiler fuses
+    and schedules collectives) and is accepted for API compat but unused.
+    """
+
+    axis: str = AXIS_DP
+    gradient_average: bool = True
+    allreduce_always_fp32: bool = False
+    delay_allreduce: bool = False
+    message_size: int = 10_000_000  # accepted for parity; XLA schedules
+
+    def reduce(self, grads):
+        return allreduce_gradients(
+            grads,
+            self.axis,
+            gradient_average=self.gradient_average,
+            allreduce_always_fp32=self.allreduce_always_fp32,
+        )
+
+    def wrap_grad_fn(self, grad_fn: Callable) -> Callable:
+        def wrapped(*args, accumulated: Optional[Any] = None, **kwargs):
+            grads = grad_fn(*args, **kwargs)
+            if accumulated is not None:
+                grads = jax.tree.map(jnp.add, accumulated, grads)
+            if self.delay_allreduce:
+                return grads
+            return self.reduce(grads)
+
+        return wrapped
+
+    def no_sync(self, grad_fn: Callable) -> Callable:
+        """Grad function variant that skips the reduction (accumulation
+        microbatches; torch DDP ``no_sync`` / apex ``delay_allreduce``)."""
+        return dataclasses.replace(self, delay_allreduce=True).wrap_grad_fn(grad_fn)
+
+    def broadcast_params(self, params):
+        """Initial parameter sync from dp rank 0 (DDP.__init__ broadcast
+        (U)). Under SPMD params are already replicated; this exists for
+        divergence recovery."""
+        return flat_dist_call(params, self.axis, op="broadcast")
+
+
+class Reducer:
+    """Manual-sync variant: ``Reducer(axis).reduce(tree)`` — apex's
+    ``Reducer`` class (U), for users who want allreduce at a time of their
+    choosing rather than wrapped into the grad fn."""
+
+    def __init__(self, axis: str = AXIS_DP, gradient_average: bool = True):
+        self.axis = axis
+        self.gradient_average = gradient_average
+
+    def reduce(self, tree):
+        return allreduce_gradients(
+            tree, self.axis, gradient_average=self.gradient_average
+        )
+
+    def broadcast(self, tree, src: int = 0):
+        return flat_dist_call(tree, self.axis, op="broadcast", src=src)

@@ -1,0 +1,31 @@
+"""Multi-process/multi-host bring-up — apex/parallel/multiproc.py (U).
+
+The reference is a pre-``torchrun`` one-process-per-GPU spawner. On TPU the
+runtime model differs: within a slice, one process drives many chips
+(single-controller); across hosts/slices, ``jax.distributed.initialize``
+wires the multi-controller runtime. This module is the thin parity shim.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+) -> None:
+    """Join the multi-controller runtime (replaces the reference's env-var
+    rendezvous + per-GPU spawn). On a single host this is a no-op."""
+    if coordinator_address is None:
+        return  # single-controller: nothing to rendezvous
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )

@@ -1,0 +1,146 @@
+"""SyncBatchNorm: cross-replica batch normalization.
+
+TPU-native re-design of apex/parallel/{optimized_sync_batchnorm*,
+sync_batchnorm*}.py + csrc/syncbn.cpp, welford.cu (U). The reference ships
+two impls (pure-torch allgather-of-stats and Welford-merge CUDA kernels);
+on TPU one suffices: per-shard moment sums reduced with a single ``psum``
+of the ``(Σx, Σx², n)`` triple over the data-parallel axis — numerically
+the Welford merge at fp32, without the bespoke kernels. Ragged last
+batches (apex's varying-count merge) are handled exactly: ``n`` rides in
+the same psum, so shards may carry different batch sizes.
+
+Channels-last vs channels-first is a ``channel_axis`` argument — layout is
+metadata under XLA, not a kernel variant.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.mesh.topology import AXIS_DP
+
+Axis = Union[str, Sequence[str]]
+
+
+def _moments(x, reduce_dims, axis: Optional[Axis], batch_weight=None):
+    """Cross-replica (mean, var, count) in fp32 via one fused psum."""
+    xf = x.astype(jnp.float32)
+    if batch_weight is None:
+        n = jnp.array(1.0, jnp.float32)
+        for d in reduce_dims:
+            n = n * x.shape[d]
+    else:
+        n = batch_weight.astype(jnp.float32)
+    s1 = jnp.sum(xf, axis=reduce_dims)
+    s2 = jnp.sum(xf * xf, axis=reduce_dims)
+    if axis is not None:
+        # one collective for the whole (Σx, Σx², n) triple, not three
+        packed = jnp.concatenate([s1, s2, jnp.broadcast_to(n, (1,))])
+        packed = lax.psum(packed, axis)
+        m = s1.shape[0]
+        s1, s2, n = packed[:m], packed[m : 2 * m], packed[2 * m]
+    mean = s1 / n
+    var = jnp.maximum(s2 / n - mean * mean, 0.0)
+    return mean, var, n
+
+
+def sync_batch_norm(
+    x,
+    scale,
+    bias,
+    running_mean=None,
+    running_var=None,
+    *,
+    axis: Optional[Axis] = AXIS_DP,
+    momentum: float = 0.1,
+    eps: float = 1e-5,
+    training: bool = True,
+    channel_axis: int = 1,
+    batch_weight=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Normalize over all dims except ``channel_axis``, with statistics
+    reduced across ``axis`` (``SyncBatchNorm.forward`` (U)).
+
+    Returns ``(y, new_running_mean, new_running_var)`` — running stats are
+    carried functionally instead of mutated buffers. ``axis=None`` degrades
+    to ordinary (local) BatchNorm. ``batch_weight`` overrides the local
+    element count for ragged shards. In eval (``training=False``) running
+    stats are used and returned unchanged.
+    """
+    ch = channel_axis % x.ndim
+    reduce_dims = tuple(d for d in range(x.ndim) if d != ch)
+    bshape = tuple(x.shape[ch] if d == ch else 1 for d in range(x.ndim))
+
+    if training:
+        mean, var, n = _moments(x, reduce_dims, axis, batch_weight)
+        if running_mean is not None:
+            # apex uses unbiased var for the running estimate
+            unbiased = var * (n / jnp.maximum(n - 1.0, 1.0))
+            new_rm = (1 - momentum) * running_mean + momentum * mean
+            new_rv = (1 - momentum) * running_var + momentum * unbiased
+        else:
+            new_rm = new_rv = None
+    else:
+        mean, var = running_mean.astype(jnp.float32), running_var.astype(jnp.float32)
+        new_rm, new_rv = running_mean, running_var
+
+    inv = lax.rsqrt(var + eps)
+    y = (x.astype(jnp.float32) - mean.reshape(bshape)) * inv.reshape(bshape)
+    if scale is not None:
+        y = y * scale.astype(jnp.float32).reshape(bshape)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32).reshape(bshape)
+    return y.astype(x.dtype), new_rm, new_rv
+
+
+@dataclasses.dataclass(frozen=True)
+class SyncBatchNorm:
+    """Layer-style wrapper: ``init`` → params/state dicts, ``apply`` inside
+    shard_map. Mirrors ``apex.parallel.SyncBatchNorm`` (U) constructor
+    (num_features, eps, momentum, affine, process_group→axis,
+    channel_last→channel_axis)."""
+
+    num_features: int
+    eps: float = 1e-5
+    momentum: float = 0.1
+    affine: bool = True
+    axis: Optional[Axis] = AXIS_DP
+    channel_axis: int = 1
+
+    def init(self):
+        params = {}
+        if self.affine:
+            params = {
+                "scale": jnp.ones((self.num_features,), jnp.float32),
+                "bias": jnp.zeros((self.num_features,), jnp.float32),
+            }
+        state = {
+            "running_mean": jnp.zeros((self.num_features,), jnp.float32),
+            "running_var": jnp.ones((self.num_features,), jnp.float32),
+        }
+        return params, state
+
+    @property
+    def specs(self):
+        p = {"scale": P(), "bias": P()} if self.affine else {}
+        return p, {"running_mean": P(), "running_var": P()}
+
+    def apply(self, params, state, x, *, training: bool = True):
+        y, rm, rv = sync_batch_norm(
+            x,
+            params.get("scale") if self.affine else None,
+            params.get("bias") if self.affine else None,
+            state["running_mean"],
+            state["running_var"],
+            axis=self.axis,
+            momentum=self.momentum,
+            eps=self.eps,
+            training=training,
+            channel_axis=self.channel_axis,
+        )
+        return y, {"running_mean": rm, "running_var": rv}
