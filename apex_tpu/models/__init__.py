@@ -1,0 +1,16 @@
+"""Reference model families for the BASELINE configs (BASELINE.md).
+
+Apex itself ships no models — its models live in the consumer's script
+(examples/imagenet/main_amp.py (U), Megatron/NeMo for apex.transformer).
+Here the models the tracked configs exercise are first-class so the
+benchmark/ example trainers are self-contained:
+
+- ``gpt``    — Megatron-style GPT (configs #4/#5: GPT-2 355M TP=8,
+  Megatron-GPT 2.7B PP×TP), the flagship.
+- ``training`` — fused train-step builder wiring amp + fused optimizers +
+  DP/TP/SP grad sync into one compiled program.
+"""
+
+from apex_tpu.models import gpt, training
+
+__all__ = ["gpt", "training"]

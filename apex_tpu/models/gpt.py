@@ -1,0 +1,294 @@
+"""Megatron-style GPT over the {dp, tp} mesh — the flagship model.
+
+The reference's transformer stack has no model of its own; apex.transformer
+is consumed by Megatron/NeMo trainers (SURVEY.md §1: "control flow always
+lives in the user's training script"). This module is that consumer, built
+from apex_tpu's own parity pieces:
+
+- ``VocabParallelEmbedding`` lookup + tied vocab-parallel output head
+  (apex/transformer/tensor_parallel/layers.py (U)),
+- fused-QKV ``ColumnParallelLinear`` → Pallas flash attention →
+  ``RowParallelLinear`` (the fmha / fast_multihead_attn capability (U)),
+- Pallas fused LayerNorm (csrc/layer_norm_cuda_kernel.cu (U)),
+- MLP = column(gelu) → row (apex/mlp (U) shape),
+- ``vocab_parallel_cross_entropy`` loss,
+- Megatron sequence parallelism (``sequence_parallel_enabled`` (U)):
+  activations sharded on the seq dim between TP blocks,
+- activation recompute via ``jax.checkpoint`` per layer.
+
+Layout is Megatron's ``[seq, batch, hidden]`` so the SP mappings (which act
+on dim 0) apply directly. All functions have *local-shard* semantics: call
+inside ``shard_map`` over a mesh with a ``tp`` axis (``tp=1`` is fine).
+Layer parameters are stacked on a leading layer axis and scanned, so
+compile time is O(1) in depth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.kernels import flash_attention, layer_norm
+from apex_tpu.mesh.topology import AXIS_TP
+from apex_tpu.transformer.tensor_parallel import random as tpr
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    column_parallel_linear,
+    init_method_normal,
+    row_parallel_linear,
+    scaled_init_method_normal,
+    vocab_parallel_embedding,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    """Model + parallelism-behaviour config (static, hashable)."""
+
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    seq_len: int = 1024
+    ffn_hidden_size: Optional[int] = None  # default 4 * hidden
+    sequence_parallel: bool = False
+    remat: bool = True
+    compute_dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    layernorm_epsilon: float = 1e-5
+    init_std: float = 0.02
+    axis: str = AXIS_TP
+
+    @property
+    def ffn(self) -> int:
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self) -> int:
+        if self.hidden_size % self.num_heads:
+            raise ValueError("hidden_size must divide by num_heads")
+        return self.hidden_size // self.num_heads
+
+    def param_count(self) -> int:
+        h, f, L = self.hidden_size, self.ffn, self.num_layers
+        per_layer = 4 * h + (h * 3 * h + 3 * h) + (h * h + h) + (h * f + f) + (f * h + h)
+        return self.vocab_size * h + self.seq_len * h + L * per_layer + 2 * h
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def _layer_init(cfg: GPTConfig, key):
+    h, f = cfg.hidden_size, cfg.ffn
+    init = init_method_normal(cfg.init_std)
+    out_init = scaled_init_method_normal(cfg.init_std, cfg.num_layers)
+    k = jax.random.split(key, 4)
+    dt = cfg.param_dtype
+    return {
+        "ln1": {"scale": jnp.ones((h,), dt), "bias": jnp.zeros((h,), dt)},
+        "attn": {
+            # fused QKV, head-major [h, heads * 3 * head_dim] so a TP shard
+            # of the out dim keeps whole (q, k, v) triples per head
+            # (Megatron's interleaved fused-QKV layout, not plain concat)
+            "qkv": {"kernel": init(k[0], (h, 3 * h), dt),
+                    "bias": jnp.zeros((3 * h,), dt)},
+            "proj": {"kernel": out_init(k[1], (h, h), dt),
+                     "bias": jnp.zeros((h,), dt)},
+        },
+        "ln2": {"scale": jnp.ones((h,), dt), "bias": jnp.zeros((h,), dt)},
+        "mlp": {
+            "fc1": {"kernel": init(k[2], (h, f), dt),
+                    "bias": jnp.zeros((f,), dt)},
+            "fc2": {"kernel": out_init(k[3], (f, h), dt),
+                    "bias": jnp.zeros((h,), dt)},
+        },
+    }
+
+
+def init(cfg: GPTConfig, key) -> Any:
+    """Global (unsharded) parameter pytree; shard with :func:`param_specs`."""
+    k_emb, k_pos, k_layers = jax.random.split(key, 3)
+    emb_init = init_method_normal(cfg.init_std)
+    layers = jax.vmap(lambda k: _layer_init(cfg, k))(
+        jax.random.split(k_layers, cfg.num_layers)
+    )
+    h = cfg.hidden_size
+    return {
+        "embedding": {
+            "word": {"table": emb_init(k_emb, (cfg.vocab_size, h), cfg.param_dtype)},
+            "position": emb_init(k_pos, (cfg.seq_len, h), cfg.param_dtype),
+        },
+        "layers": layers,
+        "final_ln": {
+            "scale": jnp.ones((h,), cfg.param_dtype),
+            "bias": jnp.zeros((h,), cfg.param_dtype),
+        },
+    }
+
+
+def param_specs(cfg: GPTConfig) -> Any:
+    """PartitionSpecs mirroring the :func:`init` tree (layer dim leading)."""
+    t = cfg.axis
+    lay = {
+        "ln1": {"scale": P(None), "bias": P(None)},
+        "attn": {
+            "qkv": {"kernel": P(None, None, t), "bias": P(None, t)},
+            "proj": {"kernel": P(None, t, None), "bias": P(None)},
+        },
+        "ln2": {"scale": P(None), "bias": P(None)},
+        "mlp": {
+            "fc1": {"kernel": P(None, None, t), "bias": P(None, t)},
+            "fc2": {"kernel": P(None, t, None), "bias": P(None)},
+        },
+    }
+    return {
+        "embedding": {"word": {"table": P(t, None)}, "position": P(None, None)},
+        "layers": lay,
+        "final_ln": {"scale": P(None), "bias": P(None)},
+    }
+
+
+def seq_partial_grad_mask(cfg: GPTConfig) -> Any:
+    """True for replicated params whose grads are *partial over tp* under
+    sequence parallelism (consumed on seq-sharded activations) and need a
+    tp-psum — apex marks these with a ``sequence_parallel_enabled``
+    attribute and all-reduces them explicitly (U: layers.py)."""
+    lay = {
+        "ln1": {"scale": True, "bias": True},
+        "attn": {
+            "qkv": {"kernel": False, "bias": False},
+            "proj": {"kernel": False, "bias": True},
+        },
+        "ln2": {"scale": True, "bias": True},
+        "mlp": {
+            "fc1": {"kernel": False, "bias": False},
+            "fc2": {"kernel": False, "bias": True},
+        },
+    }
+    return {
+        "embedding": {"word": {"table": False}, "position": False},
+        "layers": lay,
+        "final_ln": {"scale": True, "bias": True},
+    }
+
+
+# ---------------------------------------------------------------------------
+# forward (local-shard semantics — inside shard_map over cfg.axis)
+# ---------------------------------------------------------------------------
+
+def _attention(cfg: GPTConfig, p, h):
+    """h: [s(_local under SP), b, hidden] → same shape."""
+    sp = cfg.sequence_parallel
+    qkv = column_parallel_linear(
+        h, p["qkv"]["kernel"], p["qkv"]["bias"], axis=cfg.axis,
+        sequence_parallel=sp,
+    )  # [s_full, b, 3h/tp]
+    s, b, local3 = qkv.shape
+    d = cfg.head_dim
+    heads_local = local3 // (3 * d)
+    qkv = qkv.reshape(s, b, heads_local, 3, d)
+    # [b, heads_local, s, d] each
+    q, k, v = (jnp.transpose(qkv[:, :, :, i, :], (1, 2, 0, 3)) for i in range(3))
+    out = flash_attention(q, k, v, causal=True)
+    out = jnp.transpose(out, (2, 0, 1, 3)).reshape(s, b, heads_local * d)
+    return row_parallel_linear(
+        out, p["proj"]["kernel"], p["proj"]["bias"], axis=cfg.axis,
+        sequence_parallel=sp,
+    )
+
+
+def _mlp(cfg: GPTConfig, p, h):
+    sp = cfg.sequence_parallel
+    y = column_parallel_linear(
+        h, p["fc1"]["kernel"], p["fc1"]["bias"], axis=cfg.axis,
+        sequence_parallel=sp,
+    )
+    y = jax.nn.gelu(y, approximate=True)
+    return row_parallel_linear(
+        y, p["fc2"]["kernel"], p["fc2"]["bias"], axis=cfg.axis,
+        sequence_parallel=sp,
+    )
+
+
+def _block(cfg: GPTConfig, p, h):
+    x = layer_norm(h, p["ln1"]["scale"], p["ln1"]["bias"],
+                   eps=cfg.layernorm_epsilon)
+    h = h + _attention(cfg, p["attn"], x)
+    x = layer_norm(h, p["ln2"]["scale"], p["ln2"]["bias"],
+                   eps=cfg.layernorm_epsilon)
+    return h + _mlp(cfg, p["mlp"], x)
+
+
+def hidden_states(cfg: GPTConfig, params, tokens):
+    """tokens [b, s] (global ids, dp-local batch) → final-LN hidden
+    [s(_local under SP), b, hidden] in compute dtype."""
+    cast = lambda t: jax.tree.map(
+        lambda x: x.astype(cfg.compute_dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, t)
+    emb = vocab_parallel_embedding(
+        tokens, params["embedding"]["word"]["table"].astype(cfg.compute_dtype),
+        axis=cfg.axis,
+    )  # [b, s, h]
+    pos = params["embedding"]["position"][: tokens.shape[1]]
+    h = emb + pos[None].astype(cfg.compute_dtype)
+    h = jnp.transpose(h, (1, 0, 2))  # [s, b, h]
+    if cfg.sequence_parallel:
+        h = scatter_to_sequence_parallel_region(h, cfg.axis)
+
+    def body(carry, layer_p):
+        # LN affine params stay fp32 (MixedFusedLayerNorm behaviour (U):
+        # the kernel takes fp32 params with half inputs); matmul weights
+        # cast to compute dtype for the MXU.
+        lp = {**layer_p, "attn": cast(layer_p["attn"]),
+              "mlp": cast(layer_p["mlp"])}
+        return _block(cfg, lp, carry), None
+
+    if cfg.remat:
+        body = tpr.checkpoint(body)
+    h, _ = lax.scan(body, h, params["layers"])
+    # final LN runs inside the SP region (Megatron: its grads are
+    # tp-partial — see seq_partial_grad_mask)
+    return layer_norm(h, params["final_ln"]["scale"],
+                      params["final_ln"]["bias"], eps=cfg.layernorm_epsilon)
+
+
+def logits(cfg: GPTConfig, params, tokens):
+    """Vocab-sharded logits [s, b, vocab/tp] with the output head tied to
+    the word embedding (Megatron weight tying)."""
+    h = hidden_states(cfg, params, tokens)
+    if cfg.sequence_parallel:
+        # gather fwd / reduce-scatter bwd: sums each rank's partial dL/dh
+        h = gather_from_sequence_parallel_region(h, cfg.axis, True)
+    else:
+        # identity fwd / psum bwd — without this, each rank's dL/dh carries
+        # only its vocab shard's contribution into the replicated backbone
+        # (Megatron's parallel_lm_logits does the same (U))
+        h = copy_to_tensor_model_parallel_region(h, cfg.axis)
+    table = params["embedding"]["word"]["table"].astype(cfg.compute_dtype)
+    return jnp.einsum("sbh,vh->sbv", h, table)
+
+
+def loss(cfg: GPTConfig, params, tokens, targets):
+    """Mean next-token cross entropy over the local batch shard.
+
+    ``targets [b, s]``; per-token losses via vocab-parallel CE in fp32
+    (Megatron computes CE on fp32 logits).
+    """
+    lg = logits(cfg, params, tokens).astype(jnp.float32)
+    per_tok = vocab_parallel_cross_entropy(
+        lg, jnp.transpose(targets, (1, 0)), 0.0, cfg.axis
+    )
+    return jnp.mean(per_tok)

@@ -1,0 +1,156 @@
+"""Fused train step: amp + fused optimizer + DP/TP/SP grad sync in one jit.
+
+This is the whole of SURVEY.md §3.2 — apex's per-iteration call stack
+(``scale_loss`` → backward → DDP allreduce → ``FusedAdam.step()``) — as a
+single compiled XLA program over the mesh:
+
+- loss scaling + fused unscale/overflow-check: :mod:`apex_tpu.amp`
+  (apex/amp/scaler.py (U)),
+- gradient sync: ``lax.pmean`` on the dp axis replaces apex DDP's bucketed
+  NCCL allreduce (apex/parallel/distributed.py (U)); XLA's latency-hiding
+  scheduler provides the backward/comm overlap apex managed by hand,
+- the sequence-parallel tp-psum for seq-partial replicated grads mirrors
+  apex's explicit allreduce of ``sequence_parallel_enabled`` params (U),
+- optimizer: one multi-tensor Pallas sweep (apex/optimizers (U)),
+- overflow skip: ``lax.cond``-free select via ``apply_if_finite`` — the
+  functional form of apex skipping ``optimizer.step()`` on inf/nan.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.amp import ScalerConfig, ScalerState, apply_if_finite
+from apex_tpu.amp import update as scaler_update
+from apex_tpu.amp import value_and_scaled_grad
+from apex_tpu.mesh.topology import AXIS_DP, AXIS_TP, mesh_shape_of
+from apex_tpu.models import gpt
+from apex_tpu.optimizers import FusedOptimizer
+
+
+class TrainState(NamedTuple):
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+    scaler: ScalerState
+
+
+def _local_shape(shape, spec, axis_sizes):
+    """Shard a global shape per PartitionSpec."""
+    out = list(shape)
+    for i, names in enumerate(spec):
+        if names is None:
+            continue
+        for n in names if isinstance(names, (tuple, list)) else (names,):
+            out[i] //= axis_sizes[n]
+    return tuple(out)
+
+
+def _opt_state_specs(optimizer: FusedOptimizer, params, pspecs, mesh: Mesh):
+    """Infer shard_map specs for the optimizer state.
+
+    The fused optimizers pack *local* param shards into flat buffers, so
+    inside shard_map each rank owns a private buffer: scalars (step counts)
+    are replicated, buffers shard on the tp axis (equal-sized per rank —
+    shard_map concatenates them into one global array).
+    """
+    sizes = mesh_shape_of(mesh)
+    local = jax.tree.map(
+        lambda x, s: jax.ShapeDtypeStruct(
+            _local_shape(x.shape, s, sizes), x.dtype),
+        params, pspecs,
+    )
+    shapes = jax.eval_shape(optimizer.init, local)
+    return jax.tree.map(
+        lambda x: P() if x.ndim == 0 else P(AXIS_TP), shapes)
+
+
+def make_train_step(
+    cfg: gpt.GPTConfig,
+    mesh: Mesh,
+    optimizer: FusedOptimizer,
+    scaler_cfg: Optional[ScalerConfig] = None,
+):
+    """Build ``(init_fn, step_fn)`` for GPT training over ``mesh``.
+
+    ``init_fn(key) -> TrainState`` places params/optimizer state with the
+    model's shardings; ``step_fn(state, tokens, targets) -> (state,
+    metrics)`` is jitted over the mesh with donated state. ``tokens``/
+    ``targets`` are ``[batch, seq]`` with batch sharded on dp.
+    """
+    scaler_cfg = scaler_cfg or ScalerConfig(enabled=False)
+    pspecs = gpt.param_specs(cfg)
+    sp_mask = gpt.seq_partial_grad_mask(cfg)
+    scaler_specs = jax.tree.map(lambda _: P(), ScalerState(*[0] * 3))
+
+    def sharding(spec):
+        return NamedSharding(mesh, spec)
+
+    param_shapes = jax.eval_shape(lambda: gpt.init(cfg, jax.random.PRNGKey(0)))
+    opt_specs = _opt_state_specs(optimizer, param_shapes, pspecs, mesh)
+
+    def init_fn(key) -> TrainState:
+        params = jax.jit(
+            lambda k: gpt.init(cfg, k),
+            out_shardings=jax.tree.map(sharding, pspecs),
+        )(key)
+        opt_state = jax.jit(
+            jax.shard_map(optimizer.init, mesh=mesh, in_specs=(pspecs,),
+                          out_specs=opt_specs, check_vma=False)
+        )(params)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            scaler=scaler_cfg.init(),
+        )
+
+    def _local_step(state: TrainState, tokens, targets):
+        params = state.params
+        vag = value_and_scaled_grad(
+            lambda p: gpt.loss(cfg, p, tokens, targets), scaler_cfg)
+        value, grads, finite = vag(params, scaler_state=state.scaler)
+
+        # DP gradient averaging (apex DDP allreduce + 1/world_size (U))
+        grads = lax.pmean(grads, AXIS_DP)
+        if cfg.sequence_parallel:
+            grads = jax.tree.map(
+                lambda g, m: lax.psum(g, AXIS_TP) if m else g, grads, sp_mask)
+        # a single rank overflowing must skip the step everywhere
+        finite = lax.pmin(finite.astype(jnp.int32), (AXIS_DP, AXIS_TP)) > 0
+
+        new_params, new_opt = optimizer.step(grads, state.opt_state, params)
+        new_params = apply_if_finite(new_params, params, finite)
+        new_opt = apply_if_finite(new_opt, state.opt_state, finite)
+        new_scaler = scaler_update(scaler_cfg, state.scaler, finite)
+
+        metrics = {
+            "loss": lax.pmean(value, AXIS_DP),
+            "grads_finite": finite.astype(jnp.int32),
+            "loss_scale": new_scaler.loss_scale,
+        }
+        new_state = TrainState(
+            state.step + jnp.int32(1), new_params, new_opt, new_scaler)
+        return new_state, metrics
+
+    state_specs = TrainState(
+        step=P(), params=pspecs, opt_state=opt_specs, scaler=scaler_specs)
+    data_spec = P(AXIS_DP, None)
+    step_fn = jax.jit(
+        jax.shard_map(
+            _local_step, mesh=mesh,
+            in_specs=(state_specs, data_spec, data_spec),
+            out_specs=(state_specs,
+                       {"loss": P(), "grads_finite": P(), "loss_scale": P()}),
+            check_vma=False,
+        ),
+        donate_argnums=(0,),
+    )
+
+    return init_fn, step_fn

@@ -1,0 +1,73 @@
+"""Benchmark: GPT-2 355M-class training throughput on one chip.
+
+Flagship config (BASELINE.md tracked config #4's model at single-chip
+scale): full train step — bf16 forward/backward with remat, fused-Adam
+Pallas sweep, loss scaling machinery engaged (identity for bf16) — i.e.
+the whole SURVEY.md §3.2 per-iteration stack under one jit.
+
+Baseline for ``vs_baseline``: the reference publishes no numbers
+(BASELINE.md), so we use a derived A100 figure — apex-accelerated
+Megatron-class GPT-2 355M at ~40% MFU on A100 bf16 (312 TFLOP/s peak):
+0.4 * 312e12 / (6 * 355e6) ≈ 58.6k tokens/s/chip. vs_baseline =
+measured / 58600.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import mesh as mx
+from apex_tpu.amp import ScalerConfig
+from apex_tpu.models import gpt, training
+from apex_tpu.optimizers import fused_adam
+
+BASELINE_TOKENS_PER_SEC = 58600.0
+
+
+def main():
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if on_tpu:
+        cfg = gpt.GPTConfig(  # GPT-2 355M
+            vocab_size=50304, hidden_size=1024, num_layers=24, num_heads=16,
+            seq_len=1024, remat=True, compute_dtype=jnp.bfloat16,
+        )
+        batch, steps = 8, 20
+    else:  # CPU smoke fallback so the harness always gets a line
+        cfg = gpt.GPTConfig(
+            vocab_size=1024, hidden_size=256, num_layers=4, num_heads=8,
+            seq_len=256, remat=True, compute_dtype=jnp.bfloat16,
+        )
+        batch, steps = 4, 3
+
+    mesh = mx.build_mesh(tp=1, devices=jax.devices()[:1])
+    init_fn, step_fn = training.make_train_step(
+        cfg, mesh, fused_adam(1e-4), ScalerConfig(enabled=False))
+    state = init_fn(jax.random.PRNGKey(0))
+    tok = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, cfg.seq_len), 0, cfg.vocab_size)
+    tgt = jnp.roll(tok, -1, axis=1)
+
+    # warmup / compile
+    state, m = step_fn(state, tok, tgt)
+    jax.block_until_ready(m["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step_fn(state, tok, tgt)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * cfg.seq_len * steps / dt
+    print(json.dumps({
+        "metric": "gpt2_355m_train_tokens_per_sec_per_chip" if on_tpu
+        else "gpt_smoke_cpu_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
