@@ -33,8 +33,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+import numpy as np
+
 from apex_tpu.kernels import flash_attention, layer_norm
-from apex_tpu.mesh.topology import AXIS_TP
+from apex_tpu.mesh.topology import AXIS_PP, AXIS_TP
+from apex_tpu.transformer.pipeline_parallel.schedules import pipelined_loss
 from apex_tpu.transformer.tensor_parallel import random as tpr
 from apex_tpu.transformer.tensor_parallel.cross_entropy import (
     vocab_parallel_cross_entropy,
@@ -139,8 +142,12 @@ def init(cfg: GPTConfig, key) -> Any:
     }
 
 
-def param_specs(cfg: GPTConfig) -> Any:
-    """PartitionSpecs mirroring the :func:`init` tree (layer dim leading)."""
+def param_specs(cfg: GPTConfig, *, pipeline: bool = False) -> Any:
+    """PartitionSpecs mirroring the :func:`init` tree (layer dim leading).
+
+    ``pipeline=True`` shards the stacked layer dim over the ``pp`` axis
+    (each stage owns its contiguous slice of the — possibly interleave-
+    permuted, see :func:`interleave_layers` — layer stack)."""
     t = cfg.axis
     lay = {
         "ln1": {"scale": P(None), "bias": P(None)},
@@ -154,6 +161,11 @@ def param_specs(cfg: GPTConfig) -> Any:
             "fc2": {"kernel": P(None, t, None), "bias": P(None)},
         },
     }
+    if pipeline:
+        # the leading spec entry is the stacked layer dim — shard it on pp
+        lay = jax.tree.map(
+            lambda s: P(AXIS_PP, *tuple(s)[1:]), lay,
+            is_leaf=lambda x: isinstance(x, P))
     return {
         "embedding": {"word": {"table": P(t, None)}, "position": P(None, None)},
         "layers": lay,
@@ -232,12 +244,8 @@ def _block(cfg: GPTConfig, p, h):
     return h + _mlp(cfg, p["mlp"], x)
 
 
-def hidden_states(cfg: GPTConfig, params, tokens):
-    """tokens [b, s] (global ids, dp-local batch) → final-LN hidden
-    [s(_local under SP), b, hidden] in compute dtype."""
-    cast = lambda t: jax.tree.map(
-        lambda x: x.astype(cfg.compute_dtype)
-        if jnp.issubdtype(x.dtype, jnp.floating) else x, t)
+def _embed(cfg: GPTConfig, params, tokens):
+    """tokens [b, s] → entry activation [s(_local under SP), b, hidden]."""
     emb = vocab_parallel_embedding(
         tokens, params["embedding"]["word"]["table"].astype(cfg.compute_dtype),
         axis=cfg.axis,
@@ -247,14 +255,16 @@ def hidden_states(cfg: GPTConfig, params, tokens):
     h = jnp.transpose(h, (1, 0, 2))  # [s, b, h]
     if cfg.sequence_parallel:
         h = scatter_to_sequence_parallel_region(h, cfg.axis)
+    return h
+
+
+def hidden_states(cfg: GPTConfig, params, tokens):
+    """tokens [b, s] (global ids, dp-local batch) → final-LN hidden
+    [s(_local under SP), b, hidden] in compute dtype."""
+    h = _embed(cfg, params, tokens)
 
     def body(carry, layer_p):
-        # LN affine params stay fp32 (MixedFusedLayerNorm behaviour (U):
-        # the kernel takes fp32 params with half inputs); matmul weights
-        # cast to compute dtype for the MXU.
-        lp = {**layer_p, "attn": cast(layer_p["attn"]),
-              "mlp": cast(layer_p["mlp"])}
-        return _block(cfg, lp, carry), None
+        return _block(cfg, _cast_layer(cfg, layer_p), carry), None
 
     if cfg.remat:
         body = tpr.checkpoint(body)
@@ -292,3 +302,116 @@ def loss(cfg: GPTConfig, params, tokens, targets):
         lg, jnp.transpose(targets, (1, 0)), 0.0, cfg.axis
     )
     return jnp.mean(per_tok)
+
+
+# ---------------------------------------------------------------------------
+# pipeline-parallel path (pp axis sharding of the layer stack)
+# ---------------------------------------------------------------------------
+
+def interleave_permutation(num_layers: int, pp: int, vpp: int = 1) -> np.ndarray:
+    """Permutation of the stacked layer dim placing chunk ``c`` of stage
+    ``s`` (global layers ``(c*pp+s)*Lc : +Lc``) at stack position
+    ``s*vpp*Lc + c*Lc`` so a plain pp-shard of the leading dim hands every
+    stage its interleaved model chunks (apex's virtual-PP model-chunk
+    assignment (U), done once at init instead of per construction)."""
+    if num_layers % (pp * vpp):
+        raise ValueError(
+            f"num_layers={num_layers} must divide by pp*vpp={pp * vpp}")
+    lc = num_layers // (pp * vpp)
+    perm = np.empty(num_layers, dtype=np.int64)
+    pos = 0
+    for s in range(pp):
+        for c in range(vpp):
+            start = (c * pp + s) * lc
+            perm[pos: pos + lc] = np.arange(start, start + lc)
+            pos += lc
+    return perm
+
+
+def interleave_layers(params, num_layers: int, pp: int, vpp: int = 1):
+    """Reorder the global stacked layer params for pp sharding."""
+    perm = interleave_permutation(num_layers, pp, vpp)
+    return {
+        **params,
+        "layers": jax.tree.map(lambda x: x[perm], params["layers"]),
+    }
+
+
+def _cast_layer(cfg: GPTConfig, layer_p):
+    """Matmul weights to compute dtype; LN affine stays fp32 (MixedFused
+    behaviour (U))."""
+    cast = lambda t: jax.tree.map(
+        lambda x: x.astype(cfg.compute_dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, t)
+    return {**layer_p, "attn": cast(layer_p["attn"]),
+            "mlp": cast(layer_p["mlp"])}
+
+
+def pipeline_loss(
+    cfg: GPTConfig, params, tokens, targets, *,
+    n_micro: int, n_chunks: int = 1, pp_axis: str = AXIS_PP,
+):
+    """Mean CE under pipeline parallelism (local semantics: call inside
+    shard_map over a {pp, dp, tp} mesh with layers pp-sharded).
+
+    ``tokens``/``targets`` are the dp-local ``[b, s]``; the batch dim is
+    split into ``n_micro`` microbatches that stream through the stage ring
+    (SURVEY.md §3.5's warmup/steady/cooldown collapse into the masked tick
+    scan of :func:`apex_tpu.transformer.pipeline_parallel.pipeline_spmd`).
+    """
+    b, s = tokens.shape
+    if b % n_micro:
+        raise ValueError(f"local batch {b} not divisible by n_micro={n_micro}")
+    mb = b // n_micro
+    local_layers = params["layers"]
+    l_local = jax.tree.leaves(local_layers)[0].shape[0]
+    if l_local % n_chunks:
+        raise ValueError("local layer count not divisible by n_chunks")
+    chunks = jax.tree.map(
+        lambda x: x.reshape((n_chunks, l_local // n_chunks) + x.shape[1:]),
+        local_layers)
+
+    toks_mb = tokens.reshape(n_micro, mb, s)
+
+    def inject(m):
+        t_m = lax.dynamic_index_in_dim(toks_mb, m, 0, keepdims=False)
+        return _embed(cfg, params, t_m)
+
+    def chunk_fn(c, x):
+        cp = jax.tree.map(
+            lambda t: lax.dynamic_index_in_dim(t, c, 0, keepdims=False),
+            chunks)
+
+        def body(carry, layer_p):
+            return _block(cfg, _cast_layer(cfg, layer_p), carry), None
+
+        if cfg.remat:
+            body = tpr.checkpoint(body)
+        y, _ = lax.scan(body, x, cp)
+        return y
+
+    seq_local = s
+    if cfg.sequence_parallel:
+        seq_local = s // lax.axis_size(cfg.axis)
+    item = jax.ShapeDtypeStruct((seq_local, mb, cfg.hidden_size),
+                                cfg.compute_dtype)
+
+    def loss_of_outputs(outs):
+        # outs [n_micro, s_local, mb, h] → final LN + tied head + CE
+        h = jnp.transpose(outs, (1, 0, 2, 3)).reshape(
+            outs.shape[1], n_micro * mb, cfg.hidden_size)
+        h = layer_norm(h, params["final_ln"]["scale"],
+                       params["final_ln"]["bias"], eps=cfg.layernorm_epsilon)
+        if cfg.sequence_parallel:
+            h = gather_from_sequence_parallel_region(h, cfg.axis, True)
+        else:
+            h = copy_to_tensor_model_parallel_region(h, cfg.axis)
+        table = params["embedding"]["word"]["table"].astype(cfg.compute_dtype)
+        lg = jnp.einsum("sbh,vh->sbv", h, table).astype(jnp.float32)
+        tgt = jnp.transpose(targets.reshape(n_micro * mb, s), (1, 0))
+        per_tok = vocab_parallel_cross_entropy(lg, tgt, 0.0, cfg.axis)
+        return jnp.mean(per_tok)
+
+    return pipelined_loss(
+        chunk_fn, inject, loss_of_outputs, n_micro, item,
+        n_chunks=n_chunks, axis=pp_axis)

@@ -29,7 +29,7 @@ from jax.sharding import PartitionSpec as P
 from apex_tpu.amp import ScalerConfig, ScalerState, apply_if_finite
 from apex_tpu.amp import update as scaler_update
 from apex_tpu.amp import value_and_scaled_grad
-from apex_tpu.mesh.topology import AXIS_DP, AXIS_TP, mesh_shape_of
+from apex_tpu.mesh.topology import AXIS_DP, AXIS_PP, AXIS_TP, mesh_shape_of
 from apex_tpu.models import gpt
 from apex_tpu.optimizers import FusedOptimizer
 
@@ -56,9 +56,10 @@ def _opt_state_specs(optimizer: FusedOptimizer, params, pspecs, mesh: Mesh):
     """Infer shard_map specs for the optimizer state.
 
     The fused optimizers pack *local* param shards into flat buffers, so
-    inside shard_map each rank owns a private buffer: scalars (step counts)
-    are replicated, buffers shard on the tp axis (equal-sized per rank —
-    shard_map concatenates them into one global array).
+    inside shard_map each (pp, tp) rank owns a private buffer: scalars
+    (step counts) are replicated, buffers shard on the combined (pp, tp)
+    axes (equal-sized per rank — shard_map concatenates them into one
+    global array; dp ranks hold identical copies).
     """
     sizes = mesh_shape_of(mesh)
     local = jax.tree.map(
@@ -67,8 +68,10 @@ def _opt_state_specs(optimizer: FusedOptimizer, params, pspecs, mesh: Mesh):
         params, pspecs,
     )
     shapes = jax.eval_shape(optimizer.init, local)
+    buf_axes = tuple(a for a in (AXIS_PP, AXIS_TP) if a in mesh.axis_names)
+    buf_spec = P(buf_axes) if buf_axes else P()
     return jax.tree.map(
-        lambda x: P() if x.ndim == 0 else P(AXIS_TP), shapes)
+        lambda x: P() if x.ndim == 0 else buf_spec, shapes)
 
 
 def make_train_step(
@@ -76,6 +79,9 @@ def make_train_step(
     mesh: Mesh,
     optimizer: FusedOptimizer,
     scaler_cfg: Optional[ScalerConfig] = None,
+    *,
+    n_micro: int = 1,
+    n_chunks: int = 1,
 ):
     """Build ``(init_fn, step_fn)`` for GPT training over ``mesh``.
 
@@ -83,21 +89,50 @@ def make_train_step(
     model's shardings; ``step_fn(state, tokens, targets) -> (state,
     metrics)`` is jitted over the mesh with donated state. ``tokens``/
     ``targets`` are ``[batch, seq]`` with batch sharded on dp.
+
+    A mesh with a nontrivial ``pp`` axis switches to the pipelined loss:
+    ``n_micro`` microbatches stream through the stage ring, ``n_chunks``
+    virtual stages per rank (apex interleaved 1F1B).
     """
     scaler_cfg = scaler_cfg or ScalerConfig(enabled=False)
-    pspecs = gpt.param_specs(cfg)
+    axes_present = set(mesh.axis_names)
+    pp = mesh_shape_of(mesh).get(AXIS_PP, 1)
+    pipelined = pp > 1
+    if n_chunks > 1 and not pipelined:
+        raise ValueError("n_chunks > 1 requires a mesh with pp > 1")
+    pspecs = gpt.param_specs(cfg, pipeline=pipelined)
     sp_mask = gpt.seq_partial_grad_mask(cfg)
+
+    def _mentions(spec, axis):
+        return any(
+            a == axis or (isinstance(a, (tuple, list)) and axis in a)
+            for a in spec if a is not None)
+
+    # params NOT sharded over pp see only their stage's loss contribution —
+    # psum over pp reassembles them (embedding / position / final LN);
+    # derived from the specs so placement changes can't desync the mask
+    pp_mask = jax.tree.map(
+        lambda s: not _mentions(s, AXIS_PP), pspecs,
+        is_leaf=lambda x: isinstance(x, P))
     scaler_specs = jax.tree.map(lambda _: P(), ScalerState(*[0] * 3))
 
     def sharding(spec):
         return NamedSharding(mesh, spec)
 
-    param_shapes = jax.eval_shape(lambda: gpt.init(cfg, jax.random.PRNGKey(0)))
+    def _global_init(key):
+        params = gpt.init(cfg, key)
+        if pipelined:
+            params = gpt.interleave_layers(
+                params, cfg.num_layers, pp, n_chunks)
+        return params
+
+    param_shapes = jax.eval_shape(
+        lambda: _global_init(jax.random.PRNGKey(0)))
     opt_specs = _opt_state_specs(optimizer, param_shapes, pspecs, mesh)
 
     def init_fn(key) -> TrainState:
         params = jax.jit(
-            lambda k: gpt.init(cfg, k),
+            _global_init,
             out_shardings=jax.tree.map(sharding, pspecs),
         )(key)
         opt_state = jax.jit(
@@ -111,19 +146,52 @@ def make_train_step(
             scaler=scaler_cfg.init(),
         )
 
+    def _local_loss(p, tokens, targets):
+        if pipelined:
+            return gpt.pipeline_loss(
+                cfg, p, tokens, targets, n_micro=n_micro, n_chunks=n_chunks)
+        if n_micro > 1:
+            # gradient accumulation without a pipeline: scan sequential
+            # microbatches, recomputing each forward in backward (apex's
+            # forward_backward_no_pipelining capability (U))
+            b = tokens.shape[0]
+            if b % n_micro:
+                raise ValueError(
+                    f"local batch {b} not divisible by n_micro={n_micro}")
+            mb_tok = tokens.reshape(n_micro, b // n_micro, -1)
+            mb_tgt = targets.reshape(n_micro, b // n_micro, -1)
+
+            @jax.checkpoint
+            def mb_loss(p, t, y):
+                return gpt.loss(cfg, p, t, y)
+
+            def body(acc, mb):
+                t, y = mb
+                return acc + mb_loss(p, t, y), None
+
+            tot, _ = lax.scan(body, jnp.float32(0.0), (mb_tok, mb_tgt))
+            return tot / n_micro
+        return gpt.loss(cfg, p, tokens, targets)
+
     def _local_step(state: TrainState, tokens, targets):
         params = state.params
         vag = value_and_scaled_grad(
-            lambda p: gpt.loss(cfg, p, tokens, targets), scaler_cfg)
+            lambda p: _local_loss(p, tokens, targets), scaler_cfg)
         value, grads, finite = vag(params, scaler_state=state.scaler)
 
         # DP gradient averaging (apex DDP allreduce + 1/world_size (U))
-        grads = lax.pmean(grads, AXIS_DP)
+        if AXIS_DP in axes_present:
+            grads = lax.pmean(grads, AXIS_DP)
         if cfg.sequence_parallel:
             grads = jax.tree.map(
                 lambda g, m: lax.psum(g, AXIS_TP) if m else g, grads, sp_mask)
+        if pipelined:
+            grads = jax.tree.map(
+                lambda g, m: lax.psum(g, AXIS_PP) if m else g, grads, pp_mask)
         # a single rank overflowing must skip the step everywhere
-        finite = lax.pmin(finite.astype(jnp.int32), (AXIS_DP, AXIS_TP)) > 0
+        sync_axes = tuple(
+            a for a in (AXIS_DP, AXIS_TP, AXIS_PP) if a in axes_present)
+        finite = lax.pmin(finite.astype(jnp.int32), sync_axes) > 0
 
         new_params, new_opt = optimizer.step(grads, state.opt_state, params)
         new_params = apply_if_finite(new_params, params, finite)
@@ -131,7 +199,8 @@ def make_train_step(
         new_scaler = scaler_update(scaler_cfg, state.scaler, finite)
 
         metrics = {
-            "loss": lax.pmean(value, AXIS_DP),
+            "loss": lax.pmean(value, AXIS_DP)
+            if AXIS_DP in axes_present else value,
             "grads_finite": finite.astype(jnp.int32),
             "loss_scale": new_scaler.loss_scale,
         }
@@ -141,7 +210,7 @@ def make_train_step(
 
     state_specs = TrainState(
         step=P(), params=pspecs, opt_state=opt_specs, scaler=scaler_specs)
-    data_spec = P(AXIS_DP, None)
+    data_spec = P(AXIS_DP, None) if AXIS_DP in axes_present else P(None, None)
     step_fn = jax.jit(
         jax.shard_map(
             _local_step, mesh=mesh,

@@ -1,0 +1,40 @@
+"""Pipeline parallelism over the ``pp`` mesh axis.
+
+TPU-native re-design of apex/transformer/pipeline_parallel/* (U). Apex
+orchestrates three imperative fwd/bwd schedules over NCCL P2P
+(no-pipelining, 1F1B, interleaved 1F1B). On a static-graph compiler the
+schedule *is* the program: one ``lax.scan`` over pipeline ticks with a
+``ppermute`` ring transfer, differentiated end-to-end — the backward
+pipeline is the autodiff transpose of the forward one (reverse-direction
+``ppermute``), so there is no hand-written backward schedule at all.
+"""
+
+from apex_tpu.transformer.pipeline_parallel.p2p import (
+    recv_backward,
+    recv_forward,
+    send_backward,
+    send_backward_recv_forward,
+    send_forward,
+    send_forward_recv_backward,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+    pipeline_spmd,
+)
+
+__all__ = [
+    "pipeline_spmd",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
+    "get_forward_backward_func",
+    "send_forward",
+    "recv_forward",
+    "send_backward",
+    "recv_backward",
+    "send_forward_recv_backward",
+    "send_backward_recv_forward",
+]

@@ -1,0 +1,54 @@
+"""Stage-to-stage activation transfer primitives.
+
+API-parity layer over ``ppermute`` for apex/transformer/pipeline_parallel/
+p2p_communication.py (U). Apex's ``_communicate`` builds batched NCCL
+``P2POp`` lists with shape handshakes and optional fp32→fp16 conversion;
+on TPU a stage transfer is one ``lax.ppermute`` on the ``pp`` axis — shapes
+are static under jit (no handshake), dtype conversion is a cast the
+compiler fuses, and XLA overlaps the transfer with compute.
+
+All functions have shard_map-local semantics over the ``pp`` axis. Edge
+behaviour matches the reference: the first stage "receives" zeros from
+``recv_forward`` (apex returns None there; a zeros tensor is the functional
+equivalent selected away by the caller), mirrored for the last stage.
+"""
+
+from __future__ import annotations
+
+from apex_tpu.mesh.collectives import ppermute_shift
+from apex_tpu.mesh.topology import AXIS_PP
+
+
+def send_forward(x, axis: str = AXIS_PP, *, wrap: bool = False):
+    """Ship ``x`` to the next stage; returns what arrives from the previous
+    one (zeros on stage 0 unless ``wrap``). In SPMD form send/recv are one
+    collective, so ``send_forward`` *is* ``recv_forward`` shifted."""
+    return ppermute_shift(x, axis, 1, wrap=wrap)
+
+
+def recv_forward(x, axis: str = AXIS_PP, *, wrap: bool = False):
+    """Alias of :func:`send_forward` — see its docstring."""
+    return ppermute_shift(x, axis, 1, wrap=wrap)
+
+
+def send_backward(g, axis: str = AXIS_PP, *, wrap: bool = False):
+    """Ship ``g`` to the previous stage (gradient direction); zeros arrive
+    on the last stage unless ``wrap``."""
+    return ppermute_shift(g, axis, -1, wrap=wrap)
+
+
+def recv_backward(g, axis: str = AXIS_PP, *, wrap: bool = False):
+    """Alias of :func:`send_backward`."""
+    return ppermute_shift(g, axis, -1, wrap=wrap)
+
+
+def send_forward_recv_backward(x, g, axis: str = AXIS_PP):
+    """The 1F1B steady-state pair (U) — two independent permutes XLA runs
+    concurrently on opposite ICI directions."""
+    return ppermute_shift(x, axis, 1, wrap=False), ppermute_shift(
+        g, axis, -1, wrap=False)
+
+
+def send_backward_recv_forward(g, x, axis: str = AXIS_PP):
+    return ppermute_shift(g, axis, -1, wrap=False), ppermute_shift(
+        x, axis, 1, wrap=False)

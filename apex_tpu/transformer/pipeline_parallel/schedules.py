@@ -1,0 +1,195 @@
+"""Pipeline schedules as compiled programs.
+
+Reference: apex/transformer/pipeline_parallel/schedules/* (U) — three
+imperative orchestrators (``forward_backward_no_pipelining``, 1F1B
+``…_without_interleaving``, interleaved ``…_with_interleaving``) driving
+NCCL P2P per microbatch. The TPU re-design replaces the *mechanism*, keeps
+the *capability*:
+
+- The forward pipeline is one ``lax.scan`` over ticks; each tick every
+  stage applies its (virtual-)stage chunk and the activation ring rotates
+  by one via ``ppermute`` (ICI-neighbour transfer).
+- **The backward schedule is not written at all**: differentiating the
+  scan transposes every ``ppermute`` into the reverse rotation, yielding
+  the backward pipeline automatically — apex's ``backward_step`` /
+  deallocate-output-tensor bookkeeping has no analogue because XLA owns
+  buffer lifetimes.
+- Virtual pipeline stages (apex's interleaved 1F1B, model chunks per rank)
+  = a *circular* schedule: the ring wraps last→first stage, carrying each
+  microbatch through chunk 0..V-1. Microbatches enter in groups of S
+  (stage count); steady-state bubble fraction is (S-1)/(ticks) with
+  per-tick work 1/V of a full stage — the same bubble shrinkage that
+  motivates apex's interleaving.
+- Microbatch entry/exit and invalid ticks are ``where``-masks: SPMD ranks
+  all run the same program (no per-rank control flow to diverge).
+
+Scheduling table (item = microbatch ``m`` in chunk ``c``): item enters
+stage 0 at tick ``e(m) = (m // S) * S*V + m % S`` and sits on stage ``s``
+in chunk ``c`` at tick ``e(m) + c*S + s``. Inverting that per (tick,
+stage) gives the unique (m, c) a stage works on, or an invalid slot.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.mesh.collectives import ppermute_shift
+from apex_tpu.mesh.topology import AXIS_PP
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    reduce_from_tensor_model_parallel_region,
+)
+
+
+def pipeline_spmd(
+    chunk_fn: Callable,
+    inject_fn: Callable,
+    n_micro: int,
+    item: Any,
+    *,
+    n_chunks: int = 1,
+    axis: str = AXIS_PP,
+):
+    """Run the circular SPMD pipeline; returns stacked outputs.
+
+    Args:
+      chunk_fn: ``(c, x) -> y`` — apply this stage's chunk ``c`` (traced
+        int32) to activation ``x``; shapes of x and y must match ``item``.
+        Wrap in ``jax.checkpoint`` for activation recompute.
+      inject_fn: ``(m) -> x`` — produce microbatch ``m``'s entry activation
+        (e.g. the embedding); evaluated on every stage, selected on stage 0.
+      n_micro: number of microbatches (static).
+      item: array or ShapeDtypeStruct giving the activation shape/dtype.
+      n_chunks: virtual pipeline stages per rank (apex vpp).
+
+    Returns ``[n_micro, *item.shape]``: final-chunk outputs, populated on
+    the **last stage** and zeros elsewhere (mask or psum as needed).
+    """
+    S = lax.axis_size(axis)
+    V = n_chunks
+    s_idx = lax.axis_index(axis)
+    period = S * V
+    e_last = ((n_micro - 1) // S) * period + (n_micro - 1) % S
+    T = e_last + period  # completion tick of the last item, exclusive
+
+    zero_item = jnp.zeros(item.shape, item.dtype)
+    outputs0 = jnp.zeros((n_micro,) + tuple(item.shape), item.dtype)
+
+    def tick(carry, t):
+        recv, outputs = carry
+        k = t - s_idx
+        g = k // period
+        r = k % period  # lax.rem semantics fine: k>=0 whenever valid
+        c = r // S
+        m = g * S + r % S
+        valid = (k >= 0) & (m >= 0) & (m < n_micro)
+        m_c = jnp.clip(m, 0, n_micro - 1)
+
+        x_in = inject_fn(m_c)
+        enter = valid & (c == 0) & (s_idx == 0)
+        x = jnp.where(enter, x_in.astype(item.dtype), recv)
+        y = chunk_fn(c, x)
+
+        write = valid & (c == V - 1) & (s_idx == S - 1)
+        cur = lax.dynamic_index_in_dim(outputs, m_c, 0, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(write, y, cur), m_c, 0)
+
+        # ring rotation: stage s → s+1; last → 0 advances the chunk index
+        recv = ppermute_shift(y, axis, 1, wrap=True)
+        return (recv, outputs), None
+
+    (_, outputs), _ = lax.scan(
+        tick, (zero_item, outputs0), jnp.arange(T, dtype=jnp.int32))
+    return outputs
+
+
+def pipelined_loss(
+    chunk_fn: Callable,
+    inject_fn: Callable,
+    loss_of_outputs: Callable,
+    n_micro: int,
+    item: Any,
+    *,
+    n_chunks: int = 1,
+    axis: str = AXIS_PP,
+):
+    """Pipeline forward + masked last-stage loss, psum-replicated over pp.
+
+    ``loss_of_outputs(outputs) -> scalar`` runs on the stacked final
+    activations (garbage-free: zeros on non-last stages). Differentiate the
+    result for the full backward pipeline.
+    """
+    outs = pipeline_spmd(
+        chunk_fn, inject_fn, n_micro, item, n_chunks=n_chunks, axis=axis)
+    is_last = (lax.axis_index(axis) == lax.axis_size(axis) - 1).astype(
+        jnp.float32)
+    # psum-fwd / identity-bwd (the "reduce" mapping, here on the pp axis):
+    # a raw lax.psum would transpose into another psum, multiplying every
+    # cotangent by the stage count when grad is seeded on all ranks.
+    return reduce_from_tensor_model_parallel_region(
+        loss_of_outputs(outs) * is_last, axis)
+
+
+def forward_backward_no_pipelining(
+    loss_fn: Callable, params: Any, microbatches: Any, *, n_micro: int
+):
+    """Sequential microbatch grad accumulation — apex's
+    ``forward_backward_no_pipelining`` (U) (its ``no_sync`` dance is moot:
+    grad sync is wherever the caller put its ``psum``).
+
+    ``microbatches``: pytree with leading ``n_micro`` dim. Returns
+    ``(mean_loss, mean_grads)``.
+    """
+    vg = jax.value_and_grad(loss_fn)
+
+    def body(acc, mb):
+        acc_loss, acc_g = acc
+        loss, g = vg(params, mb)
+        return (acc_loss + loss, jax.tree.map(jnp.add, acc_g, g)), None
+
+    zeros_g = jax.tree.map(jnp.zeros_like, params)
+    (tot, grads), _ = lax.scan(
+        body, (jnp.float32(0.0), zeros_g), microbatches)
+    inv = 1.0 / n_micro
+    return tot * inv, jax.tree.map(lambda g: g * inv, grads)
+
+
+# parity-named schedule entry points ---------------------------------------
+def forward_backward_pipelining_without_interleaving(*args, **kw):
+    """1F1B-capability schedule (U) — see module docstring for how the
+    static-graph version subsumes it."""
+    kw.setdefault("n_chunks", 1)
+    return pipelined_loss(*args, **kw)
+
+
+def forward_backward_pipelining_with_interleaving(*args, **kw):
+    """Interleaved (virtual-stage) schedule (U); pass ``n_chunks`` = vpp."""
+    if kw.get("n_chunks", 1) < 2:
+        raise ValueError("interleaved schedule needs n_chunks >= 2")
+    return pipelined_loss(*args, **kw)
+
+
+def get_forward_backward_func(
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    pipeline_model_parallel_size: Optional[int] = None,
+):
+    """Schedule selector — apex ``get_forward_backward_func()`` (U).
+
+    Falls back to the current :mod:`parallel_state` topology when sizes are
+    not given explicitly.
+    """
+    if pipeline_model_parallel_size is None:
+        pipeline_model_parallel_size = (
+            parallel_state.get_pipeline_model_parallel_world_size())
+        virtual_pipeline_model_parallel_size = (
+            parallel_state.get_virtual_pipeline_model_parallel_world_size())
+    if pipeline_model_parallel_size > 1:
+        if (virtual_pipeline_model_parallel_size or 1) > 1:
+            return forward_backward_pipelining_with_interleaving
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining
