@@ -1,0 +1,143 @@
+"""Context parallelism: ring attention + Ulysses all-to-all attention.
+
+No reference analogue — apex has no long-context attention sharding at all
+(SURVEY.md §5: "no ring attention, no context parallel, no Ulysses"; its
+nearest relative is conv spatial parallelism's halo exchange in
+apex/contrib/bottleneck (U)). Long context is first-class here, with both
+standard strategies over the ``cp`` mesh axis:
+
+- :func:`ring_attention` — K/V chunks rotate around the ICI ring
+  (``ppermute``); each rank folds one block per hop into flash-style
+  online-softmax state (fp32 running max / sum / accumulator). Exact: the
+  final normalisation equals attention over the full sequence. Backward is
+  the autodiff transpose — the ring rotates the other way. O(s_local²)
+  score blocks live only inside each (optionally rematted) hop.
+- :func:`ulysses_attention` — ``all_to_all`` reshards [seq-sharded, all
+  heads] ↔ [all seq, head-sharded], runs the Pallas flash kernel on full
+  sequences for the local heads, and reshards back. Two collectives per
+  call, best when heads ≥ cp size.
+
+Causal masking composes with the ring by chunk-index comparison: with
+equal-length chunks, a hop's K/V block is entirely before, entirely after,
+or diagonal-equal to the local Q chunk, so only the diagonal hop pays the
+triangular mask. (Zigzag chunk ordering to balance causal work across
+ranks is a documented extension, not implemented.)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.kernels import flash_attention
+from apex_tpu.mesh.collectives import all_to_all, ppermute_shift
+from apex_tpu.mesh.topology import AXIS_CP
+
+_NEG = -1e30
+
+
+def _block_attn(q, k, v, scale, mode, rank, step, cp):
+    """One ring hop: partial (unnormalised) attention of local Q against
+    the current K/V block. mode: 'full' | 'diag' (causal within chunk) |
+    'ring_causal' (allowed iff this block came from an earlier chunk).
+    Returns (m, l, acc) pieces in fp32."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if mode == "diag":
+        sq, sk = s.shape[-2], s.shape[-1]
+        tri = lax.broadcasted_iota(jnp.int32, (sq, sk), 0) >= (
+            lax.broadcasted_iota(jnp.int32, (sq, sk), 1))
+        s = jnp.where(tri, s, _NEG)
+    elif mode == "ring_causal":
+        # K/V block originated on rank (rank - step) mod cp; allowed only
+        # when that chunk index is smaller than ours (no wraparound)
+        allowed = rank >= step
+        s = jnp.where(allowed, s, _NEG)
+    m = jnp.max(s, axis=-1)  # [b,h,q]
+    p = jnp.exp(s - m[..., None])
+    # fully-masked rows: m = -1e30, p = 1 — zero them so they contribute 0
+    p = jnp.where(m[..., None] <= _NEG / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v).astype(
+        jnp.float32)
+    return m, l, acc
+
+
+def _merge(state, part):
+    m0, l0, a0 = state
+    m1, l1, a1 = part
+    m = jnp.maximum(m0, m1)
+    w0 = jnp.exp(m0 - m)
+    w1 = jnp.exp(m1 - m)
+    return m, l0 * w0 + l1 * w1, a0 * w0[..., None] + a1 * w1[..., None]
+
+
+def ring_attention(
+    q, k, v, *,
+    axis: str = AXIS_CP,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    remat: bool = True,
+):
+    """Exact attention with K/V ring-rotating over ``axis``.
+
+    ``q, k, v``: local chunks ``[b, h, s_local, d]``, the sequence dim
+    sharded contiguously over the cp axis (rank r holds positions
+    ``[r*s_local, (r+1)*s_local)``). Returns the local output chunk in
+    q's dtype. Call inside shard_map.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected [b, h, s_local, d], got {q.shape}")
+    cp = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    d = q.shape[-1]
+    sc = float(scale) if scale is not None else 1.0 / d ** 0.5
+
+    block = _block_attn
+    if remat:
+        block = jax.checkpoint(_block_attn, static_argnums=(4, 6))
+
+    mode0 = "diag" if causal else "full"
+    state = block(q, k, v, sc, mode0, rank, 0, cp)
+    kv = (k, v)
+    for step in range(1, cp):
+        kv = jax.tree.map(
+            functools.partial(ppermute_shift, axis=axis, shift=1, wrap=True),
+            kv)
+        mode = "ring_causal" if causal else "full"
+        part = block(q, kv[0], kv[1], sc, mode, rank, step, cp)
+        state = _merge(state, part)
+    m, l, acc = state
+    l = jnp.where(l == 0.0, 1.0, l)  # all-masked rows (shouldn't occur)
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def ulysses_attention(
+    q, k, v, *,
+    axis: str = AXIS_CP,
+    causal: bool = False,
+    scale: Optional[float] = None,
+):
+    """Exact attention via seq↔head all-to-all resharding.
+
+    ``q, k, v``: ``[b, h, s_local, d]`` with seq sharded over ``axis`` and
+    all heads present; internally ``[b, h/cp, s, d]`` runs the Pallas flash
+    kernel, then the layout reverts. ``h`` must divide by the axis size.
+    """
+    cp = lax.axis_size(axis)
+    if q.shape[1] % cp:
+        raise ValueError(
+            f"num heads {q.shape[1]} must divide by cp={cp} for Ulysses")
+
+    def fwd(x):  # [b, h, s_local, d] -> [b, h/cp, s, d]
+        return all_to_all(x, axis, split_axis=1, concat_axis=2)
+
+    def rev(x):
+        return all_to_all(x, axis, split_axis=2, concat_axis=1)
+
+    out = flash_attention(
+        fwd(q), fwd(k), fwd(v), causal=causal, scale=scale)
+    return rev(out)

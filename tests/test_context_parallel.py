@@ -1,0 +1,94 @@
+"""Ring / Ulysses context-parallel attention vs the full-sequence oracle.
+
+Oracle: the single-chunk Pallas flash kernel (itself tested against the
+jnp softmax reference) run on the unsharded sequence; both fwd outputs and
+input grads must match across cp shardings, causal and not.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import mesh as mx
+from apex_tpu.kernels import flash_attention
+from apex_tpu.transformer.context_parallel import (
+    ring_attention,
+    ulysses_attention,
+)
+
+B, H, S, D = 2, 4, 64, 16
+
+
+def _qkv(key):
+    ks = jax.random.split(key, 3)
+    return tuple(jax.random.normal(k, (B, H, S, D), jnp.float32) for k in ks)
+
+
+def smap(f, mesh, in_specs, out_specs):
+    return jax.jit(jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False))
+
+
+def _ref(q, k, v, causal):
+    def f(q, k, v):
+        return flash_attention(q, k, v, causal=causal)
+    out = f(q, k, v)
+    # grads of a fixed scalar functional for comparison
+    g = jax.grad(lambda q, k, v: jnp.sum(jnp.sin(f(q, k, v))), argnums=(0, 1, 2))(
+        q, k, v)
+    return out, g
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_cp_attention_matches_full(devices8, causal, impl):
+    mesh = mx.build_mesh(cp=4, devices=devices8[:4])
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    ref_out, ref_g = _ref(q, k, v, causal)
+
+    fn = ring_attention if impl == "ring" else ulysses_attention
+
+    def local(q, k, v):
+        return fn(q, k, v, causal=causal)
+
+    spec = P(None, None, "cp", None)  # shard seq dim
+    out = smap(local, mesh, (spec,) * 3, spec)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=2e-5, atol=2e-5)
+
+    def gfn(q, k, v):
+        # differentiate the LOCAL loss: cross-rank grad contributions for
+        # k/v arrive via the transposed ppermute/all_to_all, and the global
+        # loss is the (implicit) sum of local losses
+        return jax.grad(
+            lambda q, k, v: jnp.sum(jnp.sin(local(q, k, v))),
+            argnums=(0, 1, 2))(q, k, v)
+
+    g = smap(gfn, mesh, (spec,) * 3, (spec,) * 3)(q, k, v)
+    for a, b in zip(ref_g, g):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_ring_no_remat_matches(devices8):
+    mesh = mx.build_mesh(cp=4, devices=devices8[:4])
+    q, k, v = _qkv(jax.random.PRNGKey(1))
+    spec = P(None, None, "cp", None)
+    a = smap(lambda q, k, v: ring_attention(q, k, v, causal=True, remat=True),
+             mesh, (spec,) * 3, spec)(q, k, v)
+    b = smap(lambda q, k, v: ring_attention(q, k, v, causal=True, remat=False),
+             mesh, (spec,) * 3, spec)(q, k, v)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_ulysses_head_divisibility(devices8):
+    mesh = mx.build_mesh(cp=4, devices=devices8[:4])
+    q = jnp.zeros((1, 3, 16, 8))  # 3 heads, cp=4 → error
+
+    def f(q):
+        return ulysses_attention(q, q, q)
+
+    with pytest.raises(ValueError):
+        smap(f, mesh, P(None, None, "cp", None), P(None, None, "cp", None))(q)
