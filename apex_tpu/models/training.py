@@ -31,7 +31,7 @@ from apex_tpu.amp import update as scaler_update
 from apex_tpu.amp import value_and_scaled_grad
 from apex_tpu.mesh.topology import AXIS_DP, AXIS_PP, AXIS_TP, mesh_shape_of
 from apex_tpu.models import gpt
-from apex_tpu.optimizers import FusedOptimizer
+from apex_tpu.optimizers import DistributedFusedOptimizer, FusedOptimizer
 
 
 class TrainState(NamedTuple):
@@ -67,8 +67,18 @@ def _opt_state_specs(optimizer: FusedOptimizer, params, pspecs, mesh: Mesh):
             _local_shape(x.shape, s, sizes), x.dtype),
         params, pspecs,
     )
-    shapes = jax.eval_shape(optimizer.init, local)
-    buf_axes = tuple(a for a in (AXIS_PP, AXIS_TP) if a in mesh.axis_names)
+    # ZeRO-style optimizers shard their state over dp too; their init
+    # reads the dp size from the axis, which only exists inside shard_map,
+    # so the abstract evaluation passes it statically instead
+    zero_style = isinstance(optimizer, DistributedFusedOptimizer)
+    if zero_style:
+        dp = sizes.get(optimizer.axis, 1)
+        shapes = jax.eval_shape(lambda p: optimizer.init(p, dp=dp), local)
+    else:
+        shapes = jax.eval_shape(optimizer.init, local)
+    state_axes = (AXIS_DP, AXIS_PP, AXIS_TP) if zero_style else (
+        AXIS_PP, AXIS_TP)
+    buf_axes = tuple(a for a in state_axes if a in mesh.axis_names)
     buf_spec = P(buf_axes) if buf_axes else P()
     return jax.tree.map(
         lambda x: P() if x.ndim == 0 else buf_spec, shapes)
@@ -179,8 +189,10 @@ def make_train_step(
             lambda p: _local_loss(p, tokens, targets), scaler_cfg)
         value, grads, finite = vag(params, scaler_state=state.scaler)
 
-        # DP gradient averaging (apex DDP allreduce + 1/world_size (U))
-        if AXIS_DP in axes_present:
+        # DP gradient averaging (apex DDP allreduce + 1/world_size (U));
+        # ZeRO optimizers own the dp reduction (reduce-scatter inside step)
+        if AXIS_DP in axes_present and not isinstance(
+                optimizer, DistributedFusedOptimizer):
             grads = lax.pmean(grads, AXIS_DP)
         if cfg.sequence_parallel:
             grads = jax.tree.map(

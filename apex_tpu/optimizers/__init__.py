@@ -6,6 +6,11 @@ shape). ``grad_scale`` folds amp's unscale into the sweep.
 """
 
 from apex_tpu.optimizers._base import FusedOptimizer
+from apex_tpu.optimizers.distributed import (
+    DistributedFusedOptimizer,
+    distributed_fused_adam,
+    distributed_fused_lamb,
+)
 from apex_tpu.optimizers.fused_adam import FusedAdamState, fused_adam
 from apex_tpu.optimizers.fused_adagrad import FusedAdagradState, fused_adagrad
 from apex_tpu.optimizers.fused_lamb import FusedLAMBState, fused_lamb
@@ -14,6 +19,8 @@ from apex_tpu.optimizers.fused_sgd import FusedSGDState, fused_sgd
 from apex_tpu.optimizers.larc import larc_transform
 
 # apex class-name aliases
+DistributedFusedAdam = distributed_fused_adam
+DistributedFusedLAMB = distributed_fused_lamb
 FusedAdam = fused_adam
 FusedLAMB = fused_lamb
 FusedSGD = fused_sgd
@@ -22,6 +29,9 @@ FusedAdagrad = fused_adagrad
 
 __all__ = [
     "FusedOptimizer",
+    "DistributedFusedOptimizer",
+    "distributed_fused_adam", "DistributedFusedAdam",
+    "distributed_fused_lamb", "DistributedFusedLAMB",
     "fused_adam", "FusedAdam", "FusedAdamState",
     "fused_lamb", "FusedLAMB", "FusedLAMBState",
     "fused_sgd", "FusedSGD", "FusedSGDState",

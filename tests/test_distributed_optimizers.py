@@ -1,0 +1,109 @@
+"""ZeRO-sharded optimizers vs their replicated references.
+
+Parity model: apex/contrib/test/ distributed Adam/LAMB tests (U) — the
+sharded optimizer must produce the same updated params as the unsharded
+one given identical gradients, while holding only 1/dp of the moments.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import mesh as mx
+from apex_tpu import multi_tensor as mt
+from apex_tpu.amp import ScalerConfig
+from apex_tpu.models import gpt, training
+from apex_tpu.optimizers import (
+    distributed_fused_adam,
+    distributed_fused_lamb,
+    fused_adam,
+    fused_lamb,
+)
+
+
+def _tree(key):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "a": jax.random.normal(k1, (37, 5)),
+        "b": jax.random.normal(k2, (130,)),
+        "c": {"w": jax.random.normal(k3, (8, 8, 3))},
+    }
+
+
+def smap(f, mesh, in_specs, out_specs):
+    return jax.jit(jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False))
+
+
+def _run_steps(opt, dist, mesh, params, grads, n=3):
+    """Run n identical-gradient steps; return final params from each."""
+
+    def ref_fn(p, g):
+        st = opt.init(p)
+        for _ in range(n):
+            p, st = opt.step(g, st, p)
+        return p
+
+    def dist_fn(p, g):
+        st = dist.init(p)
+        for _ in range(n):
+            p, st = dist.step(g, st, p)
+        return p
+
+    specs = jax.tree.map(lambda _: P(), params)
+    ref = smap(ref_fn, mesh, (specs, specs), specs)(params, grads)
+    out = smap(dist_fn, mesh, (specs, specs), specs)(params, grads)
+    return jax.device_get(ref), jax.device_get(out)
+
+
+def test_distributed_adam_matches_fused_adam(devices8):
+    mesh = mx.build_mesh(tp=1, devices=devices8[:4])  # dp=4
+    params = _tree(jax.random.PRNGKey(0))
+    grads = _tree(jax.random.PRNGKey(1))
+    ref, out = _run_steps(
+        fused_adam(1e-2, weight_decay=0.01),
+        distributed_fused_adam(1e-2, weight_decay=0.01),
+        mesh, params, grads)
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(out)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+def test_distributed_lamb_matches_fused_lamb(devices8):
+    mesh = mx.build_mesh(tp=1, devices=devices8[:4])
+    params = _tree(jax.random.PRNGKey(2))
+    grads = _tree(jax.random.PRNGKey(3))
+    ref, out = _run_steps(
+        fused_lamb(1e-2), distributed_fused_lamb(1e-2), mesh, params, grads)
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(out)):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
+def test_sharded_state_is_one_over_dp(devices8):
+    mesh = mx.build_mesh(tp=1, devices=devices8)  # dp=8
+    params = _tree(jax.random.PRNGKey(0))
+    dist = distributed_fused_adam(1e-3)
+    _, layout = mt.pack(params)
+    st_shapes = jax.eval_shape(lambda p: dist.init(p, dp=8), params)
+    for m, full in zip(st_shapes.m, layout.group_sizes):
+        assert m.shape[0] == mt.pad_to((full + 7) // 8, 128)
+        assert m.shape[0] < full
+
+
+def test_zero_train_step_end_to_end(devices8):
+    """GPT + ZeRO Adam over tp=2 x dp=4: loss decreases, scaler engaged."""
+    cfg = gpt.GPTConfig(vocab_size=96, hidden_size=64, num_layers=2,
+                        num_heads=4, seq_len=32, compute_dtype=jnp.float32)
+    mesh = mx.build_mesh(tp=2, devices=devices8)
+    init_fn, step_fn = training.make_train_step(
+        cfg, mesh, distributed_fused_adam(1e-2),
+        ScalerConfig(enabled=False))
+    state = init_fn(jax.random.PRNGKey(0))
+    tok = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 96)
+    tgt = jnp.roll(tok, -1, 1)
+    losses = []
+    for _ in range(5):
+        state, m = step_fn(state, tok, tgt)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+    assert all(np.isfinite(losses))
