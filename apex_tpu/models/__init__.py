@@ -11,6 +11,6 @@ benchmark/ example trainers are self-contained:
   DP/TP/SP grad sync into one compiled program.
 """
 
-from apex_tpu.models import gpt, training
+from apex_tpu.models import bert, gpt, resnet, training
 
-__all__ = ["gpt", "training"]
+__all__ = ["bert", "gpt", "resnet", "training"]

@@ -1,0 +1,160 @@
+"""BERT encoder + MLM head — BASELINE config #2 (BERT-large pretraining
+with FusedLAMB + FusedLayerNorm under amp O2).
+
+Reuses the tensor/sequence-parallel transformer stack from
+:mod:`apex_tpu.models.gpt` with bidirectional attention (``causal=False``),
+adding BERT's embedding pipeline (word + position + token-type, then
+LayerNorm) and the tied masked-LM head. The loss is vocab-parallel CE
+weighted by the MLM mask — the fmha/BERT path the reference optimises
+(apex/contrib/fmha targets BERT seqlens (U)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.kernels import layer_norm
+from apex_tpu.models import gpt
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import init_method_normal
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+)
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30528
+    hidden_size: int = 1024   # BERT-large
+    num_layers: int = 24
+    num_heads: int = 16
+    seq_len: int = 512
+    type_vocab_size: int = 2
+    sequence_parallel: bool = False
+    remat: bool = True
+    compute_dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    layernorm_epsilon: float = 1e-12  # BERT convention
+    init_std: float = 0.02
+    axis: str = "tp"
+
+    def core(self) -> gpt.GPTConfig:
+        return gpt.GPTConfig(
+            vocab_size=self.vocab_size, hidden_size=self.hidden_size,
+            num_layers=self.num_layers, num_heads=self.num_heads,
+            seq_len=self.seq_len, sequence_parallel=self.sequence_parallel,
+            remat=self.remat, compute_dtype=self.compute_dtype,
+            param_dtype=self.param_dtype,
+            layernorm_epsilon=self.layernorm_epsilon,
+            init_std=self.init_std, axis=self.axis, causal=False)
+
+
+def init(cfg: BertConfig, key) -> Any:
+    k_core, k_tt, k_head = jax.random.split(key, 3)
+    core = gpt.init(cfg.core(), k_core)
+    h = cfg.hidden_size
+    dt = cfg.param_dtype
+    emb_init = init_method_normal(cfg.init_std)
+    core["embedding"]["token_type"] = emb_init(
+        k_tt, (cfg.type_vocab_size, h), dt)
+    core["embedding"]["ln"] = {"scale": jnp.ones((h,), dt),
+                               "bias": jnp.zeros((h,), dt)}
+    core["mlm_head"] = {
+        "dense": {"kernel": emb_init(k_head, (h, h), dt),
+                  "bias": jnp.zeros((h,), dt)},
+        "ln": {"scale": jnp.ones((h,), dt), "bias": jnp.zeros((h,), dt)},
+        # decoder is tied to the word embedding; per-vocab bias is sharded
+        "bias": jnp.zeros((cfg.vocab_size,), dt),
+    }
+    return core
+
+
+def param_specs(cfg: BertConfig) -> Any:
+    specs = gpt.param_specs(cfg.core())
+    specs["embedding"]["token_type"] = P(None, None)
+    specs["embedding"]["ln"] = {"scale": P(None), "bias": P(None)}
+    specs["mlm_head"] = {
+        "dense": {"kernel": P(None, None), "bias": P(None)},
+        "ln": {"scale": P(None), "bias": P(None)},
+        "bias": P(cfg.axis),
+    }
+    return specs
+
+
+def _embed(cfg: BertConfig, params, tokens, token_type_ids):
+    core = cfg.core()
+    h = gpt._embed(core, params, tokens)  # [s(_local), b, h] post-scatter
+    # token-type + embedding LN ride on top; under SP they apply to the
+    # seq-sharded activations (type embedding is position-independent)
+    tt = jnp.take(params["embedding"]["token_type"], token_type_ids, axis=0)
+    tt = jnp.transpose(tt, (1, 0, 2)).astype(cfg.compute_dtype)
+    if cfg.sequence_parallel:
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            scatter_to_sequence_parallel_region,
+        )
+        tt = scatter_to_sequence_parallel_region(tt, cfg.axis)
+    h = h + tt
+    return layer_norm(h, params["embedding"]["ln"]["scale"],
+                      params["embedding"]["ln"]["bias"],
+                      eps=cfg.layernorm_epsilon)
+
+
+def hidden_states(cfg: BertConfig, params, tokens, token_type_ids=None):
+    """[b, s] ids → [s(_local), b, h] final hidden (post final-LN)."""
+    from jax import lax as _lax
+
+    core = cfg.core()
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros_like(tokens)
+    h = _embed(cfg, params, tokens, token_type_ids)
+
+    def body(carry, layer_p):
+        return gpt._block(core, gpt._cast_layer(core, layer_p), carry), None
+
+    if cfg.remat:
+        from apex_tpu.transformer.tensor_parallel import random as tpr
+        body = tpr.checkpoint(body)
+    h, _ = _lax.scan(body, h, params["layers"])
+    return layer_norm(h, params["final_ln"]["scale"],
+                      params["final_ln"]["bias"],
+                      eps=cfg.layernorm_epsilon)
+
+
+def mlm_logits(cfg: BertConfig, params, tokens, token_type_ids=None):
+    """Vocab-sharded MLM logits [s, b, vocab/tp]."""
+    h = hidden_states(cfg, params, tokens, token_type_ids)
+    if cfg.sequence_parallel:
+        h = gather_from_sequence_parallel_region(h, cfg.axis, True)
+    else:
+        h = copy_to_tensor_model_parallel_region(h, cfg.axis)
+    head = params["mlm_head"]
+    h = jnp.matmul(h, head["dense"]["kernel"].astype(cfg.compute_dtype))
+    h = h + head["dense"]["bias"].astype(cfg.compute_dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    h = layer_norm(h, head["ln"]["scale"], head["ln"]["bias"],
+                   eps=cfg.layernorm_epsilon)
+    table = params["embedding"]["word"]["table"].astype(cfg.compute_dtype)
+    lg = jnp.einsum("sbh,vh->sbv", h, table)
+    return lg + head["bias"].astype(cfg.compute_dtype)
+
+
+def mlm_loss(cfg: BertConfig, params, tokens, targets, mlm_mask,
+             token_type_ids=None):
+    """Masked-LM loss: mean CE over positions where ``mlm_mask`` is 1.
+
+    ``tokens``/``targets``/``mlm_mask``: [b, s]; targets hold original ids
+    at masked positions (ignored elsewhere).
+    """
+    lg = mlm_logits(cfg, params, tokens, token_type_ids).astype(jnp.float32)
+    per_tok = vocab_parallel_cross_entropy(
+        lg, jnp.transpose(targets, (1, 0)), 0.0, cfg.axis)
+    w = jnp.transpose(mlm_mask, (1, 0)).astype(jnp.float32)
+    return jnp.sum(per_tok * w) / jnp.maximum(jnp.sum(w), 1.0)

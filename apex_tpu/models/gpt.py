@@ -68,6 +68,8 @@ class GPTConfig:
     ffn_hidden_size: Optional[int] = None  # default 4 * hidden
     sequence_parallel: bool = False
     remat: bool = True
+    #: False → bidirectional attention (the BERT encoder reuses this stack)
+    causal: bool = True
     compute_dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     layernorm_epsilon: float = 1e-5
@@ -214,7 +216,7 @@ def _attention(cfg: GPTConfig, p, h):
     qkv = qkv.reshape(s, b, heads_local, 3, d)
     # [b, heads_local, s, d] each
     q, k, v = (jnp.transpose(qkv[:, :, :, i, :], (1, 2, 0, 3)) for i in range(3))
-    out = flash_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=cfg.causal)
     out = jnp.transpose(out, (2, 0, 1, 3)).reshape(s, b, heads_local * d)
     return row_parallel_linear(
         out, p["proj"]["kernel"], p["proj"]["bias"], axis=cfg.axis,

@@ -1,0 +1,171 @@
+"""ResNet-50 (NHWC) with SyncBatchNorm — BASELINE configs #1/#3.
+
+The reference exercises this model via examples/imagenet/main_amp.py (U)
+(torchvision resnet50 + amp O1 + apex DDP) and the RetinaNet config
+(SyncBatchNorm + FusedSGD). Functional NHWC implementation: params and
+BatchNorm running-stats are separate pytrees (stats are *state*, not
+weights — apex mutates buffers in place; here they are carried), and every
+BN can reduce its batch moments over the dp axis via
+:mod:`apex_tpu.parallel.sync_batchnorm`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.mesh.topology import AXIS_DP
+from apex_tpu.parallel.sync_batchnorm import sync_batch_norm
+
+#: depth 26 = one bottleneck per stage — the smallest member of the
+#: family, used by the CPU test backbone where ResNet-50 compiles slowly
+_STAGES = {26: (1, 1, 1, 1), 50: (3, 4, 6, 3), 101: (3, 4, 23, 3),
+           152: (3, 8, 36, 3)}
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    depth: int = 50
+    num_classes: int = 1000
+    width: int = 64
+    #: mesh axis for cross-replica BN stats; None = local BN (apex DDP
+    #: without convert_syncbn_model)
+    bn_axis: Optional[str] = None
+    compute_dtype: Any = jnp.bfloat16
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-5
+
+    @property
+    def stages(self):
+        if self.depth not in _STAGES:
+            raise ValueError(f"unsupported depth {self.depth}")
+        return _STAGES[self.depth]
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    std = (2.0 / fan_in) ** 0.5
+    return std * jax.random.normal(key, (kh, kw, cin, cout), jnp.float32)
+
+
+def _bn_init(c):
+    return ({"scale": jnp.ones((c,), jnp.float32),
+             "bias": jnp.zeros((c,), jnp.float32)},
+            {"mean": jnp.zeros((c,), jnp.float32),
+             "var": jnp.ones((c,), jnp.float32)})
+
+
+def _bottleneck_init(key, cin, planes, stride):
+    cout = planes * 4
+    ks = jax.random.split(key, 4)
+    p, s = {}, {}
+    p["conv1"] = _conv_init(ks[0], 1, 1, cin, planes)
+    p["bn1"], s["bn1"] = _bn_init(planes)
+    p["conv2"] = _conv_init(ks[1], 3, 3, planes, planes)
+    p["bn2"], s["bn2"] = _bn_init(planes)
+    p["conv3"] = _conv_init(ks[2], 1, 1, planes, cout)
+    p["bn3"], s["bn3"] = _bn_init(cout)
+    if stride != 1 or cin != cout:
+        p["downsample"] = _conv_init(ks[3], 1, 1, cin, cout)
+        p["bn_ds"], s["bn_ds"] = _bn_init(cout)
+    return p, s
+
+
+def init(cfg: ResNetConfig, key) -> Tuple[Any, Any]:
+    """Returns (params, bn_state)."""
+    keys = jax.random.split(key, 2 + sum(cfg.stages))
+    p: Any = {"stem": _conv_init(keys[0], 7, 7, 3, cfg.width)}
+    s: Any = {}
+    p["bn_stem"], s["bn_stem"] = _bn_init(cfg.width)
+    cin = cfg.width
+    ki = 1
+    for si, (n_blocks, planes) in enumerate(
+            zip(cfg.stages, (64, 128, 256, 512))):
+        blocks_p, blocks_s = [], []
+        for b in range(n_blocks):
+            stride = 2 if (b == 0 and si > 0) else 1
+            bp, bs = _bottleneck_init(keys[ki], cin, planes, stride)
+            ki += 1
+            blocks_p.append(bp)
+            blocks_s.append(bs)
+            cin = planes * 4
+        p[f"layer{si + 1}"] = blocks_p
+        s[f"layer{si + 1}"] = blocks_s
+    p["fc"] = {
+        "kernel": 0.01 * jax.random.normal(
+            keys[ki], (cin, cfg.num_classes), jnp.float32),
+        "bias": jnp.zeros((cfg.num_classes,), jnp.float32),
+    }
+    return p, s
+
+
+def _conv(x, w, stride=1):
+    return lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _bn(cfg: ResNetConfig, x, p, st, training):
+    y, rm, rv = sync_batch_norm(
+        x, p["scale"], p["bias"], st["mean"], st["var"],
+        axis=cfg.bn_axis, momentum=cfg.bn_momentum, eps=cfg.bn_eps,
+        training=training, channel_axis=-1)
+    new_st = {"mean": rm, "var": rv} if training else st
+    return y, new_st
+
+
+def _bottleneck(cfg, x, p, st, stride, training):
+    ns = {}
+    y = _conv(x, p["conv1"])
+    y, ns["bn1"] = _bn(cfg, y, p["bn1"], st["bn1"], training)
+    y = jax.nn.relu(y)
+    y = _conv(y, p["conv2"], stride)
+    y, ns["bn2"] = _bn(cfg, y, p["bn2"], st["bn2"], training)
+    y = jax.nn.relu(y)
+    y = _conv(y, p["conv3"])
+    y, ns["bn3"] = _bn(cfg, y, p["bn3"], st["bn3"], training)
+    if "downsample" in p:
+        sc = _conv(x, p["downsample"], stride)
+        sc, ns["bn_ds"] = _bn(cfg, sc, p["bn_ds"], st["bn_ds"], training)
+    else:
+        sc = x
+    return jax.nn.relu(y + sc), ns
+
+
+def forward(cfg: ResNetConfig, params, state, x, *, training: bool = True):
+    """x [N, H, W, 3] → (logits [N, classes] fp32, new_bn_state)."""
+    x = x.astype(cfg.compute_dtype)
+    ns: Any = {}
+    y = _conv(x, params["stem"], 2)
+    y, ns["bn_stem"] = _bn(cfg, y, params["bn_stem"], state["bn_stem"],
+                           training)
+    y = jax.nn.relu(y)
+    y = lax.reduce_window(
+        y, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+        [(0, 0), (1, 1), (1, 1), (0, 0)])
+    for si, n_blocks in enumerate(cfg.stages):
+        layer_p = params[f"layer{si + 1}"]
+        layer_s = state[f"layer{si + 1}"]
+        new_blocks = []
+        for b in range(n_blocks):
+            stride = 2 if (b == 0 and si > 0) else 1
+            y, bs = _bottleneck(cfg, y, layer_p[b], layer_s[b], stride,
+                                training)
+            new_blocks.append(bs)
+        ns[f"layer{si + 1}"] = new_blocks
+    y = jnp.mean(y.astype(jnp.float32), axis=(1, 2))
+    logits = y @ params["fc"]["kernel"] + params["fc"]["bias"]
+    return logits, ns
+
+
+def loss(cfg: ResNetConfig, params, state, images, labels, *,
+         training: bool = True):
+    """Mean softmax CE; returns (loss, new_bn_state)."""
+    logits, ns = forward(cfg, params, state, images, training=training)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+    return jnp.mean(nll), ns
