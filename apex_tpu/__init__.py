@@ -37,7 +37,12 @@ __all__ = [
     "parallel",
     "transformer",
     "contrib",
+    "checkpoint",
     "fp16_utils",
+    "mlp",
+    "fused_dense",
+    "rnn",
+    "reparameterization",
     "models",
     "testing",
     "__version__",

@@ -1,0 +1,163 @@
+"""fp16_utils — the legacy pre-amp manual mixed-precision API.
+
+TPU-native re-design of apex/fp16_utils/{fp16util,fp16_optimizer,
+loss_scaler}.py (U). The reference mutates modules in place (``model.half()``
+keeping BatchNorm fp32) and wraps optimizers in ``FP16_Optimizer`` with
+fp32 master copies. Functionally that is three pytree transforms plus the
+scaler already in :mod:`apex_tpu.amp`:
+
+- :func:`network_to_half` / :func:`bn_convert_float` — dtype casts with a
+  keep-fp32 predicate (norm layers, by key name);
+- :func:`prep_param_lists` / master↔model sync helpers — fp32 master
+  copies of half params and the grad/param movement between them;
+- :class:`FP16Optimizer` — wraps any :class:`~apex_tpu.optimizers.
+  FusedOptimizer`: keeps fp32 masters, updates them from fp16 grads with
+  loss-scale unscaling fused into the sweep, and emits half model params.
+
+``LossScaler`` / ``DynamicLossScaler`` are re-exported from amp (one
+scaler implementation serves both eras — apex kept two).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import ScalerConfig, ScalerState
+from apex_tpu.amp import update as _scaler_update
+from apex_tpu.amp.scaler import all_finite, apply_if_finite
+from apex_tpu.optimizers import FusedOptimizer
+
+__all__ = [
+    "network_to_half", "bn_convert_float", "prep_param_lists",
+    "master_params_to_model_params", "model_grads_to_master_grads",
+    "FP16Optimizer", "FP16OptimizerState", "LossScaler", "DynamicLossScaler",
+]
+
+_NORM_KEY_HINTS = ("bn", "batchnorm", "batch_norm", "ln", "layernorm",
+                   "layer_norm", "norm")
+
+
+def _default_keep_fp32(path) -> bool:
+    """Key-name heuristic for norm-layer params — the structural analogue
+    of apex's isinstance(module, _BatchNorm) walk (U)."""
+    names = [str(getattr(p, "key", getattr(p, "name", p))).lower()
+             for p in path]
+    return any(h in n for n in names for h in _NORM_KEY_HINTS)
+
+
+def network_to_half(params, half_dtype=jnp.bfloat16,
+                    keep_fp32: Optional[Callable] = _default_keep_fp32):
+    """Cast floating params to half, keeping norm-layer params fp32
+    (``network_to_half`` + ``BN_convert_float`` (U))."""
+
+    def cast(path, x):
+        if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            return x
+        if keep_fp32 is not None and keep_fp32(path):
+            return jnp.asarray(x, jnp.float32)
+        return jnp.asarray(x, half_dtype)
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def bn_convert_float(params):
+    """Force norm-hinted params back to fp32 (``BN_convert_float`` (U))."""
+
+    def cast(path, x):
+        if _default_keep_fp32(path) and jnp.issubdtype(
+                jnp.asarray(x).dtype, jnp.floating):
+            return jnp.asarray(x, jnp.float32)
+        return x
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def prep_param_lists(model_params):
+    """(model_params, fp32 master copies) — ``prep_param_lists`` (U)."""
+    masters = jax.tree.map(
+        lambda x: jnp.asarray(x, jnp.float32)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+        model_params)
+    return model_params, masters
+
+
+def master_params_to_model_params(model_params, master_params):
+    """Copy fp32 masters back into the model's dtypes (U)."""
+    return jax.tree.map(
+        lambda mod, mas: jnp.asarray(mas, jnp.asarray(mod).dtype),
+        model_params, master_params)
+
+
+def model_grads_to_master_grads(model_grads):
+    """Model-dtype grads → fp32 master grads (U)."""
+    return jax.tree.map(
+        lambda g: jnp.asarray(g, jnp.float32)
+        if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating) else g,
+        model_grads)
+
+
+class FP16OptimizerState(NamedTuple):
+    master_params: Any
+    inner: Any
+    scaler: ScalerState
+
+
+class FP16Optimizer:
+    """``FP16_Optimizer`` (U) as a pure wrapper.
+
+    ``step(state, model_params, model_grads) -> (new_model_params, state)``:
+    unscales fp16 grads into fp32 (fused into the optimizer sweep via
+    ``grad_scale``), steps the masters, skips on overflow, updates the
+    scaler, and returns freshly-halved model params.
+    """
+
+    def __init__(self, optimizer: FusedOptimizer,
+                 scaler: Optional[ScalerConfig] = None):
+        self.optimizer = optimizer
+        self.scaler = scaler or ScalerConfig()
+
+    def init(self, model_params) -> FP16OptimizerState:
+        _, masters = prep_param_lists(model_params)
+        return FP16OptimizerState(
+            master_params=masters,
+            inner=self.optimizer.init(masters),
+            scaler=self.scaler.init(),
+        )
+
+    def step(self, state: FP16OptimizerState, model_params, model_grads):
+        grads = model_grads_to_master_grads(model_grads)
+        finite = all_finite(grads)
+        inv_scale = 1.0 / state.scaler.loss_scale
+        new_masters, new_inner = self.optimizer.step(
+            grads, state.inner, state.master_params, grad_scale=inv_scale)
+        new_masters = apply_if_finite(new_masters, state.master_params, finite)
+        new_inner = apply_if_finite(new_inner, state.inner, finite)
+        new_scaler = _scaler_update(self.scaler, state.scaler, finite)
+        new_model = master_params_to_model_params(model_params, new_masters)
+        new_model = apply_if_finite(new_model, model_params, finite)
+        return new_model, FP16OptimizerState(new_masters, new_inner,
+                                             new_scaler)
+
+    @staticmethod
+    def scale_loss(loss, state: FP16OptimizerState):
+        """loss * scale — the ``optimizer.backward(loss)`` hook (U)."""
+        return jnp.asarray(loss, jnp.float32) * state.scaler.loss_scale
+
+
+def LossScaler(scale: float = 2.0 ** 16) -> ScalerConfig:
+    """Static scaler (``LossScaler`` (U))."""
+    return ScalerConfig(init_scale=scale, growth_factor=1.0,
+                        backoff_factor=1.0, min_scale=scale, max_scale=scale)
+
+
+def DynamicLossScaler(init_scale: float = 2.0 ** 16,
+                      scale_factor: float = 2.0,
+                      scale_window: int = 1000) -> ScalerConfig:
+    """Dynamic scaler (``DynamicLossScaler`` (U) — note its default window
+    is 1000 vs amp's 2000)."""
+    return ScalerConfig(init_scale=init_scale, growth_factor=scale_factor,
+                        backoff_factor=1.0 / scale_factor,
+                        growth_interval=scale_window)

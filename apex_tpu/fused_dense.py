@@ -1,0 +1,76 @@
+"""FusedDense / FusedDenseGeluDense — apex/fused_dense/fused_dense.py (U)
+over csrc/fused_dense_cuda.cu (U).
+
+GEMM+bias (and GEMM+bias+GELU+GEMM+bias) as single fused calls. As with
+:mod:`apex_tpu.mlp`, XLA performs the epilogue fusion the CUDA code does by
+hand, so these are API-parity modules over the jnp chain.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+def fused_dense(x, kernel, bias=None):
+    """y = x @ kernel + bias (``fused_dense_function`` (U))."""
+    y = jnp.matmul(x, kernel)
+    return y if bias is None else y + bias
+
+
+def fused_dense_gelu_dense(x, kernel1, bias1, kernel2, bias2):
+    """x @ W1 + b1 → gelu → @ W2 + b2 (``FusedDenseGeluDense`` (U))."""
+    h = jax.nn.gelu(fused_dense(x, kernel1, bias1), approximate=True)
+    return fused_dense(h, kernel2, bias2)
+
+
+def _linear_init(key, fan_in, fan_out, dtype):
+    bound = 1.0 / fan_in ** 0.5
+    return jax.random.uniform(key, (fan_in, fan_out), dtype, -bound, bound)
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedDense:
+    in_features: int
+    out_features: int
+    bias: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    def init(self, key):
+        p = {"kernel": _linear_init(
+            key, self.in_features, self.out_features, self.param_dtype)}
+        if self.bias:
+            p["bias"] = jnp.zeros((self.out_features,), self.param_dtype)
+        return p
+
+    def apply(self, params, x):
+        return fused_dense(x, params["kernel"], params.get("bias"))
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedDenseGeluDense:
+    in_features: int
+    intermediate_features: int
+    out_features: int
+    param_dtype: jnp.dtype = jnp.float32
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        return {
+            "fc1": {"kernel": _linear_init(
+                k1, self.in_features, self.intermediate_features,
+                self.param_dtype),
+                "bias": jnp.zeros((self.intermediate_features,),
+                                  self.param_dtype)},
+            "fc2": {"kernel": _linear_init(
+                k2, self.intermediate_features, self.out_features,
+                self.param_dtype),
+                "bias": jnp.zeros((self.out_features,), self.param_dtype)},
+        }
+
+    def apply(self, params, x):
+        return fused_dense_gelu_dense(
+            x, params["fc1"]["kernel"], params["fc1"]["bias"],
+            params["fc2"]["kernel"], params["fc2"]["bias"])

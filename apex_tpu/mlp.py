@@ -1,0 +1,77 @@
+"""Fused MLP — apex/mlp/mlp.py (U) over csrc/mlp_cuda.cu (U).
+
+Apex's ``MLP`` chains GEMM+bias+activation through one cuBLASLt-epilogue
+CUDA call to dodge kernel-launch and memory-roundtrip overhead. Under XLA
+the equivalent fusion is automatic: bias add and activation fuse into the
+matmul's epilogue during compilation, and there are no launches to
+amortise — so the TPU-native "fused MLP" is the straight-line jnp chain,
+kept as an API-parity module (same constructor surface: layer sizes, bias
+flag, activation choice). bf16 inputs hit the MXU with fp32 accumulation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+_ACTIVATIONS = {
+    "none": lambda x: x,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "gelu": lambda x: jax.nn.gelu(x, approximate=True),
+}
+
+
+def mlp(x, params, *, activation: str = "relu", final_activation: bool = False):
+    """Apply the layer chain; ``params`` is a list of {kernel[, bias]}.
+
+    Activation after every layer except (by default) the last — apex's MLP
+    applies ReLU between layers only (U).
+    """
+    act = _ACTIVATIONS[activation]
+    n = len(params)
+    for i, p in enumerate(params):
+        x = jnp.matmul(x, p["kernel"])
+        if "bias" in p:
+            x = x + p["bias"]
+        if i < n - 1 or final_activation:
+            x = act(x)
+    return x
+
+
+@dataclasses.dataclass(frozen=True)
+class MLP:
+    """apex.mlp.MLP (U): ``MLP(mlp_sizes, bias=True, activation='relu')``."""
+
+    sizes: Sequence[int]  # [in, hidden..., out]
+    bias: bool = True
+    activation: str = "relu"
+    param_dtype: jnp.dtype = jnp.float32
+
+    def __post_init__(self):
+        if len(self.sizes) < 2:
+            raise ValueError("MLP needs at least [in, out] sizes")
+        if self.activation not in _ACTIVATIONS:
+            raise ValueError(f"unknown activation {self.activation!r}")
+
+    def init(self, key):
+        params = []
+        keys = jax.random.split(key, len(self.sizes) - 1)
+        for k, fan_in, fan_out in zip(keys, self.sizes[:-1], self.sizes[1:]):
+            # apex uses kaiming-uniform-style init from nn.Linear defaults
+            bound = 1.0 / fan_in ** 0.5
+            layer = {
+                "kernel": jax.random.uniform(
+                    k, (fan_in, fan_out), self.param_dtype, -bound, bound)
+            }
+            if self.bias:
+                layer["bias"] = jnp.zeros((fan_out,), self.param_dtype)
+            params.append(layer)
+        return params
+
+    def apply(self, params, x):
+        return mlp(x, params, activation=self.activation)

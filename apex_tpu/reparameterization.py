@@ -1,0 +1,59 @@
+"""Weight-norm reparameterization — apex/reparameterization/{weight_norm,
+reparameterization}.py (U).
+
+The reference monkey-patches modules to store (g, v) and rebuild
+``w = g * v / ||v||`` pre-forward with fused norm kernels. Functionally:
+params hold ``{"g": ..., "v": ...}`` and :func:`materialize` rebuilds the
+dense weights (everything else — fusion, recompute — is XLA's problem).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def weight_norm_init(w, *, dim: int = 0):
+    """Split a weight into (g, v): g = ||w|| over all dims but ``dim``."""
+    w = jnp.asarray(w)
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    g = jnp.sqrt(jnp.sum(w.astype(jnp.float32) ** 2, axis=axes,
+                         keepdims=True))
+    return {"g": g.astype(w.dtype), "v": w}
+
+
+def weight_norm_apply(p, *, dim: int = 0, eps: float = 1e-12):
+    """w = g * v / ||v|| (``get_weight`` in the reference (U))."""
+    v = jnp.asarray(p["v"], jnp.float32)
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    norm = jnp.sqrt(jnp.sum(v ** 2, axis=axes, keepdims=True))
+    w = jnp.asarray(p["g"], jnp.float32) * v / (norm + eps)
+    return w.astype(p["v"].dtype)
+
+
+def apply_weight_norm(params: Any, *, dim: int = 0) -> Any:
+    """Reparameterize every leaf named 'kernel'/'w*' ≥2-D into (g, v) —
+    the structural analogue of the module walk in ``apply_weight_norm``
+    (U)."""
+
+    def walk(path, x):
+        x = jnp.asarray(x)
+        name = str(getattr(path[-1], "key", "")) if path else ""
+        if x.ndim >= 2 and name in ("kernel", "weight", "w", "wi", "wh"):
+            return weight_norm_init(x, dim=dim)
+        return x
+
+    return jax.tree_util.tree_map_with_path(walk, params)
+
+
+def remove_weight_norm(params: Any, *, dim: int = 0) -> Any:
+    """Collapse (g, v) leaves back into dense weights."""
+
+    def is_wn(x):
+        return isinstance(x, dict) and set(x) == {"g", "v"}
+
+    return jax.tree.map(
+        lambda x: weight_norm_apply(x, dim=dim) if is_wn(x) else x,
+        params, is_leaf=lambda x: is_wn(x) or not isinstance(x, (dict, list)))

@@ -1,0 +1,88 @@
+"""RNN cells — apex/RNN/{models,cells,RNNBackend}.py (U) (deprecated
+upstream, kept for surface parity).
+
+Fused LSTM/GRU cells: the reference fuses the gate math into single CUDA
+kernels; on TPU the gate GEMMs are one fused [4h]/[3h] matmul and XLA
+fuses the elementwise gate chain. Layers run under ``lax.scan`` (the
+compiled analogue of the reference's Python time loop).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def lstm_cell(x, h, c, wi, wh, b=None):
+    """One LSTM step: gates from one fused [.., 4h] GEMM pair.
+
+    Gate order (i, f, g, o) — torch convention the reference follows.
+    """
+    z = x @ wi + h @ wh
+    if b is not None:
+        z = z + b
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def gru_cell(x, h, wi, wh, b=None):
+    """One GRU step (torch gate order r, z, n)."""
+    zi = x @ wi
+    zh = h @ wh
+    if b is not None:
+        zi = zi + b
+    ri, zi_g, ni = jnp.split(zi, 3, axis=-1)
+    rh, zh_g, nh = jnp.split(zh, 3, axis=-1)
+    r = jax.nn.sigmoid(ri + rh)
+    z = jax.nn.sigmoid(zi_g + zh_g)
+    n = jnp.tanh(ni + r * nh)
+    return (1.0 - z) * n + z * h
+
+
+@dataclasses.dataclass(frozen=True)
+class LSTM:
+    """Single-layer LSTM over [T, B, in] (apex ``RNN/models.py`` LSTM (U))."""
+
+    input_size: int
+    hidden_size: int
+    bias: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        bound = 1.0 / self.hidden_size ** 0.5
+        p = {
+            "wi": jax.random.uniform(
+                k1, (self.input_size, 4 * self.hidden_size),
+                self.param_dtype, -bound, bound),
+            "wh": jax.random.uniform(
+                k2, (self.hidden_size, 4 * self.hidden_size),
+                self.param_dtype, -bound, bound),
+        }
+        if self.bias:
+            p["b"] = jnp.zeros((4 * self.hidden_size,), self.param_dtype)
+        return p
+
+    def apply(self, params, xs, state: Optional[Tuple] = None):
+        """xs [T, B, in] → (ys [T, B, h], (h, c))."""
+        bsz = xs.shape[1]
+        if state is None:
+            h = jnp.zeros((bsz, self.hidden_size), xs.dtype)
+            c = jnp.zeros((bsz, self.hidden_size), xs.dtype)
+        else:
+            h, c = state
+
+        def step(carry, x):
+            h, c = carry
+            h, c = lstm_cell(x, h, c, params["wi"], params["wh"],
+                             params.get("b"))
+            return (h, c), h
+
+        (h, c), ys = lax.scan(step, (h, c), xs)
+        return ys, (h, c)
