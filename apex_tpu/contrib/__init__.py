@@ -1,5 +1,30 @@
 """Optional subsystems (apex/contrib/* (U) parity)."""
 
 from apex_tpu.contrib.clip_grad import clip_grad_norm_
+from apex_tpu.contrib.focal_loss import sigmoid_focal_loss
+from apex_tpu.contrib.group_norm import group_norm_nhwc
+from apex_tpu.contrib.index_mul_2d import index_mul_2d, index_mul_2d_add
+from apex_tpu.contrib.sparsity import (
+    apply_masks,
+    compute_mask_2to4,
+    init_masks,
+    masked_step,
+)
+from apex_tpu.contrib.spatial import halo_exchange, spatial_conv2d
+from apex_tpu.contrib.transducer import transducer_joint, transducer_loss
 
-__all__ = ["clip_grad_norm_"]
+__all__ = [
+    "transducer_joint",
+    "transducer_loss",
+    "clip_grad_norm_",
+    "sigmoid_focal_loss",
+    "group_norm_nhwc",
+    "index_mul_2d",
+    "index_mul_2d_add",
+    "halo_exchange",
+    "spatial_conv2d",
+    "compute_mask_2to4",
+    "init_masks",
+    "apply_masks",
+    "masked_step",
+]

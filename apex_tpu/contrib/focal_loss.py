@@ -1,0 +1,46 @@
+"""Focal loss — apex/contrib/focal_loss/focal_loss.py (U) over its fused
+CUDA kernel (focal_loss_cuda (U)).
+
+The reference fuses sigmoid-focal-loss fwd+bwd for detection workloads
+(RetinaNet); XLA fuses the same elementwise chain, so the TPU version is
+the numerically-stable jnp formulation with a label-smoothing option.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sigmoid_focal_loss(
+    logits,
+    targets,
+    *,
+    alpha: float = 0.25,
+    gamma: float = 2.0,
+    label_smoothing: float = 0.0,
+    reduction: str = "none",
+):
+    """FL(p_t) = -alpha_t (1 - p_t)^gamma log(p_t), elementwise on logits.
+
+    ``targets`` ∈ {0, 1} (same shape as logits, possibly float). Matches
+    the torchvision/apex convention: ``alpha`` weights the positive class.
+    """
+    logits = jnp.asarray(logits, jnp.float32)
+    t = jnp.asarray(targets, jnp.float32)
+    if label_smoothing > 0.0:
+        t = t * (1.0 - label_smoothing) + 0.5 * label_smoothing
+    p = jax.nn.sigmoid(logits)
+    # stable BCE-with-logits
+    ce = jnp.maximum(logits, 0) - logits * t + jnp.log1p(
+        jnp.exp(-jnp.abs(logits)))
+    p_t = p * t + (1.0 - p) * (1.0 - t)
+    loss = ce * (1.0 - p_t) ** gamma
+    if alpha >= 0:
+        alpha_t = alpha * t + (1.0 - alpha) * (1.0 - t)
+        loss = alpha_t * loss
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss

@@ -1,0 +1,44 @@
+"""NHWC GroupNorm — apex/contrib/group_norm (U) [era].
+
+The reference ships persistent NHWC GroupNorm CUDA kernels (diffusion
+workloads). TPU layout is NHWC-native already; statistics are computed in
+fp32 over (H, W, C/G) per group and the normalise+affine (+ optional silu)
+chain fuses under XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def group_norm_nhwc(
+    x,
+    num_groups: int,
+    weight: Optional[jnp.ndarray] = None,
+    bias: Optional[jnp.ndarray] = None,
+    *,
+    eps: float = 1e-5,
+    act: str = "none",
+):
+    """x [N, H, W, C] → same; ``act`` ∈ {none, silu} (the reference fuses
+    swish for diffusion UNets)."""
+    n, h, w, c = x.shape
+    if c % num_groups:
+        raise ValueError(f"channels {c} not divisible by groups {num_groups}")
+    xg = x.reshape(n, h, w, num_groups, c // num_groups).astype(jnp.float32)
+    mean = jnp.mean(xg, axis=(1, 2, 4), keepdims=True)
+    var = jnp.mean((xg - mean) ** 2, axis=(1, 2, 4), keepdims=True)
+    y = (xg - mean) * jax.lax.rsqrt(var + eps)
+    y = y.reshape(n, h, w, c)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    if act == "silu":
+        y = y * jax.nn.sigmoid(y)
+    elif act != "none":
+        raise ValueError(f"unknown act {act!r}")
+    return y.astype(x.dtype)

@@ -1,0 +1,21 @@
+"""index_mul_2d — apex/contrib/index_mul_2d (U).
+
+``out[idx] op= in1 * in2`` row-indexed multiply (OpenFold hot op). The
+CUDA kernel exists to fuse gather→mul→scatter; on TPU the same fusion is
+one ``take``/``segment`` chain XLA handles, with exact-gradient semantics
+from plain indexing.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def index_mul_2d(in1, in2, idx):
+    """Rows ``in1[idx] * in2`` — shapes: in1 [N, D], in2 [K, D], idx [K]."""
+    return jnp.take(in1, idx, axis=0) * in2
+
+
+def index_mul_2d_add(out, in1, in2, idx):
+    """``out.at[idx].add(in1[idx] * in2)`` — the scatter-accumulate form."""
+    return out.at[idx].add(jnp.take(in1, idx, axis=0) * in2)

@@ -1,0 +1,74 @@
+"""ASP — automatic 2:4 structured sparsity (apex/contrib/sparsity (U)).
+
+The reference's ``ASP`` walks a torch model, computes 2:4 magnitude masks
+(with a CUDA-accelerated channel-permutation search), masks weights, and
+re-masks after every optimizer step via an optimizer hook. The functional
+TPU version:
+
+- :func:`compute_mask_2to4` — keep the 2 largest-|w| of every 4 along the
+  input dim (``m4n2_1d`` default pattern (U));
+- :func:`init_masks` / :func:`apply_masks` — mask pytrees for eligible
+  leaves (≥2-D, dims divisible by 4 on the reduced axis);
+- :func:`masked_step` — wrap any fused optimizer step so weights are
+  re-masked after the update (the ``ASP`` optimizer hook).
+
+The channel-permutation search (a CUDA heuristic to raise retained
+magnitude) is intentionally out of scope; masks here are per-row greedy,
+the reference's default when permutation search is disabled.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def compute_mask_2to4(w, axis: int = 0):
+    """Boolean mask keeping the top-2 magnitudes of each aligned group of
+    4 along ``axis``."""
+    w = jnp.asarray(w)
+    if w.shape[axis] % 4:
+        raise ValueError(f"dim {axis} ({w.shape[axis]}) not divisible by 4")
+    moved = jnp.moveaxis(w, axis, -1)
+    grouped = moved.reshape(moved.shape[:-1] + (moved.shape[-1] // 4, 4))
+    mag = jnp.abs(grouped)
+    # rank within each group of 4; keep the two largest
+    order = jnp.argsort(mag, axis=-1)
+    ranks = jnp.argsort(order, axis=-1)
+    mask = ranks >= 2
+    mask = mask.reshape(moved.shape)
+    return jnp.moveaxis(mask, -1, axis)
+
+
+def _eligible(x, axis: int, min_size: int = 16) -> bool:
+    x = jnp.asarray(x)
+    return (x.ndim >= 2 and x.shape[axis] % 4 == 0
+            and x.size >= min_size and jnp.issubdtype(x.dtype, jnp.floating))
+
+
+def init_masks(params: Any, *, axis: int = 0) -> Any:
+    """Masks for every eligible leaf; ineligible leaves get ``None``
+    (mirrors ASP's whitelist walk (U), structurally)."""
+    return jax.tree.map(
+        lambda w: compute_mask_2to4(w, axis) if _eligible(w, axis) else None,
+        params)
+
+
+def apply_masks(params: Any, masks: Any) -> Any:
+    return jax.tree.map(
+        lambda w, m: w if m is None else w * m.astype(w.dtype),
+        params, masks,
+        is_leaf=lambda x: x is None)
+
+
+def masked_step(step_fn: Callable, masks: Any) -> Callable:
+    """Wrap ``step(grads, state, params) -> (new_params, state)`` so the
+    updated params are re-masked (ASP's post-step hook (U))."""
+
+    def wrapped(grads, state, params, **kw):
+        new_params, new_state = step_fn(grads, state, params, **kw)
+        return apply_masks(new_params, masks), new_state
+
+    return wrapped

@@ -21,6 +21,7 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (
     forward_backward_no_pipelining,
     forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,
+    forward_backward_single_stage,
     get_forward_backward_func,
     pipeline_spmd,
 )
@@ -28,6 +29,7 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (
 __all__ = [
     "pipeline_spmd",
     "forward_backward_no_pipelining",
+    "forward_backward_single_stage",
     "forward_backward_pipelining_without_interleaving",
     "forward_backward_pipelining_with_interleaving",
     "get_forward_backward_func",

@@ -160,11 +160,17 @@ def forward_backward_no_pipelining(
 
 
 # parity-named schedule entry points ---------------------------------------
+# All three share the pipelined signature (chunk_fn, inject_fn,
+# loss_of_outputs, n_micro, item, *, n_chunks, axis) so the selector's
+# result is drop-in swappable across topologies, like apex's (U).
 def forward_backward_pipelining_without_interleaving(*args, **kw):
     """1F1B-capability schedule (U) — see module docstring for how the
     static-graph version subsumes it."""
-    kw.setdefault("n_chunks", 1)
-    return pipelined_loss(*args, **kw)
+    if kw.pop("n_chunks", 1) != 1:
+        raise ValueError(
+            "non-interleaved schedule is n_chunks=1; use "
+            "forward_backward_pipelining_with_interleaving for vpp > 1")
+    return pipelined_loss(*args, n_chunks=1, **kw)
 
 
 def forward_backward_pipelining_with_interleaving(*args, **kw):
@@ -172,6 +178,34 @@ def forward_backward_pipelining_with_interleaving(*args, **kw):
     if kw.get("n_chunks", 1) < 2:
         raise ValueError("interleaved schedule needs n_chunks >= 2")
     return pipelined_loss(*args, **kw)
+
+
+def forward_backward_single_stage(
+    chunk_fn: Callable,
+    inject_fn: Callable,
+    loss_of_outputs: Callable,
+    n_micro: int,
+    item: Any,
+    *,
+    n_chunks: int = 1,
+    axis: str = AXIS_PP,
+):
+    """pp=1 schedule with the pipelined signature: microbatches run
+    sequentially through all chunks on the one stage (the selector's
+    no-pipelining branch; for explicit grad accumulation over a loss_fn
+    use :func:`forward_backward_no_pipelining`)."""
+    del axis
+
+    def body(_, m):
+        # same stage-entry cast the pipelined path applies (schedules.py
+        # pipeline_spmd) so pp=1 and pp>1 run identical numerics
+        x = inject_fn(m).astype(item.dtype)
+        for c in range(n_chunks):
+            x = chunk_fn(c, x)
+        return None, x
+
+    _, outs = lax.scan(body, None, jnp.arange(n_micro, dtype=jnp.int32))
+    return loss_of_outputs(outs.astype(item.dtype))
 
 
 def get_forward_backward_func(
@@ -192,4 +226,4 @@ def get_forward_backward_func(
         if (virtual_pipeline_model_parallel_size or 1) > 1:
             return forward_backward_pipelining_with_interleaving
         return forward_backward_pipelining_without_interleaving
-    return forward_backward_no_pipelining
+    return forward_backward_single_stage

@@ -53,7 +53,10 @@ def test_p2p_send_forward_backward(devices8):
     np.testing.assert_allclose(fwd[1:, 0], 2.0 * np.arange(3))
     # last stage receives zeros from the backward direction
     assert bwd[3, 0] == 0.0
-    np.testing.assert_allclose(recv_forward.__doc__ is not None, True)
+    # recv_forward is the same collective as send_forward (SPMD pairing)
+    fwd2 = smap(lambda x: recv_forward(x), mesh, P("pp"), P("pp"))(x)
+    np.testing.assert_allclose(np.asarray(fwd2).reshape(4),
+                               [0.0, 0.0, 1.0, 2.0])
 
 
 # -- no-pipelining schedule ------------------------------------------------
@@ -80,7 +83,7 @@ def test_schedule_selector(devices8):
         "forward_backward_pipelining_with_interleaving")
     ps.initialize_model_parallel(2, 1, devices=devices8)
     assert get_forward_backward_func().__name__ == (
-        "forward_backward_no_pipelining")
+        "forward_backward_single_stage")
     ps.destroy_model_parallel()
 
 
