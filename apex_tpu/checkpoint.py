@@ -1,0 +1,106 @@
+"""Checkpoint / resume for train-state pytrees.
+
+The reference's story is piecewise (SURVEY.md §5): ``amp.state_dict()``
+persists scaler state, optimizers expose torch ``state_dict``, model
+checkpointing is left to the user's ``torch.save``. Here the whole
+:class:`~apex_tpu.models.training.TrainState` (params, flat optimizer
+buffers, scaler scalars, step) is one pytree, so checkpointing is a single
+save/restore:
+
+- orbax-checkpoint when available (async-capable, multi-host-aware — the
+  production path on TPU pods);
+- a dependency-free ``.npz`` fallback with identical semantics (leaf
+  arrays keyed by tree path) so the capability never gates on an import.
+
+Restoring takes a ``like`` pytree (from ``init_fn``) for structure,
+dtypes, and shardings — arrays are ``device_put`` onto the template's
+shardings, preserving ZeRO/TP/PP placements.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # pragma: no cover - exercised when orbax is present
+    import orbax.checkpoint as _ocp
+except Exception:  # pragma: no cover
+    _ocp = None
+
+
+def _path_key(path) -> str:
+    return "/".join(
+        str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p))))
+        for p in path)
+
+
+def save_checkpoint(path: str, state: Any, *, force_npz: bool = False) -> str:
+    """Write ``state`` under ``path`` (a directory for orbax, a ``.npz``
+    file otherwise). Returns the path written."""
+    if _ocp is not None and not force_npz:
+        # store a path-keyed flat dict (same key scheme as the npz
+        # fallback): orbax restores containers as plain dicts in its own
+        # key order, so custom nodes (NamedTuples) and leaf order can't be
+        # trusted round-trip — keys can
+        flat = jax.tree_util.tree_flatten_with_path(state)[0]
+        payload = {_path_key(p): jax.device_get(x) for p, x in flat}
+        ckptr = _ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.abspath(path), payload, force=True)
+        return path
+    flat = jax.tree_util.tree_flatten_with_path(state)[0]
+
+    def _np(x):
+        a = np.asarray(jax.device_get(x))
+        # npz can't store ml_dtypes (bfloat16 etc.); widen to fp32 — the
+        # loader casts back to the template leaf's dtype
+        if a.dtype.kind not in "biufc":
+            a = a.astype(np.float32)
+        return a
+
+    arrays = {_path_key(p): _np(x) for p, x in flat}
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    np.savez(path, **arrays)
+    return path
+
+
+def load_checkpoint(path: str, like: Any, *, force_npz: bool = False) -> Any:
+    """Restore a pytree shaped/sharded like ``like`` from ``path``."""
+    if _ocp is not None and not force_npz and os.path.isdir(path):
+        ckptr = _ocp.PyTreeCheckpointer()
+        restored = ckptr.restore(os.path.abspath(path))
+        flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+        leaves = []
+        for p, template in flat:
+            key = _path_key(p)
+            if key not in restored:
+                raise KeyError(f"checkpoint missing leaf {key!r}")
+            leaves.append(_place(restored[key], template))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+    if not path.endswith(".npz") and not os.path.exists(path):
+        path = path + ".npz"
+    data = np.load(path)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+    leaves = []
+    for p, template in flat:
+        key = _path_key(p)
+        if key not in data:
+            raise KeyError(f"checkpoint missing leaf {key!r}")
+        leaves.append(_place(data[key], template))
+    return jax.tree_util.tree_unflatten(
+        jax.tree.structure(like), leaves)
+
+
+def _place(x, template):
+    x = jnp.asarray(x, jnp.asarray(template).dtype)
+    if x.shape != template.shape:
+        raise ValueError(
+            f"checkpoint leaf shape {x.shape} != expected {template.shape}")
+    sharding = getattr(template, "sharding", None)
+    if sharding is not None:
+        return jax.device_put(x, sharding)
+    return x

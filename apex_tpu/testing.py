@@ -1,0 +1,45 @@
+"""Distributed-test support — apex/transformer/testing (U) re-designed.
+
+Apex emulates multi-node topology by spawning one NCCL process per local
+GPU (``NcclDistributedTestBase`` over ``MultiProcessTestCase`` (U)) and
+skips tests when GPUs are missing. The XLA backbone is strictly better
+(SURVEY.md §4): force the host platform to expose N virtual CPU devices
+and run every "distributed" test single-process on a real
+``jax.sharding.Mesh``. These helpers centralise that setup; the repo's
+``tests/conftest.py`` applies it process-wide.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def request_cpu_devices(n: int = 8) -> None:
+    """Ensure ``XLA_FLAGS`` exposes ≥ n virtual CPU devices.
+
+    Must run before the first jax backend initialisation (import this and
+    call at interpreter start — e.g. at the top of a conftest). Also pin
+    ``jax.config.update("jax_platforms", "cpu")`` afterwards: device-plugin
+    platforms override the env default.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is not None and int(m.group(1)) >= n:
+        return
+    if m is not None:
+        flags = flags.replace(m.group(0), "")
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+def assert_devices(n: int) -> list:
+    """The test-time device guard (world-size skip logic in the reference
+    becomes a hard assert: CPU simulation always satisfies it)."""
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= n, (
+        f"need {n} devices, have {len(devs)}; call request_cpu_devices "
+        "before jax initialises its backend")
+    return devs[:n]

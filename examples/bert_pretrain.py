@@ -1,0 +1,104 @@
+"""BASELINE config #2 — BERT-large pretraining shape.
+
+FusedLAMB + fused LayerNorm under amp O2 (fp16 compute + fp32 masters +
+dynamic loss scaling; bf16 needs no scaler and is the TPU default —
+--fp16 switches to the parity mode). ZeRO sharding via
+--zero (DistributedFusedLAMB, the MLPerf BERT recipe (U)).
+
+Run small (CPU simulation):
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python examples/bert_pretrain.py --layers 2 --hidden 128 --steps 3
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import mesh as mx
+from apex_tpu.amp import ScalerConfig, apply_if_finite, update as scaler_update
+from apex_tpu.amp import value_and_scaled_grad
+from apex_tpu.models import bert
+from apex_tpu.optimizers import distributed_fused_lamb, fused_lamb
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--hidden", type=int, default=1024)
+    ap.add_argument("--layers", type=int, default=24)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--fp16", action="store_true")
+    ap.add_argument("--zero", action="store_true")
+    args = ap.parse_args()
+
+    cfg = bert.BertConfig(
+        hidden_size=args.hidden, num_layers=args.layers,
+        num_heads=args.heads, seq_len=args.seq,
+        compute_dtype=jnp.float16 if args.fp16 else jnp.bfloat16)
+    mesh = mx.build_mesh(tp=args.tp)
+    scaler = (ScalerConfig() if args.fp16 else ScalerConfig(enabled=False))
+    opt = (distributed_fused_lamb(args.lr) if args.zero
+           else fused_lamb(args.lr))
+
+    params = jax.jit(lambda k: bert.init(cfg, k))(jax.random.PRNGKey(0))
+    pspecs = bert.param_specs(cfg)
+
+    def local_init(p):
+        return opt.init(p)
+
+    opt_specs = jax.tree.map(
+        lambda x: P() if x.ndim == 0 else P(("dp", "tp") if args.zero
+                                            else ("tp",)),
+        jax.eval_shape((lambda p: opt.init(p, dp=mesh.shape["dp"]))
+                       if args.zero else opt.init,
+                       jax.eval_shape(lambda: bert.init(
+                           cfg, jax.random.PRNGKey(0)))))
+    del local_init
+
+    def local_step(params, opt_state, sc_state, tok, tgt, mask):
+        vag = value_and_scaled_grad(
+            lambda p: bert.mlm_loss(cfg, p, tok, tgt, mask), scaler)
+        loss, grads, finite = vag(params, scaler_state=sc_state)
+        if not args.zero:
+            grads = jax.lax.pmean(grads, "dp")
+        finite = jax.lax.pmin(finite.astype(jnp.int32), ("dp", "tp")) > 0
+        new_p, new_o = opt.step(grads, opt_state, params)
+        new_p = apply_if_finite(new_p, params, finite)
+        new_o = apply_if_finite(new_o, opt_state, finite)
+        return new_p, new_o, scaler_update(scaler, sc_state, finite), \
+            jax.lax.pmean(loss, "dp")
+
+    sc_specs = jax.tree.map(lambda _: P(), scaler.init())
+    step = jax.jit(jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(pspecs, opt_specs, sc_specs, P("dp"), P("dp"), P("dp")),
+        out_specs=(pspecs, opt_specs, sc_specs, P()),
+        check_vma=False), donate_argnums=(0, 1))
+
+    opt_state = jax.jit(jax.shard_map(
+        opt.init, mesh=mesh, in_specs=(pspecs,), out_specs=opt_specs,
+        check_vma=False))(params)
+    sc_state = scaler.init()
+
+    rng = np.random.RandomState(0)
+    tok = jnp.asarray(rng.randint(0, cfg.vocab_size, (args.batch, args.seq)))
+    mask = jnp.asarray(rng.rand(args.batch, args.seq) < 0.15, jnp.int32)
+    tgt = tok  # "reconstruct the original ids at masked positions"
+
+    for i in range(args.steps):
+        params, opt_state, sc_state, loss = step(
+            params, opt_state, sc_state, tok, tgt, mask)
+        print(f"step {i} mlm_loss {float(loss):.4f} "
+              f"scale {float(sc_state.loss_scale):.0f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""BASELINE config #1 — ResNet-50 ImageNet-style training.
+
+The TPU-native form of examples/imagenet/main_amp.py (U): amp O1 ≈ bf16
+compute policy (no loss scaling needed), apex DDP ≈ batch sharded on the
+dp mesh axis with grad pmean, FusedSGD with momentum, SyncBatchNorm
+optional (config #3's RetinaNet pairing). Data is synthetic — the
+reference script's dataloader is orthogonal to the framework.
+
+Run (CPU simulation):
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python examples/imagenet_amp.py --steps 5 --batch 32 --image 64
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import mesh as mx
+from apex_tpu.models import resnet
+from apex_tpu.optimizers import fused_sgd
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--depth", type=int, default=50)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--syncbn", action="store_true")
+    args = ap.parse_args()
+
+    mesh = mx.build_mesh(tp=1)  # pure data parallelism
+    dp = mesh.devices.size
+    cfg = resnet.ResNetConfig(
+        depth=args.depth, bn_axis="dp" if args.syncbn else None,
+        compute_dtype=jnp.bfloat16)
+    params, bn_state = resnet.init(cfg, jax.random.PRNGKey(0))
+    opt = fused_sgd(args.lr, momentum=0.9, weight_decay=1e-4)
+    opt_state = jax.jit(opt.init)(params)
+
+    def local_step(params, bn_state, opt_state, images, labels):
+        (l, ns), g = jax.value_and_grad(
+            lambda p: resnet.loss(cfg, p, bn_state, images, labels),
+            has_aux=True)(params)
+        g = jax.lax.pmean(g, "dp")  # apex DDP allreduce (U)
+        if not args.syncbn:
+            # local BN: each rank updated running stats from its own batch
+            # shard; average them so the replicated-out-spec state stays
+            # consistent (torch DDP broadcasts buffers; pmean is the
+            # all-shards-contribute version)
+            ns = jax.lax.pmean(ns, "dp")
+        new_p, opt_state = opt.step(g, opt_state, params)
+        return new_p, ns, opt_state, jax.lax.pmean(l, "dp")
+
+    pspec = jax.tree.map(lambda _: P(), params)
+    sspec = jax.tree.map(lambda _: P(), bn_state)
+    ospec = jax.tree.map(lambda x: P(), jax.eval_shape(opt.init, params))
+    step = jax.jit(jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(pspec, sspec, ospec, P("dp"), P("dp")),
+        out_specs=(pspec, sspec, ospec, P()),
+        check_vma=False), donate_argnums=(0, 1, 2))
+
+    img = jax.random.normal(
+        jax.random.PRNGKey(1), (args.batch, args.image, args.image, 3))
+    lbl = jax.random.randint(jax.random.PRNGKey(2), (args.batch,), 0, 1000)
+
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        params, bn_state, opt_state, loss = step(
+            params, bn_state, opt_state, img, lbl)
+        print(f"step {i} loss {float(loss):.4f}")
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    print(f"{args.steps * args.batch / dt:.1f} images/s over {dp} devices")
+
+
+if __name__ == "__main__":
+    main()
