@@ -10,7 +10,10 @@ save/restore:
 - orbax-checkpoint when available (async-capable, multi-host-aware — the
   production path on TPU pods);
 - a dependency-free ``.npz`` fallback with identical semantics (leaf
-  arrays keyed by tree path) so the capability never gates on an import.
+  arrays keyed by tree path) so the capability never gates on an import;
+- a ``.atck`` fast binary format: JSON header + one contiguous blob
+  written through the native multithreaded pack engine with a CRC32
+  integrity check (csrc/host_runtime.cpp) — the native-IO path.
 
 Restoring takes a ``like`` pytree (from ``init_fn``) for structure,
 dtypes, and shardings — arrays are ``device_put`` onto the template's
@@ -19,12 +22,16 @@ shardings, preserving ZeRO/TP/PP placements.
 
 from __future__ import annotations
 
+import json
 import os
+import struct
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from apex_tpu import _native
 
 try:  # pragma: no cover - exercised when orbax is present
     import orbax.checkpoint as _ocp
@@ -38,9 +45,89 @@ def _path_key(path) -> str:
         for p in path)
 
 
+#: .atck layout: magic, header-length u64, JSON header, blob, crc32 u32.
+_MAGIC = b"ATCK0001"
+
+
+def save_checkpoint_bin(path: str, state: Any) -> str:
+    """Write the ``.atck`` fast binary format: a JSON leaf manifest + one
+    contiguous blob gathered by the native multithreaded pack engine, with
+    a trailing CRC32 of the blob."""
+    flat = jax.tree_util.tree_flatten_with_path(state)[0]
+    arrays, manifest, offsets = [], [], []
+    off = 0
+    for p, x in flat:
+        a = np.asarray(jax.device_get(x))
+        key = _path_key(p)
+        # ml_dtypes (bfloat16, fp8) have no portable numpy name; store the
+        # raw bytes and remember the dtype string. NB ascontiguousarray
+        # promotes 0-d to 1-d — record the true shape first.
+        manifest.append({"key": key, "shape": list(a.shape),
+                         "dtype": str(a.dtype)})
+        arrays.append(np.ascontiguousarray(a).reshape(-1).view(np.uint8))
+        offsets.append(off)
+        off += a.nbytes
+    blob = _native.pack_bytes(arrays, offsets, off)
+    crc = _native.crc32(blob)
+    header = json.dumps({"leaves": manifest}).encode()
+    if not path.endswith(".atck"):
+        path = path + ".atck"
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<Q", len(header)))
+        f.write(header)
+        blob.tofile(f)  # zero-copy write of the packed blob
+        f.write(struct.pack("<I", crc))
+    return path
+
+
+def load_checkpoint_bin(path: str, like: Any) -> Any:
+    """Restore from :func:`save_checkpoint_bin` output (CRC-verified)."""
+    if not path.endswith(".atck") and not os.path.exists(path):
+        path = path + ".atck"
+    with open(path, "rb") as f:
+        if f.read(len(_MAGIC)) != _MAGIC:
+            raise ValueError(f"{path}: not an .atck checkpoint")
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        manifest = json.loads(f.read(hlen))["leaves"]
+        rest = f.read()
+    blob, (crc,) = np.frombuffer(rest[:-4], np.uint8), struct.unpack(
+        "<I", rest[-4:])
+    if _native.crc32(blob) != crc:
+        raise ValueError(f"{path}: CRC mismatch — checkpoint corrupt")
+    flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+    by_key = {}
+    off = 0
+    shapes, dtypes, offsets = [], [], []
+    for m in manifest:
+        try:
+            dt = np.dtype(m["dtype"])
+        except TypeError:
+            import ml_dtypes  # bundled with jax
+            dt = np.dtype(getattr(ml_dtypes, m["dtype"]))
+        nbytes = int(np.prod(m["shape"])) * dt.itemsize if m[
+            "shape"] else dt.itemsize
+        shapes.append(tuple(m["shape"]))
+        dtypes.append(dt)
+        offsets.append(off)
+        by_key[m["key"]] = len(shapes) - 1
+        off += nbytes
+    outs = _native.unpack_bytes(blob, shapes, dtypes, offsets)
+    leaves = []
+    for p, template in flat:
+        key = _path_key(p)
+        if key not in by_key:
+            raise KeyError(f"checkpoint missing leaf {key!r}")
+        leaves.append(_place(outs[by_key[key]], template))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def save_checkpoint(path: str, state: Any, *, force_npz: bool = False) -> str:
     """Write ``state`` under ``path`` (a directory for orbax, a ``.npz``
-    file otherwise). Returns the path written."""
+    file otherwise; ``.atck`` paths use the native binary format).
+    Returns the path written."""
+    if path.endswith(".atck"):
+        return save_checkpoint_bin(path, state)
     if _ocp is not None and not force_npz:
         # store a path-keyed flat dict (same key scheme as the npz
         # fallback): orbax restores containers as plain dicts in its own
@@ -68,8 +155,17 @@ def save_checkpoint(path: str, state: Any, *, force_npz: bool = False) -> str:
     return path
 
 
+def checkpoint_exists(path: str) -> bool:
+    """True if :func:`load_checkpoint` would find a checkpoint at ``path``
+    under any of the formats save may have appended a suffix for."""
+    return any(os.path.exists(p)
+               for p in (path, path + ".npz", path + ".atck"))
+
+
 def load_checkpoint(path: str, like: Any, *, force_npz: bool = False) -> Any:
     """Restore a pytree shaped/sharded like ``like`` from ``path``."""
+    if path.endswith(".atck") or os.path.exists(path + ".atck"):
+        return load_checkpoint_bin(path, like)
     if _ocp is not None and not force_npz and os.path.isdir(path):
         ckptr = _ocp.PyTreeCheckpointer()
         restored = ckptr.restore(os.path.abspath(path))
@@ -101,6 +197,10 @@ def _place(x, template):
         raise ValueError(
             f"checkpoint leaf shape {x.shape} != expected {template.shape}")
     sharding = getattr(template, "sharding", None)
-    if sharding is not None:
+    # only force mesh-backed placements; committing to the template's
+    # single device would pin e.g. the step scalar to device 0 and clash
+    # with mesh-sharded leaves in the same jit call
+    if sharding is not None and not isinstance(
+            sharding, jax.sharding.SingleDeviceSharding):
         return jax.device_put(x, sharding)
     return x

@@ -1,0 +1,93 @@
+"""Data loading: native prefetching loaders feeding the device.
+
+The reference leaves IO to torch ``DataLoader``/DALI in its examples
+(examples/imagenet/main_amp.py (U) uses a multi-worker loader +
+DistributedSampler); apex itself ships no loader. Here the IO runtime is a
+first-class native component: a C++ background-prefetch loader over binary
+record files (csrc/host_runtime.cpp), wrapped for JAX — batches land as
+device arrays (optionally sharded over the dp mesh axis) while the next
+batch is already being read on the worker thread.
+
+File format: flat binary, one fixed-size record after another (tokens for
+LM, image+label structs for vision) — the layout Megatron-style indexed
+datasets use for the hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import _native
+from apex_tpu.mesh.topology import AXIS_DP
+
+native_available = _native.available
+RecordLoader = _native.RecordLoader
+
+
+class TokenLoader:
+    """Stream ``[batch, seq_len+1]`` token records as (tokens, targets).
+
+    The +1 column provides next-token targets without a wasted roll. With a
+    ``mesh``, the global batch is laid out over the dp axis: each host
+    reads only its process's shard (``jax.process_index`` ⇒ rank), and
+    arrays are placed with batch-sharded ``NamedSharding``.
+    """
+
+    def __init__(self, path: str, seq_len: int, batch: int, *,
+                 dtype=np.int32, mesh: Optional[Mesh] = None,
+                 seed: int = 0, shuffle: bool = True):
+        self._seq = seq_len
+        rank, world = 0, 1
+        self._sharding = None
+        if mesh is not None:
+            rank = jax.process_index()
+            world = jax.process_count()
+            if batch % world:
+                raise ValueError(
+                    f"global batch {batch} not divisible by "
+                    f"process count {world}")
+            batch //= world
+            self._sharding = NamedSharding(mesh, P(AXIS_DP, None))
+        self._loader = RecordLoader(
+            path, (seq_len + 1,), dtype, batch,
+            rank=rank, world=world, seed=seed, shuffle=shuffle)
+
+    @property
+    def num_records(self) -> int:
+        return self._loader.num_records
+
+    def __iter__(self) -> Iterator[Tuple[jnp.ndarray, jnp.ndarray]]:
+        while True:
+            yield self.next()
+
+    def next(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        rec = self._loader.next()
+        tokens, targets = rec[:, :-1], rec[:, 1:]
+        if self._sharding is not None:
+            tokens = jax.make_array_from_process_local_data(
+                self._sharding, tokens)
+            targets = jax.make_array_from_process_local_data(
+                self._sharding, targets)
+        else:
+            tokens, targets = jnp.asarray(tokens), jnp.asarray(targets)
+        return tokens, targets
+
+    def close(self):
+        self._loader.close()
+
+
+def write_token_file(path: str, tokens: np.ndarray, seq_len: int,
+                     dtype=np.int32) -> int:
+    """Chop a 1-D token stream into ``seq_len+1``-sized records and write
+    the binary file :class:`TokenLoader` reads. Returns the record count."""
+    tokens = np.asarray(tokens, dtype=dtype).reshape(-1)
+    rec = seq_len + 1
+    n = tokens.size // rec
+    tokens[: n * rec].reshape(n, rec).tofile(path)
+    return n

@@ -1,0 +1,345 @@
+// apex_tpu native host runtime.
+//
+// TPU-native counterpart of the reference's C++ host layer (csrc/
+// flatten_unflatten.cpp — apex_C's flatten/unflatten bindings — and the
+// host side of csrc/multi_tensor_apply.cuh's chunking machinery (U)).
+// On TPU the *device* side of those components is XLA/Pallas; what remains
+// genuinely native is the host runtime around it:
+//
+//  - at_pack / at_unpack: multithreaded scatter/gather of N host arrays
+//    into one contiguous staging buffer (checkpoint IO, flat-buffer init,
+//    host→device staging),
+//  - at_crc32: checksums for checkpoint integrity,
+//  - at_loader_*: a background-thread prefetching loader over fixed-record
+//    binary datasets (the IO role torch DataLoader/DALI play for the
+//    reference's examples), double-buffered so Python never waits on disk
+//    in steady state.
+//
+// Exposed with a plain C ABI for ctypes (pybind11 is not available in the
+// build image). Build: make -C csrc  (g++ -O3 -shared -fPIC -pthread).
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// pack / unpack
+// ---------------------------------------------------------------------------
+
+// Parallel gather: copy srcs[i] (sizes[i] bytes) to dst at offsets[i].
+// Threads split the *bytes*, not the arrays, so one giant embedding table
+// doesn't serialise the copy.
+void at_pack(const void** srcs, const int64_t* sizes,
+             const int64_t* offsets, int64_t n, void* dst,
+             int32_t n_threads) {
+  if (n_threads <= 0) {
+    n_threads = static_cast<int32_t>(std::thread::hardware_concurrency());
+    if (n_threads <= 0) n_threads = 4;
+  }
+  int64_t total = 0;
+  for (int64_t i = 0; i < n; ++i) total += sizes[i];
+  if (total == 0) return;
+  const int64_t kMinPerThread = 1 << 20;  // 1 MiB — below this, spawn fewer
+  int64_t want = (total + kMinPerThread - 1) / kMinPerThread;
+  if (want < n_threads) n_threads = static_cast<int32_t>(want);
+  if (n_threads < 1) n_threads = 1;
+
+  // Prefix sums over the concatenated byte stream; each thread owns a
+  // contiguous byte range [lo, hi) of it.
+  std::vector<int64_t> cum(n + 1, 0);
+  for (int64_t i = 0; i < n; ++i) cum[i + 1] = cum[i] + sizes[i];
+
+  auto worker = [&](int64_t lo, int64_t hi) {
+    // first array overlapping lo
+    int64_t i = static_cast<int64_t>(
+        std::upper_bound(cum.begin(), cum.end(), lo) - cum.begin()) - 1;
+    int64_t pos = lo;
+    while (pos < hi && i < n) {
+      int64_t in_arr = pos - cum[i];                 // offset inside array i
+      int64_t avail = sizes[i] - in_arr;
+      int64_t len = std::min(avail, hi - pos);
+      std::memcpy(static_cast<char*>(dst) + offsets[i] + in_arr,
+                  static_cast<const char*>(srcs[i]) + in_arr,
+                  static_cast<size_t>(len));
+      pos += len;
+      ++i;
+    }
+  };
+
+  if (n_threads == 1) {
+    worker(0, total);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t chunk = (total + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk, hi = std::min<int64_t>(total, lo + chunk);
+    if (lo >= hi) break;
+    ts.emplace_back(worker, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+// Parallel scatter: inverse of at_pack.
+void at_unpack(const void* src, const int64_t* sizes,
+               const int64_t* offsets, int64_t n, void** dsts,
+               int32_t n_threads) {
+  if (n_threads <= 0) {
+    n_threads = static_cast<int32_t>(std::thread::hardware_concurrency());
+    if (n_threads <= 0) n_threads = 4;
+  }
+  int64_t total = 0;
+  for (int64_t i = 0; i < n; ++i) total += sizes[i];
+  if (total == 0) return;
+  const int64_t kMinPerThread = 1 << 20;
+  int64_t want = (total + kMinPerThread - 1) / kMinPerThread;
+  if (want < n_threads) n_threads = static_cast<int32_t>(want);
+  if (n_threads < 1) n_threads = 1;
+
+  std::vector<int64_t> cum(n + 1, 0);
+  for (int64_t i = 0; i < n; ++i) cum[i + 1] = cum[i] + sizes[i];
+
+  auto worker = [&](int64_t lo, int64_t hi) {
+    int64_t i = static_cast<int64_t>(
+        std::upper_bound(cum.begin(), cum.end(), lo) - cum.begin()) - 1;
+    int64_t pos = lo;
+    while (pos < hi && i < n) {
+      int64_t in_arr = pos - cum[i];
+      int64_t avail = sizes[i] - in_arr;
+      int64_t len = std::min(avail, hi - pos);
+      std::memcpy(static_cast<char*>(dsts[i]) + in_arr,
+                  static_cast<const char*>(src) + offsets[i] + in_arr,
+                  static_cast<size_t>(len));
+      pos += len;
+      ++i;
+    }
+  };
+
+  if (n_threads == 1) {
+    worker(0, total);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t chunk = (total + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk, hi = std::min<int64_t>(total, lo + chunk);
+    if (lo >= hi) break;
+    ts.emplace_back(worker, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+// ---------------------------------------------------------------------------
+// crc32 (IEEE 802.3, table-driven; matches zlib.crc32)
+// ---------------------------------------------------------------------------
+
+static uint32_t g_crc_table[256];
+static std::once_flag g_crc_once;
+
+static void crc_init() {
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k)
+      c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    g_crc_table[i] = c;
+  }
+}
+
+uint32_t at_crc32(const void* data, int64_t nbytes, uint32_t seed) {
+  std::call_once(g_crc_once, crc_init);
+  uint32_t c = seed ^ 0xFFFFFFFFu;
+  const unsigned char* p = static_cast<const unsigned char*>(data);
+  for (int64_t i = 0; i < nbytes; ++i)
+    c = g_crc_table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------------------------
+// prefetching fixed-record loader
+// ---------------------------------------------------------------------------
+//
+// Dataset = a binary file of `record_bytes`-sized samples. The loader's
+// worker thread reads `batch` records per slot (gather by index for
+// shuffled order), cycling an epoch permutation, into `n_slots` staging
+// buffers. at_loader_next() hands Python a ready slot pointer;
+// at_loader_release() returns it to the pool. Sharding: rank r of w takes
+// records where (index % world) == rank — the reference's DistributedSampler
+// contract, done in native code.
+
+struct Loader {
+  FILE* f = nullptr;
+  int64_t record_bytes = 0;
+  int64_t n_records = 0;       // records this shard owns
+  int64_t batch = 0;
+  int32_t n_slots = 0;
+  int64_t rank = 0, world = 1;
+  uint64_t seed = 0;
+  bool shuffle = false;
+  std::vector<std::vector<char>> slots;
+  std::vector<int> state;      // 0 = free, 1 = ready, 2 = in use
+  std::vector<int64_t> seq;    // fill order, so delivery is FIFO
+  int64_t fill_seq = 0;
+  std::vector<int64_t> order;  // shard-local record indices, permuted
+  int64_t cursor = 0;          // position in `order`
+  int64_t epoch = 0;
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_free;
+  std::atomic<bool> stop{false};
+  std::atomic<int64_t> io_errors{0};
+
+  void reshuffle() {
+    order.resize(static_cast<size_t>(n_records));
+    for (int64_t i = 0; i < n_records; ++i) order[i] = i;
+    if (shuffle) {
+      std::mt19937_64 rng(seed + static_cast<uint64_t>(epoch));
+      for (int64_t i = n_records - 1; i > 0; --i) {
+        int64_t j = static_cast<int64_t>(rng() % static_cast<uint64_t>(i + 1));
+        std::swap(order[i], order[j]);
+      }
+    }
+  }
+
+  void fill(int slot) {
+    char* dst = slots[slot].data();
+    for (int64_t b = 0; b < batch; ++b) {
+      if (cursor >= n_records) {
+        cursor = 0;
+        ++epoch;
+        reshuffle();
+      }
+      int64_t local = order[cursor++];
+      int64_t global = local * world + rank;   // strided shard layout
+      if (std::fseek(f, global * record_bytes, SEEK_SET) != 0 ||
+          std::fread(dst + b * record_bytes, 1,
+                     static_cast<size_t>(record_bytes),
+                     f) != static_cast<size_t>(record_bytes)) {
+        // zero-fill so the slot stays well-defined, but COUNT the failure
+        // — Python raises on it rather than training on silent zeros
+        std::memset(dst + b * record_bytes, 0,
+                    static_cast<size_t>(record_bytes));
+        io_errors.fetch_add(1);
+        std::clearerr(f);
+      }
+    }
+  }
+
+  void run() {
+    while (!stop.load()) {
+      int slot = -1;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_free.wait(lk, [&] {
+          if (stop.load()) return true;
+          for (int i = 0; i < n_slots; ++i)
+            if (state[i] == 0) return true;
+          return false;
+        });
+        if (stop.load()) return;
+        for (int i = 0; i < n_slots; ++i)
+          if (state[i] == 0) { slot = i; break; }
+      }
+      fill(slot);
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        state[slot] = 1;
+        seq[slot] = fill_seq++;
+      }
+      cv_ready.notify_one();
+    }
+  }
+};
+
+void* at_loader_open(const char* path, int64_t record_bytes, int64_t batch,
+                     int32_t n_slots, int64_t rank, int64_t world,
+                     uint64_t seed, int32_t shuffle) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  std::fseek(f, 0, SEEK_END);
+  int64_t fsize = std::ftell(f);
+  int64_t total = fsize / record_bytes;
+  if (world < 1) world = 1;
+  if (rank < 0 || rank >= world) { std::fclose(f); return nullptr; }
+  int64_t n_local = total / world;  // drop the ragged tail, every rank equal
+  if (n_local < 1) { std::fclose(f); return nullptr; }
+
+  Loader* L = new Loader();
+  L->f = f;
+  L->record_bytes = record_bytes;
+  L->n_records = n_local;
+  L->batch = batch;
+  L->n_slots = n_slots < 2 ? 2 : n_slots;
+  L->rank = rank;
+  L->world = world;
+  L->seed = seed;
+  L->shuffle = shuffle != 0;
+  L->slots.resize(static_cast<size_t>(L->n_slots));
+  for (auto& s : L->slots)
+    s.resize(static_cast<size_t>(batch * record_bytes));
+  L->state.assign(static_cast<size_t>(L->n_slots), 0);
+  L->seq.assign(static_cast<size_t>(L->n_slots), 0);
+  L->reshuffle();
+  L->worker = std::thread(&Loader::run, L);
+  return L;
+}
+
+// Blocks until a batch is ready; returns its slot id and writes the
+// buffer pointer. -1 on shutdown.
+int32_t at_loader_next(void* handle, void** out_ptr) {
+  Loader* L = static_cast<Loader*>(handle);
+  std::unique_lock<std::mutex> lk(L->mu);
+  int slot = -1;
+  L->cv_ready.wait(lk, [&] {
+    if (L->stop.load()) return true;
+    slot = -1;
+    for (int i = 0; i < L->n_slots; ++i)
+      if (L->state[i] == 1 &&
+          (slot < 0 || L->seq[i] < L->seq[slot]))
+        slot = i;
+    return slot >= 0;
+  });
+  if (slot < 0) return -1;
+  L->state[slot] = 2;
+  *out_ptr = L->slots[slot].data();
+  return slot;
+}
+
+void at_loader_release(void* handle, int32_t slot) {
+  Loader* L = static_cast<Loader*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    if (slot >= 0 && slot < L->n_slots) L->state[slot] = 0;
+  }
+  L->cv_free.notify_one();
+}
+
+int64_t at_loader_num_records(void* handle) {
+  return static_cast<Loader*>(handle)->n_records;
+}
+
+int64_t at_loader_io_errors(void* handle) {
+  return static_cast<Loader*>(handle)->io_errors.load();
+}
+
+void at_loader_close(void* handle) {
+  Loader* L = static_cast<Loader*>(handle);
+  L->stop.store(true);
+  L->cv_free.notify_all();
+  L->cv_ready.notify_all();
+  if (L->worker.joinable()) L->worker.join();
+  std::fclose(L->f);
+  delete L;
+}
+
+int32_t at_version() { return 1; }
+
+}  // extern "C"
