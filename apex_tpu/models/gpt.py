@@ -68,6 +68,20 @@ class GPTConfig:
     ffn_hidden_size: Optional[int] = None  # default 4 * hidden
     sequence_parallel: bool = False
     remat: bool = True
+    #: None → recompute everything in backward; "dots" → save MXU (matmul)
+    #: outputs and recompute only the cheap elementwise chains — the
+    #: selective-recompute mode the reference's checkpoint() can't express
+    remat_policy: Optional[str] = None
+    #: CE sequence-chunk size: the [s, b, vocab] logits tensor never
+    #: materialises — each chunk's logits are computed, reduced to per-token
+    #: losses, and rematerialised in backward. 0 = unchunked. The memory
+    #: shape of the reference's fused xentropy kernel (apex/contrib/
+    #: xentropy (U) "saves logits memory"), done at the XLA level.
+    ce_chunk: int = 0
+    #: "flash" → Pallas blockwise kernel (O(s) memory — long context);
+    #: "xla" → materialised-scores attention (faster at short seq where
+    #: the s×s block fits comfortably); "auto" picks by seq_len.
+    attn_impl: str = "auto"
     #: False → bidirectional attention (the BERT encoder reuses this stack)
     causal: bool = True
     compute_dtype: Any = jnp.bfloat16
@@ -216,7 +230,22 @@ def _attention(cfg: GPTConfig, p, h):
     qkv = qkv.reshape(s, b, heads_local, 3, d)
     # [b, heads_local, s, d] each
     q, k, v = (jnp.transpose(qkv[:, :, :, i, :], (1, 2, 0, 3)) for i in range(3))
-    out = flash_attention(q, k, v, causal=cfg.causal)
+    impl = cfg.attn_impl
+    if impl == "auto":
+        impl = "flash" if s >= 2048 else "xla"
+    if impl not in ("flash", "xla"):
+        raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
+    if impl == "flash":
+        out = flash_attention(q, k, v, causal=cfg.causal)
+    else:
+        sc = 1.0 / d ** 0.5
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sc
+        if cfg.causal:
+            tri = lax.broadcasted_iota(jnp.int32, (s, s), 0) >= (
+                lax.broadcasted_iota(jnp.int32, (s, s), 1))
+            scores = jnp.where(tri, scores, -1e30)
+        p_attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p_attn, v)
     out = jnp.transpose(out, (2, 0, 1, 3)).reshape(s, b, heads_local * d)
     return row_parallel_linear(
         out, p["proj"]["kernel"], p["proj"]["bias"], axis=cfg.axis,
@@ -269,7 +298,7 @@ def hidden_states(cfg: GPTConfig, params, tokens):
         return _block(cfg, _cast_layer(cfg, layer_p), carry), None
 
     if cfg.remat:
-        body = tpr.checkpoint(body)
+        body = tpr.checkpoint(body, policy=_remat_policy(cfg))
     h, _ = lax.scan(body, h, params["layers"])
     # final LN runs inside the SP region (Megatron: its grads are
     # tp-partial — see seq_partial_grad_mask)
@@ -293,17 +322,54 @@ def logits(cfg: GPTConfig, params, tokens):
     return jnp.einsum("sbh,vh->sbv", h, table)
 
 
+def _ce_of_hidden(cfg: GPTConfig, params, h, targets_sb):
+    """Mean CE from final hidden states ``h [s, b, hid]`` (already
+    SP-gathered / copy-region'd) against ``targets_sb [s, b]``.
+
+    With ``cfg.ce_chunk`` the sequence dim is scanned in chunks under
+    ``jax.checkpoint``: forward keeps only per-token losses, backward
+    recomputes each chunk's logits — peak memory drops from
+    O(s·b·vocab) to O(chunk·b·vocab)."""
+    table = params["embedding"]["word"]["table"].astype(cfg.compute_dtype)
+    s, b = targets_sb.shape
+    chunk = cfg.ce_chunk
+    if chunk > 0 and s % chunk:
+        raise ValueError(
+            f"ce_chunk={chunk} must divide the (SP-local) sequence "
+            f"length {s}")
+    if chunk <= 0:
+        lg = jnp.einsum("sbh,vh->sbv", h, table).astype(jnp.float32)
+        return jnp.mean(
+            vocab_parallel_cross_entropy(lg, targets_sb, 0.0, cfg.axis))
+
+    hs = h.reshape(s // chunk, chunk, b, h.shape[-1])
+    ts = targets_sb.reshape(s // chunk, chunk, b)
+
+    @jax.checkpoint
+    def ce_block(hb, tb):
+        lg = jnp.einsum("sbh,vh->sbv", hb, table).astype(jnp.float32)
+        return jnp.sum(vocab_parallel_cross_entropy(lg, tb, 0.0, cfg.axis))
+
+    def body(acc, xt):
+        hb, tb = xt
+        return acc + ce_block(hb, tb), None
+
+    tot, _ = lax.scan(body, jnp.float32(0.0), (hs, ts))
+    return tot / (s * b)
+
+
 def loss(cfg: GPTConfig, params, tokens, targets):
     """Mean next-token cross entropy over the local batch shard.
 
     ``targets [b, s]``; per-token losses via vocab-parallel CE in fp32
     (Megatron computes CE on fp32 logits).
     """
-    lg = logits(cfg, params, tokens).astype(jnp.float32)
-    per_tok = vocab_parallel_cross_entropy(
-        lg, jnp.transpose(targets, (1, 0)), 0.0, cfg.axis
-    )
-    return jnp.mean(per_tok)
+    h = hidden_states(cfg, params, tokens)
+    if cfg.sequence_parallel:
+        h = gather_from_sequence_parallel_region(h, cfg.axis, True)
+    else:
+        h = copy_to_tensor_model_parallel_region(h, cfg.axis)
+    return _ce_of_hidden(cfg, params, h, jnp.transpose(targets, (1, 0)))
 
 
 # ---------------------------------------------------------------------------
@@ -337,6 +403,14 @@ def interleave_layers(params, num_layers: int, pp: int, vpp: int = 1):
         **params,
         "layers": jax.tree.map(lambda x: x[perm], params["layers"]),
     }
+
+
+def _remat_policy(cfg: GPTConfig):
+    if cfg.remat_policy is None:
+        return None
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}")
 
 
 def _cast_layer(cfg: GPTConfig, layer_p):
@@ -388,7 +462,7 @@ def pipeline_loss(
             return _block(cfg, _cast_layer(cfg, layer_p), carry), None
 
         if cfg.remat:
-            body = tpr.checkpoint(body)
+            body = tpr.checkpoint(body, policy=_remat_policy(cfg))
         y, _ = lax.scan(body, x, cp)
         return y
 
@@ -408,11 +482,8 @@ def pipeline_loss(
             h = gather_from_sequence_parallel_region(h, cfg.axis, True)
         else:
             h = copy_to_tensor_model_parallel_region(h, cfg.axis)
-        table = params["embedding"]["word"]["table"].astype(cfg.compute_dtype)
-        lg = jnp.einsum("sbh,vh->sbv", h, table).astype(jnp.float32)
         tgt = jnp.transpose(targets.reshape(n_micro * mb, s), (1, 0))
-        per_tok = vocab_parallel_cross_entropy(lg, tgt, 0.0, cfg.axis)
-        return jnp.mean(per_tok)
+        return _ce_of_hidden(cfg, params, h, tgt)
 
     return pipelined_loss(
         chunk_fn, inject, loss_of_outputs, n_micro, item,

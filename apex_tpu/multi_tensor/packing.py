@@ -13,10 +13,13 @@ import numpy as np
 #: (rows, LANE) tiles with no remainder handling.
 LANE = 128
 
-#: Pad granularity: 8 sublanes × 128 lanes covers the fp32 min tile; it also
-#: divides the bf16 (16, 128) tile when rows are even, which padding to a
-#: multiple of 2048 guarantees.
-_PAD_MULTIPLE = 16 * LANE
+#: Pad granularity: 512 rows × 128 lanes. The flat-op kernels tile the
+#: (rows, 128) view with the largest power-of-two row block that divides
+#: rows (flat_ops._block_rows, capped at 512); padding to 512·128 elements
+#: guarantees they always get the full 512-row block — with 16·128 padding
+#: a 355M-param buffer degraded to 16-row blocks, a ~170k-step sequential
+#: grid. 256 KiB of fp32 padding is noise at any size where it matters.
+_PAD_MULTIPLE = 512 * LANE
 
 
 def pad_to(n: int, multiple: int = _PAD_MULTIPLE) -> int:

@@ -60,12 +60,11 @@ class ShardedAdamState(NamedTuple):
 
 
 def _shard_len(n: int, dp: int) -> int:
-    """Per-rank shard length: lane-aligned (the flat-op kernels need LANE
-    multiples, not the full pack granularity — keeps small-model shards at
-    1/dp instead of one pack quantum each)."""
-    from apex_tpu.kernels._utils import LANE
-
-    return mt.pad_to((n + dp - 1) // dp, LANE)
+    """Per-rank shard length, padded to the full pack quantum so the
+    flat-op kernels sweep the shard with max-size row blocks (see
+    packing._PAD_MULTIPLE — lane-only alignment degrades the Pallas grid
+    to tiny blocks on large models)."""
+    return mt.pad_to((n + dp - 1) // dp)
 
 
 def _pad_group(buf, shard: int, dp: int):
