@@ -19,6 +19,10 @@ A ground-up JAX/XLA/Pallas re-design of the capabilities of apex
   device mesh (reference: apex/transformer/* (U)).
 - ``apex_tpu.mesh``         — the single first-class communication backend:
   mesh axes over ICI/DCN + XLA collectives, replacing NCCL process groups.
+- ``apex_tpu.data``         — native prefetching data loaders (C++ host
+  runtime, csrc/host_runtime.cpp).
+- ``apex_tpu.profiler``     — tracing/metrics subsystem (xprof hooks,
+  per-step timing, structured metrics).
 
 Citation convention: ``(U)`` paths refer to the upstream apex layout as
 documented in SURVEY.md (the reference mount was empty at survey time).
@@ -38,6 +42,8 @@ __all__ = [
     "transformer",
     "contrib",
     "checkpoint",
+    "data",
+    "profiler",
     "fp16_utils",
     "mlp",
     "fused_dense",

@@ -1,9 +1,22 @@
 """Optional subsystems (apex/contrib/* (U) parity)."""
 
 from apex_tpu.contrib.clip_grad import clip_grad_norm_
+from apex_tpu.contrib.conv_bias_relu import (
+    conv_bias,
+    conv_bias_mask_relu,
+    conv_bias_relu,
+    conv_frozen_scale_bias_relu,
+)
 from apex_tpu.contrib.focal_loss import sigmoid_focal_loss
 from apex_tpu.contrib.group_norm import group_norm_nhwc
+from apex_tpu.contrib.groupbn import group_batch_norm_nhwc
 from apex_tpu.contrib.index_mul_2d import index_mul_2d, index_mul_2d_add
+from apex_tpu.contrib.multihead_attn import (
+    encdec_attn,
+    init_encdec_attn,
+    init_self_attn,
+    self_attn,
+)
 from apex_tpu.contrib.sparsity import (
     apply_masks,
     compute_mask_2to4,
@@ -19,6 +32,10 @@ __all__ = [
     "clip_grad_norm_",
     "sigmoid_focal_loss",
     "group_norm_nhwc",
+    "group_batch_norm_nhwc",
+    "conv_bias", "conv_bias_relu", "conv_bias_mask_relu",
+    "conv_frozen_scale_bias_relu",
+    "self_attn", "encdec_attn", "init_self_attn", "init_encdec_attn",
     "index_mul_2d",
     "index_mul_2d_add",
     "halo_exchange",

@@ -1,0 +1,44 @@
+"""Fused conv epilogues — ``apex.contrib.conv_bias_relu`` (U).
+
+The reference routes Conv2d+Bias(+ReLU / +residual-add+ReLU / mask-grad)
+through cuDNN-frontend fusion engines (apex/contrib/conv_bias_relu/
+conv_bias_relu.py + csrc/cudnn_fused_conv_bias_relu (U)). XLA fuses conv
+epilogues natively, so these are thin NHWC compositions whose value is API
+parity + the guarantee the epilogue stays fused (elementwise chains fold
+into the convolution's output write)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _conv_nhwc(x, w, stride, padding):
+    return lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def conv_bias(x, w, bias, *, stride: int = 1, padding: str = "SAME"):
+    """``ConvBias`` (U): NHWC conv + channel bias."""
+    return _conv_nhwc(x, w, stride, padding) + bias
+
+
+def conv_bias_relu(x, w, bias, *, stride: int = 1, padding: str = "SAME"):
+    """``ConvBiasReLU`` (U)."""
+    return jnp.maximum(conv_bias(x, w, bias, stride=stride, padding=padding), 0)
+
+
+def conv_bias_mask_relu(x, w, bias, mask, *, stride: int = 1,
+                        padding: str = "SAME"):
+    """``ConvBiasMaskReLU`` (U): the mask zeroes activations before ReLU
+    (used for dropout-style masks with exact recompute)."""
+    return jnp.maximum(
+        conv_bias(x, w, bias, stride=stride, padding=padding) * mask, 0)
+
+
+def conv_frozen_scale_bias_relu(x, w, scale, bias, *, stride: int = 1,
+                                padding: str = "SAME"):
+    """``ConvFrozenScaleBiasReLU`` (U): conv → y*scale + bias → ReLU, the
+    frozen-BatchNorm inference fusion."""
+    return jnp.maximum(_conv_nhwc(x, w, stride, padding) * scale + bias, 0)

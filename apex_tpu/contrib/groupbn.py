@@ -1,0 +1,61 @@
+"""Group BatchNorm (NHWC) with fused add+ReLU — ``apex.contrib.groupbn`` (U).
+
+The reference's ``BatchNorm2d_NHWC`` (apex/contrib/groupbn/batch_norm.py +
+csrc/groupbn/* (U), and the cudnn_gbn [era] twin) is BatchNorm over a
+*group* of ranks — statistics reduced across a subset of the dp axis (its
+``bn_group``/peer-memory machinery) — in NHWC layout, with optional fused
+``z`` residual add and ReLU epilogue (``bn_addrelu``). TPU-native:
+
+- Welford batch moments over (N, H, W) locally, ``psum`` over ``axis``
+  (any mesh axis = the "group"); outside shard_map it degrades to local BN,
+- normalisation + affine + (add z) + ReLU as one elementwise chain XLA
+  fuses into the producing op,
+- running stats carried functionally (the reference mutates buffers).
+
+``group_norm_nhwc`` (GroupNorm, no batch statistics) lives in
+:mod:`apex_tpu.contrib.group_norm`; this module is the *batch*-norm
+variant.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import _moments
+
+
+def group_batch_norm_nhwc(
+    x, scale, bias, running_mean, running_var, *,
+    axis: Optional[str] = None,
+    momentum: float = 0.1,
+    eps: float = 1e-5,
+    training: bool = True,
+    z: Optional[jnp.ndarray] = None,
+    relu: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """``BatchNorm2d_NHWC.forward`` (U) — returns (y, new_mean, new_var).
+
+    ``x`` is NHWC; ``axis`` names the mesh axis the stat-group spans
+    (``bn_group`` (U)); ``z`` is the fused residual add input and ``relu``
+    the fused epilogue (``bn_addrelu`` kernels (U)).
+    """
+    xf = x.astype(jnp.float32)
+    if training:
+        mean, var, n_total = _moments(
+            xf, tuple(range(x.ndim - 1)), axis)
+        # unbiased correction over the *group-wide* count
+        unbiased = var * (n_total / jnp.maximum(n_total - 1.0, 1.0))
+        new_mean = (1 - momentum) * running_mean + momentum * mean
+        new_var = (1 - momentum) * running_var + momentum * unbiased
+    else:
+        mean, var = running_mean, running_var
+        new_mean, new_var = running_mean, running_var
+    inv = jnp.float32(1.0) / jnp.sqrt(var + eps)
+    y = (xf - mean) * inv * scale + bias
+    if z is not None:
+        y = y + z.astype(jnp.float32)
+    if relu:
+        y = jnp.maximum(y, 0)
+    return y.astype(x.dtype), new_mean, new_var

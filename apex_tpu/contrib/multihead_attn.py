@@ -1,0 +1,160 @@
+"""Fused multi-head attention blocks — ``apex.contrib.multihead_attn`` (U).
+
+The reference ships hand-fused CUDA MHA blocks (apex/contrib/csrc/
+multihead_attn/* (U)): ``SelfMultiheadAttn`` / ``EncdecMultiheadAttn``
+with ``impl='fast'|'default'``, optional pre-LayerNorm with fused residual
+add (``*_norm_add`` variants), bias on/off, and a separate-scaling "matmul
+in fp16, softmax fp32" recipe. On TPU the individual fusions (QKV GEMM +
+bias, scale + mask + softmax, dropout, context GEMM, out-proj + residual)
+are XLA's job; what this module reproduces is the *block semantics and API
+surface*, built on the Pallas flash kernel for the attention core (the
+fmha/fast_multihead_attn capability, SURVEY.md §2.4).
+
+Functional API: ``init_*`` builds the parameter pytree; the apply function
+takes ``[seq, batch, hidden]`` (the reference's time-first layout) and
+returns the same. Dropout takes an explicit PRNG key — dropped (None key)
+at inference, exactly like the reference's ``training`` flag.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.kernels import flash_attention, layer_norm
+
+
+def _uniform_init(key, shape, dtype, scale):
+    return jax.random.uniform(key, shape, dtype, -scale, scale)
+
+
+def init_self_attn(key, hidden: int, *, bias: bool = True,
+                   include_norm_add: bool = False, dtype=jnp.float32) -> Any:
+    """Parameters for :func:`self_attn` (``SelfMultiheadAttn.__init__``'s
+    ``qkv_weight``/``out_proj_weight`` + optional ``lyr_norm`` (U))."""
+    kq, ko = jax.random.split(key)
+    scale = (1.0 / hidden) ** 0.5
+    p = {
+        "qkv": {"kernel": _uniform_init(kq, (hidden, 3 * hidden), dtype, scale)},
+        "out": {"kernel": _uniform_init(ko, (hidden, hidden), dtype, scale)},
+    }
+    if bias:
+        p["qkv"]["bias"] = jnp.zeros((3 * hidden,), dtype)
+        p["out"]["bias"] = jnp.zeros((hidden,), dtype)
+    if include_norm_add:
+        p["ln"] = {"scale": jnp.ones((hidden,), dtype),
+                   "bias": jnp.zeros((hidden,), dtype)}
+    return p
+
+
+def init_encdec_attn(key, hidden: int, *, bias: bool = True,
+                     include_norm_add: bool = False, dtype=jnp.float32) -> Any:
+    """Parameters for :func:`encdec_attn` (separate Q and KV projections —
+    ``q_weight``/``kv_weight`` (U))."""
+    kq, kk, ko = jax.random.split(key, 3)
+    scale = (1.0 / hidden) ** 0.5
+    p = {
+        "q": {"kernel": _uniform_init(kq, (hidden, hidden), dtype, scale)},
+        "kv": {"kernel": _uniform_init(kk, (hidden, 2 * hidden), dtype, scale)},
+        "out": {"kernel": _uniform_init(ko, (hidden, hidden), dtype, scale)},
+    }
+    if bias:
+        p["q"]["bias"] = jnp.zeros((hidden,), dtype)
+        p["kv"]["bias"] = jnp.zeros((2 * hidden,), dtype)
+        p["out"]["bias"] = jnp.zeros((hidden,), dtype)
+    if include_norm_add:
+        p["ln"] = {"scale": jnp.ones((hidden,), dtype),
+                   "bias": jnp.zeros((hidden,), dtype)}
+    return p
+
+
+def _proj(x, p):
+    y = jnp.einsum("sbh,hk->sbk", x, p["kernel"].astype(x.dtype))
+    if "bias" in p:
+        y = y + p["bias"].astype(x.dtype)
+    return y
+
+
+def _heads(x, num_heads):  # [s, b, h] -> [b, heads, s, d]
+    s, b, h = x.shape
+    d = h // num_heads
+    return jnp.transpose(x.reshape(s, b, num_heads, d), (1, 2, 0, 3))
+
+
+def _unheads(x):  # [b, heads, s, d] -> [s, b, h]
+    b, n, s, d = x.shape
+    return jnp.transpose(x, (2, 0, 1, 3)).reshape(s, b, n * d)
+
+
+def _attn_core(q, k, v, *, causal, key_padding_lens, dropout_p, rng):
+    if not (dropout_p and rng is not None):
+        return flash_attention(q, k, v, causal=causal,
+                               kv_lengths=key_padding_lens)
+    # The reference drops attention *probabilities* before the context GEMM
+    # (softmax → dropout → P·V (U)); that needs the materialised P, so the
+    # dropout path computes scores directly instead of the flash kernel.
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / d ** 0.5
+    sq, sk = s.shape[-2], s.shape[-1]
+    if causal:
+        tri = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(tri, s, -1e30)
+    if key_padding_lens is not None:
+        col = jnp.arange(sk)[None, None, None, :]
+        s = jnp.where(col < key_padding_lens[:, None, None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    keep = jax.random.bernoulli(rng, 1.0 - dropout_p, p.shape)
+    p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+
+
+def self_attn(params, x, num_heads: int, *,
+              causal: bool = False,
+              key_padding_lens: Optional[jnp.ndarray] = None,
+              dropout_p: float = 0.0,
+              rng: Optional[jnp.ndarray] = None,
+              include_norm_add: bool = False,
+              eps: float = 1e-5):
+    """``SelfMultiheadAttn.forward`` (U): fused QKV → attention → out-proj.
+
+    ``x`` is ``[seq, batch, hidden]``. With ``include_norm_add`` the block
+    pre-normalises and returns ``x + attn(LN(x))`` (the ``*_norm_add``
+    fused variant (U)); otherwise the raw block output.
+    """
+    inp = x
+    if include_norm_add:
+        x = layer_norm(x, params["ln"]["scale"], params["ln"]["bias"],
+                       eps=eps)
+    qkv = _proj(x, params["qkv"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    out = _attn_core(
+        _heads(q, num_heads), _heads(k, num_heads), _heads(v, num_heads),
+        causal=causal, key_padding_lens=key_padding_lens,
+        dropout_p=dropout_p, rng=rng)
+    y = _proj(_unheads(out), params["out"])
+    return inp + y if include_norm_add else y
+
+
+def encdec_attn(params, query, memory, num_heads: int, *,
+                key_padding_lens: Optional[jnp.ndarray] = None,
+                dropout_p: float = 0.0,
+                rng: Optional[jnp.ndarray] = None,
+                include_norm_add: bool = False,
+                eps: float = 1e-5):
+    """``EncdecMultiheadAttn.forward`` (U): Q from the decoder stream,
+    fused KV from encoder ``memory``."""
+    inp = query
+    if include_norm_add:
+        query = layer_norm(query, params["ln"]["scale"],
+                           params["ln"]["bias"], eps=eps)
+    q = _proj(query, params["q"])
+    kv = _proj(memory, params["kv"])
+    k, v = jnp.split(kv, 2, axis=-1)
+    out = _attn_core(
+        _heads(q, num_heads), _heads(k, num_heads), _heads(v, num_heads),
+        causal=False, key_padding_lens=key_padding_lens,
+        dropout_p=dropout_p, rng=rng)
+    y = _proj(_unheads(out), params["out"])
+    return inp + y if include_norm_add else y

@@ -1,0 +1,161 @@
+"""Tracing / profiling — the observability subsystem (SURVEY.md §5).
+
+The reference has no first-class profiler: it leans on external nsys/
+nvprof with scattered ``torch.cuda.Event`` timings and nvtx ranges in
+contrib benchmarks (U). The TPU build makes this a component:
+
+- :class:`StepTimer` — per-step wall timing with correct device sync
+  (value-fetch barrier — ``block_until_ready`` can return at dispatch
+  time on remote-attached devices), windowed statistics, and derived
+  throughput/MFU,
+- :func:`trace` / :func:`annotate` — ``jax.profiler`` xprof trace capture
+  and named ranges (the nvtx equivalent, viewable in XProf/TensorBoard),
+- :class:`MetricsLogger` — structured per-step metrics: in-memory ring,
+  optional JSONL file, optional TensorBoard writer when available.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture an xprof trace of the enclosed block (``nsys profile``'s
+    role for the reference)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named trace range (nvtx.range_push/pop (U) equivalent)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def _sync(value):
+    """Device barrier that survives remote-attached runtimes: fetch one
+    element's value instead of trusting block_until_ready."""
+    if value is None:
+        return
+    leaf = jax.tree_util.tree_leaves(value)[0]
+    arr = jnp.asarray(leaf)
+    _ = np.asarray(jax.device_get(arr.ravel()[0] if arr.ndim else arr))
+
+
+class StepTimer:
+    """Wall-clock per-step timing with device sync and derived rates.
+
+    >>> timer = StepTimer(tokens_per_step=batch * seq)
+    >>> for batch in loader:
+    ...     state, metrics = step_fn(state, *batch)
+    ...     timer.tick(metrics["loss"])   # sync point
+    >>> timer.summary()["tokens_per_sec"]
+    """
+
+    def __init__(self, *, tokens_per_step: Optional[int] = None,
+                 model_flops_per_step: Optional[float] = None,
+                 window: int = 50):
+        self._tokens = tokens_per_step
+        self._flops = model_flops_per_step
+        self._window = window
+        self._times: List[float] = []
+        self._last: Optional[float] = None
+
+    def tick(self, sync_on: Any = None) -> float:
+        """Record one step boundary; returns the step's duration (0.0 on
+        the first call). ``sync_on``: any device value produced by the
+        step — fetched to pin the measurement to real execution."""
+        _sync(sync_on)
+        now = time.perf_counter()
+        dt = 0.0 if self._last is None else now - self._last
+        self._last = now
+        if dt > 0.0:
+            self._times.append(dt)
+            if len(self._times) > self._window:
+                self._times.pop(0)
+        return dt
+
+    def reset(self):
+        self._times.clear()
+        self._last = None
+
+    def summary(self) -> Dict[str, float]:
+        if not self._times:
+            return {}
+        ts = np.asarray(self._times)
+        out = {
+            "steps": float(len(ts)),
+            "mean_step_s": float(ts.mean()),
+            "median_step_s": float(np.median(ts)),
+            "p90_step_s": float(np.percentile(ts, 90)),
+            "min_step_s": float(ts.min()),
+        }
+        if self._tokens:
+            out["tokens_per_sec"] = self._tokens / float(np.median(ts))
+        if self._flops:
+            out["model_flops_per_sec"] = self._flops / float(np.median(ts))
+        return out
+
+
+class MetricsLogger:
+    """Structured per-step metrics: ring buffer + optional JSONL sink +
+    optional TensorBoard (the "structured metrics dict" plan, SURVEY.md
+    §5 'Metrics / logging')."""
+
+    def __init__(self, jsonl_path: Optional[str] = None,
+                 tensorboard_dir: Optional[str] = None,
+                 history: int = 1000):
+        self._jsonl = open(jsonl_path, "a") if jsonl_path else None
+        self._tb = None
+        if tensorboard_dir is not None:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self._tb = SummaryWriter(tensorboard_dir)
+            except Exception:
+                self._tb = None
+        self._hist: List[Dict[str, float]] = []
+        self._cap = history
+
+    def log(self, step: int, metrics: Dict[str, Any]):
+        flat = {k: float(jax.device_get(v)) if hasattr(v, "dtype") else
+                float(v) for k, v in metrics.items()}
+        flat["step"] = step
+        self._hist.append(flat)
+        if len(self._hist) > self._cap:
+            self._hist.pop(0)
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps(flat) + "\n")
+            self._jsonl.flush()
+        if self._tb is not None:
+            for k, v in flat.items():
+                if k != "step":
+                    self._tb.add_scalar(k, v, step)
+
+    @property
+    def history(self) -> List[Dict[str, float]]:
+        return list(self._hist)
+
+    def close(self):
+        if self._jsonl is not None:
+            self._jsonl.close()
+        if self._tb is not None:
+            self._tb.close()
+
+
+def model_flops_per_token(n_params: int, *, include_backward: bool = True,
+                          remat: bool = False) -> float:
+    """6N per token (fwd+bwd), 2N fwd-only; +2N when full-remat replays
+    the forward — the MFU denominators used in bench.py."""
+    if not include_backward:
+        return 2.0 * n_params
+    return (8.0 if remat else 6.0) * n_params

@@ -6,7 +6,9 @@ Config #5: Megatron-GPT 2.7B, PP×TP     → --tp 8 --pp 8 --preset 2p7b
 
 Everything (amp, grad sync, pipeline schedule, fused optimizer) comes from
 apex_tpu.models.training.make_train_step — this script is argument
-plumbing plus a synthetic-token loop.
+plumbing plus data/metrics wiring: the native prefetching TokenLoader
+(--data, synthetic tokens otherwise), per-step StepTimer/MetricsLogger,
+and .atck checkpoint save/resume (--ckpt).
 
 Run small (CPU simulation):
   PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
@@ -15,12 +17,16 @@ Run small (CPU simulation):
 """
 
 import argparse
-import time
+import os
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from apex_tpu import checkpoint as ckpt
+from apex_tpu import data as atdata
 from apex_tpu import mesh as mx
+from apex_tpu import profiler
 from apex_tpu.amp import ScalerConfig
 from apex_tpu.models import gpt, training
 from apex_tpu.optimizers import fused_adam
@@ -46,6 +52,10 @@ def main():
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--no-sp", action="store_true")
+    ap.add_argument("--data", help="binary token file (apex_tpu.data "
+                    "format); synthetic tokens if omitted")
+    ap.add_argument("--ckpt", help=".atck checkpoint path to save/resume")
+    ap.add_argument("--metrics", help="JSONL metrics path")
     args = ap.parse_args()
 
     cfg = gpt.GPTConfig(
@@ -57,18 +67,40 @@ def main():
         n_micro=args.n_micro, n_chunks=args.vpp)
 
     state = init_fn(jax.random.PRNGKey(0))
-    tok = jax.random.randint(
-        jax.random.PRNGKey(1), (args.batch, cfg.seq_len), 0, cfg.vocab_size)
-    tgt = jnp.roll(tok, -1, axis=1)
+    if args.ckpt and ckpt.checkpoint_exists(args.ckpt):
+        state = ckpt.load_checkpoint(args.ckpt, state)
+        print(f"resumed from {args.ckpt} at step {int(state.step)}")
 
-    t0 = time.perf_counter()
+    loader = None
+    if args.data:
+        loader = atdata.TokenLoader(
+            args.data, cfg.seq_len, args.batch, mesh=mesh, seed=0)
+        batches = iter(loader)
+    else:
+        tok = jax.random.randint(
+            jax.random.PRNGKey(1), (args.batch, cfg.seq_len), 0,
+            cfg.vocab_size)
+        batches = iter(lambda: (tok, jnp.roll(tok, -1, axis=1)), None)
+
+    timer = profiler.StepTimer(tokens_per_step=args.batch * cfg.seq_len)
+    log = profiler.MetricsLogger(jsonl_path=args.metrics)
     for i in range(args.steps):
+        tok, tgt = next(batches)
         state, m = step_fn(state, tok, tgt)
+        timer.tick(m["loss"])
+        log.log(i, m)
         print(f"step {i} loss {float(m['loss']):.4f}")
-    jax.block_until_ready(m["loss"])
-    dt = time.perf_counter() - t0
-    toks = args.steps * args.batch * cfg.seq_len
-    print(f"{toks / dt:.0f} tokens/s on mesh {dict(mesh.shape)}")
+    s = timer.summary()
+    if s:
+        print(f"{s['tokens_per_sec']:.0f} tokens/s on mesh "
+              f"{dict(mesh.shape)} (median {s['median_step_s']*1e3:.1f} "
+              f"ms/step)")
+    if args.ckpt:
+        ckpt.save_checkpoint(args.ckpt, state)
+        print(f"saved {args.ckpt}")
+    if loader is not None:
+        loader.close()
+    log.close()
 
 
 if __name__ == "__main__":

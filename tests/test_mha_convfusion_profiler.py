@@ -1,0 +1,171 @@
+"""contrib multihead_attn / conv fusions / groupbn + profiler subsystem.
+
+Oracle pattern (SURVEY.md §4): fused block vs unfused jnp reference at
+fp32, per-dtype tolerances.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import profiler
+from apex_tpu.contrib import (
+    conv_bias_relu,
+    encdec_attn,
+    group_batch_norm_nhwc,
+    init_encdec_attn,
+    init_self_attn,
+    self_attn,
+)
+from apex_tpu.contrib.conv_bias_relu import conv_frozen_scale_bias_relu
+
+
+def _ref_attention(q, k, v, causal=False):
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / d ** 0.5
+    if causal:
+        sq = q.shape[2]
+        mask = jnp.tril(jnp.ones((sq, sq), bool))
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+
+
+def _ref_self_attn(params, x, num_heads, causal=False):
+    qkv = jnp.einsum("sbh,hk->sbk", x, params["qkv"]["kernel"])
+    qkv = qkv + params["qkv"]["bias"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        s, b, h = t.shape
+        return jnp.transpose(
+            t.reshape(s, b, num_heads, h // num_heads), (1, 2, 0, 3))
+
+    o = _ref_attention(heads(q), heads(k), heads(v), causal)
+    b, n, s, d = o.shape
+    o = jnp.transpose(o, (2, 0, 1, 3)).reshape(s, b, n * d)
+    return jnp.einsum("sbh,hk->sbk", o, params["out"]["kernel"]) + params[
+        "out"]["bias"]
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_self_attn_matches_reference(causal):
+    key = jax.random.PRNGKey(0)
+    p = init_self_attn(key, 64)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 2, 64))
+    got = self_attn(p, x, 4, causal=causal)
+    want = _ref_self_attn(p, x, 4, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_self_attn_norm_add_residual():
+    p = init_self_attn(jax.random.PRNGKey(0), 64, include_norm_add=True)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 2, 64))
+    y = self_attn(p, x, 4, include_norm_add=True)
+    assert y.shape == x.shape
+    # zeroing the out-projection must reduce the block to identity
+    p0 = {**p, "out": {"kernel": jnp.zeros_like(p["out"]["kernel"]),
+                       "bias": jnp.zeros_like(p["out"]["bias"])}}
+    np.testing.assert_allclose(
+        np.asarray(self_attn(p0, x, 4, include_norm_add=True)),
+        np.asarray(x), rtol=1e-6, atol=1e-6)
+
+
+def test_encdec_attn_shapes_and_memory_lengths():
+    p = init_encdec_attn(jax.random.PRNGKey(0), 64)
+    q = jax.random.normal(jax.random.PRNGKey(1), (6, 2, 64))
+    mem = jax.random.normal(jax.random.PRNGKey(2), (12, 2, 64))
+    y = encdec_attn(p, q, mem, 4)
+    assert y.shape == q.shape
+    # masking all-but-first memory position == attending to 1-length memory
+    lens = jnp.array([1, 1], jnp.int32)
+    y_masked = encdec_attn(p, q, mem, 4, key_padding_lens=lens)
+    y_trunc = encdec_attn(p, q, mem[:1], 4)
+    np.testing.assert_allclose(np.asarray(y_masked), np.asarray(y_trunc),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_conv_bias_relu_fusions():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 3))
+    w = jax.random.normal(jax.random.PRNGKey(1), (3, 3, 3, 5)) * 0.1
+    b = jnp.linspace(-1, 1, 5)
+    from jax import lax
+    ref = lax.conv_general_dilated(
+        x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")) + b
+    np.testing.assert_allclose(
+        np.asarray(conv_bias_relu(x, w, b)),
+        np.asarray(jnp.maximum(ref, 0)), rtol=1e-5, atol=1e-5)
+    scale = jnp.full((5,), 2.0)
+    np.testing.assert_allclose(
+        np.asarray(conv_frozen_scale_bias_relu(x, w, scale, b)),
+        np.asarray(jnp.maximum((ref - b) * 2.0 + b, 0)),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_group_batch_norm_nhwc_local_stats():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 6, 6, 3)) * 3 + 1
+    scale = jnp.ones((3,))
+    bias = jnp.zeros((3,))
+    rm = jnp.zeros((3,))
+    rv = jnp.ones((3,))
+    y, nm, nv = group_batch_norm_nhwc(x, scale, bias, rm, rv, axis=None)
+    # normalised output has ~zero mean / unit variance per channel
+    np.testing.assert_allclose(np.asarray(y.mean((0, 1, 2))), 0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y.std((0, 1, 2))), 1, atol=1e-3)
+    # running stats moved toward the batch stats
+    assert float(jnp.abs(nm - 0.1 * x.mean((0, 1, 2))).max()) < 1e-5
+    # fused add+relu epilogue
+    z = -jnp.ones_like(x) * 10.0
+    y2, _, _ = group_batch_norm_nhwc(x, scale, bias, rm, rv, axis=None,
+                                     z=z, relu=True)
+    assert float(y2.min()) == 0.0
+
+
+def test_group_batch_norm_cross_replica(devices8=None):
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu import mesh as mx
+    mesh = mx.build_mesh(tp=1, devices=jax.devices()[:8])
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 4, 4, 3))
+    scale = jnp.ones((3,)); bias = jnp.zeros((3,))
+    rm = jnp.zeros((3,)); rv = jnp.ones((3,))
+
+    def local(xl):
+        y, nm, nv = group_batch_norm_nhwc(xl, scale, bias, rm, rv, axis="dp")
+        return y, nm, nv
+    y, nm, nv = jax.jit(jax.shard_map(
+        local, mesh=mesh, in_specs=(P("dp"),),
+        out_specs=(P("dp"), P(), P()), check_vma=False))(x)
+    # group stats == global batch stats
+    _, nm_ref, _ = group_batch_norm_nhwc(x, scale, bias, rm, rv, axis=None)
+    np.testing.assert_allclose(np.asarray(nm), np.asarray(nm_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_step_timer_and_metrics(tmp_path):
+    timer = profiler.StepTimer(tokens_per_step=100, window=10)
+    x = jnp.arange(4.0)
+    timer.tick(x)
+    for _ in range(3):
+        timer.tick(x * 2)
+    s = timer.summary()
+    assert s["steps"] == 3 and s["tokens_per_sec"] > 0
+    assert profiler.model_flops_per_token(100, remat=True) == 800.0
+
+    log = profiler.MetricsLogger(jsonl_path=str(tmp_path / "m.jsonl"))
+    log.log(0, {"loss": jnp.float32(3.5), "lr": 0.1})
+    log.log(1, {"loss": jnp.float32(3.2), "lr": 0.1})
+    log.close()
+    import json
+    lines = [json.loads(l) for l in open(tmp_path / "m.jsonl")]
+    assert lines[1]["loss"] == pytest.approx(3.2)
+    assert log.history[0]["step"] == 0
+
+
+def test_annotate_and_sync():
+    with profiler.annotate("test-range"):
+        y = jnp.sum(jnp.arange(10.0))
+    profiler._sync(y)
+    assert float(y) == 45.0
