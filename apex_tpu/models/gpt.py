@@ -36,7 +36,8 @@ from jax.sharding import PartitionSpec as P
 import numpy as np
 
 from apex_tpu.kernels import flash_attention, layer_norm
-from apex_tpu.mesh.topology import AXIS_PP, AXIS_TP
+from apex_tpu.mesh.topology import AXIS_CP, AXIS_PP, AXIS_TP
+from apex_tpu.transformer.context_parallel import ring_attention
 from apex_tpu.transformer.pipeline_parallel.schedules import pipelined_loss
 from apex_tpu.transformer.tensor_parallel import random as tpr
 from apex_tpu.transformer.tensor_parallel.cross_entropy import (
@@ -82,6 +83,13 @@ class GPTConfig:
     #: "xla" → materialised-scores attention (faster at short seq where
     #: the s×s block fits comfortably); "auto" picks by seq_len.
     attn_impl: str = "auto"
+    #: Long-context mode (no reference analogue — SURVEY.md §5 "no ring
+    #: attention"): activations stay sequence-sharded over the ``cp`` mesh
+    #: axis through the whole stack; attention is exact ring attention
+    #: (K/V chunks rotate over ICI). Composes with TP and PP; mutually
+    #: exclusive with Megatron sequence_parallel (both shard the seq dim).
+    context_parallel: bool = False
+    cp_axis: str = AXIS_CP
     #: False → bidirectional attention (the BERT encoder reuses this stack)
     causal: bool = True
     compute_dtype: Any = jnp.bfloat16
@@ -235,7 +243,9 @@ def _attention(cfg: GPTConfig, p, h):
         impl = "flash" if s >= 2048 else "xla"
     if impl not in ("flash", "xla"):
         raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
-    if impl == "flash":
+    if cfg.context_parallel:
+        out = ring_attention(q, k, v, axis=cfg.cp_axis, causal=cfg.causal)
+    elif impl == "flash":
         out = flash_attention(q, k, v, causal=cfg.causal)
     else:
         sc = 1.0 / d ** 0.5
@@ -275,13 +285,33 @@ def _block(cfg: GPTConfig, p, h):
     return h + _mlp(cfg, p["mlp"], x)
 
 
+def _cp_slice(cfg: GPTConfig, x, dim: int):
+    """Slice this cp rank's contiguous sequence chunk of ``x`` along
+    ``dim`` (ring_attention's layout contract: rank r holds positions
+    [r·s_local, (r+1)·s_local))."""
+    cp = lax.axis_size(cfg.cp_axis)
+    s = x.shape[dim]
+    if s % cp:
+        raise ValueError(f"seq len {s} not divisible by cp={cp}")
+    r = lax.axis_index(cfg.cp_axis)
+    return lax.dynamic_slice_in_dim(x, r * (s // cp), s // cp, dim)
+
+
 def _embed(cfg: GPTConfig, params, tokens):
-    """tokens [b, s] → entry activation [s(_local under SP), b, hidden]."""
+    """tokens [b, s] → entry activation [s(_local under SP/CP), b,
+    hidden]."""
+    if cfg.context_parallel and cfg.sequence_parallel:
+        raise ValueError(
+            "context_parallel and sequence_parallel both shard the "
+            "sequence dim; enable one")
+    pos = params["embedding"]["position"][: tokens.shape[1]]
+    if cfg.context_parallel:
+        tokens = _cp_slice(cfg, tokens, 1)
+        pos = _cp_slice(cfg, pos, 0)
     emb = vocab_parallel_embedding(
         tokens, params["embedding"]["word"]["table"].astype(cfg.compute_dtype),
         axis=cfg.axis,
-    )  # [b, s, h]
-    pos = params["embedding"]["position"][: tokens.shape[1]]
+    )  # [b, s_local, h]
     h = emb + pos[None].astype(cfg.compute_dtype)
     h = jnp.transpose(h, (1, 0, 2))  # [s, b, h]
     if cfg.sequence_parallel:
@@ -369,7 +399,12 @@ def loss(cfg: GPTConfig, params, tokens, targets):
         h = gather_from_sequence_parallel_region(h, cfg.axis, True)
     else:
         h = copy_to_tensor_model_parallel_region(h, cfg.axis)
-    return _ce_of_hidden(cfg, params, h, jnp.transpose(targets, (1, 0)))
+    tgt = jnp.transpose(targets, (1, 0))
+    if cfg.context_parallel:
+        # local mean over this rank's chunk; shards are equal-sized so the
+        # global mean is the cp-pmean the train step applies
+        tgt = _cp_slice(cfg, tgt, 0)
+    return _ce_of_hidden(cfg, params, h, tgt)
 
 
 # ---------------------------------------------------------------------------
@@ -469,6 +504,8 @@ def pipeline_loss(
     seq_local = s
     if cfg.sequence_parallel:
         seq_local = s // lax.axis_size(cfg.axis)
+    if cfg.context_parallel:
+        seq_local = s // lax.axis_size(cfg.cp_axis)
     item = jax.ShapeDtypeStruct((seq_local, mb, cfg.hidden_size),
                                 cfg.compute_dtype)
 
@@ -483,6 +520,8 @@ def pipeline_loss(
         else:
             h = copy_to_tensor_model_parallel_region(h, cfg.axis)
         tgt = jnp.transpose(targets.reshape(n_micro * mb, s), (1, 0))
+        if cfg.context_parallel:
+            tgt = _cp_slice(cfg, tgt, 0)
         return _ce_of_hidden(cfg, params, h, tgt)
 
     return pipelined_loss(

@@ -29,7 +29,13 @@ from jax.sharding import PartitionSpec as P
 from apex_tpu.amp import ScalerConfig, ScalerState, apply_if_finite
 from apex_tpu.amp import update as scaler_update
 from apex_tpu.amp import value_and_scaled_grad
-from apex_tpu.mesh.topology import AXIS_DP, AXIS_PP, AXIS_TP, mesh_shape_of
+from apex_tpu.mesh.topology import (
+    AXIS_CP,
+    AXIS_DP,
+    AXIS_PP,
+    AXIS_TP,
+    mesh_shape_of,
+)
 from apex_tpu.models import gpt
 from apex_tpu.optimizers import DistributedFusedOptimizer, FusedOptimizer
 
@@ -106,6 +112,11 @@ def make_train_step(
     """
     scaler_cfg = scaler_cfg or ScalerConfig(enabled=False)
     axes_present = set(mesh.axis_names)
+    cp_active = cfg.context_parallel and (
+        mesh_shape_of(mesh).get(cfg.cp_axis, 1) > 1)
+    if cfg.context_parallel and cfg.cp_axis not in axes_present:
+        raise ValueError(
+            f"context_parallel needs mesh axis {cfg.cp_axis!r}")
     pp = mesh_shape_of(mesh).get(AXIS_PP, 1)
     pipelined = pp > 1
     if n_chunks > 1 and not pipelined:
@@ -194,6 +205,10 @@ def make_train_step(
         if AXIS_DP in axes_present and not isinstance(
                 optimizer, DistributedFusedOptimizer):
             grads = lax.pmean(grads, AXIS_DP)
+        if cp_active:
+            # params are replicated over cp but each rank saw only its
+            # sequence chunk — mean of equal-sized chunk losses
+            grads = lax.pmean(grads, cfg.cp_axis)
         if cfg.sequence_parallel:
             grads = jax.tree.map(
                 lambda g, m: lax.psum(g, AXIS_TP) if m else g, grads, sp_mask)
@@ -201,8 +216,10 @@ def make_train_step(
             grads = jax.tree.map(
                 lambda g, m: lax.psum(g, AXIS_PP) if m else g, grads, pp_mask)
         # a single rank overflowing must skip the step everywhere
-        sync_axes = tuple(
-            a for a in (AXIS_DP, AXIS_TP, AXIS_PP) if a in axes_present)
+        sync_names = [AXIS_DP, AXIS_TP, AXIS_PP]
+        if cp_active:
+            sync_names.append(cfg.cp_axis)
+        sync_axes = tuple(a for a in sync_names if a in axes_present)
         finite = lax.pmin(finite.astype(jnp.int32), sync_axes) > 0
 
         new_params, new_opt = optimizer.step(grads, state.opt_state, params)
@@ -210,9 +227,13 @@ def make_train_step(
         new_opt = apply_if_finite(new_opt, state.opt_state, finite)
         new_scaler = scaler_update(scaler_cfg, state.scaler, finite)
 
+        loss_out = value
+        if AXIS_DP in axes_present:
+            loss_out = lax.pmean(loss_out, AXIS_DP)
+        if cp_active:
+            loss_out = lax.pmean(loss_out, cfg.cp_axis)
         metrics = {
-            "loss": lax.pmean(value, AXIS_DP)
-            if AXIS_DP in axes_present else value,
+            "loss": loss_out,
             "grads_finite": finite.astype(jnp.int32),
             "loss_scale": new_scaler.loss_scale,
         }

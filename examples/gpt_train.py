@@ -46,6 +46,9 @@ def main():
     ap.add_argument("--preset", default="tiny", choices=sorted(PRESETS))
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--pp", type=int, default=1)
+    ap.add_argument("--cp", type=int, default=1,
+                    help="context parallelism: ring attention over cp "
+                    "seq shards (long-context mode)")
     ap.add_argument("--n-micro", type=int, default=1)
     ap.add_argument("--vpp", type=int, default=1)
     ap.add_argument("--batch", type=int, default=8)
@@ -59,9 +62,10 @@ def main():
     args = ap.parse_args()
 
     cfg = gpt.GPTConfig(
-        sequence_parallel=(args.tp > 1 and not args.no_sp),
+        sequence_parallel=(args.tp > 1 and args.cp == 1 and not args.no_sp),
+        context_parallel=(args.cp > 1),
         remat=True, compute_dtype=jnp.bfloat16, **PRESETS[args.preset])
-    mesh = mx.build_mesh(tp=args.tp, pp=args.pp)
+    mesh = mx.build_mesh(tp=args.tp, pp=args.pp, cp=args.cp)
     init_fn, step_fn = training.make_train_step(
         cfg, mesh, fused_adam(args.lr), ScalerConfig(enabled=False),
         n_micro=args.n_micro, n_chunks=args.vpp)
