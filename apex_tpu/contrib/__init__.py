@@ -1,5 +1,6 @@
 """Optional subsystems (apex/contrib/* (U) parity)."""
 
+from apex_tpu.contrib.bottleneck import bottleneck, init_bottleneck
 from apex_tpu.contrib.clip_grad import clip_grad_norm_
 from apex_tpu.contrib.conv_bias_relu import (
     conv_bias,
@@ -30,6 +31,7 @@ __all__ = [
     "transducer_joint",
     "transducer_loss",
     "clip_grad_norm_",
+    "bottleneck", "init_bottleneck",
     "sigmoid_focal_loss",
     "group_norm_nhwc",
     "group_batch_norm_nhwc",

@@ -1,0 +1,98 @@
+"""Fused ResNet bottleneck block — ``apex.contrib.bottleneck`` (U).
+
+The reference's ``Bottleneck``/``SpatialBottleneck`` (apex/contrib/
+bottleneck/bottleneck.py (U)) is a drop-in for torchvision's bottleneck
+with every conv running as a fused NHWC conv+scale+bias(+relu) kernel
+(frozen-BatchNorm folded into per-channel scale/bias) and, in the spatial
+variant, the 3×3 conv's H dim sharded across GPUs with peer-memory halo
+exchange. TPU-native: the fusions are the `conv_bias_relu` epilogue
+compositions (XLA folds them into the conv), and spatial parallelism is
+`contrib.spatial`'s ``ppermute`` halo exchange.
+
+Structure (torchvision bottleneck, NHWC):
+  1×1 conv (c_in → width)  + scale/bias + relu
+  3×3 conv (width → width, stride) + scale/bias + relu     [spatial-shardable]
+  1×1 conv (width → 4·width) + scale/bias
+  (+ optional 1×1 stride downsample on the residual) → add → relu
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.contrib.spatial import spatial_conv2d
+
+
+def init_bottleneck(key, c_in: int, width: int, *, stride: int = 1,
+                    dtype=jnp.float32) -> Any:
+    """Parameters: three convs + frozen-BN scale/bias each, and a
+    downsample path when shape changes (``Bottleneck.__init__`` (U))."""
+    ks = jax.random.split(key, 4)
+    c_out = 4 * width
+
+    def conv(k, kh, kw, ci, co):
+        fan = kh * kw * ci
+        return jax.random.normal(k, (kh, kw, ci, co), dtype) * (2.0 / fan) ** 0.5
+
+    p = {
+        "conv1": {"kernel": conv(ks[0], 1, 1, c_in, width),
+                  "scale": jnp.ones((width,), dtype),
+                  "bias": jnp.zeros((width,), dtype)},
+        "conv2": {"kernel": conv(ks[1], 3, 3, width, width),
+                  "scale": jnp.ones((width,), dtype),
+                  "bias": jnp.zeros((width,), dtype)},
+        "conv3": {"kernel": conv(ks[2], 1, 1, width, c_out),
+                  "scale": jnp.ones((c_out,), dtype),
+                  "bias": jnp.zeros((c_out,), dtype)},
+    }
+    if stride != 1 or c_in != c_out:
+        p["downsample"] = {"kernel": conv(ks[3], 1, 1, c_in, c_out),
+                           "scale": jnp.ones((c_out,), dtype),
+                           "bias": jnp.zeros((c_out,), dtype)}
+    return p
+
+
+def _csb(x, p, *, stride=1, relu=True, padding="SAME"):
+    y = lax.conv_general_dilated(
+        x, p["kernel"].astype(x.dtype), (stride, stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    y = y * p["scale"].astype(x.dtype) + p["bias"].astype(x.dtype)
+    return jnp.maximum(y, 0) if relu else y
+
+
+def bottleneck(params, x, *, stride: int = 1,
+               spatial_axis: Optional[str] = None):
+    """``Bottleneck.forward`` (U) on NHWC ``x``.
+
+    ``spatial_axis`` names the mesh axis H is sharded over
+    (``SpatialBottleneck`` (U)): the 3×3 conv exchanges one halo row per
+    side via ``ppermute`` and runs VALID on H — identical results to the
+    unsharded block sliced per rank (stride 1 on H, the reference's
+    constraint for spatial groups). Call inside shard_map in that case.
+    """
+    out = _csb(x, params["conv1"])
+    if spatial_axis is None:
+        out = _csb(out, params["conv2"], stride=stride)
+    else:
+        if stride != 1:
+            raise NotImplementedError(
+                "spatial bottleneck requires H-stride 1 (reference keeps "
+                "strided convs on unsharded dims)")
+        p2 = params["conv2"]
+        y = spatial_conv2d(out, p2["kernel"].astype(out.dtype),
+                           axis=spatial_axis)
+        y = y * p2["scale"].astype(out.dtype) + p2["bias"].astype(out.dtype)
+        out = jnp.maximum(y, 0)
+    out = _csb(out, params["conv3"], relu=False)
+    res = x
+    if "downsample" in params:
+        res = _csb(x, params["downsample"], stride=stride, relu=False)
+    elif stride != 1:
+        # init_bottleneck always pairs stride!=1 with a downsample conv —
+        # an identity residual cannot match the strided main path
+        raise ValueError("stride != 1 requires a 'downsample' entry")
+    return jnp.maximum(out + res, 0)

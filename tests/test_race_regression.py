@@ -1,0 +1,100 @@
+"""Overlap-equivalence regression tests (SURVEY.md §5 'race detection').
+
+The reference's only race regression is tests/distributed/DDP/
+ddp_race_condition_test.py (U): the bucketed allreduce overlapped with
+backward must produce the same gradients as one monolithic reduce. XLA has
+no data races, but the *scheduling-equivalence* property is still worth
+pinning: flat-buffer (bucketed) collectives, per-tensor collectives, and
+in-step reductions must agree bitwise; pipelined and non-pipelined
+microbatch schedules must agree numerically.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import mesh as mx
+from apex_tpu.multi_tensor import pack, unpack
+from apex_tpu.parallel.distributed import allreduce_gradients, flat_dist_call
+
+
+@pytest.fixture
+def dp8():
+    return mx.build_mesh(tp=1, devices=jax.devices()[:8])
+
+
+def _grads(key):
+    ks = jax.random.split(key, 3)
+    return {
+        "w": jax.random.normal(ks[0], (64, 32)),
+        "b": jax.random.normal(ks[1], (32,)),
+        "emb": jax.random.normal(ks[2], (128, 16)),
+    }
+
+
+def test_bucketed_equals_monolithic_bitwise(dp8):
+    """apex's race test oracle: flat-bucketed reduce == per-tensor reduce,
+    bit-for-bit (same psum, same operand order)."""
+    grads = _grads(jax.random.PRNGKey(0))
+
+    def bucketed(g):
+        bufs, layout = pack(g)
+        reduced = [jax.lax.psum(b, "dp") for b in bufs]
+        return unpack(reduced, layout)
+
+    def monolithic(g):
+        return jax.tree.map(lambda x: jax.lax.psum(x, "dp"), g)
+
+    spec = jax.tree.map(lambda _: P(), grads)
+    run = lambda f: jax.jit(jax.shard_map(
+        f, mesh=dp8, in_specs=(spec,), out_specs=spec, check_vma=False))(
+            grads)
+    a, b = run(bucketed), run(monolithic)
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_allreduce_gradients_matches_flat_dist_call(dp8):
+    grads = _grads(jax.random.PRNGKey(1))
+    spec = jax.tree.map(lambda _: P(), grads)
+
+    a = jax.jit(jax.shard_map(
+        lambda g: allreduce_gradients(g, gradient_average=False),
+        mesh=dp8, in_specs=(spec,), out_specs=spec, check_vma=False))(grads)
+    b = jax.jit(jax.shard_map(
+        lambda g: flat_dist_call(g, op="psum"),
+        mesh=dp8, in_specs=(spec,), out_specs=spec, check_vma=False))(grads)
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_pipelined_loss_equals_single_stage():
+    """PP schedule equivalence: the 1F1B ring over pp=2 must compute the
+    same loss as the same model with no pipeline (the reference's
+    test_pipeline_parallel_fwd_bwd.py oracle: 'losses under PP == no-PP
+    reference' (U))."""
+    from apex_tpu.models import gpt
+
+    cfg = gpt.GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                        num_heads=2, seq_len=16, remat=False,
+                        compute_dtype=jnp.float32)
+    params = jax.jit(lambda k: gpt.init(cfg, k))(jax.random.PRNGKey(0))
+    tok = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+    tgt = jnp.roll(tok, -1, 1)
+
+    mesh1 = mx.build_mesh(tp=1, devices=jax.devices()[:1])
+    pspec = gpt.param_specs(cfg)
+    base = jax.jit(jax.shard_map(
+        lambda p: gpt.loss(cfg, p, tok, tgt), mesh=mesh1,
+        in_specs=(pspec,), out_specs=P(), check_vma=False))(params)
+
+    mesh = mx.build_mesh(tp=1, pp=2, dp=1, devices=jax.devices()[:2])
+    pp_params = gpt.interleave_layers(params, cfg.num_layers, 2)
+    pspec_pp = gpt.param_specs(cfg, pipeline=True)
+    pp = jax.jit(jax.shard_map(
+        lambda p: gpt.pipeline_loss(cfg, p, tok, tgt, n_micro=2),
+        mesh=mesh, in_specs=(pspec_pp,), out_specs=P(), check_vma=False))(
+            pp_params)
+    np.testing.assert_allclose(float(pp), float(base), rtol=1e-5)
