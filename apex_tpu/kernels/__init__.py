@@ -5,6 +5,7 @@ native kernel path); kernels fall back to the Pallas interpreter off-TPU so
 the CPU test backbone exercises identical semantics.
 """
 
+from apex_tpu.kernels.blockwise_attention import blockwise_attention
 from apex_tpu.kernels.layer_norm import layer_norm, rms_norm
 from apex_tpu.kernels.softmax import (
     scaled_masked_softmax,
@@ -22,6 +23,7 @@ from apex_tpu.kernels.flat_ops import (
 )
 
 __all__ = [
+    "blockwise_attention",
     "layer_norm",
     "rms_norm",
     "scaled_masked_softmax",

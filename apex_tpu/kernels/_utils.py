@@ -44,3 +44,16 @@ def pick_block_rows(hidden_padded: int, *, bytes_per_el: int = 4,
             break
         rows //= 2
     return max(rows, SUBLANE_F32)
+
+
+def widen_f16(x):
+    """Mosaic has no f16 type — TPU hardware is bf16/f32-native — so
+    float16 operands are widened to f32 at the public kernel boundaries
+    (outputs cast back by the caller). Applied on every backend so CPU
+    interpret-mode tests exercise the same numerics the chip runs.
+    Returns ``(array, was_f16)``; passes non-arrays/None through."""
+    import jax.numpy as _jnp
+
+    if x is not None and getattr(x, "dtype", None) == _jnp.float16:
+        return x.astype(_jnp.float32), True
+    return x, False

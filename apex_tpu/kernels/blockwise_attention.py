@@ -1,0 +1,70 @@
+"""Blockwise attention at the XLA level — flash memory shape, MXU codegen.
+
+Complements the Pallas flash kernel (flash_attention.py): the sequence is
+scanned in query chunks under ``jax.checkpoint``, so only an
+O(chunk · s) score block is ever live and the backward rematerialises per
+chunk — the same memory envelope as flash attention, but the inner
+matmul/softmax compiles through XLA's native attention codegen (which at
+TPU matmul shapes can beat a hand-tiled kernel). Exact, differentiable by
+construction, any length divisible by the chunk.
+
+This is the XLA half of the fmha capability (U); the Pallas kernel remains
+the fully-fused path and the var-seqlen (kv_lengths) provider.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def blockwise_attention(q, k, v, *, causal: bool = False,
+                        scale: Optional[float] = None,
+                        q_chunk: int = 1024):
+    """Attention over ``[b, h, s, d]`` scanning ``q_chunk`` rows at a time.
+
+    A non-dividing ``q_chunk`` shrinks to the largest divisor of ``s`` so
+    the O(chunk·s) score-memory bound always holds.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected [b, h, s, d], got {q.shape}")
+    b, h, s, d = q.shape
+    sk = k.shape[2]
+    if causal and s != sk:
+        raise ValueError("causal attention requires sq == sk")
+    sc = float(scale) if scale is not None else 1.0 / d ** 0.5
+    if s % q_chunk:
+        # shrink to the largest divisor of s — never abandon chunking (a
+        # single chunk would materialise the full s×s f32 score matrix)
+        q_chunk = min(q_chunk, s)
+        while s % q_chunk:
+            q_chunk -= 1
+    if s <= q_chunk:
+        return _one_chunk(q, k, v, jnp.int32(0), sc, causal)
+
+    n = s // q_chunk
+    qs = jnp.moveaxis(q.reshape(b, h, n, q_chunk, d), 2, 0)  # [n,b,h,c,d]
+
+    @jax.checkpoint
+    def one(qc, idx):
+        return _one_chunk(qc, k, v, idx * q_chunk, sc, causal)
+
+    def body(_, x):
+        qc, idx = x
+        return None, one(qc, idx)
+
+    _, out = lax.scan(body, None, (qs, jnp.arange(n, dtype=jnp.int32)))
+    return jnp.moveaxis(out, 0, 2).reshape(b, h, s, d)
+
+
+def _one_chunk(qc, k, v, row0, sc, causal):
+    s_blk = jnp.einsum("bhqd,bhkd->bhqk", qc, k).astype(jnp.float32) * sc
+    if causal:
+        rows = row0 + lax.broadcasted_iota(jnp.int32, s_blk.shape[-2:], 0)
+        cols = lax.broadcasted_iota(jnp.int32, s_blk.shape[-2:], 1)
+        s_blk = jnp.where(rows >= cols, s_blk, -1e30)
+    p = jax.nn.softmax(s_blk, axis=-1).astype(qc.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)

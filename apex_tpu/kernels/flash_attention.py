@@ -27,7 +27,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.kernels._utils import LANE, cdiv, round_up, use_interpret
+from apex_tpu.kernels._utils import LANE, cdiv, round_up, use_interpret, widen_f16
 
 _NEG = -1e30
 _LANES = 128  # stat scratch lane width
@@ -398,6 +398,9 @@ def flash_attention(
     if causal and sq != sk:
         raise ValueError("causal attention requires sq == sk")
     s = float(scale) if scale is not None else 1.0 / d ** 0.5
+    q, was16 = widen_f16(q)
+    k, _ = widen_f16(k)
+    v, _ = widen_f16(v)
     q3 = q.reshape(b * h, sq, d)
     k3 = k.reshape(b * h, sk, d)
     v3 = v.reshape(b * h, sk, d)
@@ -405,7 +408,8 @@ def flash_attention(
     if kv_lengths is not None:
         lens = jnp.repeat(jnp.asarray(kv_lengths, jnp.int32), h)
     out = _flash(q3, k3, v3, lens, s, causal)
-    return out.reshape(b, h, sq, d)
+    out = out.reshape(b, h, sq, d)
+    return out.astype(jnp.float16) if was16 else out
 
 
 def mha(q, k, v, *, causal=False, scale=None, kv_lengths=None):

@@ -24,7 +24,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.kernels._utils import LANE, cdiv, use_interpret
+from apex_tpu.kernels._utils import LANE, cdiv, use_interpret, widen_f16
+
+
+def _narrow(buf, dtype):
+    """Cast a kernel output to the requested dtype when the kernel had to
+    run widened (Mosaic has no f16)."""
+    return buf if buf.dtype == dtype else buf.astype(dtype)
 
 _MAX_BLOCK_ROWS = 512
 
@@ -81,6 +87,8 @@ def scale_flat(bufs: Sequence[jnp.ndarray], scale) -> Tuple[List[jnp.ndarray], j
     s = jnp.asarray(scale, jnp.float32).reshape(1, 1)
     outs, flags = [], []
     for buf in bufs:
+        want = buf.dtype
+        buf, _ = widen_f16(buf)
         x2 = _view2d(buf)
         bm = _block_rows(x2.shape[0])
         out, flag = pl.pallas_call(
@@ -94,7 +102,7 @@ def scale_flat(bufs: Sequence[jnp.ndarray], scale) -> Tuple[List[jnp.ndarray], j
             ],
             interpret=use_interpret(),
         )(s, x2)
-        outs.append(out.reshape(-1))
+        outs.append(_narrow(out.reshape(-1), want))
         flags.append(flag[0, 0])
     found_inf = jnp.stack(flags).sum() > 0
     return outs, found_inf
@@ -128,9 +136,12 @@ def axpby_flat(a, xbufs: Sequence[jnp.ndarray], b, ybufs: Sequence[jnp.ndarray],
     s = jnp.stack([jnp.asarray(a, jnp.float32), jnp.asarray(b, jnp.float32)]).reshape(1, 2)
     outs, flags = [], []
     for xb, yb in zip(xbufs, ybufs):
+        want = jnp.dtype(out_dtype) if out_dtype else xb.dtype
+        xb, _ = widen_f16(xb)
+        yb, _ = widen_f16(yb)
         x2, y2 = _view2d(xb), _view2d(yb)
         bm = _block_rows(x2.shape[0])
-        dt = out_dtype or xb.dtype
+        dt = jnp.float32 if want == jnp.float16 else want
         out, flag = pl.pallas_call(
             _axpby_kernel,
             grid=(x2.shape[0] // bm,),
@@ -142,7 +153,7 @@ def axpby_flat(a, xbufs: Sequence[jnp.ndarray], b, ybufs: Sequence[jnp.ndarray],
             ],
             interpret=use_interpret(),
         )(s, x2, y2)
-        outs.append(out.reshape(-1))
+        outs.append(_narrow(out.reshape(-1), want))
         flags.append(flag[0, 0])
     found_inf = jnp.stack(flags).sum() > 0
     return outs, found_inf
@@ -170,6 +181,7 @@ def l2norm_flat(bufs: Sequence[jnp.ndarray]) -> jnp.ndarray:
     """``amp_C.multi_tensor_l2norm`` (U) global mode: ‖all buffers‖₂."""
     total = jnp.float32(0.0)
     for buf in bufs:
+        buf, _ = widen_f16(buf)
         x2 = _view2d(buf)
         bm = _block_rows(x2.shape[0])
         acc = pl.pallas_call(
@@ -236,21 +248,25 @@ def adam_flat(p_bufs, g_bufs, m_bufs, v_bufs, *, lr, b1, b2, eps, weight_decay,
                                out_is_delta=out_is_delta)
     new_p, new_m, new_v = [], [], []
     for pb, gb, mb, vb in zip(p_bufs, g_bufs, m_bufs, v_bufs):
+        want = jnp.dtype(out_dtype) if out_dtype else pb.dtype
+        pb, _ = widen_f16(pb)
+        gb, _ = widen_f16(gb)
         p2, g2, m2, v2 = map(_view2d, (pb, gb, mb, vb))
         bm = _block_rows(p2.shape[0])
+        dt = jnp.float32 if want == jnp.float16 else want
         np_, nm_, nv_ = pl.pallas_call(
             kernel,
             grid=(p2.shape[0] // bm,),
             in_specs=[_smem_spec((1, 8))] + [_vspec(bm)] * 4,
             out_specs=[_vspec(bm)] * 3,
             out_shape=[
-                jax.ShapeDtypeStruct(p2.shape, out_dtype or pb.dtype),
+                jax.ShapeDtypeStruct(p2.shape, dt),
                 jax.ShapeDtypeStruct(m2.shape, jnp.float32),
                 jax.ShapeDtypeStruct(v2.shape, jnp.float32),
             ],
             interpret=use_interpret(),
         )(s, p2, g2, m2, v2)
-        new_p.append(np_.reshape(-1))
+        new_p.append(_narrow(np_.reshape(-1), want))
         new_m.append(nm_.reshape(-1))
         new_v.append(nv_.reshape(-1))
     return new_p, new_m, new_v
@@ -294,6 +310,9 @@ def sgd_flat(p_bufs, g_bufs, m_bufs, *, lr, momentum, dampening, weight_decay,
                                out_is_delta=out_is_delta)
     new_p, new_m = [], []
     for pb, gb, mb in zip(p_bufs, g_bufs, m_bufs):
+        want = pb.dtype
+        pb, _ = widen_f16(pb)
+        gb, _ = widen_f16(gb)
         p2, g2, m2 = map(_view2d, (pb, gb, mb))
         bm = _block_rows(p2.shape[0])
         np_, nm_ = pl.pallas_call(
@@ -307,7 +326,7 @@ def sgd_flat(p_bufs, g_bufs, m_bufs, *, lr, momentum, dampening, weight_decay,
             ],
             interpret=use_interpret(),
         )(s, p2, g2, m2)
-        new_p.append(np_.reshape(-1))
+        new_p.append(_narrow(np_.reshape(-1), want))
         new_m.append(nm_.reshape(-1))
     return new_p, new_m
 
@@ -341,6 +360,9 @@ def adagrad_flat(p_bufs, g_bufs, h_bufs, *, lr, eps, weight_decay,
     kernel = functools.partial(_adagrad_kernel, out_is_delta=out_is_delta)
     new_p, new_h = [], []
     for pb, gb, hb in zip(p_bufs, g_bufs, h_bufs):
+        want = pb.dtype
+        pb, _ = widen_f16(pb)
+        gb, _ = widen_f16(gb)
         p2, g2, h2 = map(_view2d, (pb, gb, hb))
         bm = _block_rows(p2.shape[0])
         np_, nh_ = pl.pallas_call(
@@ -354,6 +376,6 @@ def adagrad_flat(p_bufs, g_bufs, h_bufs, *, lr, eps, weight_decay,
             ],
             interpret=use_interpret(),
         )(s, p2, g2, h2)
-        new_p.append(np_.reshape(-1))
+        new_p.append(_narrow(np_.reshape(-1), want))
         new_h.append(nh_.reshape(-1))
     return new_p, new_h

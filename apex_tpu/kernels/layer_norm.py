@@ -30,7 +30,14 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.kernels._utils import LANE, cdiv, pick_block_rows, round_up, use_interpret
+from apex_tpu.kernels._utils import (
+    LANE,
+    cdiv,
+    pick_block_rows,
+    round_up,
+    use_interpret,
+    widen_f16,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -224,7 +231,11 @@ def layer_norm(x, weight: Optional[jnp.ndarray] = None,
         weight = jnp.ones((hidden,), jnp.float32)
     if bias is None:
         bias = jnp.zeros((hidden,), weight.dtype)
-    return _norm(x, weight, bias, float(eps), True)
+    x, was16 = widen_f16(x)
+    weight, _ = widen_f16(weight)
+    bias, _ = widen_f16(bias)
+    y = _norm(x, weight, bias, float(eps), True)
+    return y.astype(jnp.float16) if was16 else y
 
 
 def rms_norm(x, weight: Optional[jnp.ndarray] = None, *, eps: float = 1e-5):
@@ -232,5 +243,8 @@ def rms_norm(x, weight: Optional[jnp.ndarray] = None, *, eps: float = 1e-5):
     hidden = x.shape[-1]
     if weight is None:
         weight = jnp.ones((hidden,), jnp.float32)
-    bias = jnp.zeros((hidden,), weight.dtype)
-    return _norm(x, weight, bias, float(eps), False)
+    x, was16 = widen_f16(x)
+    weight, _ = widen_f16(weight)
+    bias = jnp.zeros((hidden,), weight.dtype)  # after widening — no f16
+    y = _norm(x, weight, bias, float(eps), False)
+    return y.astype(jnp.float16) if was16 else y

@@ -24,7 +24,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.kernels._utils import LANE, pick_block_rows, round_up, use_interpret
+from apex_tpu.kernels._utils import LANE, pick_block_rows, round_up, use_interpret, widen_f16
 
 _NEG = -30000.0  # mask fill; reference uses -10000.0 for fp16
 
@@ -153,6 +153,7 @@ def scaled_masked_softmax(x, mask: Optional[jnp.ndarray] = None, *,
     """
     shape = x.shape
     sq, sk = shape[-2], shape[-1]
+    x, was16 = widen_f16(x)
     x3 = x.reshape(-1, sq, sk)
     m3 = None
     if mask is not None:
@@ -163,7 +164,8 @@ def scaled_masked_softmax(x, mask: Optional[jnp.ndarray] = None, *,
                 f"mask batch {m3.shape[0]} does not divide flattened batch "
                 f"{x3.shape[0]}"
             )
-    return _softmax(x3, m3, float(scale), False).reshape(shape)
+    y = _softmax(x3, m3, float(scale), False).reshape(shape)
+    return y.astype(jnp.float16) if was16 else y
 
 
 def scaled_upper_triang_masked_softmax(x, *, scale: float = 1.0):
@@ -173,5 +175,7 @@ def scaled_upper_triang_masked_softmax(x, *, scale: float = 1.0):
     sq, sk = shape[-2], shape[-1]
     if sq != sk:
         raise ValueError(f"causal softmax requires square scores, got {sq}x{sk}")
+    x, was16 = widen_f16(x)
     x3 = x.reshape(-1, sq, sk)
-    return _softmax(x3, None, float(scale), True).reshape(shape)
+    y = _softmax(x3, None, float(scale), True).reshape(shape)
+    return y.astype(jnp.float16) if was16 else y

@@ -21,7 +21,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.kernels._utils import LANE, pick_block_rows, round_up, use_interpret
+from apex_tpu.kernels._utils import LANE, pick_block_rows, round_up, use_interpret, widen_f16
 
 
 def _fwd_kernel(x_ref, t_ref, loss_ref, lse_ref, *, vocab: int,
@@ -131,6 +131,7 @@ def softmax_cross_entropy(logits, target, label_smoothing: float = 0.0,
     smoothing, ``ignore_index`` rows contribute zero loss and zero grad.
     """
     shape = target.shape
+    logits, _ = widen_f16(logits)  # loss is fp32 either way
     loss, _ = _run_fwd(logits.reshape(-1, logits.shape[-1]),
                        target.reshape(-1).astype(jnp.int32),
                        float(label_smoothing), ignore_index)
@@ -138,17 +139,25 @@ def softmax_cross_entropy(logits, target, label_smoothing: float = 0.0,
 
 
 def _sce_fwd(logits, target, label_smoothing, ignore_index):
+    orig_dtype = logits.dtype
+    logits, _ = widen_f16(logits)
     x2 = logits.reshape(-1, logits.shape[-1])
     t2 = target.reshape(-1).astype(jnp.int32)
     loss, lse = _run_fwd(x2, t2, float(label_smoothing), ignore_index)
-    return loss.reshape(target.shape), (x2, t2, lse, logits.shape, target.shape)
+    # residuals must be JAX types — carry the pre-widening dtype in a
+    # zero-size array
+    dtype_tag = jnp.zeros((0,), orig_dtype)
+    return loss.reshape(target.shape), (
+        x2, t2, lse, logits.shape, target.shape, dtype_tag)
 
 
 def _sce_bwd(label_smoothing, ignore_index, res, dy):
-    x2, t2, lse, lshape, tshape = res
+    x2, t2, lse, lshape, tshape, dtype_tag = res
     dx = _run_bwd(x2, t2, lse, dy.reshape(-1).astype(jnp.float32),
                   float(label_smoothing), ignore_index)
-    return dx.reshape(lshape), np.zeros(tshape, dtype=jax.dtypes.float0)
+    # cotangent dtype must match the primal input's (f16 widened at entry)
+    return (dx.reshape(lshape).astype(dtype_tag.dtype),
+            np.zeros(tshape, dtype=jax.dtypes.float0))
 
 
 softmax_cross_entropy.defvjp(_sce_fwd, _sce_bwd)

@@ -36,6 +36,7 @@ from jax.sharding import PartitionSpec as P
 import numpy as np
 
 from apex_tpu.kernels import flash_attention, layer_norm
+from apex_tpu.kernels.blockwise_attention import blockwise_attention
 from apex_tpu.mesh.topology import AXIS_CP, AXIS_PP, AXIS_TP
 from apex_tpu.transformer.context_parallel import ring_attention
 from apex_tpu.transformer.pipeline_parallel.schedules import pipelined_loss
@@ -79,9 +80,10 @@ class GPTConfig:
     #: shape of the reference's fused xentropy kernel (apex/contrib/
     #: xentropy (U) "saves logits memory"), done at the XLA level.
     ce_chunk: int = 0
-    #: "flash" → Pallas blockwise kernel (O(s) memory — long context);
-    #: "xla" → materialised-scores attention (faster at short seq where
-    #: the s×s block fits comfortably); "auto" picks by seq_len.
+    #: "flash" → Pallas blockwise kernel; "xla" → materialised-scores
+    #: attention (fastest at short seq); "xla_chunked" → q-chunk scanned
+    #: attention with flash's O(chunk·s) memory but XLA matmul codegen
+    #: (fastest at long seq); "auto" picks by seq_len.
     attn_impl: str = "auto"
     #: Long-context mode (no reference analogue — SURVEY.md §5 "no ring
     #: attention"): activations stay sequence-sharded over the ``cp`` mesh
@@ -240,13 +242,15 @@ def _attention(cfg: GPTConfig, p, h):
     q, k, v = (jnp.transpose(qkv[:, :, :, i, :], (1, 2, 0, 3)) for i in range(3))
     impl = cfg.attn_impl
     if impl == "auto":
-        impl = "flash" if s >= 2048 else "xla"
-    if impl not in ("flash", "xla"):
+        impl = "xla_chunked" if s >= 2048 else "xla"
+    if impl not in ("flash", "xla", "xla_chunked"):
         raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
     if cfg.context_parallel:
         out = ring_attention(q, k, v, axis=cfg.cp_axis, causal=cfg.causal)
     elif impl == "flash":
         out = flash_attention(q, k, v, causal=cfg.causal)
+    elif impl == "xla_chunked":
+        out = blockwise_attention(q, k, v, causal=cfg.causal)
     else:
         sc = 1.0 / d ** 0.5
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sc

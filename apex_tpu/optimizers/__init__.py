@@ -21,6 +21,11 @@ from apex_tpu.optimizers.larc import larc_transform
 # apex class-name aliases
 DistributedFusedAdam = distributed_fused_adam
 DistributedFusedLAMB = distributed_fused_lamb
+#: FusedMixedPrecisionLamb [era] (apex/optimizers/fused_mixed_precision_
+#: lamb.py (U)): fp16 model params with fp32 master math. Structural here:
+#: the flat-op kernels always compute fp32 and cast back to each param
+#: group's dtype, and amp O2 carries fp32 masters in the train state.
+FusedMixedPrecisionLamb = fused_lamb
 FusedAdam = fused_adam
 FusedLAMB = fused_lamb
 FusedSGD = fused_sgd
@@ -34,6 +39,7 @@ __all__ = [
     "distributed_fused_lamb", "DistributedFusedLAMB",
     "fused_adam", "FusedAdam", "FusedAdamState",
     "fused_lamb", "FusedLAMB", "FusedLAMBState",
+    "FusedMixedPrecisionLamb",
     "fused_sgd", "FusedSGD", "FusedSGDState",
     "fused_novograd", "FusedNovoGrad", "FusedNovoGradState",
     "fused_adagrad", "FusedAdagrad", "FusedAdagradState",

@@ -34,7 +34,7 @@ def main():
             seq_len=1024, remat=True, ce_chunk=256,
             compute_dtype=jnp.bfloat16,
         )
-        batch, steps = 16, 20
+        batch, steps = 32, 15
     else:  # CPU smoke fallback so the harness always gets a line
         cfg = gpt.GPTConfig(
             vocab_size=1024, hidden_size=256, num_layers=4, num_heads=8,

@@ -1,0 +1,141 @@
+"""Blockwise (chunked-XLA) attention + float16 kernel-boundary widening.
+
+Mosaic has no f16 type, so every public Pallas wrapper widens float16
+operands to f32 and narrows the result (kernels/_utils.widen_f16) —
+these tests pin output dtypes and numerics for the fp16 (apex O2/O3
+parity) path on every backend.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.kernels import flash_attention, layer_norm
+from apex_tpu.kernels.blockwise_attention import blockwise_attention
+from apex_tpu.kernels.flat_ops import adam_flat, l2norm_flat, scale_flat
+from apex_tpu.kernels.softmax import scaled_upper_triang_masked_softmax
+from apex_tpu.kernels.xentropy import softmax_cross_entropy
+
+
+def _naive(q, k, v, causal):
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / d ** 0.5
+    if causal:
+        sq = q.shape[2]
+        s = jnp.where(jnp.tril(jnp.ones((sq, sq), bool)), s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_blockwise_matches_naive(causal):
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 2, 256, 32))
+    k = jax.random.normal(jax.random.PRNGKey(1), (2, 2, 256, 32))
+    v = jax.random.normal(jax.random.PRNGKey(2), (2, 2, 256, 32))
+    got = blockwise_attention(q, k, v, causal=causal, q_chunk=64)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(_naive(q, k, v, causal)),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_blockwise_grads_match_naive():
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 128, 16))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 128, 16))
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 2, 128, 16))
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    g1 = jax.grad(loss(lambda q, k, v: blockwise_attention(
+        q, k, v, causal=True, q_chunk=32)), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss(lambda q, k, v: _naive(q, k, v, True)),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_blockwise_nondivisible_shrinks_chunk():
+    """A non-dividing q_chunk shrinks to a divisor (never a full-matrix
+    fallback) and stays exact."""
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 100, 16))
+    k, v = q + 1, q - 1
+    got = blockwise_attention(q, k, v, causal=True, q_chunk=64)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(_naive(q, k, v, True)),
+                               rtol=1e-4, atol=1e-4)
+    # prime length: degenerates to chunk 1 only for prime s <= q_chunk²
+    got_p = blockwise_attention(q[:, :, :97], k[:, :, :97], v[:, :, :97],
+                                causal=True, q_chunk=32)
+    np.testing.assert_allclose(
+        np.asarray(got_p),
+        np.asarray(_naive(q[:, :, :97], k[:, :, :97], v[:, :, :97], True)),
+        rtol=1e-4, atol=1e-4)
+
+
+# -- f16 widening ----------------------------------------------------------
+
+def test_layer_norm_f16_dtype_and_numerics():
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 128), jnp.float16)
+    w = jnp.ones((128,), jnp.float32)
+    b = jnp.zeros((128,), jnp.float32)
+    y = layer_norm(x, w, b)
+    assert y.dtype == jnp.float16
+    ref = layer_norm(x.astype(jnp.float32), w, b)
+    np.testing.assert_allclose(np.asarray(y, np.float32), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+    # grads flow and carry the input dtype
+    g = jax.grad(lambda x: layer_norm(x, w, b).astype(jnp.float32).sum())(x)
+    assert g.dtype == jnp.float16
+
+
+def test_rms_norm_f16_dtype():
+    from apex_tpu.kernels import rms_norm
+    x = jax.random.normal(jax.random.PRNGKey(0), (32, 128), jnp.float16)
+    w = jnp.ones((128,), jnp.float16)  # f16 weight must also widen
+    y = rms_norm(x, w)
+    assert y.dtype == jnp.float16
+    assert bool(jnp.isfinite(y.astype(jnp.float32)).all())
+
+
+def test_flash_attention_f16_dtype():
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 64, 32), jnp.float16)
+    out = flash_attention(q, q, q, causal=True)
+    assert out.dtype == jnp.float16
+    assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+
+
+def test_softmax_xentropy_f16():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 2, 32, 32), jnp.float16)
+    y = scaled_upper_triang_masked_softmax(x)
+    assert y.dtype == jnp.float16
+    rows = jnp.sum(y.astype(jnp.float32), -1)
+    np.testing.assert_allclose(np.asarray(rows), 1.0, rtol=2e-3)
+
+    logits = jax.random.normal(jax.random.PRNGKey(1), (8, 64), jnp.float16)
+    tgt = jnp.arange(8) % 64
+    loss = softmax_cross_entropy(logits, tgt)
+    assert loss.dtype == jnp.float32
+    g = jax.grad(lambda l: softmax_cross_entropy(l, tgt).sum())(logits)
+    assert g.dtype == jnp.float16
+
+
+def test_flat_ops_f16_buffers():
+    n = 2048
+    p16 = jnp.full((n,), 0.5, jnp.float16)
+    g16 = jnp.full((n,), 2.0, jnp.float16)
+    outs, found = scale_flat([g16], 0.5)
+    assert outs[0].dtype == jnp.float16
+    np.testing.assert_allclose(np.asarray(outs[0], np.float32), 1.0)
+    assert not bool(found)
+    assert float(l2norm_flat([g16])) == pytest.approx(
+        np.sqrt(n * 4.0), rel=1e-3)
+    m = jnp.zeros((n,), jnp.float32)
+    v = jnp.zeros((n,), jnp.float32)
+    new_p, new_m, new_v = adam_flat(
+        [p16], [g16], [m], [v], lr=0.1, b1=0.9, b2=0.999, eps=1e-8,
+        weight_decay=0.0, bias_correction1=0.1, bias_correction2=0.001)
+    assert new_p[0].dtype == jnp.float16
+    assert new_m[0].dtype == jnp.float32
+    assert bool(jnp.isfinite(new_p[0].astype(jnp.float32)).all())

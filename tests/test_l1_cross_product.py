@@ -1,0 +1,90 @@
+"""L1 integration: precision-mode × loss-scaling × data-parallel cross
+product (SURVEY.md §4: tests/L1/common main_amp.py + compare.py (U)).
+
+The reference trains an imagenet-ish model under every (opt-level,
+loss-scale, DDP) combination and diffs end-of-run losses/weights against
+saved references. Here the oracle is in-process: fp32 single-device
+training is the reference run; every other combination must track it
+(same seed, same data) within mode-appropriate tolerance, and DP on/off
+must agree exactly for the same effective batch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import mesh as mx
+from apex_tpu.amp import ScalerConfig
+from apex_tpu.models import gpt, training
+from apex_tpu.optimizers import fused_adam, fused_sgd
+
+CFG = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+           seq_len=16, remat=False)
+STEPS = 6
+
+
+def _data():
+    tok = jax.random.randint(jax.random.PRNGKey(7), (8, 16), 0, 64)
+    return tok, jnp.roll(tok, -1, 1)
+
+
+def _train(compute_dtype, scaler_cfg, n_devices, opt=None, steps=STEPS):
+    cfg = gpt.GPTConfig(compute_dtype=compute_dtype, **CFG)
+    mesh = mx.build_mesh(tp=1, devices=jax.devices()[:n_devices])
+    init_fn, step_fn = training.make_train_step(
+        cfg, mesh, opt or fused_adam(5e-3), scaler_cfg)
+    state = init_fn(jax.random.PRNGKey(0))
+    tok, tgt = _data()
+    losses = []
+    for _ in range(steps):
+        state, m = step_fn(state, tok, tgt)
+        losses.append(float(m["loss"]))
+    return np.asarray(losses), state
+
+
+@pytest.fixture(scope="module")
+def reference():
+    """O0: fp32, no scaling, single device."""
+    return _train(jnp.float32, ScalerConfig(enabled=False), 1)
+
+
+def test_o0_converges(reference):
+    losses, _ = reference
+    assert losses[-1] < losses[0]
+
+
+def test_bf16_tracks_fp32(reference):
+    """bf16 compute (the TPU O1/O2 analogue, no scaler needed)."""
+    ref_losses, _ = reference
+    losses, _ = _train(jnp.bfloat16, ScalerConfig(enabled=False), 1)
+    np.testing.assert_allclose(losses, ref_losses, rtol=0.08)
+
+
+def test_fp16_dynamic_scaling_tracks_fp32(reference):
+    """fp16 + dynamic loss scaler (apex O2 parity mode)."""
+    ref_losses, _ = reference
+    losses, state = _train(jnp.float16, ScalerConfig(), 1)
+    np.testing.assert_allclose(losses, ref_losses, rtol=0.08)
+    assert float(state.scaler.loss_scale) > 0
+
+
+def test_dp_matches_single_device(reference):
+    """DDP on/off with identical effective batch: same loss curve (the
+    cross_product_distributed leg (U)). Params agree to reduction-order
+    tolerance — pmean-of-shard-grads reassociates the batch sum, and Adam
+    amplifies ulp-level drift on near-zero moments."""
+    ref_losses, ref_state = reference
+    losses, state = _train(jnp.float32, ScalerConfig(enabled=False), 8)
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(ref_state.params),
+                    jax.tree.leaves(state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-2, atol=1e-4)
+
+
+def test_sgd_cross(reference):
+    """Second optimizer leg of the cross product."""
+    losses, _ = _train(jnp.float32, ScalerConfig(enabled=False), 1,
+                       opt=fused_sgd(0.1, momentum=0.9))
+    assert losses[-1] < losses[0]

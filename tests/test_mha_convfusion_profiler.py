@@ -73,6 +73,25 @@ def test_self_attn_norm_add_residual():
         np.asarray(x), rtol=1e-6, atol=1e-6)
 
 
+def test_self_attn_prob_dropout_semantics():
+    """Dropout hits the attention probabilities (apex semantics), so with
+    p→0 the result converges to the no-dropout path and with rng=None
+    dropout is off entirely."""
+    p = init_self_attn(jax.random.PRNGKey(0), 64)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 2, 64))
+    base = self_attn(p, x, 4)
+    off = self_attn(p, x, 4, dropout_p=0.5, rng=None)
+    np.testing.assert_allclose(np.asarray(off), np.asarray(base),
+                               rtol=1e-5, atol=1e-5)
+    tiny = self_attn(p, x, 4, dropout_p=1e-7, rng=jax.random.PRNGKey(2))
+    np.testing.assert_allclose(np.asarray(tiny), np.asarray(base),
+                               rtol=1e-3, atol=1e-3)
+    # with real dropout the output changes and stays finite
+    drop = self_attn(p, x, 4, dropout_p=0.5, rng=jax.random.PRNGKey(2))
+    assert np.isfinite(np.asarray(drop)).all()
+    assert float(jnp.abs(drop - base).max()) > 1e-3
+
+
 def test_encdec_attn_shapes_and_memory_lengths():
     p = init_encdec_attn(jax.random.PRNGKey(0), 64)
     q = jax.random.normal(jax.random.PRNGKey(1), (6, 2, 64))
