@@ -43,6 +43,7 @@ __all__ = [
     "contrib",
     "checkpoint",
     "data",
+    "normalization",
     "profiler",
     "fp16_utils",
     "mlp",

@@ -31,7 +31,8 @@ from apex_tpu.amp.scaler import all_finite, apply_if_finite
 from apex_tpu.optimizers import FusedOptimizer
 
 __all__ = [
-    "network_to_half", "bn_convert_float", "prep_param_lists",
+    "network_to_half", "bn_convert_float", "fp16_model", "FP16Model",
+    "prep_param_lists",
     "master_params_to_model_params", "model_grads_to_master_grads",
     "FP16Optimizer", "FP16OptimizerState", "LossScaler", "DynamicLossScaler",
 ]
@@ -61,6 +62,27 @@ def network_to_half(params, half_dtype=jnp.bfloat16,
         return jnp.asarray(x, half_dtype)
 
     return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def fp16_model(apply_fn, params, half_dtype=jnp.bfloat16):
+    """``FP16Model`` (U): wrap an apply function so params are half (BN
+    kept fp32) and inputs are cast to half on the way in. Returns
+    ``(wrapped_apply, half_params)``."""
+    half_params = network_to_half(params, half_dtype)
+
+    def wrapped(p, *inputs, **kw):
+        cast_in = tuple(
+            x.astype(half_dtype)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x
+            for x in inputs)
+        return apply_fn(p, *cast_in, **kw)
+
+    return wrapped, half_params
+
+
+#: apex class-name alias (U: fp16_utils/fp16util.py ``FP16Model``)
+FP16Model = fp16_model
 
 
 def bn_convert_float(params):

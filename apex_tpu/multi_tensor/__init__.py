@@ -18,6 +18,7 @@ contiguous view — apex's "flatten trick, but once, statically"
 from apex_tpu.multi_tensor.packing import (
     LANE,
     FlatLayout,
+    MultiTensorApply,
     flatten_dense_tensors,
     pack,
     pack_cast,
@@ -29,6 +30,7 @@ from apex_tpu.multi_tensor.packing import (
 __all__ = [
     "LANE",
     "FlatLayout",
+    "MultiTensorApply",
     "flatten_dense_tensors",
     "pack",
     "pack_cast",

@@ -175,3 +175,47 @@ def unflatten_dense_tensors(flat: jnp.ndarray, like: Sequence[jnp.ndarray]) -> L
         out.append(jax.lax.dynamic_slice_in_dim(flat, offset, size).reshape(t.shape))
         offset += size
     return out
+
+
+class MultiTensorApply:
+    """Call-shape parity with apex's ``MultiTensorApply`` (apex/
+    multi_tensor_apply/multi_tensor_apply.py (U)): ``apply(op, noop_flag,
+    tensor_lists, *args)`` runs ``op`` across every tensor in one logical
+    sweep. Here each list is packed into flat per-dtype buffers (the
+    static form of apex's runtime chunking — chunk_size is accepted for
+    API compatibility and unused: XLA tiles the flat buffer itself) and
+    ``op`` receives the list of flat buffers per operand; outputs are
+    sliced back to tensor lists.
+    """
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        self.chunk_size = chunk_size
+
+    def __call__(self, op, noop_flag, tensor_lists, *args):
+        layouts = []
+        packed = []
+        for tl in tensor_lists:
+            bufs, layout = pack(list(tl))
+            packed.append(bufs)
+            layouts.append(layout)
+        outs = op(*packed, *args)
+        if outs is None or (isinstance(outs, (tuple, list))
+                            and len(outs) == 0):
+            return outs
+        # normalise to a list of buffer-lists: op may return one buffer,
+        # one buffer-list, or several buffer-lists
+        if not isinstance(outs, (tuple, list)):
+            outs = [[outs]]
+        elif not isinstance(outs[0], (tuple, list)):
+            outs = [list(outs)]
+        # outputs mirror the dtype grouping of the first input list (the
+        # apex sweeps all write buffers grouped like their inputs); a
+        # different grouping needs pack/unpack directly
+        for o in outs:
+            if len(o) != layouts[0].num_groups:
+                raise ValueError(
+                    f"op returned {len(o)} buffer(s) but the input "
+                    f"grouping has {layouts[0].num_groups} dtype "
+                    f"group(s); use pack/unpack directly for ops that "
+                    f"regroup dtypes")
+        return [unpack(list(o), layouts[0]) for o in outs]

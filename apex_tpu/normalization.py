@@ -1,0 +1,32 @@
+"""apex.normalization name-parity layer over the Pallas norm kernels.
+
+The reference's four classes (apex/normalization/fused_layer_norm.py (U))
+differ only in statistic (mean+var vs RMS) and parameter dtype handling
+(``MixedFused*`` keep fp32 affine params with half I/O). The Pallas
+kernels (apex_tpu/kernels/layer_norm.py) implement both statistics with
+fp32 internals and allow any param/input dtype mix, so every class maps to
+a functional alias of the same two kernels:
+
+- ``FusedLayerNorm`` / ``MixedFusedLayerNorm``  → :func:`fused_layer_norm`
+- ``FusedRMSNorm``   / ``MixedFusedRMSNorm``    → :func:`fused_rms_norm`
+
+(The Mixed variants are behavioural defaults here, not separate code: pass
+fp32 ``weight``/``bias`` with bf16/fp16 ``x``.)
+"""
+
+from apex_tpu.kernels.layer_norm import layer_norm as fused_layer_norm
+from apex_tpu.kernels.layer_norm import rms_norm as fused_rms_norm
+
+FusedLayerNorm = fused_layer_norm
+MixedFusedLayerNorm = fused_layer_norm
+FusedRMSNorm = fused_rms_norm
+MixedFusedRMSNorm = fused_rms_norm
+
+__all__ = [
+    "fused_layer_norm",
+    "fused_rms_norm",
+    "FusedLayerNorm",
+    "MixedFusedLayerNorm",
+    "FusedRMSNorm",
+    "MixedFusedRMSNorm",
+]

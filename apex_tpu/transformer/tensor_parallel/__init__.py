@@ -12,6 +12,7 @@ from apex_tpu.transformer.tensor_parallel.mappings import (  # noqa: F401
 from apex_tpu.transformer.tensor_parallel.random import (  # noqa: F401
     RNGStatesTracker,
     checkpoint,
+    get_cuda_rng_tracker,
     get_rng_tracker,
     model_parallel_rng_key,
     model_parallel_seed_keys,
@@ -32,6 +33,9 @@ __all__ = [
     "reduce_scatter_to_sequence_parallel_region",
     "RNGStatesTracker",
     "get_rng_tracker",
+    "get_cuda_rng_tracker",
+    "set_tensor_model_parallel_attributes",
+    "param_is_tensor_parallel",
     "model_parallel_rng_key",
     "model_parallel_seed_keys",
     "checkpoint",
@@ -54,6 +58,8 @@ def __getattr__(name):
         "column_parallel_linear",
         "row_parallel_linear",
         "vocab_parallel_embedding",
+        "set_tensor_model_parallel_attributes",
+        "param_is_tensor_parallel",
     ):
         from apex_tpu.transformer.tensor_parallel import layers
 

@@ -247,3 +247,19 @@ def param_is_tensor_parallel(spec: P) -> bool:
         for a in spec
         if a is not None
     )
+
+
+def set_tensor_model_parallel_attributes(spec: P, is_parallel: bool,
+                                         dim: int, stride: int = 1) -> P:
+    """apex marks torch tensors with ``tensor_model_parallel`` attributes
+    (U: layers.py) so downstream code can identify sharded params; under
+    pjit the PartitionSpec *is* that metadata. This parity helper builds
+    the spec the attribute triple implies: ``dim`` sharded on tp when
+    ``is_parallel`` (``stride`` has no layout meaning under XLA and is
+    accepted for API compatibility)."""
+    del stride
+    if not is_parallel:
+        return spec
+    parts = list(spec) + [None] * (dim + 1 - len(spec))
+    parts[dim] = AXIS_TP
+    return P(*parts)

@@ -92,6 +92,11 @@ def get_rng_tracker(seed: int = 0, axis: str = AXIS_TP) -> RNGStatesTracker:
     return RNGStatesTracker({"default": base, "model-parallel-rng": per_rank})
 
 
+#: apex name parity — ``get_cuda_rng_tracker`` (U); there is no CUDA RNG
+#: state on TPU, only functional keys, so it is the same tracker.
+get_cuda_rng_tracker = get_rng_tracker
+
+
 def checkpoint(
     fn: Optional[Callable] = None,
     *,
