@@ -66,17 +66,14 @@ def network_to_half(params, half_dtype=jnp.bfloat16,
 
 def fp16_model(apply_fn, params, half_dtype=jnp.bfloat16):
     """``FP16Model`` (U): wrap an apply function so params are half (BN
-    kept fp32) and inputs are cast to half on the way in. Returns
-    ``(wrapped_apply, half_params)``."""
+    kept fp32) and floating inputs — including pytree inputs — are cast to
+    half on the way in. Returns ``(wrapped_apply, half_params)``."""
+    from apex_tpu.amp.policy import _cast_floating
+
     half_params = network_to_half(params, half_dtype)
 
     def wrapped(p, *inputs, **kw):
-        cast_in = tuple(
-            x.astype(half_dtype)
-            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
-            else x
-            for x in inputs)
-        return apply_fn(p, *cast_in, **kw)
+        return apply_fn(p, *_cast_floating(inputs, half_dtype), **kw)
 
     return wrapped, half_params
 

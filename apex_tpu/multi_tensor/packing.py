@@ -186,12 +186,26 @@ class MultiTensorApply:
     API compatibility and unused: XLA tiles the flat buffer itself) and
     ``op`` receives the list of flat buffers per operand; outputs are
     sliced back to tensor lists.
+
+    Overflow detection is **returned, not written**: apex mutates the
+    ``noop_flag`` buffer in place, which has no functional equivalent, so
+    ``noop_flag`` must be None and ops signal overflow by returning
+    ``(buffers, found_inf)`` — that aux value is passed through, e.g.::
+
+        mta = MultiTensorApply()
+        [unscaled], found_inf = mta(scale_flat, None, [grads], 1/scale)
     """
 
     def __init__(self, chunk_size: int = 2048 * 32):
         self.chunk_size = chunk_size
 
     def __call__(self, op, noop_flag, tensor_lists, *args):
+        if noop_flag is not None:
+            raise NotImplementedError(
+                "apex mutates the overflow buffer in place; here ops "
+                "return the flag instead — pass noop_flag=None and read "
+                "the op's returned found_inf (see MultiTensorApply "
+                "docstring)")
         layouts = []
         packed = []
         for tl in tensor_lists:
@@ -202,9 +216,16 @@ class MultiTensorApply:
         if outs is None or (isinstance(outs, (tuple, list))
                             and len(outs) == 0):
             return outs
+        # the flat_ops sweeps return (buffer_list, found_inf): unpack the
+        # buffers, pass the aux flag through
+        aux = None
+        if (isinstance(outs, tuple) and len(outs) == 2
+                and isinstance(outs[0], (tuple, list))
+                and not isinstance(outs[1], (tuple, list))):
+            outs, aux = [list(outs[0])], outs[1]
         # normalise to a list of buffer-lists: op may return one buffer,
         # one buffer-list, or several buffer-lists
-        if not isinstance(outs, (tuple, list)):
+        elif not isinstance(outs, (tuple, list)):
             outs = [[outs]]
         elif not isinstance(outs[0], (tuple, list)):
             outs = [list(outs)]
@@ -212,10 +233,12 @@ class MultiTensorApply:
         # apex sweeps all write buffers grouped like their inputs); a
         # different grouping needs pack/unpack directly
         for o in outs:
-            if len(o) != layouts[0].num_groups:
+            if not isinstance(o, (tuple, list)) or len(o) != layouts[
+                    0].num_groups:
                 raise ValueError(
-                    f"op returned {len(o)} buffer(s) but the input "
-                    f"grouping has {layouts[0].num_groups} dtype "
-                    f"group(s); use pack/unpack directly for ops that "
-                    f"regroup dtypes")
-        return [unpack(list(o), layouts[0]) for o in outs]
+                    f"op must return buffer list(s) matching the input's "
+                    f"{layouts[0].num_groups} dtype group(s) (got "
+                    f"{type(o).__name__}); use pack/unpack directly for "
+                    f"ops that regroup dtypes")
+        unpacked = [unpack(list(o), layouts[0]) for o in outs]
+        return (unpacked, aux) if aux is not None else unpacked

@@ -37,23 +37,26 @@ def test_multi_tensor_apply_call_shape():
     mta = MultiTensorApply(2048 * 32)
     tensors = [jnp.ones((33,)), jnp.full((7, 5), 2.0)]
 
-    def op(bufs, scale):
-        outs, _ = scale_flat(bufs, scale)
-        return [outs]
-
-    (scaled,) = mta(op, None, [tensors], 3.0)
+    # the canonical composition: a flat_ops sweep returning
+    # (buffers, found_inf) — the aux flag passes through
+    (scaled,), found_inf = mta(scale_flat, None, [tensors], 3.0)
     np.testing.assert_allclose(np.asarray(scaled[0]), 3.0)
     np.testing.assert_allclose(np.asarray(scaled[1]), 6.0)
     assert scaled[1].shape == (7, 5)
+    assert not bool(found_inf)
 
     # bare-buffer return normalises too (single dtype group)
     (doubled,) = mta(lambda bufs, s: bufs[0] * s, None, [tensors], 2.0)
     np.testing.assert_allclose(np.asarray(doubled[0]), 2.0)
 
-    # regrouping ops are rejected with a clear error
     import pytest
+
+    # regrouping ops are rejected with a clear error
     with pytest.raises(ValueError, match="dtype"):
         mta(lambda bufs: [bufs[0], bufs[0]], None, [tensors])
+    # apex's mutated overflow buffer has no functional equivalent
+    with pytest.raises(NotImplementedError, match="found_inf"):
+        mta(scale_flat, jnp.zeros((1,), jnp.int32), [tensors], 1.0)
 
 
 def test_set_tensor_model_parallel_attributes():
@@ -88,3 +91,14 @@ def test_fp16_model_wrapper():
     # inputs really are cast: a value not representable in bf16 rounds
     y2 = wrapped(half, jnp.full((2, 4), 1.0 + 2.0 ** -10, jnp.float32))
     np.testing.assert_allclose(np.asarray(y2), 4.0)  # 1+2^-10 -> 1 in bf16
+
+    # pytree inputs cast too (the torch FP16Model only saw positional
+    # tensors; jax apply fns commonly take batch dicts)
+    def apply_dict(p, batch):
+        return batch["x"] @ p["w"]
+
+    wrapped2, half2 = fp16_model(apply_dict, params, jnp.bfloat16)
+    y3 = wrapped2(half2, {"x": jnp.full((2, 4), 1.0 + 2.0 ** -10,
+                                        jnp.float32)})
+    assert y3.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(y3.astype(jnp.float32)), 4.0)
