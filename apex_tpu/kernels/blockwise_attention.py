@@ -26,8 +26,9 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
                         q_chunk: int = 1024):
     """Attention over ``[b, h, s, d]`` scanning ``q_chunk`` rows at a time.
 
-    A non-dividing ``q_chunk`` shrinks to the largest divisor of ``s`` so
-    the O(chunk·s) score-memory bound always holds.
+    A non-dividing length is handled as full chunks + one tail chunk of
+    ``s mod q_chunk`` rows, so the O(chunk·s) score-memory bound holds for
+    every length with full-size chunks (no degenerate tiny-chunk scans).
     """
     if q.ndim != 4:
         raise ValueError(f"expected [b, h, s, d], got {q.shape}")
@@ -36,17 +37,13 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
     if causal and s != sk:
         raise ValueError("causal attention requires sq == sk")
     sc = float(scale) if scale is not None else 1.0 / d ** 0.5
-    if s % q_chunk:
-        # shrink to the largest divisor of s — never abandon chunking (a
-        # single chunk would materialise the full s×s f32 score matrix)
-        q_chunk = min(q_chunk, s)
-        while s % q_chunk:
-            q_chunk -= 1
     if s <= q_chunk:
         return _one_chunk(q, k, v, jnp.int32(0), sc, causal)
 
     n = s // q_chunk
-    qs = jnp.moveaxis(q.reshape(b, h, n, q_chunk, d), 2, 0)  # [n,b,h,c,d]
+    s_main = n * q_chunk
+    qs = jnp.moveaxis(
+        q[:, :, :s_main].reshape(b, h, n, q_chunk, d), 2, 0)  # [n,b,h,c,d]
 
     @jax.checkpoint
     def one(qc, idx):
@@ -57,7 +54,11 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
         return None, one(qc, idx)
 
     _, out = lax.scan(body, None, (qs, jnp.arange(n, dtype=jnp.int32)))
-    return jnp.moveaxis(out, 0, 2).reshape(b, h, s, d)
+    out = jnp.moveaxis(out, 0, 2).reshape(b, h, s_main, d)
+    if s_main == s:
+        return out
+    tail = _one_chunk(q[:, :, s_main:], k, v, jnp.int32(s_main), sc, causal)
+    return jnp.concatenate([out, tail], axis=2)
 
 
 def _one_chunk(qc, k, v, row0, sc, causal):

@@ -42,16 +42,21 @@ def _ref(q, k, v, causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.parametrize("impl", ["ring", "ulysses", "ulysses_flash"])
 def test_cp_attention_matches_full(devices8, causal, impl):
     mesh = mx.build_mesh(cp=4, devices=devices8[:4])
     q, k, v = _qkv(jax.random.PRNGKey(0))
     ref_out, ref_g = _ref(q, k, v, causal)
 
-    fn = ring_attention if impl == "ring" else ulysses_attention
-
-    def local(q, k, v):
-        return fn(q, k, v, causal=causal)
+    if impl == "ring":
+        def local(q, k, v):
+            return ring_attention(q, k, v, causal=causal)
+    elif impl == "ulysses":
+        def local(q, k, v):
+            return ulysses_attention(q, k, v, causal=causal)
+    else:  # the Pallas-kernel branch must stay covered
+        def local(q, k, v):
+            return ulysses_attention(q, k, v, causal=causal, impl="flash")
 
     spec = P(None, None, "cp", None)  # shard seq dim
     out = smap(local, mesh, (spec,) * 3, spec)(q, k, v)
