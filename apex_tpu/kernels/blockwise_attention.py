@@ -6,7 +6,7 @@ O(chunk · s) score block is ever live and the backward rematerialises per
 chunk — the same memory envelope as flash attention, but the inner
 matmul/softmax compiles through XLA's native attention codegen (which at
 TPU matmul shapes can beat a hand-tiled kernel). Exact, differentiable by
-construction, any length divisible by the chunk.
+construction, any length (full chunks + one tail chunk).
 
 This is the XLA half of the fmha capability (U); the Pallas kernel remains
 the fully-fused path and the var-seqlen (kv_lengths) provider.
@@ -57,7 +57,11 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
     out = jnp.moveaxis(out, 0, 2).reshape(b, h, s_main, d)
     if s_main == s:
         return out
-    tail = _one_chunk(q[:, :, s_main:], k, v, jnp.int32(s_main), sc, causal)
+    # tail goes through the same checkpointed path so its score block is
+    # rematerialised in backward, not saved as an O(tail*s) residual
+    tail = jax.checkpoint(
+        lambda qc: _one_chunk(qc, k, v, jnp.int32(s_main), sc, causal)
+    )(q[:, :, s_main:])
     return jnp.concatenate([out, tail], axis=2)
 
 

@@ -31,6 +31,10 @@ from apex_tpu.kernels._utils import LANE, cdiv, round_up, use_interpret, widen_f
 
 _NEG = -1e30
 _LANES = 128  # stat scratch lane width
+# default tile sizes; overridable per call (tuned on v5e: larger K tiles
+# amortise the per-block softmax-statistics update against MXU work)
+_DEFAULT_BLOCK_Q = 512
+_DEFAULT_BLOCK_K = 512
 
 
 def _row_ids(bq: int, width: int, i):
@@ -203,9 +207,19 @@ def _pad_qkv(x, sp, dp):
     return jnp.pad(x, ((0, 0), (0, sp - s), (0, dp - d)))
 
 
-def _blocks(sq, sk, d, *, max_block=128):
-    bq = min(max_block, round_up(sq, 8))
-    bk = min(max_block, round_up(sk, 8))
+def _fit_block(want: int, seq: int) -> int:
+    """Largest tile ≤ ``want`` that doesn't pad ``seq`` by more than a
+    quarter (misaligned lengths — the var-seqlen use case — would
+    otherwise compute up to a whole masked-out extra tile)."""
+    b = min(want, round_up(seq, 8))
+    while b > 128 and round_up(seq, b) - seq > seq // 4:
+        b //= 2
+    return b
+
+
+def _blocks(sq, sk, d, *, block_q=None, block_k=None):
+    bq = _fit_block(block_q or _DEFAULT_BLOCK_Q, sq)
+    bk = _fit_block(block_k or _DEFAULT_BLOCK_K, sk)
     dp = round_up(d, LANE)
     return bq, bk, dp
 
@@ -220,10 +234,10 @@ def _len_spec():
                         memory_space=pltpu.SMEM)
 
 
-def _run_fwd(q, k, v, lengths, scale, causal):
+def _run_fwd(q, k, v, lengths, scale, causal, block_q=None, block_k=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
-    bq, bk, dp = _blocks(sq, sk, d)
+    bq, bk, dp = _blocks(sq, sk, d, block_q=block_q, block_k=block_k)
     sqp, skp = round_up(sq, bq), round_up(sk, bk)
     qp = _pad_qkv(q, sqp, dp)
     kp = _pad_qkv(k, skp, dp)
@@ -265,10 +279,11 @@ def _drop_len(kernel, *refs, **kw):
     return kernel(None, *refs, **kw)
 
 
-def _run_bwd(q, k, v, do, lse, delta, lengths, scale, causal):
+def _run_bwd(q, k, v, do, lse, delta, lengths, scale, causal,
+             block_q=None, block_k=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
-    bq, bk, dp = _blocks(sq, sk, d)
+    bq, bk, dp = _blocks(sq, sk, d, block_q=block_q, block_k=block_k)
     sqp, skp = round_up(sq, bq), round_up(sk, bk)
     qp, dop = _pad_qkv(q, sqp, dp), _pad_qkv(do, sqp, dp)
     kp, vp = _pad_qkv(k, skp, dp), _pad_qkv(v, skp, dp)
@@ -349,22 +364,23 @@ def _run_bwd(q, k, v, do, lse, delta, lengths, scale, causal):
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash(q3, k3, v3, lengths, scale, causal):
-    out, _ = _run_fwd(q3, k3, v3, lengths, scale, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q3, k3, v3, lengths, scale, causal, block_q, block_k):
+    out, _ = _run_fwd(q3, k3, v3, lengths, scale, causal, block_q, block_k)
     return out
 
 
-def _flash_fwd(q3, k3, v3, lengths, scale, causal):
-    out, lse = _run_fwd(q3, k3, v3, lengths, scale, causal)
+def _flash_fwd(q3, k3, v3, lengths, scale, causal, block_q, block_k):
+    out, lse = _run_fwd(q3, k3, v3, lengths, scale, causal, block_q, block_k)
     return out, (q3, k3, v3, out, lse, lengths)
 
 
-def _flash_bwd(scale, causal, res, do):
+def _flash_bwd(scale, causal, block_q, block_k, res, do):
     q3, k3, v3, out, lse, lengths = res
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1, keepdims=True)
-    dq, dk, dv = _run_bwd(q3, k3, v3, do, lse, delta, lengths, scale, causal)
+    dq, dk, dv = _run_bwd(q3, k3, v3, do, lse, delta, lengths, scale, causal,
+                          block_q, block_k)
     dlen = None
     if lengths is not None:
         import numpy as np
@@ -381,6 +397,8 @@ def flash_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     kv_lengths: Optional[jnp.ndarray] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ):
     """Blockwise attention over ``[batch, heads, seq, head_dim]`` inputs.
 
@@ -388,6 +406,8 @@ def flash_attention(
     - ``scale``: softmax temperature; default ``1/sqrt(head_dim)``.
     - ``kv_lengths``: optional ``[batch]`` int — keys/values beyond the
       per-example length are masked (fmha var-seqlen capability (U)).
+    - ``block_q``/``block_k``: tile-size overrides (defaults tuned for
+      v5e; shrink for tiny VMEM budgets or very small head_dim).
 
     Returns attention output of the same shape/dtype as ``q``.
     """
@@ -407,7 +427,7 @@ def flash_attention(
     lens = None
     if kv_lengths is not None:
         lens = jnp.repeat(jnp.asarray(kv_lengths, jnp.int32), h)
-    out = _flash(q3, k3, v3, lens, s, causal)
+    out = _flash(q3, k3, v3, lens, s, causal, block_q, block_k)
     out = out.reshape(b, h, sq, d)
     return out.astype(jnp.float16) if was16 else out
 

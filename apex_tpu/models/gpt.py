@@ -85,6 +85,24 @@ class GPTConfig:
     #: attention with flash's O(chunk·s) memory but XLA matmul codegen
     #: (fastest at long seq); "auto" picks by seq_len.
     attn_impl: str = "auto"
+    #: Unroll factor for the layer scan (1 = rolled). The measured axon
+    #: runtime charges a multi-ms fixed cost per loop iteration/dispatch,
+    #: so unrolling the depth loop lets XLA fuse across layer boundaries
+    #: and removes per-iteration overhead; compile time grows with the
+    #: factor. True = fully unrolled.
+    scan_unroll: Any = 1
+    #: "pallas" → fused Pallas LN kernel (opaque to XLA fusion);
+    #: "xla" → jnp LayerNorm that XLA fuses into neighbouring ops —
+    #: faster when the layer scan is unrolled. Numerics identical (fp32
+    #: statistics either way).
+    ln_impl: str = "pallas"
+    #: Storage dtype of the materialised score matrix in the "xla"
+    #: attention path. TPU matmuls accumulate fp32 internally either way,
+    #: so "f32" only changes what is written to HBM (the bf16 einsum
+    #: output upcast) at 2x the score traffic; "compute" keeps scores in
+    #: compute dtype with fp32 max/exp/sum softmax statistics — flash
+    #:-kernel numerics at half the bandwidth.
+    attn_score_dtype: str = "f32"
     #: Long-context mode (no reference analogue — SURVEY.md §5 "no ring
     #: attention"): activations stay sequence-sharded over the ``cp`` mesh
     #: axis through the whole stack; attention is exact ring attention
@@ -253,12 +271,32 @@ def _attention(cfg: GPTConfig, p, h):
         out = blockwise_attention(q, k, v, causal=cfg.causal)
     else:
         sc = 1.0 / d ** 0.5
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sc
+        tri = None
         if cfg.causal:
             tri = lax.broadcasted_iota(jnp.int32, (s, s), 0) >= (
                 lax.broadcasted_iota(jnp.int32, (s, s), 1))
-            scores = jnp.where(tri, scores, -1e30)
-        p_attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        if cfg.attn_score_dtype == "compute":
+            # scores stay in compute dtype; the scale is folded into q
+            # BEFORE the einsum so the truncated output never holds the
+            # unscaled dot product (which overflows fp16's 65504 range)
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q * jnp.asarray(
+                sc, q.dtype), k)
+            if tri is not None:
+                finfo = jnp.finfo(scores.dtype)
+                scores = jnp.where(tri, scores, finfo.min)
+            m = jnp.max(scores, axis=-1, keepdims=True).astype(jnp.float32)
+            e = jnp.exp(scores.astype(jnp.float32) - m)
+            p_attn = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(q.dtype)
+        elif cfg.attn_score_dtype == "f32":
+            scores = jnp.einsum(
+                "bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sc
+            if tri is not None:
+                scores = jnp.where(tri, scores, -1e30)
+            p_attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        else:
+            raise ValueError(
+                f"unknown attn_score_dtype {cfg.attn_score_dtype!r} "
+                "(expected 'f32' or 'compute')")
         out = jnp.einsum("bhqk,bhkd->bhqd", p_attn, v)
     out = jnp.transpose(out, (2, 0, 1, 3)).reshape(s, b, heads_local * d)
     return row_parallel_linear(
@@ -280,12 +318,24 @@ def _mlp(cfg: GPTConfig, p, h):
     )
 
 
+def _layer_norm(cfg: GPTConfig, h, scale, bias):
+    if cfg.ln_impl == "xla":
+        h32 = h.astype(jnp.float32)
+        mu = jnp.mean(h32, axis=-1, keepdims=True)
+        d = h32 - mu
+        var = jnp.mean(d * d, axis=-1, keepdims=True)
+        y = d * lax.rsqrt(var + cfg.layernorm_epsilon)
+        return (y * scale.astype(jnp.float32)
+                + bias.astype(jnp.float32)).astype(h.dtype)
+    if cfg.ln_impl != "pallas":
+        raise ValueError(f"unknown ln_impl {cfg.ln_impl!r}")
+    return layer_norm(h, scale, bias, eps=cfg.layernorm_epsilon)
+
+
 def _block(cfg: GPTConfig, p, h):
-    x = layer_norm(h, p["ln1"]["scale"], p["ln1"]["bias"],
-                   eps=cfg.layernorm_epsilon)
+    x = _layer_norm(cfg, h, p["ln1"]["scale"], p["ln1"]["bias"])
     h = h + _attention(cfg, p["attn"], x)
-    x = layer_norm(h, p["ln2"]["scale"], p["ln2"]["bias"],
-                   eps=cfg.layernorm_epsilon)
+    x = _layer_norm(cfg, h, p["ln2"]["scale"], p["ln2"]["bias"])
     return h + _mlp(cfg, p["mlp"], x)
 
 
@@ -333,11 +383,11 @@ def hidden_states(cfg: GPTConfig, params, tokens):
 
     if cfg.remat:
         body = tpr.checkpoint(body, policy=_remat_policy(cfg))
-    h, _ = lax.scan(body, h, params["layers"])
+    h, _ = lax.scan(body, h, params["layers"], unroll=cfg.scan_unroll)
     # final LN runs inside the SP region (Megatron: its grads are
     # tp-partial — see seq_partial_grad_mask)
-    return layer_norm(h, params["final_ln"]["scale"],
-                      params["final_ln"]["bias"], eps=cfg.layernorm_epsilon)
+    return _layer_norm(cfg, h, params["final_ln"]["scale"],
+                       params["final_ln"]["bias"])
 
 
 def logits(cfg: GPTConfig, params, tokens):
@@ -502,7 +552,7 @@ def pipeline_loss(
 
         if cfg.remat:
             body = tpr.checkpoint(body, policy=_remat_policy(cfg))
-        y, _ = lax.scan(body, x, cp)
+        y, _ = lax.scan(body, x, cp, unroll=cfg.scan_unroll)
         return y
 
     seq_local = s
@@ -517,8 +567,8 @@ def pipeline_loss(
         # outs [n_micro, s_local, mb, h] → final LN + tied head + CE
         h = jnp.transpose(outs, (1, 0, 2, 3)).reshape(
             outs.shape[1], n_micro * mb, cfg.hidden_size)
-        h = layer_norm(h, params["final_ln"]["scale"],
-                       params["final_ln"]["bias"], eps=cfg.layernorm_epsilon)
+        h = _layer_norm(cfg, h, params["final_ln"]["scale"],
+                        params["final_ln"]["bias"])
         if cfg.sequence_parallel:
             h = gather_from_sequence_parallel_region(h, cfg.axis, True)
         else:

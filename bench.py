@@ -33,6 +33,11 @@ def main():
             vocab_size=50304, hidden_size=1024, num_layers=24, num_heads=16,
             seq_len=1024, remat=True, ce_chunk=256,
             compute_dtype=jnp.bfloat16,
+            # measured on v5e (docs/DESIGN.md perf notes): Pallas flash
+            # (512x512 tiles) beats both XLA attention variants once the
+            # whole step is jitted; XLA-fused LN beats the opaque Pallas
+            # LN call inside the layer scan
+            attn_impl="flash", ln_impl="xla",
         )
         batch, steps = 32, 15
     else:  # CPU smoke fallback so the harness always gets a line

@@ -25,11 +25,11 @@ def _data(key, batch=8, seq=32, vocab=96):
     return tok, jnp.roll(tok, -1, axis=1)
 
 
-def _run(devices, tp, sp, steps=2, remat=True, opt=None):
+def _run(devices, tp, sp, steps=2, remat=True, opt=None, **cfg_kw):
     # parity runs use SGD: it is linear in the gradient, so cross-mesh
     # reduction-order fp noise stays O(eps) instead of being amplified by
     # Adam's zero-moment first step (~lr * sign(g))
-    cfg = gpt.GPTConfig(sequence_parallel=sp, remat=remat, **CFG)
+    cfg = gpt.GPTConfig(sequence_parallel=sp, remat=remat, **{**CFG, **cfg_kw})
     mesh = mx.build_mesh(tp=tp, devices=devices)
     init_fn, step_fn = training.make_train_step(
         cfg, mesh, opt or fused_sgd(0.1), ScalerConfig(enabled=False))
@@ -84,3 +84,14 @@ def test_param_count():
     cfg = gpt.GPTConfig()  # GPT-2 355M-class
     n = cfg.param_count()
     assert 3.0e8 < n < 4.2e8
+
+
+def test_perf_knobs_match_defaults(devices8):
+    """The measured-fast configuration (XLA-fused LN, unrolled layer scan,
+    compute-dtype scores) is numerically the same model as the defaults —
+    at fp32 compute the score-dtype knob only moves where the softmax
+    scale is applied and LN/unroll only reorder fp ops."""
+    _, ref = _run(devices8, tp=2, sp=False, steps=1)
+    _, fast = _run(devices8, tp=2, sp=False, steps=1, ln_impl="xla",
+                   scan_unroll=True, attn_score_dtype="compute")
+    np.testing.assert_allclose(ref, fast, rtol=2e-5)
