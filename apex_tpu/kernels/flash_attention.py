@@ -31,10 +31,13 @@ from apex_tpu.kernels._utils import LANE, cdiv, round_up, use_interpret, widen_f
 
 _NEG = -1e30
 _LANES = 128  # stat scratch lane width
-# default tile sizes; overridable per call (tuned on v5e: larger K tiles
-# amortise the per-block softmax-statistics update against MXU work)
+# default tile sizes; overridable per call (tuned on v5e end-to-end:
+# 512x512 is fastest for both directions in-model — isolated kernel
+# microbenches through the tunnel mislead, trust whole-step timings)
 _DEFAULT_BLOCK_Q = 512
 _DEFAULT_BLOCK_K = 512
+_DEFAULT_BLOCK_Q_BWD = 512
+_DEFAULT_BLOCK_K_BWD = 512
 
 
 def _row_ids(bq: int, width: int, i):
@@ -283,7 +286,9 @@ def _run_bwd(q, k, v, do, lse, delta, lengths, scale, causal,
              block_q=None, block_k=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
-    bq, bk, dp = _blocks(sq, sk, d, block_q=block_q, block_k=block_k)
+    bq, bk, dp = _blocks(sq, sk, d,
+                         block_q=block_q or _DEFAULT_BLOCK_Q_BWD,
+                         block_k=block_k or _DEFAULT_BLOCK_K_BWD)
     sqp, skp = round_up(sq, bq), round_up(sk, bk)
     qp, dop = _pad_qkv(q, sqp, dp), _pad_qkv(do, sqp, dp)
     kp, vp = _pad_qkv(k, skp, dp), _pad_qkv(v, skp, dp)

@@ -67,6 +67,13 @@ def _opt_state_specs(optimizer: FusedOptimizer, params, pspecs, mesh: Mesh):
     axes (equal-sized per rank — shard_map concatenates them into one
     global array; dp ranks hold identical copies).
     """
+    state_pspecs = getattr(optimizer, "state_pspecs", None)
+    if state_pspecs is not None:
+        # tree-layout optimizers: state mirrors the param tree, so it
+        # shards exactly like the params (DistributedFusedOptimizer is a
+        # different NamedTuple without the field — getattr keeps the ZeRO
+        # path on the flat-buffer inference below)
+        return state_pspecs(pspecs)
     sizes = mesh_shape_of(mesh)
     local = jax.tree.map(
         lambda x, s: jax.ShapeDtypeStruct(

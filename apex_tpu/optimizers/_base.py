@@ -22,6 +22,9 @@ class FusedOptimizer(NamedTuple):
       call shape (``FusedAdam.step()`` (U)): the kernel writes new params
       directly, saving one elementwise pass and, for half params, one
       rounding.
+    - ``state_pspecs(param_pspecs) -> state pytree of PartitionSpecs`` —
+      optional; optimizers whose state mirrors the param tree (tree
+      layout) provide it so train steps can shard state like params.
 
     Both entry points accept ``grad_scale`` so amp's unscale fuses into the
     sweep (SURVEY.md §3.2).
@@ -30,6 +33,7 @@ class FusedOptimizer(NamedTuple):
     init: Callable
     update: Callable
     step: Callable
+    state_pspecs: Any = None
 
 
 def resolve_lr(learning_rate: Schedule, count) -> jnp.ndarray:

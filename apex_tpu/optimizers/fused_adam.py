@@ -1,16 +1,26 @@
-"""FusedAdam — one Pallas sweep for the whole Adam step.
+"""FusedAdam — one Pallas sweep (or leafwise XLA fusion) for the Adam step.
 
 TPU-native re-design of ``apex.optimizers.FusedAdam`` (apex/optimizers/
-fused_adam.py (U) over csrc/multi_tensor_adam.cu (U)): parameters, grads
-and both moments are packed into per-dtype flat buffers once per step and a
-single kernel updates everything — no per-tensor launches, hyperparameters
-traced so LR schedules don't recompile.
+fused_adam.py (U) over csrc/multi_tensor_adam.cu (U)). Two layouts:
+
+- ``layout="flat"``: parameters, grads and both moments are packed into
+  per-dtype flat buffers each step and a single Pallas kernel updates
+  everything — apex's multi-tensor shape, right for trees of many small
+  tensors.
+- ``layout="tree"``: moments mirror the param pytree and the update is
+  leafwise ``jnp`` that XLA fuses into one elementwise kernel per leaf —
+  no pack/unpack copies, so peak HBM drops by ~3 bytes/param-step; right
+  for trees of few large (e.g. layer-stacked) tensors, where the packing
+  traffic is pure overhead.
+
+Hyperparameters are traced either way, so LR schedules don't recompile.
 """
 
 from __future__ import annotations
 
 from typing import Any, NamedTuple, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from apex_tpu import multi_tensor as mt
@@ -30,6 +40,14 @@ class FusedAdamState(NamedTuple):
     v: Tuple[jnp.ndarray, ...]
 
 
+def _bias_corrections(count, b1, b2, bias_correction):
+    if not bias_correction:
+        one = jnp.float32(1.0)
+        return one, one
+    c = count.astype(jnp.float32)
+    return 1.0 - jnp.float32(b1) ** c, 1.0 - jnp.float32(b2) ** c
+
+
 def fused_adam(
     learning_rate: Schedule = 1e-3,
     b1: float = 0.9,
@@ -38,19 +56,20 @@ def fused_adam(
     weight_decay: float = 0.0,
     adam_w_mode: bool = True,
     bias_correction: bool = True,
+    layout: str = "flat",
 ) -> FusedOptimizer:
     """Build a FusedAdam transform (AdamW by default, like apex (U)).
 
     ``adam_w_mode=False`` reproduces classic Adam-with-L2 (decay folded
-    into the gradient before the moments).
+    into the gradient before the moments). ``layout``: "flat" (Pallas
+    multi-tensor sweep) or "tree" (leafwise XLA fusion — see module
+    docstring for the trade-off); identical math either way.
     """
-
-    def _bias_corrections(count):
-        if not bias_correction:
-            one = jnp.float32(1.0)
-            return one, one
-        c = count.astype(jnp.float32)
-        return 1.0 - jnp.float32(b1) ** c, 1.0 - jnp.float32(b2) ** c
+    if layout not in ("flat", "tree"):
+        raise ValueError(f"unknown layout {layout!r}")
+    if layout == "tree":
+        return _tree_adam(learning_rate, b1, b2, eps, weight_decay,
+                          adam_w_mode, bias_correction)
 
     def init(params) -> FusedAdamState:
         _, layout = mt.pack(params)
@@ -65,7 +84,7 @@ def fused_adam(
             raise ValueError("fused_adam requires params")
         pbufs, gbufs, layout = pack_pair(params, grads)
         count = state.count + 1
-        bc1, bc2 = _bias_corrections(count)
+        bc1, bc2 = _bias_corrections(count, b1, b2, bias_correction)
         out_bufs, new_m, new_v = adam_flat(
             pbufs, gbufs, list(state.m), list(state.v),
             lr=resolve_lr(learning_rate, count), b1=b1, b2=b2, eps=eps,
@@ -84,3 +103,67 @@ def fused_adam(
         return _sweep(grads, state, params, grad_scale, out_is_delta=False)
 
     return FusedOptimizer(init=init, update=update, step=step)
+
+
+class TreeAdamState(NamedTuple):
+    count: jnp.ndarray
+    m: Any  # mirrors the param pytree, fp32
+    v: Any
+
+
+def _tree_adam(learning_rate, b1, b2, eps, weight_decay, adam_w_mode,
+               bias_correction):
+    """Leafwise Adam: same math as the flat sweep, no packing copies."""
+
+    def init(params) -> TreeAdamState:
+        z = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return TreeAdamState(
+            count=jnp.zeros((), jnp.int32),
+            m=jax.tree.map(z, params),
+            v=jax.tree.map(z, params),
+        )
+
+    def _sweep(grads, state, params, grad_scale, out_is_delta):
+        if params is None:
+            raise ValueError("fused_adam requires params")
+        count = state.count + 1
+        bc1, bc2 = _bias_corrections(count, b1, b2, bias_correction)
+        lr = resolve_lr(learning_rate, count)
+        gs = jnp.float32(1.0) if grad_scale is None else jnp.asarray(
+            grad_scale, jnp.float32)
+
+        def leaf(p, g, m, v):
+            g32 = g.astype(jnp.float32) * gs
+            p32 = p.astype(jnp.float32)
+            if weight_decay and not adam_w_mode:
+                g32 = g32 + weight_decay * p32
+            m_new = b1 * m + (1.0 - b1) * g32
+            v_new = b2 * v + (1.0 - b2) * g32 * g32
+            upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            if weight_decay and adam_w_mode:
+                upd = upd + weight_decay * p32
+            delta = -lr * upd
+            out = delta if out_is_delta else p32 + delta
+            return out.astype(p.dtype), m_new, v_new
+
+        outs = jax.tree.map(leaf, params, grads, state.m, state.v)
+        # unzip the per-leaf (out, m, v) triples structurally — transpose
+        # against the params treedef, never by guessing at tuple shapes
+        # (params may legitimately contain tuple containers)
+        out_t, m_t, v_t = jax.tree.transpose(
+            jax.tree.structure(params), jax.tree.structure((0, 0, 0)), outs)
+        return out_t, TreeAdamState(count, m_t, v_t)
+
+    def update(grads, state, params=None, *, grad_scale=None):
+        return _sweep(grads, state, params, grad_scale, out_is_delta=True)
+
+    def step(grads, state, params, *, grad_scale=None):
+        return _sweep(grads, state, params, grad_scale, out_is_delta=False)
+
+    def state_pspecs(param_pspecs):
+        from jax.sharding import PartitionSpec as P
+
+        return TreeAdamState(count=P(), m=param_pspecs, v=param_pspecs)
+
+    return FusedOptimizer(init=init, update=update, step=step,
+                          state_pspecs=state_pspecs)

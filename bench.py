@@ -33,13 +33,14 @@ def main():
             vocab_size=50304, hidden_size=1024, num_layers=24, num_heads=16,
             seq_len=1024, remat=True, ce_chunk=256,
             compute_dtype=jnp.bfloat16,
-            # measured on v5e (docs/DESIGN.md perf notes): Pallas flash
-            # (512x512 tiles) beats both XLA attention variants once the
-            # whole step is jitted; XLA-fused LN beats the opaque Pallas
-            # LN call inside the layer scan
-            attn_impl="flash", ln_impl="xla",
+            # measured on v5e: Pallas flash (512x512 tiles) beats both XLA
+            # attention variants once the whole step is jitted; XLA-fused
+            # LN beats the opaque Pallas LN call inside the layer scan;
+            # saving only the qkv/fc1 projections (selective remat) at
+            # b=20 beats full remat at b=32
+            attn_impl="flash", ln_impl="xla", remat_policy="qkv_fc1",
         )
-        batch, steps = 32, 15
+        batch, steps = 20, 15
     else:  # CPU smoke fallback so the harness always gets a line
         cfg = gpt.GPTConfig(
             vocab_size=1024, hidden_size=256, num_layers=4, num_heads=8,
@@ -48,8 +49,11 @@ def main():
         batch, steps = 4, 3
 
     mesh = mx.build_mesh(tp=1, devices=jax.devices()[:1])
+    # tree-layout Adam: moments mirror the (few, large, layer-stacked)
+    # param leaves — no flat-packing copies, ~4 GB lower peak HBM
     init_fn, step_fn = training.make_train_step(
-        cfg, mesh, fused_adam(1e-4), ScalerConfig(enabled=False))
+        cfg, mesh, fused_adam(1e-4, layout="tree"),
+        ScalerConfig(enabled=False))
     state = init_fn(jax.random.PRNGKey(0))
     tok = jax.random.randint(
         jax.random.PRNGKey(1), (batch, cfg.seq_len), 0, cfg.vocab_size)

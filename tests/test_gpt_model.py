@@ -95,3 +95,12 @@ def test_perf_knobs_match_defaults(devices8):
     _, fast = _run(devices8, tp=2, sp=False, steps=1, ln_impl="xla",
                    scan_unroll=True, attn_score_dtype="compute")
     np.testing.assert_allclose(ref, fast, rtol=2e-5)
+
+
+@pytest.mark.parametrize("policy", ["dots", "qkv_fc1", "fc1"])
+def test_remat_policies_match_full_remat(devices8, policy):
+    """Selective-recompute policies change only what is saved, never the
+    math."""
+    _, ref = _run(devices8, tp=2, sp=False, steps=1)
+    _, sel = _run(devices8, tp=2, sp=False, steps=1, remat_policy=policy)
+    np.testing.assert_allclose(ref, sel, rtol=1e-5)
