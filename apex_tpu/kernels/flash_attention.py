@@ -24,6 +24,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -377,6 +378,11 @@ def _flash(q3, k3, v3, lengths, scale, causal, block_q, block_k):
 
 def _flash_fwd(q3, k3, v3, lengths, scale, causal, block_q, block_k):
     out, lse = _run_fwd(q3, k3, v3, lengths, scale, causal, block_q, block_k)
+    # named so remat policies can pin the kernel's residuals: with
+    # save_only_these_names("flash_out", "flash_lse") the backward replay
+    # restores (out, lse) instead of re-running the forward kernel
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, (q3, k3, v3, out, lse, lengths)
 
 

@@ -77,8 +77,11 @@ class GPTConfig:
     #: the replay) and recompute proj/fc2/attention — fits ~1.5x the batch
     #: of "dots" at most of its speedup; "fc1" → save only the fc1
     #: projection (the single biggest matmul), lightest footprint of the
-    #: selective modes. Selective-recompute modes the reference's
-    #: checkpoint() can't express.
+    #: selective modes; "qkv_fc1_attn" / "fc1_attn" → additionally pin
+    #: the flash kernel's (out, lse) residuals so backward never re-runs
+    #: the forward attention kernel (require ``attn_impl="flash"``).
+    #: Selective-recompute modes the reference's checkpoint() can't
+    #: express.
     remat_policy: Optional[str] = None
     #: CE sequence-chunk size: the [s, b, vocab] logits tensor never
     #: materialises — each chunk's logits are computed, reduced to per-token
@@ -505,6 +508,14 @@ def interleave_layers(params, num_layers: int, pp: int, vpp: int = 1):
 def _remat_policy(cfg: GPTConfig):
     if cfg.remat_policy is None:
         return None
+    if cfg.remat_policy in ("qkv_fc1_attn", "fc1_attn") and (
+            cfg.attn_impl != "flash" or cfg.context_parallel):
+        # only the Pallas flash path emits the flash_out/flash_lse names;
+        # anywhere else the policy would silently degrade to its non-attn
+        # variant while claiming the kernel residuals are pinned
+        raise ValueError(
+            f"remat_policy {cfg.remat_policy!r} requires attn_impl='flash' "
+            "(without context_parallel); use 'qkv_fc1'/'fc1' otherwise")
     if cfg.remat_policy == "dots":
         return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
     if cfg.remat_policy == "qkv_fc1":
@@ -512,6 +523,16 @@ def _remat_policy(cfg: GPTConfig):
             "attn_qkv", "mlp_fc1")
     if cfg.remat_policy == "fc1":
         return jax.checkpoint_policies.save_only_these_names("mlp_fc1")
+    if cfg.remat_policy == "qkv_fc1_attn":
+        # additionally pins the flash kernel's (out, lse) residuals so the
+        # backward replay skips the forward attention kernel entirely
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_qkv", "mlp_fc1", "flash_out", "flash_lse")
+    if cfg.remat_policy == "fc1_attn":
+        # like qkv_fc1_attn minus the qkv projection — its replay is one
+        # cheap matmul, and dropping the save fits a ~25% larger batch
+        return jax.checkpoint_policies.save_only_these_names(
+            "mlp_fc1", "flash_out", "flash_lse")
     raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}")
 
 

@@ -72,11 +72,11 @@ def fused_adam(
                           adam_w_mode, bias_correction)
 
     def init(params) -> FusedAdamState:
-        _, layout = mt.pack(params)
+        _, mt_layout = mt.pack(params)
         return FusedAdamState(
             count=jnp.zeros((), jnp.int32),
-            m=zeros_like_group_f32(layout),
-            v=zeros_like_group_f32(layout),
+            m=zeros_like_group_f32(mt_layout),
+            v=zeros_like_group_f32(mt_layout),
         )
 
     def _sweep(grads, state, params, grad_scale, out_is_delta):

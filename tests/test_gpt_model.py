@@ -97,10 +97,27 @@ def test_perf_knobs_match_defaults(devices8):
     np.testing.assert_allclose(ref, fast, rtol=2e-5)
 
 
-@pytest.mark.parametrize("policy", ["dots", "qkv_fc1", "fc1"])
+@pytest.mark.parametrize(
+    "policy", ["dots", "qkv_fc1", "fc1", "qkv_fc1_attn", "fc1_attn"])
 def test_remat_policies_match_full_remat(devices8, policy):
     """Selective-recompute policies change only what is saved, never the
     math."""
-    _, ref = _run(devices8, tp=2, sp=False, steps=1)
-    _, sel = _run(devices8, tp=2, sp=False, steps=1, remat_policy=policy)
+    extra = {"attn_impl": "flash"} if policy.endswith("_attn") else {}
+    _, ref = _run(devices8, tp=2, sp=False, steps=1, **extra)
+    _, sel = _run(devices8, tp=2, sp=False, steps=1, remat_policy=policy,
+                  **extra)
+    np.testing.assert_allclose(ref, sel, rtol=1e-5)
+
+
+def test_attn_pinning_requires_flash(devices8):
+    with pytest.raises(ValueError, match="flash"):
+        _run(devices8, tp=2, sp=False, steps=1, remat_policy="fc1_attn")
+
+
+def test_attn_residual_pinning_with_flash(devices8):
+    """qkv_fc1_attn + the Pallas flash path: pinned (out, lse) kernel
+    residuals must reproduce full-remat numerics exactly."""
+    _, ref = _run(devices8, tp=2, sp=False, steps=1, attn_impl="flash")
+    _, sel = _run(devices8, tp=2, sp=False, steps=1, attn_impl="flash",
+                  remat_policy="qkv_fc1_attn")
     np.testing.assert_allclose(ref, sel, rtol=1e-5)
