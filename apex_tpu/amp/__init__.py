@@ -54,6 +54,9 @@ __all__ = [
     "value_and_scaled_grad",
     "Amp",
     "initialize",
+    "master_params",
+    "state_dict",
+    "load_state_dict",
     "HALF_DTYPES",
 ]
 
@@ -148,3 +151,25 @@ def initialize(
             return policy.cast_to_output(out)
 
     return ctx, wrapped
+
+
+def master_params(state_or_params: Any) -> Any:
+    """The fp32 master copy of the parameters — ``amp.master_params`` (U).
+
+    Accepts either an :class:`apex_tpu.fp16_utils.FP16OptimizerState`-style
+    object (anything with a ``master_params`` attribute — the O2 pattern,
+    where fp32 masters live in the optimizer state) or a plain param
+    pytree (O0/O1, where params already are the masters)."""
+    masters = getattr(state_or_params, "master_params", None)
+    return state_or_params if masters is None else masters
+
+
+def state_dict(state: ScalerState) -> dict:
+    """Module-level alias of :meth:`Amp.state_dict` — apex exposes
+    ``amp.state_dict()`` at the package level (U)."""
+    return Amp.state_dict(state)
+
+
+def load_state_dict(d: dict) -> ScalerState:
+    """Module-level alias of :meth:`Amp.load_state_dict` (U)."""
+    return Amp.load_state_dict(d)

@@ -162,6 +162,29 @@ def value_and_scaled_grad(
     """
 
     def wrapped(*args, scaler_state: ScalerState):
+        if not cfg.enabled:
+            # identity scaler: no scale/unscale multiplies. Half grads are
+            # still promoted to fp32 (cross-replica reductions and master
+            # math must not run in 8 mantissa bits), and all_finite is
+            # still reported — but as an *observability* flag only: like
+            # apex without a scaler, the step is never skipped, so the
+            # train step's overflow selects fold away.
+            grad_fn = jax.value_and_grad(fun, argnums=argnums,
+                                         has_aux=has_aux)
+            if has_aux:
+                (value, aux), grads = grad_fn(*args)
+            else:
+                value, grads = grad_fn(*args)
+            grads = jax.tree.map(
+                lambda g: g.astype(jnp.float32)
+                if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating)
+                and jnp.asarray(g).dtype != jnp.float32 else g, grads)
+            finite = all_finite(grads)
+            value = jnp.asarray(value, jnp.float32)
+            if has_aux:
+                return (value, aux), grads, finite
+            return value, grads, finite
+
         def scaled_fun(*inner):
             out = fun(*inner)
             if has_aux:

@@ -222,16 +222,20 @@ def make_train_step(
         if pipelined:
             grads = jax.tree.map(
                 lambda g, m: lax.psum(g, AXIS_PP) if m else g, grads, pp_mask)
-        # a single rank overflowing must skip the step everywhere
         sync_names = [AXIS_DP, AXIS_TP, AXIS_PP]
         if cp_active:
             sync_names.append(cfg.cp_axis)
         sync_axes = tuple(a for a in sync_names if a in axes_present)
+        # every rank must agree on finiteness (skip decision when the
+        # scaler is on; replicated metric either way)
         finite = lax.pmin(finite.astype(jnp.int32), sync_axes) > 0
-
         new_params, new_opt = optimizer.step(grads, state.opt_state, params)
-        new_params = apply_if_finite(new_params, params, finite)
-        new_opt = apply_if_finite(new_opt, state.opt_state, finite)
+        if scaler_cfg.enabled:
+            # a single rank overflowing skips the step everywhere
+            new_params = apply_if_finite(new_params, params, finite)
+            new_opt = apply_if_finite(new_opt, state.opt_state, finite)
+        # identity scaler: like apex without a scaler the step is never
+        # skipped — grads_finite stays a truthful observability metric
         new_scaler = scaler_update(scaler_cfg, state.scaler, finite)
 
         loss_out = value

@@ -17,6 +17,7 @@ from apex_tpu.parallel.distributed import (  # noqa: F401
 )
 from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
     SyncBatchNorm,
+    convert_syncbn_model,
     sync_batch_norm,
 )
 from apex_tpu.optimizers.larc import larc_transform as LARC  # noqa: F401  (apex/parallel/LARC.py (U))
@@ -28,6 +29,7 @@ __all__ = [
     "allreduce_gradients",
     "flat_dist_call",
     "SyncBatchNorm",
+    "convert_syncbn_model",
     "sync_batch_norm",
     "LARC",
     "initialize_distributed",

@@ -144,3 +144,38 @@ class SyncBatchNorm:
             channel_axis=self.channel_axis,
         )
         return y, {"running_mean": rm, "running_var": rv}
+
+
+def convert_syncbn_model(model_or_layer, axis: Axis = AXIS_DP,
+                         channel_axis: Optional[int] = None):
+    """Enable cross-replica batchnorm on an existing definition —
+    ``apex.parallel.convert_syncbn_model`` (U).
+
+    The reference walks a ``torch.nn`` module tree and rewrites every
+    ``BatchNorm*`` into ``SyncBatchNorm`` in place. Definitions here are
+    immutable configs, so the conversion is a copy:
+
+    - a :class:`SyncBatchNorm` layer → same layer with statistics reduced
+      over ``axis`` (and optionally a new ``channel_axis``);
+    - any dataclass config exposing ``bn_axis`` (e.g.
+      :class:`apex_tpu.models.resnet.ResNetConfig`) → copy with
+      ``bn_axis=axis``, flipping every BN in that model to sync.
+    """
+    if isinstance(model_or_layer, SyncBatchNorm):
+        kw = {"axis": axis}
+        if channel_axis is not None:
+            kw["channel_axis"] = channel_axis
+        return dataclasses.replace(model_or_layer, **kw)
+    if dataclasses.is_dataclass(model_or_layer) and hasattr(
+            model_or_layer, "bn_axis"):
+        if channel_axis is not None:
+            # model configs fix their own data layout (e.g. the ResNet
+            # family is NHWC); silently dropping the request would let a
+            # channels-first caller believe it was applied
+            raise ValueError(
+                "channel_axis is only supported when converting a "
+                "SyncBatchNorm layer; model configs own their layout")
+        return dataclasses.replace(model_or_layer, bn_axis=axis)
+    raise TypeError(
+        "convert_syncbn_model expects a SyncBatchNorm layer or a model "
+        f"config with a bn_axis field, got {type(model_or_layer).__name__}")

@@ -1,0 +1,27 @@
+"""Transformer-stack logging knobs — apex/transformer/log_util.py (U).
+
+The reference exposes ``get_transformer_logger`` (a namespaced
+``logging.Logger``) and ``set_logging_level``. Same surface here; the
+logger namespace is ``apex_tpu.transformer``.
+"""
+
+from __future__ import annotations
+
+import logging
+
+_NAMESPACE = "apex_tpu.transformer"
+
+
+def get_transformer_logger(name: str | None = None) -> logging.Logger:
+    """Namespaced logger for transformer-stack modules (U)."""
+    return logging.getLogger(
+        f"{_NAMESPACE}.{name}" if name else _NAMESPACE)
+
+
+def set_logging_level(verbosity) -> None:
+    """Set the transformer-stack logging level (U: ``set_logging_level``).
+
+    ``verbosity`` is anything ``logging`` accepts: an int level or a name
+    like ``"INFO"``.
+    """
+    get_transformer_logger().setLevel(verbosity)

@@ -116,3 +116,21 @@ def build_num_microbatches_calculator(
     return RampupBatchsizeNumMicroBatches(
         start, increment, samples, global_batch_size, micro_batch_size, data_parallel_size
     )
+
+
+def setup_microbatch_calculator(
+    rank: int,
+    rampup_batch_size: Optional[Sequence[int]],
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int,
+) -> NumMicroBatchesCalculator:
+    """apex's canonical factory signature (apex/transformer/
+    microbatches.py (U)): leading ``rank`` (upstream uses it only for
+    rank-0 logging), then the same four arguments as
+    :func:`build_num_microbatches_calculator`. Returns the instance
+    instead of installing a module-global singleton."""
+    del rank  # logging-only upstream; callers own their logging here
+    return build_num_microbatches_calculator(
+        rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size)

@@ -17,6 +17,9 @@ from apex_tpu.transformer.tensor_parallel.random import (  # noqa: F401
     model_parallel_rng_key,
     model_parallel_seed_keys,
 )
+from apex_tpu.transformer.tensor_parallel.data import (  # noqa: F401
+    broadcast_data,
+)
 from apex_tpu.transformer.tensor_parallel.utils import (  # noqa: F401
     VocabUtility,
     divide,
@@ -42,6 +45,7 @@ __all__ = [
     "divide",
     "split_tensor_along_last_dim",
     "VocabUtility",
+    "broadcast_data",
     # provided by layers / cross_entropy submodules
     "ColumnParallelLinear",
     "RowParallelLinear",

@@ -102,3 +102,47 @@ def test_fp16_model_wrapper():
                                         jnp.float32)})
     assert y3.dtype == jnp.bfloat16
     np.testing.assert_allclose(np.asarray(y3.astype(jnp.float32)), 4.0)
+
+
+def test_name_layer_additions():
+    """Second parity sweep: symbols at apex's canonical locations."""
+    from apex_tpu.amp import load_state_dict, master_params, state_dict
+    from apex_tpu.parallel import convert_syncbn_model
+    from apex_tpu.transformer.log_util import (
+        get_transformer_logger,
+        set_logging_level,
+    )
+    from apex_tpu.transformer.microbatches import setup_microbatch_calculator
+    from apex_tpu.transformer.tensor_parallel import broadcast_data  # noqa: F401
+
+    # amp state round-trip
+    from apex_tpu.amp import ScalerConfig
+    st = ScalerConfig().init()
+    assert load_state_dict(state_dict(st)).loss_scale == st.loss_scale
+    # master_params: passthrough for plain trees, attribute for O2 states
+    tree = {"w": jnp.ones(3)}
+    assert master_params(tree) is tree
+
+    class S:
+        master_params = tree
+    assert master_params(S()) is tree
+
+    # convert_syncbn_model on a layer and a model config
+    from apex_tpu.mesh.topology import AXIS_DP
+    from apex_tpu.models.resnet import ResNetConfig
+    from apex_tpu.parallel import SyncBatchNorm
+    bn = SyncBatchNorm(8, axis=None)
+    assert convert_syncbn_model(bn).axis == AXIS_DP
+    cfg = ResNetConfig()
+    assert convert_syncbn_model(cfg).bn_axis == AXIS_DP
+    import pytest as _pytest
+    with _pytest.raises(TypeError):
+        convert_syncbn_model(object())
+
+    # microbatch factory — apex's 5-arg signature (leading rank)
+    calc = setup_microbatch_calculator(0, None, 64, 8, 2)
+    assert calc.get() == 4
+
+    # logging namespace
+    set_logging_level("DEBUG")
+    assert get_transformer_logger("x").name.startswith("apex_tpu.transformer")
