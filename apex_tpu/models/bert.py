@@ -45,6 +45,13 @@ class BertConfig:
     layernorm_epsilon: float = 1e-12  # BERT convention
     init_std: float = 0.02
     axis: str = "tp"
+    # perf knobs, forwarded to the core stack (same measured v5e guidance
+    # as GPT — docs/DESIGN.md "Performance engineering")
+    remat_policy: Any = None
+    attn_impl: str = "auto"
+    ln_impl: str = "pallas"
+    attn_score_dtype: str = "f32"
+    scan_unroll: Any = 1
 
     def core(self) -> gpt.GPTConfig:
         return gpt.GPTConfig(
@@ -54,7 +61,10 @@ class BertConfig:
             remat=self.remat, compute_dtype=self.compute_dtype,
             param_dtype=self.param_dtype,
             layernorm_epsilon=self.layernorm_epsilon,
-            init_std=self.init_std, axis=self.axis, causal=False)
+            init_std=self.init_std, axis=self.axis, causal=False,
+            remat_policy=self.remat_policy, attn_impl=self.attn_impl,
+            ln_impl=self.ln_impl, attn_score_dtype=self.attn_score_dtype,
+            scan_unroll=self.scan_unroll)
 
 
 def init(cfg: BertConfig, key) -> Any:

@@ -113,3 +113,15 @@ def test_resnet_syncbn_matches_big_batch(devices8):
         mesh, (P(), P(), P("dp")), (P("dp"), P()))(params, state, x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_bert_perf_knobs_forwarded():
+    """BertConfig forwards the measured perf knobs into the core stack."""
+    from apex_tpu.models import bert
+
+    cfg = bert.BertConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                          num_heads=2, seq_len=16, attn_impl="flash",
+                          ln_impl="xla", remat_policy="qkv_fc1_attn")
+    core = cfg.core()
+    assert core.attn_impl == "flash" and core.ln_impl == "xla"
+    assert core.remat_policy == "qkv_fc1_attn" and not core.causal
