@@ -89,10 +89,13 @@ class GPTConfig:
     #: shape of the reference's fused xentropy kernel (apex/contrib/
     #: xentropy (U) "saves logits memory"), done at the XLA level.
     ce_chunk: int = 0
-    #: "flash" → Pallas blockwise kernel; "xla" → materialised-scores
-    #: attention (fastest at short seq); "xla_chunked" → q-chunk scanned
-    #: attention with flash's O(chunk·s) memory but XLA matmul codegen
-    #: (fastest at long seq); "auto" picks by seq_len.
+    #: "flash" → Pallas blockwise kernel (fastest on TPU from ~1k seq —
+    #: 2x+ over the XLA paths at 4k, docs/DESIGN.md); "xla" →
+    #: materialised-scores attention (fastest at short seq and the only
+    #: fast path off-TPU, where Pallas runs interpreted); "xla_chunked"
+    #: → q-chunk scanned attention with flash's O(chunk·s) memory but
+    #: XLA codegen (the off-TPU long-seq fallback); "auto" picks by
+    #: backend and seq_len per those measurements.
     attn_impl: str = "auto"
     #: Unroll factor for the layer scan (1 = rolled). The measured axon
     #: runtime charges a multi-ms fixed cost per loop iteration/dispatch,
@@ -105,12 +108,14 @@ class GPTConfig:
     #: faster when the layer scan is unrolled. Numerics identical (fp32
     #: statistics either way).
     ln_impl: str = "pallas"
-    #: Storage dtype of the materialised score matrix in the "xla"
-    #: attention path. TPU matmuls accumulate fp32 internally either way,
-    #: so "f32" only changes what is written to HBM (the bf16 einsum
-    #: output upcast) at 2x the score traffic; "compute" keeps scores in
-    #: compute dtype with fp32 max/exp/sum softmax statistics — flash
-    #:-kernel numerics at half the bandwidth.
+    #: Storage dtype of the materialised score matrix — applies ONLY to
+    #: the "xla" attention path (flash/xla_chunked never materialise
+    #: scores to HBM, so the knob is moot there, including when "auto"
+    #: resolves to flash). TPU matmuls accumulate fp32 internally either
+    #: way, so "f32" only changes what is written to HBM (the bf16
+    #: einsum output upcast) at 2x the score traffic; "compute" keeps
+    #: scores in compute dtype with fp32 max/exp/sum softmax statistics —
+    #: flash-kernel numerics at half the bandwidth.
     attn_score_dtype: str = "f32"
     #: Long-context mode (no reference analogue — SURVEY.md §5 "no ring
     #: attention"): activations stay sequence-sharded over the ``cp`` mesh
@@ -270,7 +275,18 @@ def _attention(cfg: GPTConfig, p, h):
     q, k, v = (jnp.transpose(qkv[:, :, :, i, :], (1, 2, 0, 3)) for i in range(3))
     impl = cfg.attn_impl
     if impl == "auto":
-        impl = "xla_chunked" if s >= 2048 else "xla"
+        from apex_tpu.kernels._utils import use_interpret
+
+        if use_interpret():
+            # off-TPU the Pallas kernel runs interpreted (orders of
+            # magnitude slower) — stay on the XLA paths
+            impl = "xla_chunked" if s >= 2048 else "xla"
+        else:
+            # measured on v5e end-to-end (docs/DESIGN.md): tuned flash
+            # beats materialised-scores XLA at 1024 and chunked-XLA by
+            # >2x at 4096; below 1024 the scores are small enough that
+            # XLA's fused path wins on dispatch count
+            impl = "flash" if s >= 1024 else "xla"
     if impl not in ("flash", "xla", "xla_chunked"):
         raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
     if cfg.context_parallel:

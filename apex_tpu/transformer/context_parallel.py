@@ -14,9 +14,10 @@ standard strategies over the ``cp`` mesh axis:
   score blocks live only inside each (optionally rematted) hop.
 - :func:`ulysses_attention` — ``all_to_all`` reshards [seq-sharded, all
   heads] ↔ [all seq, head-sharded], runs full-sequence attention for the
-  local heads (chunked-XLA blockwise by default, the Pallas flash kernel
-  via ``impl="flash"``), and reshards back. Two collectives per call,
-  best when heads ≥ cp size.
+  local heads (the Pallas flash kernel by default on TPU, chunked-XLA
+  blockwise off-TPU where Pallas runs interpreted; override with
+  ``impl=``), and reshards back. Two collectives per call, best when
+  heads ≥ cp size.
 
 Causal masking composes with the ring by chunk-index comparison: with
 equal-length chunks, a hop's K/V block is entirely before, entirely after,
@@ -128,16 +129,19 @@ def ulysses_attention(
     ``q, k, v``: ``[b, h, s_local, d]`` with seq sharded over ``axis`` and
     all heads present; internally ``[b, h/cp, s, d]`` runs full-sequence
     attention for the local heads, then the layout reverts. ``h`` must
-    divide by the axis size. ``impl``: "flash" (Pallas kernel),
-    "xla_chunked" (q-chunk scan — measured faster on current TPUs), or
-    "auto".
+    divide by the axis size. ``impl``: "flash" (Pallas kernel — measured
+    fastest on TPU at the long sequences Ulysses exists for, since the
+    512x512 tile retune), "xla_chunked" (q-chunk scan; the off-TPU
+    default, where Pallas runs interpreted), or "auto".
     """
     cp = lax.axis_size(axis)
     if q.shape[1] % cp:
         raise ValueError(
             f"num heads {q.shape[1]} must divide by cp={cp} for Ulysses")
     if impl == "auto":
-        impl = "xla_chunked"
+        from apex_tpu.kernels._utils import use_interpret
+
+        impl = "xla_chunked" if use_interpret() else "flash"
     if impl not in ("flash", "xla_chunked"):
         raise ValueError(f"unknown impl {impl!r}")
 
