@@ -89,6 +89,10 @@ class GPTConfig:
     #: shape of the reference's fused xentropy kernel (apex/contrib/
     #: xentropy (U) "saves logits memory"), done at the XLA level.
     ce_chunk: int = 0
+    #: "xla" → vocab-parallel CE (any tp); "fused" → the Pallas xentropy
+    #: kernel per chunk (single-pass lse, backward recomputes softmax
+    #: from logits) — requires the vocab unsharded locally (tp == 1).
+    ce_impl: str = "xla"
     #: "flash" → Pallas blockwise kernel (fastest on TPU from ~1k seq —
     #: 2x+ over the XLA paths at 4k, docs/DESIGN.md); "xla" →
     #: materialised-scores attention (fastest at short seq and the only
@@ -448,10 +452,32 @@ def _ce_of_hidden(cfg: GPTConfig, params, h, targets_sb):
         raise ValueError(
             f"ce_chunk={chunk} must divide the (SP-local) sequence "
             f"length {s}")
+    if cfg.ce_impl == "fused":
+        from apex_tpu.kernels.xentropy import softmax_cross_entropy
+
+        if table.shape[0] != cfg.vocab_size:
+            # the kernel's lse spans only the rows it is given — on a
+            # vocab-sharded table every rank would compute a different,
+            # silently wrong loss
+            raise ValueError(
+                "ce_impl='fused' needs the vocab unsharded locally "
+                f"(tp == 1); local table rows {table.shape[0]} != "
+                f"vocab_size {cfg.vocab_size}")
+
+        def ce_sum(lg, tb):
+            n = lg.shape[0] * lg.shape[1]
+            return jnp.sum(softmax_cross_entropy(
+                lg.reshape(n, lg.shape[-1]), tb.reshape(n)))
+    elif cfg.ce_impl == "xla":
+        def ce_sum(lg, tb):
+            return jnp.sum(
+                vocab_parallel_cross_entropy(lg, tb, 0.0, cfg.axis))
+    else:
+        raise ValueError(f"unknown ce_impl {cfg.ce_impl!r}")
+
     if chunk <= 0:
         lg = jnp.einsum("sbh,vh->sbv", h, table).astype(jnp.float32)
-        return jnp.mean(
-            vocab_parallel_cross_entropy(lg, targets_sb, 0.0, cfg.axis))
+        return ce_sum(lg, targets_sb) / (s * b)
 
     hs = h.reshape(s // chunk, chunk, b, h.shape[-1])
     ts = targets_sb.reshape(s // chunk, chunk, b)
@@ -459,7 +485,7 @@ def _ce_of_hidden(cfg: GPTConfig, params, h, targets_sb):
     @jax.checkpoint
     def ce_block(hb, tb):
         lg = jnp.einsum("sbh,vh->sbv", hb, table).astype(jnp.float32)
-        return jnp.sum(vocab_parallel_cross_entropy(lg, tb, 0.0, cfg.axis))
+        return ce_sum(lg, tb)
 
     def body(acc, xt):
         hb, tb = xt

@@ -121,3 +121,28 @@ def test_attn_residual_pinning_with_flash(devices8):
     _, sel = _run(devices8, tp=2, sp=False, steps=1, attn_impl="flash",
                   remat_policy="qkv_fc1_attn")
     np.testing.assert_allclose(ref, sel, rtol=1e-5)
+
+
+def test_ce_impl_fused_matches_xla(devices8):
+    """ce_impl="fused" (Pallas xentropy per chunk, tp=1) equals the
+    vocab-parallel XLA CE."""
+    _, ref = _run(devices8, tp=1, sp=False, steps=1, ce_chunk=16)
+    _, fus = _run(devices8, tp=1, sp=False, steps=1, ce_chunk=16,
+                  ce_impl="fused")
+    np.testing.assert_allclose(ref, fus, rtol=1e-5)
+
+
+def test_ce_impl_validated(devices8):
+    with pytest.raises(ValueError, match="ce_impl"):
+        _run(devices8, tp=1, sp=False, steps=1, ce_impl="nope")
+
+
+def test_ce_impl_fused_unchunked_matches_xla(devices8):
+    _, ref = _run(devices8, tp=1, sp=False, steps=1)
+    _, fus = _run(devices8, tp=1, sp=False, steps=1, ce_impl="fused")
+    np.testing.assert_allclose(ref, fus, rtol=1e-5)
+
+
+def test_ce_impl_fused_rejects_sharded_vocab(devices8):
+    with pytest.raises(ValueError, match="unsharded"):
+        _run(devices8, tp=2, sp=False, steps=1, ce_impl="fused")
