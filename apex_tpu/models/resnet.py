@@ -136,10 +136,13 @@ def _bottleneck(cfg, x, p, st, stride, training):
     return jax.nn.relu(y + sc), ns
 
 
-def forward(cfg: ResNetConfig, params, state, x, *, training: bool = True):
-    """x [N, H, W, 3] → (logits [N, classes] fp32, new_bn_state)."""
+def features(cfg: ResNetConfig, params, state, x, *, training: bool = True):
+    """x [N, H, W, 3] → (stage feature maps {"c2".."c5"} NHWC in compute
+    dtype, new_bn_state) — the multi-scale backbone surface detection
+    heads consume (BASELINE config #3's RetinaNet pairing)."""
     x = x.astype(cfg.compute_dtype)
     ns: Any = {}
+    feats: Any = {}
     y = _conv(x, params["stem"], 2)
     y, ns["bn_stem"] = _bn(cfg, y, params["bn_stem"], state["bn_stem"],
                            training)
@@ -157,6 +160,14 @@ def forward(cfg: ResNetConfig, params, state, x, *, training: bool = True):
                                 training)
             new_blocks.append(bs)
         ns[f"layer{si + 1}"] = new_blocks
+        feats[f"c{si + 2}"] = y
+    return feats, ns
+
+
+def forward(cfg: ResNetConfig, params, state, x, *, training: bool = True):
+    """x [N, H, W, 3] → (logits [N, classes] fp32, new_bn_state)."""
+    feats, ns = features(cfg, params, state, x, training=training)
+    y = feats[f"c{len(cfg.stages) + 1}"]
     y = jnp.mean(y.astype(jnp.float32), axis=(1, 2))
     logits = y @ params["fc"]["kernel"] + params["fc"]["bias"]
     return logits, ns

@@ -42,6 +42,26 @@ def resolve_lr(learning_rate: Schedule, count) -> jnp.ndarray:
     return jnp.asarray(learning_rate, jnp.float32)
 
 
+def resolve_grad_scale(grad_scale) -> jnp.ndarray:
+    return (jnp.float32(1.0) if grad_scale is None
+            else jnp.asarray(grad_scale, jnp.float32))
+
+
+def tree_sweep(leaf: Callable, params, grads, *moment_trees):
+    """Shared scaffolding of the tree-layout optimizers: map ``leaf(p, g,
+    *moments) -> (out, *new_moments)`` over the leaves and unzip the
+    result tuples structurally (``jax.tree.transpose`` against the params
+    treedef — params may legitimately contain tuple containers, so no
+    shape guessing). Returns ``(out_tree, new_moment_trees...)``."""
+    if params is None:
+        raise ValueError("tree-layout optimizers require params")
+    outs = jax.tree.map(leaf, params, grads, *moment_trees)
+    width = 1 + len(moment_trees)
+    return jax.tree.transpose(
+        jax.tree.structure(params),
+        jax.tree.structure(tuple(range(width))), outs)
+
+
 def pack_pair(params, grads):
     """Pack params in their own dtypes and grads as fp32 master grads at the
     params' offsets — never downcasting possibly-still-scaled grads into a

@@ -29,7 +29,9 @@ from apex_tpu.optimizers._base import (
     FusedOptimizer,
     Schedule,
     pack_pair,
+    resolve_grad_scale,
     resolve_lr,
+    tree_sweep,
     zeros_like_group_f32,
 )
 
@@ -124,13 +126,10 @@ def _tree_adam(learning_rate, b1, b2, eps, weight_decay, adam_w_mode,
         )
 
     def _sweep(grads, state, params, grad_scale, out_is_delta):
-        if params is None:
-            raise ValueError("fused_adam requires params")
         count = state.count + 1
         bc1, bc2 = _bias_corrections(count, b1, b2, bias_correction)
         lr = resolve_lr(learning_rate, count)
-        gs = jnp.float32(1.0) if grad_scale is None else jnp.asarray(
-            grad_scale, jnp.float32)
+        gs = resolve_grad_scale(grad_scale)
 
         def leaf(p, g, m, v):
             g32 = g.astype(jnp.float32) * gs
@@ -146,12 +145,7 @@ def _tree_adam(learning_rate, b1, b2, eps, weight_decay, adam_w_mode,
             out = delta if out_is_delta else p32 + delta
             return out.astype(p.dtype), m_new, v_new
 
-        outs = jax.tree.map(leaf, params, grads, state.m, state.v)
-        # unzip the per-leaf (out, m, v) triples structurally — transpose
-        # against the params treedef, never by guessing at tuple shapes
-        # (params may legitimately contain tuple containers)
-        out_t, m_t, v_t = jax.tree.transpose(
-            jax.tree.structure(params), jax.tree.structure((0, 0, 0)), outs)
+        out_t, m_t, v_t = tree_sweep(leaf, params, grads, state.m, state.v)
         return out_t, TreeAdamState(count, m_t, v_t)
 
     def update(grads, state, params=None, *, grad_scale=None):

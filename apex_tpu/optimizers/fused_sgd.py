@@ -1,10 +1,13 @@
 """FusedSGD — apex/optimizers/fused_sgd.py (U) over
-csrc/multi_tensor_sgd_kernel.cu (U), as one Pallas sweep."""
+csrc/multi_tensor_sgd_kernel.cu (U), as one Pallas sweep (``layout=
+"flat"``) or leafwise XLA fusion (``layout="tree"`` — no packing copies;
+see fused_adam's module docstring for the trade-off)."""
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import Any, NamedTuple, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from apex_tpu import multi_tensor as mt
@@ -13,7 +16,9 @@ from apex_tpu.optimizers._base import (
     FusedOptimizer,
     Schedule,
     pack_pair,
+    resolve_grad_scale,
     resolve_lr,
+    tree_sweep,
     zeros_like_group_f32,
 )
 
@@ -23,15 +28,26 @@ class FusedSGDState(NamedTuple):
     momentum: Tuple[jnp.ndarray, ...]
 
 
+class TreeSGDState(NamedTuple):
+    count: jnp.ndarray
+    momentum: Any  # mirrors the param pytree, fp32
+
+
 def fused_sgd(
     learning_rate: Schedule = 1e-3,
     momentum: float = 0.0,
     dampening: float = 0.0,
     weight_decay: float = 0.0,
     nesterov: bool = False,
+    layout: str = "flat",
 ) -> FusedOptimizer:
     if nesterov and (momentum <= 0 or dampening != 0):
         raise ValueError("nesterov requires momentum > 0 and dampening = 0")
+    if layout not in ("flat", "tree"):
+        raise ValueError(f"unknown layout {layout!r}")
+    if layout == "tree":
+        return _tree_sgd(learning_rate, momentum, dampening, weight_decay,
+                         nesterov)
 
     def init(params) -> FusedSGDState:
         _, layout = mt.pack(params)
@@ -64,3 +80,54 @@ def fused_sgd(
         return _sweep(grads, state, params, grad_scale, out_is_delta=False)
 
     return FusedOptimizer(init=init, update=update, step=step)
+
+
+def _tree_sgd(learning_rate, momentum, dampening, weight_decay, nesterov):
+    """Leafwise SGD: same math as the flat sweep, no packing copies."""
+
+    def init(params) -> TreeSGDState:
+        return TreeSGDState(
+            count=jnp.zeros((), jnp.int32),
+            momentum=jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params),
+        )
+
+    def _sweep(grads, state, params, grad_scale, out_is_delta):
+        count = state.count + 1
+        lr = resolve_lr(learning_rate, count)
+        gs = resolve_grad_scale(grad_scale)
+        # torch/apex first-step semantics: momentum buffer = raw grad,
+        # which equals zero dampening on step 0 (traced, no recompile)
+        damp_eff = jnp.where(state.count == 0, 0.0, dampening)
+
+        def leaf(p, g, m):
+            g32 = g.astype(jnp.float32) * gs
+            p32 = p.astype(jnp.float32)
+            if weight_decay:
+                g32 = g32 + weight_decay * p32
+            if momentum:
+                m_new = momentum * m + (1.0 - damp_eff) * g32
+                upd = g32 + momentum * m_new if nesterov else m_new
+            else:
+                m_new = m
+                upd = g32
+            delta = -lr * upd
+            out = delta if out_is_delta else p32 + delta
+            return out.astype(p.dtype), m_new
+
+        out_t, m_t = tree_sweep(leaf, params, grads, state.momentum)
+        return out_t, TreeSGDState(count, m_t)
+
+    def update(grads, state, params=None, *, grad_scale=None):
+        return _sweep(grads, state, params, grad_scale, out_is_delta=True)
+
+    def step(grads, state, params, *, grad_scale=None):
+        return _sweep(grads, state, params, grad_scale, out_is_delta=False)
+
+    def state_pspecs(param_pspecs):
+        from jax.sharding import PartitionSpec as P
+
+        return TreeSGDState(count=P(), momentum=param_pspecs)
+
+    return FusedOptimizer(init=init, update=update, step=step,
+                          state_pspecs=state_pspecs)

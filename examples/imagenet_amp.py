@@ -40,7 +40,9 @@ def main():
         depth=args.depth, bn_axis="dp" if args.syncbn else None,
         compute_dtype=jnp.bfloat16)
     params, bn_state = resnet.init(cfg, jax.random.PRNGKey(0))
-    opt = fused_sgd(args.lr, momentum=0.9, weight_decay=1e-4)
+    # tree layout: leafwise XLA-fused update (the flat Pallas sweep runs
+    # interpreted — minutes per step — on the CPU simulation backend)
+    opt = fused_sgd(args.lr, momentum=0.9, weight_decay=1e-4, layout="tree")
     opt_state = jax.jit(opt.init)(params)
 
     def local_step(params, bn_state, opt_state, images, labels):

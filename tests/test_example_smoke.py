@@ -41,3 +41,22 @@ def test_gpt_train_example_end_to_end(tmp_path):
                         timeout=900)
     assert r2.returncode == 0, r2.stderr[-2000:]
     assert "resumed" in r2.stdout and "at step 2" in r2.stdout
+
+
+def test_retinanet_example_smoke(tmp_path):
+    """BASELINE config #3: SyncBN + FusedSGD + focal loss detection slice
+    runs end-to-end on the simulated mesh with a decreasing loss."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep + env.get("PYTHONPATH", ""))
+    cmd = [sys.executable,
+           os.path.join(repo, "examples", "retinanet_detect.py"),
+           "--steps", "2", "--batch", "1", "--image", "32",
+           "--classes", "4", "--depth", "26"]
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    losses = [float(l.split("loss ")[1].split(" ")[0])
+              for l in r.stdout.splitlines() if l.startswith("step ")]
+    assert len(losses) == 2 and losses[1] < losses[0]

@@ -357,3 +357,35 @@ class TestTreeLayoutAdam:
         p2, state = jax.jit(tx.step)(grads, state, params)
         assert [x.shape for x in p2] == [(4,), (3,), (2,)]
         assert [x.shape for x in state.m] == [(4,), (3,), (2,)]
+
+
+class TestTreeLayoutSGD:
+    @pytest.mark.parametrize("momentum,nesterov,wd", [
+        (0.0, False, 0.0), (0.9, False, 1e-2), (0.9, True, 0.0)])
+    def test_matches_torch_sgd(self, momentum, nesterov, wd):
+        tx = opt.fused_sgd(1e-2, momentum=momentum, nesterov=nesterov,
+                           weight_decay=wd, layout="tree")
+        params, tparams = run_both(
+            tx, lambda ps: torch.optim.SGD(ps, lr=1e-2, momentum=momentum,
+                                           nesterov=nesterov,
+                                           weight_decay=wd))
+        assert_trees_close(params, tparams, rtol=2e-5, atol=2e-5)
+
+    def test_matches_flat_layout(self):
+        key = jax.random.PRNGKey(5)
+        params = make_tree(key)
+        grads = jax.tree.map(
+            lambda p: jax.random.normal(jax.random.fold_in(key, 7),
+                                        p.shape, p.dtype), params)
+        out = {}
+        for lay in ("flat", "tree"):
+            tx = opt.fused_sgd(1e-2, momentum=0.9, dampening=0.1,
+                               weight_decay=1e-3, layout=lay)
+            state = tx.init(params)
+            p, state = jax.jit(tx.step)(grads, state, params)
+            p, _ = jax.jit(tx.step)(grads, state, p)
+            out[lay] = p
+        for a, b in zip(jax.tree.leaves(out["flat"]), jax.tree.leaves(out["tree"])):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=2e-5, atol=2e-6)
