@@ -47,6 +47,36 @@ def resolve_grad_scale(grad_scale) -> jnp.ndarray:
             else jnp.asarray(grad_scale, jnp.float32))
 
 
+def bias_corrections(count, b1, b2, enabled: bool):
+    """Adam-family bias-correction pair (1-b1^t, 1-b2^t), or (1, 1)."""
+    if not enabled:
+        one = jnp.float32(1.0)
+        return one, one
+    c = count.astype(jnp.float32)
+    return 1.0 - jnp.float32(b1) ** c, 1.0 - jnp.float32(b2) ** c
+
+
+def zeros_like_tree(params):
+    """fp32 zeros mirroring the param pytree (tree-layout moment init)."""
+    return jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+
+def finish_tree_optimizer(init: Callable, sweep: Callable,
+                          state_pspecs: Callable) -> FusedOptimizer:
+    """Wrap a tree-layout ``sweep(grads, state, params, grad_scale,
+    out_is_delta)`` into the FusedOptimizer update/step contract — the
+    shared tail of every ``layout="tree"`` optimizer."""
+
+    def update(grads, state, params=None, *, grad_scale=None):
+        return sweep(grads, state, params, grad_scale, True)
+
+    def step(grads, state, params, *, grad_scale=None):
+        return sweep(grads, state, params, grad_scale, False)
+
+    return FusedOptimizer(init=init, update=update, step=step,
+                          state_pspecs=state_pspecs)
+
+
 def tree_sweep(leaf: Callable, params, grads, *moment_trees):
     """Shared scaffolding of the tree-layout optimizers: map ``leaf(p, g,
     *moments) -> (out, *new_moments)`` over the leaves and unzip the

@@ -37,6 +37,7 @@ from apex_tpu import multi_tensor as mt
 from apex_tpu.kernels.flat_ops import adam_flat
 from apex_tpu.mesh.topology import AXIS_DP
 from apex_tpu.optimizers._base import (
+    bias_corrections,
     Schedule,
     pack_pair,
     resolve_lr,
@@ -101,13 +102,6 @@ def distributed_fused_adam(
 ) -> DistributedFusedOptimizer:
     """ZeRO-sharded FusedAdam (``DistributedFusedAdam`` (U))."""
 
-    def _bias_corrections(count):
-        if not bias_correction:
-            one = jnp.float32(1.0)
-            return one, one
-        c = count.astype(jnp.float32)
-        return 1.0 - jnp.float32(b1) ** c, 1.0 - jnp.float32(b2) ** c
-
     def init(params, dp: Optional[int] = None) -> ShardedAdamState:
         _, layout = mt.pack(params)
         dp = dp or lax.axis_size(axis)
@@ -137,7 +131,7 @@ def distributed_fused_adam(
             for p, s in zip(pbufs, shards)
         ]
         count = state.count + 1
-        bc1, bc2 = _bias_corrections(count)
+        bc1, bc2 = bias_corrections(count, b1, b2, bias_correction)
         gscale = jnp.float32(1.0 if grad_scale is None else grad_scale) / dp
         out_shards, new_m, new_v = adam_flat(
             p_shards, g_shards, list(state.m), list(state.v),

@@ -12,9 +12,13 @@ from apex_tpu.kernels.flat_ops import adagrad_flat
 from apex_tpu.optimizers._base import (
     FusedOptimizer,
     Schedule,
+    finish_tree_optimizer,
     pack_pair,
+    resolve_grad_scale,
     resolve_lr,
+    tree_sweep,
     zeros_like_group_f32,
+    zeros_like_tree,
 )
 
 
@@ -27,7 +31,15 @@ def fused_adagrad(
     learning_rate: Schedule = 1e-2,
     eps: float = 1e-10,
     weight_decay: float = 0.0,
+    layout: str = "flat",
 ) -> FusedOptimizer:
+    """``layout``: "flat" (Pallas sweep) or "tree" (leafwise XLA fusion,
+    no packing copies); identical math either way."""
+    if layout not in ("flat", "tree"):
+        raise ValueError(f"unknown layout {layout!r}")
+    if layout == "tree":
+        return _tree_adagrad(learning_rate, eps, weight_decay)
+
     def init(params) -> FusedAdagradState:
         _, layout = mt.pack(params)
         return FusedAdagradState(
@@ -56,3 +68,41 @@ def fused_adagrad(
         return _sweep(grads, state, params, grad_scale, out_is_delta=False)
 
     return FusedOptimizer(init=init, update=update, step=step)
+
+
+class TreeAdagradState(NamedTuple):
+    count: jnp.ndarray
+    sum_sq: object  # mirrors the param pytree, fp32
+
+
+def _tree_adagrad(learning_rate, eps, weight_decay):
+    """Leafwise Adagrad: same math as the flat kernel sweep."""
+
+    def init(params) -> TreeAdagradState:
+        return TreeAdagradState(
+            count=jnp.zeros((), jnp.int32),
+            sum_sq=zeros_like_tree(params),
+        )
+
+    def _sweep(grads, state, params, grad_scale, out_is_delta):
+        count = state.count + 1
+        lr = resolve_lr(learning_rate, count)
+        gs = resolve_grad_scale(grad_scale)
+
+        def leaf(p, g, h):
+            p32 = p.astype(jnp.float32)
+            g32 = g.astype(jnp.float32) * gs + weight_decay * p32
+            h_new = h + g32 * g32
+            upd = lr * g32 / (jnp.sqrt(h_new) + eps)
+            out = -upd if out_is_delta else p32 - upd
+            return out.astype(p.dtype), h_new
+
+        out_t, h_t = tree_sweep(leaf, params, grads, state.sum_sq)
+        return out_t, TreeAdagradState(count, h_t)
+
+    def state_pspecs(param_pspecs):
+        from jax.sharding import PartitionSpec as P
+
+        return TreeAdagradState(count=P(), sum_sq=param_pspecs)
+
+    return finish_tree_optimizer(init, _sweep, state_pspecs)

@@ -20,7 +20,6 @@ from __future__ import annotations
 
 from typing import Any, NamedTuple, Optional, Tuple
 
-import jax
 import jax.numpy as jnp
 
 from apex_tpu import multi_tensor as mt
@@ -28,11 +27,14 @@ from apex_tpu.kernels.flat_ops import adam_flat
 from apex_tpu.optimizers._base import (
     FusedOptimizer,
     Schedule,
+    bias_corrections,
+    finish_tree_optimizer,
     pack_pair,
     resolve_grad_scale,
     resolve_lr,
     tree_sweep,
     zeros_like_group_f32,
+    zeros_like_tree,
 )
 
 
@@ -40,14 +42,6 @@ class FusedAdamState(NamedTuple):
     count: jnp.ndarray
     m: Tuple[jnp.ndarray, ...]
     v: Tuple[jnp.ndarray, ...]
-
-
-def _bias_corrections(count, b1, b2, bias_correction):
-    if not bias_correction:
-        one = jnp.float32(1.0)
-        return one, one
-    c = count.astype(jnp.float32)
-    return 1.0 - jnp.float32(b1) ** c, 1.0 - jnp.float32(b2) ** c
 
 
 def fused_adam(
@@ -86,7 +80,7 @@ def fused_adam(
             raise ValueError("fused_adam requires params")
         pbufs, gbufs, layout = pack_pair(params, grads)
         count = state.count + 1
-        bc1, bc2 = _bias_corrections(count, b1, b2, bias_correction)
+        bc1, bc2 = bias_corrections(count, b1, b2, bias_correction)
         out_bufs, new_m, new_v = adam_flat(
             pbufs, gbufs, list(state.m), list(state.v),
             lr=resolve_lr(learning_rate, count), b1=b1, b2=b2, eps=eps,
@@ -118,16 +112,15 @@ def _tree_adam(learning_rate, b1, b2, eps, weight_decay, adam_w_mode,
     """Leafwise Adam: same math as the flat sweep, no packing copies."""
 
     def init(params) -> TreeAdamState:
-        z = lambda p: jnp.zeros(p.shape, jnp.float32)
         return TreeAdamState(
             count=jnp.zeros((), jnp.int32),
-            m=jax.tree.map(z, params),
-            v=jax.tree.map(z, params),
+            m=zeros_like_tree(params),
+            v=zeros_like_tree(params),
         )
 
     def _sweep(grads, state, params, grad_scale, out_is_delta):
         count = state.count + 1
-        bc1, bc2 = _bias_corrections(count, b1, b2, bias_correction)
+        bc1, bc2 = bias_corrections(count, b1, b2, bias_correction)
         lr = resolve_lr(learning_rate, count)
         gs = resolve_grad_scale(grad_scale)
 
@@ -148,16 +141,9 @@ def _tree_adam(learning_rate, b1, b2, eps, weight_decay, adam_w_mode,
         out_t, m_t, v_t = tree_sweep(leaf, params, grads, state.m, state.v)
         return out_t, TreeAdamState(count, m_t, v_t)
 
-    def update(grads, state, params=None, *, grad_scale=None):
-        return _sweep(grads, state, params, grad_scale, out_is_delta=True)
-
-    def step(grads, state, params, *, grad_scale=None):
-        return _sweep(grads, state, params, grad_scale, out_is_delta=False)
-
     def state_pspecs(param_pspecs):
         from jax.sharding import PartitionSpec as P
 
         return TreeAdamState(count=P(), m=param_pspecs, v=param_pspecs)
 
-    return FusedOptimizer(init=init, update=update, step=step,
-                          state_pspecs=state_pspecs)
+    return finish_tree_optimizer(init, _sweep, state_pspecs)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 from typing import NamedTuple, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from apex_tpu import multi_tensor as mt
@@ -24,11 +25,16 @@ from apex_tpu.kernels.flat_ops import adam_flat, l2norm_flat
 from apex_tpu.optimizers._base import (
     FusedOptimizer,
     Schedule,
+    bias_corrections,
     broadcast_per_leaf,
+    finish_tree_optimizer,
     pack_pair,
     per_leaf_norms,
+    resolve_grad_scale,
     resolve_lr,
+    tree_sweep,
     zeros_like_group_f32,
+    zeros_like_tree,
 )
 
 
@@ -47,6 +53,7 @@ def fused_lamb(
     bias_correction: bool = True,
     max_grad_norm: Optional[float] = 1.0,
     always_adapt: bool = False,
+    layout: str = "flat",
 ) -> FusedOptimizer:
     """apex FusedLAMB defaults: eps=1e-6, wd=0.01, global clip at 1.0.
 
@@ -54,7 +61,15 @@ def fused_lamb(
     trust ratio is only applied when weight decay is active (apex skips
     adaptation for wd=0 param groups); with ``True`` it is always applied.
     Degenerate tensors (zero ‖p‖ or ‖u‖) always fall back to ratio 1.
+    ``layout``: "flat" (Pallas multi-tensor sweeps) or "tree" (leafwise
+    XLA fusion, no packing copies — see fused_adam's module docstring);
+    identical math either way, and the trust ratio is per-tensor in both.
     """
+    if layout not in ("flat", "tree"):
+        raise ValueError(f"unknown layout {layout!r}")
+    if layout == "tree":
+        return _tree_lamb(learning_rate, b1, b2, eps, weight_decay,
+                          bias_correction, max_grad_norm, always_adapt)
 
     def init(params) -> FusedLAMBState:
         _, layout = mt.pack(params)
@@ -76,12 +91,7 @@ def fused_lamb(
             clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
             gscale = gscale * clip
 
-        if bias_correction:
-            c = count.astype(jnp.float32)
-            bc1 = 1.0 - jnp.float32(b1) ** c
-            bc2 = 1.0 - jnp.float32(b2) ** c
-        else:
-            bc1 = bc2 = jnp.float32(1.0)
+        bc1, bc2 = bias_corrections(count, b1, b2, bias_correction)
 
         # Phase 1 (stage-1 kernel): u = mhat/(sqrt(vhat)+eps) + wd*p, via
         # the adam sweep with lr=1 emitting a delta (u = -delta).
@@ -124,3 +134,63 @@ def fused_lamb(
         return _sweep(grads, state, params, grad_scale, out_is_delta=False)
 
     return FusedOptimizer(init=init, update=update, step=step)
+
+
+class TreeLAMBState(NamedTuple):
+    count: jnp.ndarray
+    m: object  # mirrors the param pytree, fp32
+    v: object
+
+
+def _tree_lamb(learning_rate, b1, b2, eps, weight_decay, bias_correction,
+               max_grad_norm, always_adapt):
+    """Leafwise NVLAMB: same two-phase math, per-leaf trust ratios."""
+
+    def init(params) -> TreeLAMBState:
+        return TreeLAMBState(
+            count=jnp.zeros((), jnp.int32),
+            m=zeros_like_tree(params),
+            v=zeros_like_tree(params),
+        )
+
+    def _sweep(grads, state, params, grad_scale, out_is_delta):
+        count = state.count + 1
+        gscale = resolve_grad_scale(grad_scale)
+        if max_grad_norm is not None:
+            gn2 = sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree.leaves(grads))
+            gnorm = jnp.sqrt(gn2) * gscale
+            gscale = gscale * jnp.minimum(
+                1.0, max_grad_norm / (gnorm + 1e-6))
+        bc1, bc2 = bias_corrections(count, b1, b2, bias_correction)
+        lr = resolve_lr(learning_rate, count)
+
+        def leaf(p, g, m, v):
+            g32 = g.astype(jnp.float32) * gscale
+            p32 = p.astype(jnp.float32)
+            m_new = b1 * m + (1.0 - b1) * g32
+            v_new = b2 * v + (1.0 - b2) * g32 * g32
+            u = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            if weight_decay:
+                u = u + weight_decay * p32
+            if always_adapt or weight_decay != 0.0:
+                pn = jnp.linalg.norm(p32.reshape(-1))
+                un = jnp.linalg.norm(u.reshape(-1))
+                ok = (pn > 0.0) & (un > 0.0)
+                ratio = jnp.where(ok, pn / jnp.where(un > 0.0, un, 1.0), 1.0)
+            else:
+                ratio = jnp.float32(1.0)
+            delta = -lr * ratio * u
+            out = delta if out_is_delta else p32 + delta
+            return out.astype(p.dtype), m_new, v_new
+
+        out_t, m_t, v_t = tree_sweep(leaf, params, grads, state.m, state.v)
+        return out_t, TreeLAMBState(count, m_t, v_t)
+
+    def state_pspecs(param_pspecs):
+        from jax.sharding import PartitionSpec as P
+
+        return TreeLAMBState(count=P(), m=param_pspecs, v=param_pspecs)
+
+    return finish_tree_optimizer(init, _sweep, state_pspecs)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import NamedTuple, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from apex_tpu import multi_tensor as mt
@@ -17,10 +18,14 @@ from apex_tpu.optimizers._base import (
     FusedOptimizer,
     Schedule,
     broadcast_per_leaf,
+    finish_tree_optimizer,
     pack_pair,
     per_leaf_norms,
+    resolve_grad_scale,
     resolve_lr,
+    tree_sweep,
     zeros_like_group_f32,
+    zeros_like_tree,
 )
 
 
@@ -37,7 +42,16 @@ def fused_novograd(
     eps: float = 1e-8,
     weight_decay: float = 0.0,
     grad_averaging: bool = True,
+    layout: str = "flat",
 ) -> FusedOptimizer:
+    """``layout``: "flat" (packed buffers) or "tree" (leafwise, no packing
+    copies); identical math, per-tensor second moments in both."""
+    if layout not in ("flat", "tree"):
+        raise ValueError(f"unknown layout {layout!r}")
+    if layout == "tree":
+        return _tree_novograd(learning_rate, b1, b2, eps, weight_decay,
+                              grad_averaging)
+
     def init(params) -> FusedNovoGradState:
         _, layout = mt.pack(params)
         n_leaves = len(layout.leaves)
@@ -84,3 +98,54 @@ def fused_novograd(
         return _sweep(grads, state, params, grad_scale, out_is_delta=False)
 
     return FusedOptimizer(init=init, update=update, step=step)
+
+
+class TreeNovoGradState(NamedTuple):
+    count: jnp.ndarray
+    m: object  # mirrors the param pytree, fp32
+    v: object  # per-leaf fp32 scalars (layer-wise second moments)
+
+
+def _tree_novograd(learning_rate, b1, b2, eps, weight_decay,
+                   grad_averaging):
+    """Leafwise NovoGrad: per-leaf scalar second moments, no packing."""
+
+    def init(params) -> TreeNovoGradState:
+        return TreeNovoGradState(
+            count=jnp.zeros((), jnp.int32),
+            m=zeros_like_tree(params),
+            v=jax.tree.map(lambda p: jnp.zeros((), jnp.float32), params),
+        )
+
+    def _sweep(grads, state, params, grad_scale, out_is_delta):
+        count = state.count + 1
+        gscale = resolve_grad_scale(grad_scale)
+        coeff = (1.0 - b1) if grad_averaging else 1.0
+        lr = resolve_lr(learning_rate, count)
+        first = state.count == 0
+
+        def leaf(p, g, m, v):
+            p32 = p.astype(jnp.float32)
+            g32 = g.astype(jnp.float32) * gscale
+            gsq = jnp.sum(jnp.square(g32))
+            # apex initialises v to the first grad-norm² rather than
+            # decaying from zero
+            v_new = jnp.where(first, gsq, b2 * v + (1.0 - b2) * gsq)
+            denom = jnp.sqrt(v_new) + eps
+            m_new = b1 * m + coeff * (g32 / denom + weight_decay * p32)
+            delta = -lr * m_new
+            out = delta if out_is_delta else p32 + delta
+            return out.astype(p.dtype), m_new, v_new
+
+        out_t, m_t, v_t = tree_sweep(leaf, params, grads, state.m, state.v)
+        return out_t, TreeNovoGradState(count, m_t, v_t)
+
+    def state_pspecs(param_pspecs):
+        from jax.sharding import PartitionSpec as P
+
+        return TreeNovoGradState(
+            count=P(), m=param_pspecs,
+            v=jax.tree.map(lambda _: P(), param_pspecs,
+                           is_leaf=lambda x: isinstance(x, P)))
+
+    return finish_tree_optimizer(init, _sweep, state_pspecs)

@@ -7,7 +7,6 @@ from __future__ import annotations
 
 from typing import Any, NamedTuple, Tuple
 
-import jax
 import jax.numpy as jnp
 
 from apex_tpu import multi_tensor as mt
@@ -15,11 +14,13 @@ from apex_tpu.kernels.flat_ops import sgd_flat
 from apex_tpu.optimizers._base import (
     FusedOptimizer,
     Schedule,
+    finish_tree_optimizer,
     pack_pair,
     resolve_grad_scale,
     resolve_lr,
     tree_sweep,
     zeros_like_group_f32,
+    zeros_like_tree,
 )
 
 
@@ -88,8 +89,7 @@ def _tree_sgd(learning_rate, momentum, dampening, weight_decay, nesterov):
     def init(params) -> TreeSGDState:
         return TreeSGDState(
             count=jnp.zeros((), jnp.int32),
-            momentum=jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            momentum=zeros_like_tree(params),
         )
 
     def _sweep(grads, state, params, grad_scale, out_is_delta):
@@ -118,16 +118,9 @@ def _tree_sgd(learning_rate, momentum, dampening, weight_decay, nesterov):
         out_t, m_t = tree_sweep(leaf, params, grads, state.momentum)
         return out_t, TreeSGDState(count, m_t)
 
-    def update(grads, state, params=None, *, grad_scale=None):
-        return _sweep(grads, state, params, grad_scale, out_is_delta=True)
-
-    def step(grads, state, params, *, grad_scale=None):
-        return _sweep(grads, state, params, grad_scale, out_is_delta=False)
-
     def state_pspecs(param_pspecs):
         from jax.sharding import PartitionSpec as P
 
         return TreeSGDState(count=P(), momentum=param_pspecs)
 
-    return FusedOptimizer(init=init, update=update, step=step,
-                          state_pspecs=state_pspecs)
+    return finish_tree_optimizer(init, _sweep, state_pspecs)

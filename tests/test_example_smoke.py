@@ -60,3 +60,20 @@ def test_retinanet_example_smoke(tmp_path):
     losses = [float(l.split("loss ")[1].split(" ")[0])
               for l in r.stdout.splitlines() if l.startswith("step ")]
     assert len(losses) == 2 and losses[1] < losses[0]
+
+
+def test_imagenet_example_smoke(tmp_path):
+    """BASELINE config #1: ResNet + bf16-policy + DP grad pmean +
+    FusedSGD runs end-to-end on the simulated mesh."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep + env.get("PYTHONPATH", ""))
+    cmd = [sys.executable, os.path.join(repo, "examples", "imagenet_amp.py"),
+           "--steps", "2", "--batch", "8", "--image", "32", "--depth", "26"]
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    losses = [float(l.rsplit(" ", 1)[1])
+              for l in r.stdout.splitlines() if l.startswith("step ")]
+    assert len(losses) == 2 and losses[1] < losses[0]

@@ -389,3 +389,64 @@ class TestTreeLayoutSGD:
             np.testing.assert_allclose(np.asarray(a, np.float32),
                                        np.asarray(b, np.float32),
                                        rtol=2e-5, atol=2e-6)
+
+
+class TestTreeLayoutLAMB:
+    def test_matches_flat_layout(self):
+        key = jax.random.PRNGKey(11)
+        params = make_tree(key)
+        grads = jax.tree.map(
+            lambda p: jax.random.normal(jax.random.fold_in(key, 13),
+                                        p.shape, p.dtype), params)
+        out = {}
+        for lay in ("flat", "tree"):
+            tx = opt.fused_lamb(1e-2, weight_decay=0.01, layout=lay)
+            state = tx.init(params)
+            p, state = jax.jit(tx.step)(grads, state, params)
+            p, _ = jax.jit(tx.step)(grads, state, p)
+            out[lay] = p
+        for a, b in zip(jax.tree.leaves(out["flat"]),
+                        jax.tree.leaves(out["tree"])):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=5e-5, atol=5e-6)
+
+    def test_no_adapt_without_wd(self):
+        """use_nvlamb=False + wd=0: both layouts skip trust adaptation."""
+        key = jax.random.PRNGKey(17)
+        params = make_tree(key)
+        grads = jax.tree.map(jnp.ones_like, params)
+        out = {}
+        for lay in ("flat", "tree"):
+            tx = opt.fused_lamb(1e-2, weight_decay=0.0, max_grad_norm=None,
+                                layout=lay)
+            p, _ = jax.jit(tx.step)(grads, tx.init(params), params)
+            out[lay] = p
+        for a, b in zip(jax.tree.leaves(out["flat"]),
+                        jax.tree.leaves(out["tree"])):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("maker,kw", [
+    (opt.fused_adagrad, dict(weight_decay=1e-3)),
+    (opt.fused_novograd, dict(weight_decay=1e-3)),
+])
+def test_tree_layout_matches_flat(maker, kw):
+    key = jax.random.PRNGKey(23)
+    params = make_tree(key)
+    grads = jax.tree.map(
+        lambda p: jax.random.normal(jax.random.fold_in(key, 29),
+                                    p.shape, p.dtype), params)
+    out = {}
+    for lay in ("flat", "tree"):
+        tx = maker(1e-2, layout=lay, **kw)
+        state = tx.init(params)
+        p, state = jax.jit(tx.step)(grads, state, params)
+        p, _ = jax.jit(tx.step)(grads, state, p)
+        out[lay] = p
+    for a, b in zip(jax.tree.leaves(out["flat"]), jax.tree.leaves(out["tree"])):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=5e-5, atol=5e-6)
