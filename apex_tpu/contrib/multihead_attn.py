@@ -18,6 +18,7 @@ at inference, exactly like the reference's ``training`` flag.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Optional
 
 import jax
@@ -158,3 +159,56 @@ def encdec_attn(params, query, memory, num_heads: int, *,
         dropout_p=dropout_p, rng=rng)
     y = _proj(_unheads(out), params["out"])
     return inp + y if include_norm_add else y
+
+
+@dataclasses.dataclass(frozen=True)
+class SelfMultiheadAttn:
+    """Layer-style wrapper at apex's class name and argument order
+    (apex/contrib/multihead_attn/self_multihead_attn.py (U):
+    ``SelfMultiheadAttn(embed_dim, num_heads, dropout, bias, ...)``):
+    ``.init(key)`` → params; ``.apply(params, x, ...)`` ==
+    :func:`self_attn` with this layer's dropout/norm-add defaults."""
+
+    hidden: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = True
+    include_norm_add: bool = False
+    dtype: Any = jnp.float32
+
+    def init(self, key):
+        return init_self_attn(key, self.hidden, bias=self.bias,
+                              include_norm_add=self.include_norm_add,
+                              dtype=self.dtype)
+
+    def apply(self, params, x, **kw):
+        kw.setdefault("include_norm_add", self.include_norm_add)
+        kw.setdefault("dropout_p", self.dropout)
+        return self_attn(params, x, self.num_heads, **kw)
+
+    __call__ = apply
+
+
+@dataclasses.dataclass(frozen=True)
+class EncdecMultiheadAttn:
+    """Layer-style wrapper at apex's class name and argument order
+    (apex/contrib/multihead_attn/encdec_multihead_attn.py (U))."""
+
+    hidden: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = True
+    include_norm_add: bool = False
+    dtype: Any = jnp.float32
+
+    def init(self, key):
+        return init_encdec_attn(key, self.hidden, bias=self.bias,
+                                include_norm_add=self.include_norm_add,
+                                dtype=self.dtype)
+
+    def apply(self, params, query, memory, **kw):
+        kw.setdefault("include_norm_add", self.include_norm_add)
+        kw.setdefault("dropout_p", self.dropout)
+        return encdec_attn(params, query, memory, self.num_heads, **kw)
+
+    __call__ = apply

@@ -134,9 +134,27 @@ class FP16Optimizer:
     """
 
     def __init__(self, optimizer: FusedOptimizer,
-                 scaler: Optional[ScalerConfig] = None):
+                 scaler: Optional[ScalerConfig] = None, *,
+                 static_loss_scale: Optional[float] = None,
+                 dynamic_loss_scale: bool = False,
+                 dynamic_loss_args: Optional[dict] = None):
+        """Accepts either an explicit :class:`ScalerConfig` or apex's
+        constructor shapes (``FP16_Optimizer(opt, 128.0)``,
+        ``static_loss_scale=128.``, ``dynamic_loss_scale=True,
+        dynamic_loss_args={"init_scale": ..., "scale_factor": ...,
+        "scale_window": ...}`` (U))."""
+        if isinstance(scaler, (int, float)):
+            # apex's second positional arg is static_loss_scale
+            scaler = LossScaler(float(scaler))
+        elif scaler is None:
+            if dynamic_loss_scale:
+                scaler = DynamicLossScaler(**(dynamic_loss_args or {}))
+            elif static_loss_scale is not None:
+                scaler = LossScaler(float(static_loss_scale))
+            else:
+                scaler = ScalerConfig()
         self.optimizer = optimizer
-        self.scaler = scaler or ScalerConfig()
+        self.scaler = scaler
 
     def init(self, model_params) -> FP16OptimizerState:
         _, masters = prep_param_lists(model_params)
@@ -180,3 +198,10 @@ def DynamicLossScaler(init_scale: float = 2.0 ** 16,
     return ScalerConfig(init_scale=init_scale, growth_factor=scale_factor,
                         backoff_factor=1.0 / scale_factor,
                         growth_interval=scale_window)
+
+
+#: apex's exact symbol names (apex/fp16_utils/fp16util.py,
+#: fp16_optimizer.py (U)) for drop-in imports
+BN_convert_float = bn_convert_float
+FP16_Optimizer = FP16Optimizer
+__all__ += ["BN_convert_float", "FP16_Optimizer"]

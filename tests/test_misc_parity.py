@@ -106,3 +106,55 @@ def test_weight_norm_identity():
     back = remove_weight_norm(wn)
     np.testing.assert_allclose(np.asarray(back["layer"]["kernel"]),
                                np.asarray(w), rtol=1e-5)
+
+
+def test_multihead_attn_class_wrappers():
+    """SelfMultiheadAttn / EncdecMultiheadAttn at apex's class names wrap
+    the functional blocks."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.contrib.multihead_attn import (
+        EncdecMultiheadAttn,
+        SelfMultiheadAttn,
+        encdec_attn,
+        self_attn,
+    )
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.fold_in(key, 1), (8, 2, 32))
+    mem = jax.random.normal(jax.random.fold_in(key, 2), (6, 2, 32))
+
+    layer = SelfMultiheadAttn(32, 4, include_norm_add=True)
+    p = layer.init(key)
+    np.testing.assert_allclose(
+        np.asarray(layer(p, x)),
+        np.asarray(self_attn(p, x, 4, include_norm_add=True)))
+
+    enc = EncdecMultiheadAttn(32, 4)
+    pe = enc.init(key)
+    np.testing.assert_allclose(
+        np.asarray(enc(pe, x, mem)),
+        np.asarray(encdec_attn(pe, x, mem, 4)))
+
+
+def test_fp16_optimizer_apex_ctor_shapes():
+    """FP16_Optimizer accepts apex's constructor shapes."""
+    import pytest as _pytest
+
+    from apex_tpu.fp16_utils import FP16_Optimizer
+    from apex_tpu.optimizers import fused_sgd
+
+    o1 = FP16_Optimizer(fused_sgd(1e-2), 128.0)  # positional static scale
+    assert float(o1.scaler.init_scale) == 128.0
+    assert o1.scaler.growth_factor == 1.0
+    o2 = FP16_Optimizer(fused_sgd(1e-2), static_loss_scale=64.0)
+    assert float(o2.scaler.init_scale) == 64.0
+    o3 = FP16_Optimizer(
+        fused_sgd(1e-2), dynamic_loss_scale=True,
+        dynamic_loss_args={"init_scale": 1024.0, "scale_window": 500})
+    assert float(o3.scaler.init_scale) == 1024.0
+    assert o3.scaler.growth_interval == 500
+    o4 = FP16_Optimizer(fused_sgd(1e-2), dynamic_loss_scale=True)
+    assert o4.scaler.growth_interval == 1000  # DynamicLossScaler default
