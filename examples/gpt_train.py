@@ -59,20 +59,54 @@ def main():
                     "format); synthetic tokens if omitted")
     ap.add_argument("--ckpt", help=".atck checkpoint path to save/resume")
     ap.add_argument("--metrics", help="JSONL metrics path")
+    ap.add_argument("--remat-policy", default=None,
+                    choices=["dots", "qkv_fc1", "fc1", "qkv_fc1_attn",
+                             "fc1_attn"],
+                    help="selective-recompute policy (the *_attn variants "
+                    "imply --attn-impl flash; bench uses qkv_fc1_attn)")
+    ap.add_argument("--attn-impl", default="auto",
+                    choices=["auto", "flash", "xla", "xla_chunked"])
+    ap.add_argument("--opt-layout", default="tree",
+                    choices=["flat", "tree"],
+                    help="optimizer state layout; tree (default) avoids "
+                    "flat-packing copies and is the measured-fast choice "
+                    "for layer-stacked models. Resuming a checkpoint "
+                    "requires the layout it was saved with.")
+    ap.add_argument("--ln-impl", default="xla", choices=["xla", "pallas"],
+                    help="XLA-fused LN (measured faster in-model) or the "
+                    "Pallas kernel")
     args = ap.parse_args()
 
+    # chunked CE once the (cp-local) sequence is long enough to make the
+    # logits tensor worth not materialising
+    seq = PRESETS[args.preset]["seq_len"]
+    ce_chunk = 512 if seq >= 1024 and (seq // args.cp) % 512 == 0 else 0
+    attn_impl = args.attn_impl
+    if (args.remat_policy or "").endswith("_attn") and attn_impl == "auto":
+        # the *_attn policies pin the flash kernel's residuals — they
+        # require the flash path explicitly
+        attn_impl = "flash"
     cfg = gpt.GPTConfig(
         sequence_parallel=(args.tp > 1 and args.cp == 1 and not args.no_sp),
         context_parallel=(args.cp > 1),
-        remat=True, compute_dtype=jnp.bfloat16, **PRESETS[args.preset])
+        remat=True, compute_dtype=jnp.bfloat16,
+        remat_policy=args.remat_policy, ln_impl=args.ln_impl,
+        attn_impl=attn_impl, ce_chunk=ce_chunk, **PRESETS[args.preset])
     mesh = mx.build_mesh(tp=args.tp, pp=args.pp, cp=args.cp)
     init_fn, step_fn = training.make_train_step(
-        cfg, mesh, fused_adam(args.lr), ScalerConfig(enabled=False),
+        cfg, mesh, fused_adam(args.lr, layout=args.opt_layout),
+        ScalerConfig(enabled=False),
         n_micro=args.n_micro, n_chunks=args.vpp)
 
     state = init_fn(jax.random.PRNGKey(0))
     if args.ckpt and ckpt.checkpoint_exists(args.ckpt):
-        state = ckpt.load_checkpoint(args.ckpt, state)
+        try:
+            state = ckpt.load_checkpoint(args.ckpt, state)
+        except KeyError as e:
+            raise SystemExit(
+                f"checkpoint {args.ckpt} does not match the current "
+                f"optimizer-state structure ({e}); if it was saved with a "
+                "different --opt-layout, resume with that layout") from e
         print(f"resumed from {args.ckpt} at step {int(state.step)}")
 
     loader = None
