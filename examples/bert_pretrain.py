@@ -45,23 +45,28 @@ def main():
         compute_dtype=jnp.float16 if args.fp16 else jnp.bfloat16)
     mesh = mx.build_mesh(tp=args.tp)
     scaler = (ScalerConfig() if args.fp16 else ScalerConfig(enabled=False))
+    # tree layout off the ZeRO path: leafwise XLA-fused update (the flat
+    # Pallas sweep runs interpreted — minutes/step — off-TPU)
     opt = (distributed_fused_lamb(args.lr) if args.zero
-           else fused_lamb(args.lr))
+           else fused_lamb(args.lr, layout="tree"))
 
     params = jax.jit(lambda k: bert.init(cfg, k))(jax.random.PRNGKey(0))
     pspecs = bert.param_specs(cfg)
 
-    def local_init(p):
-        return opt.init(p)
-
-    opt_specs = jax.tree.map(
-        lambda x: P() if x.ndim == 0 else P(("dp", "tp") if args.zero
-                                            else ("tp",)),
-        jax.eval_shape((lambda p: opt.init(p, dp=mesh.shape["dp"]))
-                       if args.zero else opt.init,
-                       jax.eval_shape(lambda: bert.init(
-                           cfg, jax.random.PRNGKey(0)))))
-    del local_init
+    state_pspecs = getattr(opt, "state_pspecs", None)
+    if state_pspecs is not None:
+        # tree layout: optimizer state mirrors the param tree
+        opt_specs = state_pspecs(pspecs)
+    else:
+        # flat layouts: scalars replicated, buffers sharded over the
+        # model (+dp for ZeRO) axes
+        opt_specs = jax.tree.map(
+            lambda x: P() if x.ndim == 0 else P(("dp", "tp") if args.zero
+                                                else ("tp",)),
+            jax.eval_shape((lambda p: opt.init(p, dp=mesh.shape["dp"]))
+                           if args.zero else opt.init,
+                           jax.eval_shape(lambda: bert.init(
+                               cfg, jax.random.PRNGKey(0)))))
 
     def local_step(params, opt_state, sc_state, tok, tgt, mask):
         vag = value_and_scaled_grad(

@@ -80,12 +80,18 @@ def main():
     # chunked CE once the (cp-local) sequence is long enough to make the
     # logits tensor worth not materialising
     seq = PRESETS[args.preset]["seq_len"]
-    ce_chunk = 512 if seq >= 1024 and (seq // args.cp) % 512 == 0 else 0
+    ce_chunk = 512 if (seq // args.cp) >= 1024 and (seq // args.cp) % 512 == 0 else 0
     attn_impl = args.attn_impl
-    if (args.remat_policy or "").endswith("_attn") and attn_impl == "auto":
+    if (args.remat_policy or "").endswith("_attn"):
         # the *_attn policies pin the flash kernel's residuals — they
         # require the flash path explicitly
-        attn_impl = "flash"
+        if attn_impl == "auto":
+            attn_impl = "flash"
+        elif attn_impl != "flash" or args.cp > 1:
+            raise SystemExit(
+                f"--remat-policy {args.remat_policy} requires the flash "
+                "attention path (and no --cp); drop --attn-impl "
+                f"{args.attn_impl} or pick a non-_attn policy")
     cfg = gpt.GPTConfig(
         sequence_parallel=(args.tp > 1 and args.cp == 1 and not args.no_sp),
         context_parallel=(args.cp > 1),
