@@ -123,12 +123,37 @@ def test_mha_layout_wrapper():
 
 
 def test_long_sequence_multiblock():
-    # force multiple q/k blocks (block=128) to exercise the online softmax
+    # explicit small tiles force multiple q/k blocks regardless of the
+    # (larger) tuned defaults, exercising the online-softmax merge
     b, h, s, d = 1, 1, 300, 8
     ks = jax.random.split(jax.random.PRNGKey(6), 3)
     q = jax.random.normal(ks[0], (b, h, s, d))
     k = jax.random.normal(ks[1], (b, h, s, d))
     v = jax.random.normal(ks[2], (b, h, s, d))
-    out = flash_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
     ref = _ref_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=5e-5, atol=5e-5)
+
+
+def test_misaligned_length_default_tiles():
+    """A length just past a tile multiple: _fit_block shrinks the tile
+    instead of padding by up to a whole masked-out block; fwd+bwd match
+    the reference."""
+    b, h, s, d = 1, 2, 1040, 8
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d))
+    k = jax.random.normal(ks[1], (b, h, s, d))
+    v = jax.random.normal(ks[2], (b, h, s, d))
+
+    def f(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    def ref(q, k, v):
+        return jnp.sum(_ref_attention(q, k, v, causal=True) ** 2)
+
+    np.testing.assert_allclose(float(f(q, k, v)), float(ref(q, k, v)),
+                               rtol=1e-4)
+    g = jax.grad(f)(q, k, v)
+    gr = jax.grad(ref)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                               rtol=2e-4, atol=2e-4)
