@@ -52,6 +52,8 @@ __all__ = [
     "reparameterization",
     "models",
     "testing",
+    "capabilities",
+    "has_capability",
     "__version__",
 ]
 
@@ -59,6 +61,11 @@ __all__ = [
 def __getattr__(name):
     # Lazy subpackage imports keep `import apex_tpu` light and avoid
     # touching jax backends at import time.
+    if name in ("capabilities", "has_capability"):
+        import importlib
+
+        mod = importlib.import_module("apex_tpu._capabilities")
+        return getattr(mod, name)
     if name in __all__:
         import importlib
 

@@ -158,3 +158,31 @@ def test_fp16_optimizer_apex_ctor_shapes():
     assert o3.scaler.growth_interval == 500
     o4 = FP16_Optimizer(fused_sgd(1e-2), dynamic_loss_scale=True)
     assert o4.scaler.growth_interval == 1000  # DynamicLossScaler default
+
+
+def test_capabilities_registry():
+    """Runtime capabilities registry replaces apex's build-time feature
+    flags (SURVEY.md §5 'Config / flag system')."""
+    import apex_tpu
+
+    caps = apex_tpu.capabilities()
+    for always in ("amp", "fused_optimizers", "flash_attention",
+                   "transformer", "syncbn", "context_parallel"):
+        assert caps[always] is True
+    assert caps["backend"] == "cpu"  # conftest forces the CPU platform
+    assert caps["pallas_native"] is False  # interpret mode off-TPU
+    assert isinstance(caps["native_host_runtime"], bool)
+    assert apex_tpu.has_capability("xentropy")
+    assert not apex_tpu.has_capability("nonexistent_feature")
+
+
+def test_capabilities_repeated_access():
+    """apex_tpu.capabilities stays the callable on every access (the
+    submodule must not shadow the lazily-exported function)."""
+    import apex_tpu
+
+    first = apex_tpu.capabilities
+    second = apex_tpu.capabilities
+    assert callable(first) and callable(second)
+    assert apex_tpu.capabilities()["amp"] is True
+    assert apex_tpu.capabilities()["amp"] is True  # second call, same result
