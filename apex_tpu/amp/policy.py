@@ -20,7 +20,7 @@ dynamic loss scaling exactly like apex.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Union
+from typing import Any, Union
 
 import jax
 import jax.numpy as jnp

@@ -25,7 +25,7 @@ from __future__ import annotations
 import json
 import os
 import struct
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp

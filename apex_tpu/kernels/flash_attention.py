@@ -28,7 +28,7 @@ from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.kernels._utils import LANE, cdiv, round_up, use_interpret, widen_f16
+from apex_tpu.kernels._utils import LANE, round_up, use_interpret, widen_f16
 
 _NEG = -1e30
 _LANES = 128  # stat scratch lane width

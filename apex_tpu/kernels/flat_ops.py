@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.kernels._utils import LANE, cdiv, use_interpret, widen_f16
+from apex_tpu.kernels._utils import LANE, use_interpret, widen_f16
 
 
 def _narrow(buf, dtype):

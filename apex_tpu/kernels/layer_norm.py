@@ -32,7 +32,6 @@ from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.kernels._utils import (
     LANE,
-    cdiv,
     pick_block_rows,
     round_up,
     use_interpret,

@@ -12,7 +12,7 @@ flag, activation choice). bf16 inputs hit the MXU with fp32 accumulation.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Optional, Sequence
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp

@@ -18,7 +18,6 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from apex_tpu.mesh.topology import AXIS_DP
 from apex_tpu.parallel.sync_batchnorm import sync_batch_norm
 
 #: depth 26 = one bottleneck per stage — the smallest member of the

@@ -30,7 +30,6 @@ from apex_tpu.amp import ScalerConfig, ScalerState, apply_if_finite
 from apex_tpu.amp import update as scaler_update
 from apex_tpu.amp import value_and_scaled_grad
 from apex_tpu.mesh.topology import (
-    AXIS_CP,
     AXIS_DP,
     AXIS_PP,
     AXIS_TP,

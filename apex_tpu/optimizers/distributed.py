@@ -26,7 +26,7 @@ dp-gradient ``pmean`` (the reduce-scatter below replaces it).
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Optional, Tuple
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp

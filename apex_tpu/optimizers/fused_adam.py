@@ -18,7 +18,7 @@ Hyperparameters are traced either way, so LR schedules don't recompile.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional, Tuple
+from typing import Any, NamedTuple, Tuple
 
 import jax.numpy as jnp
 

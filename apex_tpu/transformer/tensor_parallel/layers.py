@@ -34,7 +34,7 @@ from apex_tpu.transformer.tensor_parallel.mappings import (
     reduce_scatter_to_sequence_parallel_region,
     scatter_to_tensor_model_parallel_region,
 )
-from apex_tpu.transformer.tensor_parallel.utils import VocabUtility, divide
+from apex_tpu.transformer.tensor_parallel.utils import VocabUtility
 
 
 def init_method_normal(sigma: float) -> Callable:
