@@ -1,0 +1,63 @@
+"""Torch-oracle coverage for the RNN cells (the parity claim tested
+against the reference implementation itself) and the multiproc shim's
+single-host no-op contract. Layer-shape and weight-norm roundtrip
+behaviour live in test_misc_parity.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from apex_tpu import rnn
+
+
+def test_lstm_cell_matches_torch():
+    I, H = 6, 8
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 5)
+    wi = jax.random.normal(ks[0], (I, 4 * H)) * 0.2
+    wh = jax.random.normal(ks[1], (H, 4 * H)) * 0.2
+    b = jax.random.normal(ks[2], (4 * H,)) * 0.1
+    x = jax.random.normal(ks[3], (3, I))
+    h0 = jnp.zeros((3, H)); c0 = jnp.zeros((3, H))
+    h1, c1 = rnn.lstm_cell(x, h0, c0, wi, wh, b)
+
+    cell = torch.nn.LSTMCell(I, H)
+    with torch.no_grad():
+        cell.weight_ih.copy_(torch.tensor(np.asarray(wi).T))
+        cell.weight_hh.copy_(torch.tensor(np.asarray(wh).T))
+        cell.bias_ih.copy_(torch.tensor(np.asarray(b)))
+        cell.bias_hh.zero_()
+        th, tc = cell(torch.tensor(np.asarray(x)),
+                      (torch.zeros(3, H), torch.zeros(3, H)))
+    np.testing.assert_allclose(np.asarray(h1), th.numpy(), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c1), tc.numpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_gru_cell_matches_torch():
+    I, H = 5, 7
+    key = jax.random.PRNGKey(1)
+    ks = jax.random.split(key, 4)
+    wi = jax.random.normal(ks[0], (I, 3 * H)) * 0.2
+    wh = jax.random.normal(ks[1], (H, 3 * H)) * 0.2
+    x = jax.random.normal(ks[2], (2, I))
+    h0 = jax.random.normal(ks[3], (2, H)) * 0.1
+    h1 = rnn.gru_cell(x, h0, wi, wh)
+
+    cell = torch.nn.GRUCell(I, H, bias=False)
+    with torch.no_grad():
+        cell.weight_ih.copy_(torch.tensor(np.asarray(wi).T))
+        cell.weight_hh.copy_(torch.tensor(np.asarray(wh).T))
+        th = cell(torch.tensor(np.asarray(x)), torch.tensor(np.asarray(h0)))
+    np.testing.assert_allclose(np.asarray(h1), th.numpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_multiproc_single_host_noop():
+    """No coordinator → no-op (single-controller bring-up); must not touch
+    jax.distributed state."""
+    from apex_tpu.parallel import initialize_distributed
+
+    initialize_distributed()  # returns without error, no rendezvous
