@@ -58,6 +58,10 @@ def test_gru_cell_matches_torch():
 def test_multiproc_single_host_noop():
     """No coordinator → no-op (single-controller bring-up); must not touch
     jax.distributed state."""
+    from jax._src import distributed as jdist
+
     from apex_tpu.parallel import initialize_distributed
 
+    before = jdist.global_state.client
     initialize_distributed()  # returns without error, no rendezvous
+    assert jdist.global_state.client is before  # untouched
