@@ -37,6 +37,7 @@ _ALWAYS_ON = (
     "distributed_optimizers",  # distributed_fused_adam/lamb (ZeRO)
     "syncbn",               # syncbn kernels
     "context_parallel",     # ring/Ulysses attention (no apex analogue)
+    "moe",                  # expert-parallel MoE over ep (no apex analogue)
 )
 
 

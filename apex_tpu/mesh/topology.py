@@ -28,11 +28,11 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-# Canonical axis names. EP (expert parallelism) is reserved — absent in the
-# reference (SURVEY.md §2.5) but kept in the namespace so MoE can slot in.
-# CP (context parallelism: ring / all-to-all attention over the sequence
-# dim) has no reference analogue either (SURVEY.md §5 "no ring attention")
-# but is first-class here: long-context sharding shapes the core design.
+# Canonical axis names. EP (expert parallelism — transformer.moe) and CP
+# (context parallelism: ring / all-to-all attention over the sequence dim)
+# have no reference analogue (SURVEY.md §2.5 "EP absent", §5 "no ring
+# attention") but are first-class here: MoE and long-context sharding
+# shape the core design.
 AXIS_DP = "dp"
 AXIS_PP = "pp"
 AXIS_TP = "tp"
@@ -40,42 +40,46 @@ AXIS_CP = "cp"
 AXIS_EP = "ep"
 
 #: Default axis order, outermost → innermost: cp sits next to tp so ring
-#: attention's ppermute hops ride adjacent ICI links.
-DEFAULT_AXIS_ORDER = (AXIS_PP, AXIS_DP, AXIS_CP, AXIS_TP)
+#: attention's ppermute hops ride adjacent ICI links; ep next to dp so
+#: MoE's all_to_all dispatch crosses the same links grad-psum already
+#: owns (experts shard over what would otherwise be data ranks).
+DEFAULT_AXIS_ORDER = (AXIS_PP, AXIS_DP, AXIS_EP, AXIS_CP, AXIS_TP)
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Declarative mesh shape.
 
-    ``dp=None`` infers data parallelism as ``n_devices // (tp * pp * cp)``
-    — the world-size factorisation apex's ``initialize_model_parallel``
-    does, extended by the cp (context-parallel) axis.
+    ``dp=None`` infers data parallelism as ``n_devices // (tp * pp * cp *
+    ep)`` — the world-size factorisation apex's ``initialize_model_parallel``
+    does, extended by the cp (context-parallel) and ep (expert-parallel)
+    axes.
     """
 
     tp: int = 1
     pp: int = 1
     cp: int = 1
+    ep: int = 1
     dp: Optional[int] = None
     axis_order: Sequence[str] = DEFAULT_AXIS_ORDER
 
     def resolve_dp(self, n_devices: int) -> int:
-        if self.tp < 1 or self.pp < 1 or self.cp < 1:
+        if self.tp < 1 or self.pp < 1 or self.cp < 1 or self.ep < 1:
             raise ValueError(
-                f"tp, pp, cp must be >= 1, got tp={self.tp} pp={self.pp} "
-                f"cp={self.cp}")
-        model_parallel = self.tp * self.pp * self.cp
+                f"tp, pp, cp, ep must be >= 1, got tp={self.tp} "
+                f"pp={self.pp} cp={self.cp} ep={self.ep}")
+        model_parallel = self.tp * self.pp * self.cp * self.ep
         if self.dp is not None:
             total = model_parallel * self.dp
             if total != n_devices:
                 raise ValueError(
-                    f"tp*pp*cp*dp = {total} != device count {n_devices}"
+                    f"tp*pp*cp*ep*dp = {total} != device count {n_devices}"
                 )
             return self.dp
         if n_devices % model_parallel != 0:
             raise ValueError(
                 f"device count {n_devices} not divisible by "
-                f"tp*pp*cp={model_parallel}"
+                f"tp*pp*cp*ep={model_parallel}"
             )
         return n_devices // model_parallel
 
@@ -87,8 +91,9 @@ def build_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
     axis_order: Sequence[str] = DEFAULT_AXIS_ORDER,
     cp: int = 1,
+    ep: int = 1,
 ) -> Mesh:
-    """Build a ``Mesh`` with named {pp, dp, tp} axes over ``devices``.
+    """Build a ``Mesh`` with named {pp, dp, ep, cp, tp} axes over ``devices``.
 
     Drop-in conceptual replacement for ``initialize_model_parallel(tp, pp)``
     (U): every apex "process group" becomes a mesh axis; rank queries become
@@ -99,9 +104,11 @@ def build_mesh(
     if devices is None:
         devices = jax.devices()
     n = len(devices)
-    cfg = MeshConfig(tp=tp, pp=pp, cp=cp, dp=dp, axis_order=tuple(axis_order))
+    cfg = MeshConfig(
+        tp=tp, pp=pp, cp=cp, ep=ep, dp=dp, axis_order=tuple(axis_order))
     dp_size = cfg.resolve_dp(n)
-    sizes = {AXIS_DP: dp_size, AXIS_PP: pp, AXIS_TP: tp, AXIS_CP: cp}
+    sizes = {AXIS_DP: dp_size, AXIS_PP: pp, AXIS_TP: tp, AXIS_CP: cp,
+             AXIS_EP: ep}
     unknown = set(cfg.axis_order) - set(sizes)
     if unknown:
         raise ValueError(f"unknown axis names in axis_order: {sorted(unknown)}")

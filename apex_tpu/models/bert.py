@@ -127,7 +127,8 @@ def hidden_states(cfg: BertConfig, params, tokens, token_type_ids=None):
     h = _embed(cfg, params, tokens, token_type_ids)
 
     def body(carry, layer_p):
-        return gpt._block(core, gpt._cast_layer(core, layer_p), carry), None
+        # dense core (no MoE in the BERT stack): aux term is always 0
+        return gpt._block(core, gpt._cast_layer(core, layer_p), carry)[0], None
 
     if cfg.remat:
         from apex_tpu.transformer.tensor_parallel import random as tpr

@@ -38,7 +38,8 @@ import numpy as np
 
 from apex_tpu.kernels import flash_attention, layer_norm
 from apex_tpu.kernels.blockwise_attention import blockwise_attention
-from apex_tpu.mesh.topology import AXIS_CP, AXIS_PP, AXIS_TP
+from apex_tpu.mesh.topology import AXIS_CP, AXIS_EP, AXIS_PP, AXIS_TP
+from apex_tpu.transformer import moe as moe_mod
 from apex_tpu.transformer.context_parallel import ring_attention
 from apex_tpu.transformer.pipeline_parallel.schedules import pipelined_loss
 from apex_tpu.transformer.tensor_parallel import random as tpr
@@ -130,6 +131,18 @@ class GPTConfig:
     cp_axis: str = AXIS_CP
     #: False → bidirectional attention (the BERT encoder reuses this stack)
     causal: bool = True
+    #: Mixture of experts (no reference analogue — SURVEY.md §2.5 "EP
+    #: absent"): > 0 replaces every layer's MLP with a
+    #: ``transformer.moe`` FFN of this many experts, sharded over the
+    #: ``ep`` mesh axis (``ep=1`` runs them locally). The CE objective
+    #: gains ``moe_aux_coef ×`` the summed per-layer load-balance loss.
+    #: Composes with dp/tp/cp; sequence_parallel and pipeline parallelism
+    #: are not supported with MoE.
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
+    ep_axis: str = AXIS_EP
     compute_dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     layernorm_epsilon: float = 1e-5
@@ -148,7 +161,12 @@ class GPTConfig:
 
     def param_count(self) -> int:
         h, f, L = self.hidden_size, self.ffn, self.num_layers
-        per_layer = 4 * h + (h * 3 * h + 3 * h) + (h * h + h) + (h * f + f) + (f * h + h)
+        per_layer = 4 * h + (h * 3 * h + 3 * h) + (h * h + h)
+        if self.num_experts:
+            e = self.num_experts
+            per_layer += h * e + e * (h * f + f + f * h + h)
+        else:
+            per_layer += (h * f + f) + (f * h + h)
         return self.vocab_size * h + self.seq_len * h + L * per_layer + 2 * h
 
 
@@ -162,7 +180,7 @@ def _layer_init(cfg: GPTConfig, key):
     out_init = scaled_init_method_normal(cfg.init_std, cfg.num_layers)
     k = jax.random.split(key, 4)
     dt = cfg.param_dtype
-    return {
+    p = {
         "ln1": {"scale": jnp.ones((h,), dt), "bias": jnp.zeros((h,), dt)},
         "attn": {
             # fused QKV, head-major [h, heads * 3 * head_dim] so a TP shard
@@ -174,13 +192,27 @@ def _layer_init(cfg: GPTConfig, key):
                      "bias": jnp.zeros((h,), dt)},
         },
         "ln2": {"scale": jnp.ones((h,), dt), "bias": jnp.zeros((h,), dt)},
-        "mlp": {
+    }
+    if cfg.num_experts:
+        e = cfg.num_experts
+        ke = jax.random.split(k[3], 2)
+        p["moe"] = {
+            "router": {"kernel": init(k[2], (h, e), dt)},
+            "experts": {
+                "w1": init(ke[0], (e, h, f), dt),
+                "b1": jnp.zeros((e, f), dt),
+                "w2": out_init(ke[1], (e, f, h), dt),
+                "b2": jnp.zeros((e, h), dt),
+            },
+        }
+    else:
+        p["mlp"] = {
             "fc1": {"kernel": init(k[2], (h, f), dt),
                     "bias": jnp.zeros((f,), dt)},
             "fc2": {"kernel": out_init(k[3], (f, h), dt),
                     "bias": jnp.zeros((h,), dt)},
-        },
-    }
+        }
+    return p
 
 
 def init(cfg: GPTConfig, key) -> Any:
@@ -218,11 +250,19 @@ def param_specs(cfg: GPTConfig, *, pipeline: bool = False) -> Any:
             "proj": {"kernel": P(None, t, None), "bias": P(None)},
         },
         "ln2": {"scale": P(None), "bias": P(None)},
-        "mlp": {
+    }
+    if cfg.num_experts:
+        ep = cfg.ep_axis
+        lay["moe"] = {
+            "router": {"kernel": P(None, None, None)},
+            "experts": {"w1": P(None, ep), "b1": P(None, ep),
+                        "w2": P(None, ep), "b2": P(None, ep)},
+        }
+    else:
+        lay["mlp"] = {
             "fc1": {"kernel": P(None, None, t), "bias": P(None, t)},
             "fc2": {"kernel": P(None, t, None), "bias": P(None)},
-        },
-    }
+        }
     if pipeline:
         # the leading spec entry is the stacked layer dim — shard it on pp
         lay = jax.tree.map(
@@ -247,11 +287,17 @@ def seq_partial_grad_mask(cfg: GPTConfig) -> Any:
             "proj": {"kernel": False, "bias": True},
         },
         "ln2": {"scale": True, "bias": True},
-        "mlp": {
+    }
+    if cfg.num_experts:  # moe × sequence_parallel is rejected anyway
+        lay["moe"] = {
+            "router": {"kernel": False},
+            "experts": {"w1": False, "b1": False, "w2": False, "b2": False},
+        }
+    else:
+        lay["mlp"] = {
             "fc1": {"kernel": False, "bias": False},
             "fc2": {"kernel": False, "bias": True},
-        },
-    }
+        }
     return {
         "embedding": {"word": {"table": False}, "position": False},
         "layers": lay,
@@ -363,11 +409,32 @@ def _layer_norm(cfg: GPTConfig, h, scale, bias):
     return layer_norm(h, scale, bias, eps=cfg.layernorm_epsilon)
 
 
+def _moe_cfg(cfg: GPTConfig) -> moe_mod.MoEConfig:
+    return moe_mod.MoEConfig(
+        num_experts=cfg.num_experts, hidden_size=cfg.hidden_size,
+        ffn_hidden_size=cfg.ffn, top_k=cfg.moe_top_k,
+        capacity_factor=cfg.moe_capacity_factor,
+        aux_loss_coef=cfg.moe_aux_coef, param_dtype=cfg.param_dtype,
+        compute_dtype=cfg.compute_dtype, axis=cfg.ep_axis)
+
+
 def _block(cfg: GPTConfig, p, h):
+    """One transformer layer; returns ``(h, aux)`` — aux is the MoE
+    load-balance term, 0 for the dense MLP."""
     x = _layer_norm(cfg, h, p["ln1"]["scale"], p["ln1"]["bias"])
     h = h + _attention(cfg, p["attn"], x)
     x = _layer_norm(cfg, h, p["ln2"]["scale"], p["ln2"]["bias"])
-    return h + _mlp(cfg, p["mlp"], x)
+    if cfg.num_experts:
+        if cfg.sequence_parallel:
+            raise ValueError(
+                "num_experts > 0 does not compose with sequence_parallel "
+                "(MoE routes over full-h activations); shard the batch "
+                "over ep instead")
+        s, b, hd = x.shape
+        y, aux = moe_mod.moe_ffn(
+            _moe_cfg(cfg), p["moe"], x.reshape(s * b, hd))
+        return h + y.reshape(s, b, hd), aux
+    return h + _mlp(cfg, p["mlp"], x), jnp.float32(0.0)
 
 
 def _cp_slice(cfg: GPTConfig, x, dim: int):
@@ -404,21 +471,32 @@ def _embed(cfg: GPTConfig, params, tokens):
     return h
 
 
-def hidden_states(cfg: GPTConfig, params, tokens):
-    """tokens [b, s] (global ids, dp-local batch) → final-LN hidden
-    [s(_local under SP), b, hidden] in compute dtype."""
+def hidden_states_and_aux(cfg: GPTConfig, params, tokens):
+    """tokens [b, s] (global ids, dp-local batch) → (final-LN hidden
+    [s(_local under SP), b, hidden] in compute dtype, summed MoE aux
+    loss — 0 for dense models)."""
     h = _embed(cfg, params, tokens)
 
     def body(carry, layer_p):
-        return _block(cfg, _cast_layer(cfg, layer_p), carry), None
+        h, aux = carry
+        h, a = _block(cfg, _cast_layer(cfg, layer_p), h)
+        return (h, aux + a), None
 
     if cfg.remat:
         body = tpr.checkpoint(body, policy=_remat_policy(cfg))
-    h, _ = lax.scan(body, h, params["layers"], unroll=cfg.scan_unroll)
+    (h, aux), _ = lax.scan(
+        body, (h, jnp.float32(0.0)), params["layers"],
+        unroll=cfg.scan_unroll)
     # final LN runs inside the SP region (Megatron: its grads are
     # tp-partial — see seq_partial_grad_mask)
     return _layer_norm(cfg, h, params["final_ln"]["scale"],
-                       params["final_ln"]["bias"])
+                       params["final_ln"]["bias"]), aux
+
+
+def hidden_states(cfg: GPTConfig, params, tokens):
+    """tokens [b, s] (global ids, dp-local batch) → final-LN hidden
+    [s(_local under SP), b, hidden] in compute dtype."""
+    return hidden_states_and_aux(cfg, params, tokens)[0]
 
 
 def logits(cfg: GPTConfig, params, tokens):
@@ -499,9 +577,10 @@ def loss(cfg: GPTConfig, params, tokens, targets):
     """Mean next-token cross entropy over the local batch shard.
 
     ``targets [b, s]``; per-token losses via vocab-parallel CE in fp32
-    (Megatron computes CE on fp32 logits).
+    (Megatron computes CE on fp32 logits). With ``num_experts`` the MoE
+    load-balance term is folded in at ``moe_aux_coef``.
     """
-    h = hidden_states(cfg, params, tokens)
+    h, aux = hidden_states_and_aux(cfg, params, tokens)
     if cfg.sequence_parallel:
         h = gather_from_sequence_parallel_region(h, cfg.axis, True)
     else:
@@ -511,7 +590,10 @@ def loss(cfg: GPTConfig, params, tokens, targets):
         # local mean over this rank's chunk; shards are equal-sized so the
         # global mean is the cp-pmean the train step applies
         tgt = _cp_slice(cfg, tgt, 0)
-    return _ce_of_hidden(cfg, params, h, tgt)
+    ce = _ce_of_hidden(cfg, params, h, tgt)
+    if cfg.num_experts:
+        ce = ce + jnp.float32(cfg.moe_aux_coef) * aux
+    return ce
 
 
 # ---------------------------------------------------------------------------
@@ -584,6 +666,12 @@ def _cast_layer(cfg: GPTConfig, layer_p):
     cast = lambda t: jax.tree.map(
         lambda x: x.astype(cfg.compute_dtype)
         if jnp.issubdtype(x.dtype, jnp.floating) else x, t)
+    if cfg.num_experts:
+        # router stays param dtype: moe_ffn computes routing in fp32 and
+        # softmax-over-experts is the numerically fragile spot
+        return {**layer_p, "attn": cast(layer_p["attn"]),
+                "moe": {"router": layer_p["moe"]["router"],
+                        "experts": cast(layer_p["moe"]["experts"])}}
     return {**layer_p, "attn": cast(layer_p["attn"]),
             "mlp": cast(layer_p["mlp"])}
 
@@ -600,6 +688,10 @@ def pipeline_loss(
     (SURVEY.md §3.5's warmup/steady/cooldown collapse into the masked tick
     scan of :func:`apex_tpu.transformer.pipeline_parallel.pipeline_spmd`).
     """
+    if cfg.num_experts:
+        raise ValueError(
+            "num_experts > 0 is not supported with pipeline parallelism "
+            "yet; MoE composes with dp/tp/cp/ep")
     b, s = tokens.shape
     if b % n_micro:
         raise ValueError(f"local batch {b} not divisible by n_micro={n_micro}")
@@ -624,7 +716,8 @@ def pipeline_loss(
             chunks)
 
         def body(carry, layer_p):
-            return _block(cfg, _cast_layer(cfg, layer_p), carry), None
+            # aux dropped: MoE is rejected above, so it is always 0
+            return _block(cfg, _cast_layer(cfg, layer_p), carry)[0], None
 
         if cfg.remat:
             body = tpr.checkpoint(body, policy=_remat_policy(cfg))

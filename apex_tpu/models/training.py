@@ -127,6 +127,25 @@ def make_train_step(
     pipelined = pp > 1
     if n_chunks > 1 and not pipelined:
         raise ValueError("n_chunks > 1 requires a mesh with pp > 1")
+    ep_axis = getattr(cfg, "ep_axis", "ep")
+    ep_size = mesh_shape_of(mesh).get(ep_axis, 1)
+    if ep_size > 1:
+        # ep > 1 shards the batch too (tokens over ("dp", ep)); for a
+        # dense model that is extra data parallelism, for MoE the expert
+        # leaves additionally shard over ep
+        if pipelined:
+            raise ValueError("ep > 1 with pp > 1 is not supported")
+    if cfg.num_experts:
+        # fail at build time, not mid-trace (the model raises too, but
+        # deep inside the first step)
+        if pipelined:
+            raise ValueError(
+                "num_experts > 0 is not supported with pipeline "
+                "parallelism yet; MoE composes with dp/tp/cp/ep")
+        if cfg.sequence_parallel:
+            raise ValueError(
+                "num_experts > 0 does not compose with sequence_parallel; "
+                "shard the batch over ep instead")
     pspecs = gpt.param_specs(cfg, pipeline=pipelined)
     sp_mask = gpt.seq_partial_grad_mask(cfg)
 
@@ -141,6 +160,18 @@ def make_train_step(
     pp_mask = jax.tree.map(
         lambda s: not _mentions(s, AXIS_PP), pspecs,
         is_leaf=lambda x: isinstance(x, P))
+    # ep-sharded leaves (MoE experts): their grads already sum every ep
+    # rank's token contributions through the transposed all_to_all, so
+    # they get / ep_size instead of a pmean (mean-over-global-batch
+    # semantics); everything else is replicated over ep and pmeans
+    ep_mask = jax.tree.map(
+        lambda s: _mentions(s, ep_axis), pspecs,
+        is_leaf=lambda x: isinstance(x, P))
+    if ep_size > 1 and any(jax.tree.leaves(ep_mask)) and getattr(
+            optimizer, "state_pspecs", None) is None:
+        raise ValueError(
+            "MoE over ep > 1 needs a tree-layout optimizer (its state "
+            "mirrors the ep-sharded params); pass layout='tree'")
     scaler_specs = jax.tree.map(lambda _: P(), ScalerState(*[0] * 3))
 
     def sharding(spec):
@@ -211,6 +242,11 @@ def make_train_step(
         if AXIS_DP in axes_present and not isinstance(
                 optimizer, DistributedFusedOptimizer):
             grads = lax.pmean(grads, AXIS_DP)
+        if ep_size > 1:
+            inv = 1.0 / ep_size
+            grads = jax.tree.map(
+                lambda g, m: g * inv if m else lax.pmean(g, ep_axis),
+                grads, ep_mask)
         if cp_active:
             # params are replicated over cp but each rank saw only its
             # sequence chunk — mean of equal-sized chunk losses
@@ -224,6 +260,8 @@ def make_train_step(
         sync_names = [AXIS_DP, AXIS_TP, AXIS_PP]
         if cp_active:
             sync_names.append(cfg.cp_axis)
+        if ep_size > 1:
+            sync_names.append(ep_axis)
         sync_axes = tuple(a for a in sync_names if a in axes_present)
         # every rank must agree on finiteness (skip decision when the
         # scaler is on; replicated metric either way)
@@ -240,6 +278,8 @@ def make_train_step(
         loss_out = value
         if AXIS_DP in axes_present:
             loss_out = lax.pmean(loss_out, AXIS_DP)
+        if ep_size > 1:
+            loss_out = lax.pmean(loss_out, ep_axis)
         if cp_active:
             loss_out = lax.pmean(loss_out, cfg.cp_axis)
         metrics = {
@@ -253,7 +293,10 @@ def make_train_step(
 
     state_specs = TrainState(
         step=P(), params=pspecs, opt_state=opt_specs, scaler=scaler_specs)
-    data_spec = P(AXIS_DP, None) if AXIS_DP in axes_present else P(None, None)
+    batch_axes = tuple(
+        a for a, on in ((AXIS_DP, AXIS_DP in axes_present),
+                        (ep_axis, ep_size > 1)) if on)
+    data_spec = P(batch_axes, None) if batch_axes else P(None, None)
     step_fn = jax.jit(
         jax.shard_map(
             _local_step, mesh=mesh,

@@ -21,6 +21,8 @@ __all__ = [
     "tensor_parallel",
     "pipeline_parallel",
     "functional",
+    "moe",
+    "context_parallel",
     "AttnMaskType",
     "LayerType",
     "ModelType",
@@ -31,7 +33,8 @@ __all__ = [
 
 
 def __getattr__(name):
-    if name in ("pipeline_parallel", "functional", "layers", "testing"):
+    if name in ("pipeline_parallel", "functional", "layers", "testing",
+                "moe", "context_parallel"):
         import importlib
 
         return importlib.import_module(f"apex_tpu.transformer.{name}")

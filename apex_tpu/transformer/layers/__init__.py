@@ -1,0 +1,19 @@
+"""apex.transformer.layers — LN wrapper at its canonical path (U)."""
+
+from apex_tpu.transformer.layers.layer_norm import (  # noqa: F401
+    FastLayerNorm,
+    FusedLayerNorm,
+    FusedRMSNorm,
+    fused_layer_norm,
+    fused_rms_norm,
+    get_layer_norm,
+)
+
+__all__ = [
+    "FastLayerNorm",
+    "FusedLayerNorm",
+    "FusedRMSNorm",
+    "fused_layer_norm",
+    "fused_rms_norm",
+    "get_layer_norm",
+]

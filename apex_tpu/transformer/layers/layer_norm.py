@@ -1,0 +1,45 @@
+"""LN selection wrapper — apex/transformer/layers/layer_norm.py (U).
+
+The reference chooses between ``FastLayerNorm`` (the contrib persistent
+kernel, hidden sizes to 65k) and ``FusedLayerNorm`` (the core extension)
+via ``get_layer_norm(..., persist_layer_norm=...)``. On TPU one Pallas
+kernel covers both regimes (apex_tpu/kernels/layer_norm.py handles any
+hidden size; SURVEY.md §2.4 "merge with core LN kernel on TPU"), so both
+names resolve to it and ``get_layer_norm`` only decides statistics/eps.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from apex_tpu.normalization import (  # noqa: F401
+    FusedLayerNorm,
+    FusedRMSNorm,
+    fused_layer_norm,
+    fused_rms_norm,
+)
+
+#: contrib fast_layer_norm (U) — same kernel here (no 65k-hidden split).
+FastLayerNorm = FusedLayerNorm
+
+
+def get_layer_norm(eps: float = 1e-5, persist_layer_norm: bool = False,
+                   rms: bool = False):
+    """Return ``norm(x, weight=None, bias=None)``.
+
+    ``persist_layer_norm`` is accepted for signature parity and ignored:
+    the kernel choice it toggled in the reference does not exist on TPU.
+    """
+    del persist_layer_norm
+    fn = fused_rms_norm if rms else fused_layer_norm
+    return functools.partial(fn, eps=eps)
+
+
+__all__ = [
+    "FastLayerNorm",
+    "FusedLayerNorm",
+    "FusedRMSNorm",
+    "fused_layer_norm",
+    "fused_rms_norm",
+    "get_layer_norm",
+]

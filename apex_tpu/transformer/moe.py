@@ -1,0 +1,178 @@
+"""Mixture-of-experts layer with expert parallelism over the ``ep`` axis.
+
+No reference analogue: SURVEY.md §2.5 marks EP "absent" in apex — this is
+a beyond-parity component, built because the ``ep`` mesh axis is where a
+TPU framework scales FFN capacity past what TP can hold.
+
+Design (GShard/Switch, the canonical TPU formulation):
+
+- **Router** runs in fp32 (softmax over expert logits is the one place
+  MoE numerics are fragile), top-1 (Switch) or top-2 (GShard) selection
+  with the top-2 gates renormalised to sum to 1.
+- **Dispatch/combine are one-hot einsums**, not gathers: a ``[slots,
+  E, C]`` dispatch tensor contracted on the MXU. Scatter/gather-free —
+  static shapes, no data-dependent control flow, XLA fuses the one-hot
+  construction into the contraction.
+- **Capacity** ``C = ceil(top_k · tokens · capacity_factor / E)`` bounds
+  each expert's buffer; tokens past an expert's capacity are *dropped*
+  (contribute zero for that slot — Switch semantics). Slot-major
+  priority: every token's first choice is placed before any token's
+  second choice.
+- **Expert parallelism**: experts shard over ``ep``; each rank dispatches
+  its local tokens into a ``[E, C, h]`` buffer and one ``all_to_all``
+  (ICI) regroups it to ``[E_local, R·C, h]`` so each rank runs only its
+  own experts' FFNs, batched in a single 3D einsum. A second
+  ``all_to_all`` routes outputs back. With ``R`` ranks the per-rank FLOP
+  and memory cost is 1/R of the dense-MoE layer — the reason ep exists.
+- **Load-balance aux loss** (Switch): ``E · Σ_e f_e · P_e`` with ``f_e``
+  the fraction of assignments routed to expert ``e`` (pre-capacity) and
+  ``P_e`` the mean router probability. Computed over the rank's local
+  tokens; average it over dp/ep with the main loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.mesh.topology import AXIS_EP
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    """Shape/routing config for one MoE FFN layer."""
+
+    num_experts: int
+    hidden_size: int
+    ffn_hidden_size: Optional[int] = None  # default 4 * hidden
+    top_k: int = 2                # 1 = Switch, 2 = GShard
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.01
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    axis: Optional[str] = AXIS_EP  # None → dense (no expert parallelism)
+
+    @property
+    def ffn(self) -> int:
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    def capacity(self, n_tokens: int) -> int:
+        return max(1, math.ceil(
+            self.top_k * n_tokens * self.capacity_factor / self.num_experts))
+
+
+def init_moe(cfg: MoEConfig, key) -> dict:
+    """Global (unsharded) params. Shard the expert-stacked leaves with
+    ``PartitionSpec("ep")`` on dim 0; the router stays replicated."""
+    h, f, e = cfg.hidden_size, cfg.ffn, cfg.num_experts
+    kr, k1, k2 = jax.random.split(key, 3)
+    dt = cfg.param_dtype
+    init = jax.nn.initializers.normal(0.02)
+    return {
+        "router": {"kernel": init(kr, (h, e), dt)},
+        "experts": {
+            "w1": init(k1, (e, h, f), dt),
+            "b1": jnp.zeros((e, f), dt),
+            "w2": init(k2, (e, f, h), dt),
+            "b2": jnp.zeros((e, h), dt),
+        },
+    }
+
+
+def moe_pspecs(P):
+    """PartitionSpecs for :func:`init_moe` params (pass ``PartitionSpec``)."""
+    return {
+        "router": {"kernel": P()},
+        "experts": {"w1": P("ep"), "b1": P("ep"),
+                    "w2": P("ep"), "b2": P("ep")},
+    }
+
+
+def _route(cfg: MoEConfig, router_kernel, x):
+    """fp32 routing. Returns (gates [n,k], expert_idx [n,k], probs [n,E])."""
+    logits = x.astype(jnp.float32) @ router_kernel.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates, idx = lax.top_k(probs, cfg.top_k)
+    if cfg.top_k > 1:
+        gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+    return gates, idx, probs
+
+
+def moe_ffn(cfg: MoEConfig, params: dict, x):
+    """Apply the MoE FFN to local tokens ``x [n, hidden]``.
+
+    Inside ``shard_map`` with ``cfg.axis`` bound, ``params["experts"]``
+    leaves are the rank-local expert shard; with ``cfg.axis=None`` (or the
+    axis absent) the layer is a dense MoE on one device. Returns
+    ``(y [n, hidden], aux_loss scalar)``; callers fold
+    ``cfg.aux_loss_coef * aux_loss`` into the objective.
+
+    Capacity is sized from the *local* token count, so R ranks give each
+    expert ``R·C`` total slots — the same budget as the dense layer on
+    the full batch (drops can differ at the margin: the cap is enforced
+    per source rank).
+    """
+    n, h = x.shape
+    E = cfg.num_experts
+    ranks = 1
+    if cfg.axis is not None:
+        try:
+            ranks = lax.axis_size(cfg.axis)
+        except NameError:  # axis not bound: dense path
+            ranks = 1
+    e_loc = params["experts"]["w1"].shape[0]
+    if e_loc * ranks != E:
+        raise ValueError(
+            f"experts shard {e_loc} x {ranks} ranks != num_experts {E}")
+    C = cfg.capacity(n)
+
+    gates, idx, probs = _route(cfg, params["router"]["kernel"], x)
+
+    # Slot-major assignment order: flatten [n, k] → [k*n] so slot 0 of
+    # every token outranks any slot 1 when competing for capacity.
+    oh = jax.nn.one_hot(idx, E, dtype=jnp.int32)          # [n, k, E]
+    ohf = oh.transpose(1, 0, 2).reshape(cfg.top_k * n, E)  # [k*n, E]
+    pos_in_expert = jnp.cumsum(ohf, axis=0) - ohf          # [k*n, E]
+    pos = jnp.sum(pos_in_expert * ohf, axis=-1)            # [k*n]
+    keep = pos < C  # every slot is routed (top_k indices are in-range)
+
+    cdt = cfg.compute_dtype
+    # dispatch tensor [slots, E, C] — einsum-dispatch, no scatters
+    disp = (ohf.astype(cdt)[:, :, None]
+            * jax.nn.one_hot(pos, C, dtype=cdt)[:, None, :]
+            * keep.astype(cdt)[:, None, None])
+    # collapse slots to token granularity: every (e, c) cell is owned by
+    # at most one (token, slot) assignment, so the slot-sum is exact
+    disp_tok = disp.reshape(cfg.top_k, n, E, C).sum(0)       # [n, E, C]
+    expert_in = jnp.einsum("tec,th->ech", disp_tok, x.astype(cdt))
+
+    if ranks > 1:
+        # [E, C, h] → [E_loc, R*C, h]: rank r keeps experts [r*E_loc, ...)
+        expert_in = lax.all_to_all(
+            expert_in, cfg.axis, split_axis=0, concat_axis=1, tiled=True)
+
+    w = params["experts"]
+    hid = jnp.einsum("ech,ehf->ecf", expert_in, w["w1"].astype(cdt))
+    hid = jax.nn.gelu(hid + w["b1"].astype(cdt)[:, None, :])
+    out = jnp.einsum("ecf,efh->ech", hid, w["w2"].astype(cdt))
+    out = out + w["b2"].astype(cdt)[:, None, :]
+
+    if ranks > 1:
+        out = lax.all_to_all(
+            out, cfg.axis, split_axis=1, concat_axis=0, tiled=True)
+
+    gflat = gates.astype(cdt).T.reshape(cfg.top_k * n)      # slot-major
+    comb_tok = (disp * gflat[:, None, None]).reshape(
+        cfg.top_k, n, E, C).sum(0)                           # [n, E, C]
+    y = jnp.einsum("tec,ech->th", comb_tok, out).astype(x.dtype)
+
+    # Switch load-balance loss over local tokens (pre-capacity fractions).
+    f = jnp.mean(ohf.reshape(cfg.top_k, n, E).astype(jnp.float32), axis=(0, 1))
+    p = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(f * p)
+    return y, aux

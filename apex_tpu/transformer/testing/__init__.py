@@ -1,0 +1,46 @@
+"""apex.transformer.testing (U) — distributed-test support + toy models.
+
+The reference ships ``NcclDistributedTestBase`` (one NCCL process per
+GPU) and standalone toy GPT/BERT models for schedule/parallelism tests.
+Here the process-spawning base collapses into :func:`request_cpu_devices`
+(simulate any mesh on CPU — SURVEY.md §4) and the toy models are tiny
+configs of the real model stack, so tests exercise the production code
+path instead of a parallel implementation.
+"""
+
+from __future__ import annotations
+
+from apex_tpu.testing import assert_devices, request_cpu_devices  # noqa: F401
+
+
+def standalone_gpt_config(**overrides):
+    """Tiny GPTConfig for schedule/parallelism tests — the role of the
+    reference's ``standalone_gpt`` toy model (U)."""
+    import jax.numpy as jnp
+
+    from apex_tpu.models.gpt import GPTConfig
+
+    base = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                seq_len=32, remat=False, compute_dtype=jnp.float32)
+    base.update(overrides)
+    return GPTConfig(**base)
+
+
+def standalone_bert_config(**overrides):
+    """Tiny BertConfig — the reference's ``standalone_bert`` role (U)."""
+    import jax.numpy as jnp
+
+    from apex_tpu.models.bert import BertConfig
+
+    base = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                seq_len=32, compute_dtype=jnp.float32)
+    base.update(overrides)
+    return BertConfig(**base)
+
+
+__all__ = [
+    "assert_devices",
+    "request_cpu_devices",
+    "standalone_gpt_config",
+    "standalone_bert_config",
+]

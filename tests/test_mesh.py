@@ -16,12 +16,12 @@ from apex_tpu import mesh as mx
 
 def test_build_mesh_infers_dp(devices8):
     m = mx.build_mesh(tp=2, pp=2, devices=devices8)
-    assert mx.mesh_shape_of(m) == {"pp": 2, "dp": 2, "cp": 1, "tp": 2}
+    assert mx.mesh_shape_of(m) == {"pp": 2, "dp": 2, "ep": 1, "cp": 1, "tp": 2}
 
 
 def test_build_mesh_cp_axis(devices8):
     m = mx.build_mesh(tp=2, cp=2, devices=devices8)
-    assert mx.mesh_shape_of(m) == {"pp": 1, "dp": 2, "cp": 2, "tp": 2}
+    assert mx.mesh_shape_of(m) == {"pp": 1, "dp": 2, "ep": 1, "cp": 2, "tp": 2}
 
 
 def test_build_mesh_rejects_bad_factorization(devices8):
@@ -35,8 +35,8 @@ def test_tp_innermost_axis_is_adjacent(devices8):
     # tp must vary fastest so TP collectives ride adjacent (ICI) links.
     m = mx.build_mesh(tp=4, pp=1, devices=devices8)
     ids = np.vectorize(lambda d: d.id)(m.devices)
-    assert ids.shape == (1, 2, 1, 4)
-    assert list(ids[0, 0, 0, :]) == [0, 1, 2, 3]
+    assert ids.shape == (1, 2, 1, 1, 4)
+    assert list(ids[0, 0, 0, 0, :]) == [0, 1, 2, 3]
 
 
 def test_psum_and_axis_queries(devices8):

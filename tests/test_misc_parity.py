@@ -186,3 +186,41 @@ def test_capabilities_repeated_access():
     assert callable(first) and callable(second)
     assert apex_tpu.capabilities()["amp"] is True
     assert apex_tpu.capabilities()["amp"] is True  # second call, same result
+
+
+def test_transformer_layers_ln_wrapper():
+    """apex/transformer/layers/layer_norm.py (U): get_layer_norm returns a
+    working norm; FastLayerNorm and FusedLayerNorm are the same kernel on
+    TPU (SURVEY.md 2.4 'merge with core LN kernel')."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.transformer import layers
+
+    assert layers.FastLayerNorm is layers.FusedLayerNorm
+    x = jnp.arange(24, dtype=jnp.float32).reshape(2, 12)
+    y = layers.get_layer_norm(eps=1e-6, persist_layer_norm=True)(x)
+    ref = (x - x.mean(-1, keepdims=True)) / jnp.sqrt(
+        x.var(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    r = layers.get_layer_norm(rms=True)(x)
+    rref = x / jnp.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(np.asarray(r), np.asarray(rref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_transformer_testing_helpers():
+    """apex/transformer/testing (U) role: toy configs drive the real model
+    stack; device helpers centralise the CPU-simulation backbone."""
+    import jax
+
+    from apex_tpu.models import gpt
+    from apex_tpu.transformer import testing as ttesting
+
+    cfg = ttesting.standalone_gpt_config(num_layers=1)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    assert params is not None
+    bcfg = ttesting.standalone_bert_config()
+    assert bcfg.hidden_size == 64
+    assert len(ttesting.assert_devices(8)) == 8

@@ -106,6 +106,14 @@ CHECKS = {
     "apex_tpu.mesh": ["build_mesh"],
     "apex_tpu.transformer.context_parallel": [
         "ring_attention", "ulysses_attention"],
+    "apex_tpu.transformer.moe": [
+        "MoEConfig", "init_moe", "moe_ffn", "moe_pspecs"],
+    # §2.2 misc transformer: LN wrapper + testing helpers at canonical paths
+    "apex_tpu.transformer.layers": [
+        "FastLayerNorm", "FusedLayerNorm", "get_layer_norm"],
+    "apex_tpu.transformer.testing": [
+        "request_cpu_devices", "assert_devices",
+        "standalone_gpt_config", "standalone_bert_config"],
 }
 
 
