@@ -138,6 +138,7 @@ def make_train_step(
     if cfg.num_experts:
         # fail at build time, not mid-trace (the model raises too, but
         # deep inside the first step)
+        gpt._moe_cfg(cfg)  # validates top_k vs num_experts
         if pipelined:
             raise ValueError(
                 "num_experts > 0 is not supported with pipeline "

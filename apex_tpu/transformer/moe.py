@@ -57,6 +57,12 @@ class MoEConfig:
     compute_dtype: Any = jnp.bfloat16
     axis: Optional[str] = AXIS_EP  # None → dense (no expert parallelism)
 
+    def __post_init__(self):
+        if not 1 <= self.top_k <= self.num_experts:
+            raise ValueError(
+                f"top_k={self.top_k} must be in [1, num_experts="
+                f"{self.num_experts}]")
+
     @property
     def ffn(self) -> int:
         return self.ffn_hidden_size or 4 * self.hidden_size

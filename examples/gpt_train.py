@@ -14,6 +14,9 @@ Run small (CPU simulation):
   PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python examples/gpt_train.py --preset tiny --tp 2 --pp 2 --n-micro 2
+
+MoE (no apex analogue): --experts 8 --ep 2 shards 8 experts over an
+ep=2 mesh axis (Switch/GShard routing, aux loss folded into the loss).
 """
 
 import argparse
@@ -49,6 +52,13 @@ def main():
     ap.add_argument("--cp", type=int, default=1,
                     help="context parallelism: ring attention over cp "
                     "seq shards (long-context mode)")
+    ap.add_argument("--experts", type=int, default=0,
+                    help="mixture of experts: replace every MLP with this "
+                    "many experts (0 = dense)")
+    ap.add_argument("--ep", type=int, default=1,
+                    help="expert parallelism: shard experts over an "
+                    "ep mesh axis (needs --experts divisible by ep; "
+                    "requires --opt-layout tree)")
     ap.add_argument("--n-micro", type=int, default=1)
     ap.add_argument("--vpp", type=int, default=1)
     ap.add_argument("--batch", type=int, default=8)
@@ -93,12 +103,14 @@ def main():
                 "attention path (and no --cp); drop --attn-impl "
                 f"{args.attn_impl} or pick a non-_attn policy")
     cfg = gpt.GPTConfig(
-        sequence_parallel=(args.tp > 1 and args.cp == 1 and not args.no_sp),
+        sequence_parallel=(args.tp > 1 and args.cp == 1 and not args.no_sp
+                           and args.experts == 0),
         context_parallel=(args.cp > 1),
         remat=True, compute_dtype=jnp.bfloat16,
         remat_policy=args.remat_policy, ln_impl=args.ln_impl,
-        attn_impl=attn_impl, ce_chunk=ce_chunk, **PRESETS[args.preset])
-    mesh = mx.build_mesh(tp=args.tp, pp=args.pp, cp=args.cp)
+        attn_impl=attn_impl, ce_chunk=ce_chunk,
+        num_experts=args.experts, **PRESETS[args.preset])
+    mesh = mx.build_mesh(tp=args.tp, pp=args.pp, cp=args.cp, ep=args.ep)
     init_fn, step_fn = training.make_train_step(
         cfg, mesh, fused_adam(args.lr, layout=args.opt_layout),
         ScalerConfig(enabled=False),

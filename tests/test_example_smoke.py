@@ -97,3 +97,21 @@ def test_simple_distributed_example_smoke(tmp_path):
     losses = [float(l.split("loss ")[1].split(" ")[0]) for l in steps]
     assert len(losses) == 3 and losses[-1] < losses[0]
     assert all("scale 65536" in l for l in steps)  # fp16 dynamic scaler on
+
+
+def test_gpt_train_moe_example_smoke(tmp_path):
+    """--experts/--ep flag plumbing: MoE-GPT over ep=2 x tp=2 trains with
+    a falling loss through the flagship example."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep + env.get("PYTHONPATH", ""))
+    cmd = [sys.executable, os.path.join(repo, "examples", "gpt_train.py"),
+           "--preset", "tiny", "--experts", "4", "--ep", "2", "--tp", "2",
+           "--steps", "2", "--batch", "8"]
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    losses = [float(l.rsplit(" ", 1)[1])
+              for l in r.stdout.splitlines() if l.startswith("step ")]
+    assert len(losses) == 2 and losses[1] < losses[0]

@@ -14,14 +14,13 @@ from apex_tpu import mesh as mx
 from apex_tpu.amp import ScalerConfig
 from apex_tpu.models import gpt, training
 from apex_tpu.optimizers import fused_adam, fused_sgd
+from apex_tpu.transformer.testing import standalone_gpt_config
 
 
 def _cfg(**kw):
-    base = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
-                seq_len=32, remat=False, compute_dtype=jnp.float32,
-                num_experts=4, moe_top_k=2, moe_capacity_factor=4.0)
+    base = dict(num_experts=4, moe_top_k=2, moe_capacity_factor=4.0)
     base.update(kw)
-    return gpt.GPTConfig(**base)
+    return standalone_gpt_config(**base)
 
 
 def _data(batch=16, seq=32):
