@@ -136,8 +136,9 @@ class GPTConfig:
     #: ``transformer.moe`` FFN of this many experts, sharded over the
     #: ``ep`` mesh axis (``ep=1`` runs them locally). The CE objective
     #: gains ``moe_aux_coef ×`` the summed per-layer load-balance loss.
-    #: Composes with dp/tp/cp; sequence_parallel and pipeline parallelism
-    #: are not supported with MoE.
+    #: Composes with dp/tp/cp and pp (aux rides the pipeline tick scan;
+    #: ep > 1 with pp > 1 is rejected); sequence_parallel is not
+    #: supported with MoE.
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
@@ -688,10 +689,6 @@ def pipeline_loss(
     (SURVEY.md §3.5's warmup/steady/cooldown collapse into the masked tick
     scan of :func:`apex_tpu.transformer.pipeline_parallel.pipeline_spmd`).
     """
-    if cfg.num_experts:
-        raise ValueError(
-            "num_experts > 0 is not supported with pipeline parallelism "
-            "yet; MoE composes with dp/tp/cp/ep")
     b, s = tokens.shape
     if b % n_micro:
         raise ValueError(f"local batch {b} not divisible by n_micro={n_micro}")
@@ -716,13 +713,15 @@ def pipeline_loss(
             chunks)
 
         def body(carry, layer_p):
-            # aux dropped: MoE is rejected above, so it is always 0
-            return _block(cfg, _cast_layer(cfg, layer_p), carry)[0], None
+            h, aux = carry
+            h, a = _block(cfg, _cast_layer(cfg, layer_p), h)
+            return (h, aux + a), None
 
         if cfg.remat:
             body = tpr.checkpoint(body, policy=_remat_policy(cfg))
-        y, _ = lax.scan(body, x, cp, unroll=cfg.scan_unroll)
-        return y
+        (y, aux), _ = lax.scan(
+            body, (x, jnp.float32(0.0)), cp, unroll=cfg.scan_unroll)
+        return (y, aux) if cfg.num_experts else y
 
     seq_local = s
     if cfg.sequence_parallel:
@@ -747,6 +746,13 @@ def pipeline_loss(
             tgt = _cp_slice(cfg, tgt, 0)
         return _ce_of_hidden(cfg, params, h, tgt)
 
+    if cfg.num_experts:
+        ce, aux = pipelined_loss(
+            chunk_fn, inject, loss_of_outputs, n_micro, item,
+            n_chunks=n_chunks, axis=pp_axis, with_aux=True)
+        # aux is summed over (stage, chunk, microbatch); CE is a mean
+        # over microbatches — match by averaging the aux sum
+        return ce + jnp.float32(cfg.moe_aux_coef) * aux / n_micro
     return pipelined_loss(
         chunk_fn, inject, loss_of_outputs, n_micro, item,
         n_chunks=n_chunks, axis=pp_axis)

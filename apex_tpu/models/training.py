@@ -139,10 +139,6 @@ def make_train_step(
         # fail at build time, not mid-trace (the model raises too, but
         # deep inside the first step)
         gpt._moe_cfg(cfg)  # validates top_k vs num_experts
-        if pipelined:
-            raise ValueError(
-                "num_experts > 0 is not supported with pipeline "
-                "parallelism yet; MoE composes with dp/tp/cp/ep")
         if cfg.sequence_parallel:
             raise ValueError(
                 "num_experts > 0 does not compose with sequence_parallel; "

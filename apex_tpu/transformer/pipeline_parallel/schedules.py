@@ -53,13 +53,16 @@ def pipeline_spmd(
     *,
     n_chunks: int = 1,
     axis: str = AXIS_PP,
+    with_aux: bool = False,
 ):
     """Run the circular SPMD pipeline; returns stacked outputs.
 
     Args:
       chunk_fn: ``(c, x) -> y`` — apply this stage's chunk ``c`` (traced
         int32) to activation ``x``; shapes of x and y must match ``item``.
-        Wrap in ``jax.checkpoint`` for activation recompute.
+        Wrap in ``jax.checkpoint`` for activation recompute. With
+        ``with_aux`` it returns ``(y, aux)`` — a scalar per tick (e.g. a
+        MoE load-balance term) summed over *valid* ticks only.
       inject_fn: ``(m) -> x`` — produce microbatch ``m``'s entry activation
         (e.g. the embedding); evaluated on every stage, selected on stage 0.
       n_micro: number of microbatches (static).
@@ -67,7 +70,9 @@ def pipeline_spmd(
       n_chunks: virtual pipeline stages per rank (apex vpp).
 
     Returns ``[n_micro, *item.shape]``: final-chunk outputs, populated on
-    the **last stage** and zeros elsewhere (mask or psum as needed).
+    the **last stage** and zeros elsewhere (mask or psum as needed). With
+    ``with_aux``: ``(outputs, aux_sum)`` — aux_sum is this *stage's* total
+    (psum over the pp axis for the global sum).
     """
     S = lax.axis_size(axis)
     V = n_chunks
@@ -80,7 +85,7 @@ def pipeline_spmd(
     outputs0 = jnp.zeros((n_micro,) + tuple(item.shape), item.dtype)
 
     def tick(carry, t):
-        recv, outputs = carry
+        recv, outputs, aux_acc = carry
         k = t - s_idx
         g = k // period
         r = k % period  # lax.rem semantics fine: k>=0 whenever valid
@@ -92,7 +97,13 @@ def pipeline_spmd(
         x_in = inject_fn(m_c)
         enter = valid & (c == 0) & (s_idx == 0)
         x = jnp.where(enter, x_in.astype(item.dtype), recv)
-        y = chunk_fn(c, x)
+        out = chunk_fn(c, x)
+        if with_aux:
+            y, aux = out
+            # garbage ticks (pipeline bubble) must not contribute
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+        else:
+            y = out
 
         write = valid & (c == V - 1) & (s_idx == S - 1)
         cur = lax.dynamic_index_in_dim(outputs, m_c, 0, keepdims=False)
@@ -101,10 +112,13 @@ def pipeline_spmd(
 
         # ring rotation: stage s → s+1; last → 0 advances the chunk index
         recv = ppermute_shift(y, axis, 1, wrap=True)
-        return (recv, outputs), None
+        return (recv, outputs, aux_acc), None
 
-    (_, outputs), _ = lax.scan(
-        tick, (zero_item, outputs0), jnp.arange(T, dtype=jnp.int32))
+    (_, outputs, aux_sum), _ = lax.scan(
+        tick, (zero_item, outputs0, jnp.float32(0.0)),
+        jnp.arange(T, dtype=jnp.int32))
+    if with_aux:
+        return outputs, aux_sum
     return outputs
 
 
@@ -117,22 +131,30 @@ def pipelined_loss(
     *,
     n_chunks: int = 1,
     axis: str = AXIS_PP,
+    with_aux: bool = False,
 ):
     """Pipeline forward + masked last-stage loss, psum-replicated over pp.
 
     ``loss_of_outputs(outputs) -> scalar`` runs on the stacked final
     activations (garbage-free: zeros on non-last stages). Differentiate the
-    result for the full backward pipeline.
+    result for the full backward pipeline. With ``with_aux`` (chunk_fn
+    returns ``(y, aux)``) the result is ``(loss, aux_total)`` — aux_total
+    summed over every stage's layers (psum-fwd/id-bwd over pp).
     """
-    outs = pipeline_spmd(
-        chunk_fn, inject_fn, n_micro, item, n_chunks=n_chunks, axis=axis)
+    res = pipeline_spmd(
+        chunk_fn, inject_fn, n_micro, item, n_chunks=n_chunks, axis=axis,
+        with_aux=with_aux)
+    outs, aux = res if with_aux else (res, None)
     is_last = (lax.axis_index(axis) == lax.axis_size(axis) - 1).astype(
         jnp.float32)
     # psum-fwd / identity-bwd (the "reduce" mapping, here on the pp axis):
     # a raw lax.psum would transpose into another psum, multiplying every
     # cotangent by the stage count when grad is seeded on all ranks.
-    return reduce_from_tensor_model_parallel_region(
+    loss = reduce_from_tensor_model_parallel_region(
         loss_of_outputs(outs) * is_last, axis)
+    if with_aux:
+        return loss, reduce_from_tensor_model_parallel_region(aux, axis)
+    return loss
 
 
 def forward_backward_no_pipelining(
