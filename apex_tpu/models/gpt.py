@@ -136,9 +136,9 @@ class GPTConfig:
     #: ``transformer.moe`` FFN of this many experts, sharded over the
     #: ``ep`` mesh axis (``ep=1`` runs them locally). The CE objective
     #: gains ``moe_aux_coef ×`` the summed per-layer load-balance loss.
-    #: Composes with dp/tp/cp and pp (aux rides the pipeline tick scan;
-    #: ep > 1 with pp > 1 is rejected); sequence_parallel is not
-    #: supported with MoE.
+    #: Composes with dp/tp/cp/pp/ep in any combination (the aux loss
+    #: rides the pipeline tick scan; the expert all_to_all runs inside
+    #: each tick); sequence_parallel is not supported with MoE.
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
@@ -472,11 +472,10 @@ def _embed(cfg: GPTConfig, params, tokens):
     return h
 
 
-def hidden_states_and_aux(cfg: GPTConfig, params, tokens):
-    """tokens [b, s] (global ids, dp-local batch) → (final-LN hidden
-    [s(_local under SP), b, hidden] in compute dtype, summed MoE aux
-    loss — 0 for dense models)."""
-    h = _embed(cfg, params, tokens)
+def _scan_blocks(cfg: GPTConfig, h, layers):
+    """Scan ``h`` through stacked layer params; returns ``(h, aux_sum)``
+    (the remat policy and aux accumulation shared by the flat and
+    pipelined forward paths)."""
 
     def body(carry, layer_p):
         h, aux = carry
@@ -486,8 +485,16 @@ def hidden_states_and_aux(cfg: GPTConfig, params, tokens):
     if cfg.remat:
         body = tpr.checkpoint(body, policy=_remat_policy(cfg))
     (h, aux), _ = lax.scan(
-        body, (h, jnp.float32(0.0)), params["layers"],
-        unroll=cfg.scan_unroll)
+        body, (h, jnp.float32(0.0)), layers, unroll=cfg.scan_unroll)
+    return h, aux
+
+
+def hidden_states_and_aux(cfg: GPTConfig, params, tokens):
+    """tokens [b, s] (global ids, dp-local batch) → (final-LN hidden
+    [s(_local under SP), b, hidden] in compute dtype, summed MoE aux
+    loss — 0 for dense models)."""
+    h, aux = _scan_blocks(cfg, _embed(cfg, params, tokens),
+                          params["layers"])
     # final LN runs inside the SP region (Megatron: its grads are
     # tp-partial — see seq_partial_grad_mask)
     return _layer_norm(cfg, h, params["final_ln"]["scale"],
@@ -711,16 +718,7 @@ def pipeline_loss(
         cp = jax.tree.map(
             lambda t: lax.dynamic_index_in_dim(t, c, 0, keepdims=False),
             chunks)
-
-        def body(carry, layer_p):
-            h, aux = carry
-            h, a = _block(cfg, _cast_layer(cfg, layer_p), h)
-            return (h, aux + a), None
-
-        if cfg.remat:
-            body = tpr.checkpoint(body, policy=_remat_policy(cfg))
-        (y, aux), _ = lax.scan(
-            body, (x, jnp.float32(0.0)), cp, unroll=cfg.scan_unroll)
+        y, aux = _scan_blocks(cfg, x, cp)
         return (y, aux) if cfg.num_experts else y
 
     seq_local = s

@@ -128,13 +128,11 @@ def make_train_step(
     if n_chunks > 1 and not pipelined:
         raise ValueError("n_chunks > 1 requires a mesh with pp > 1")
     ep_axis = getattr(cfg, "ep_axis", "ep")
+    # ep > 1 shards the batch too (tokens over ("dp", ep)); for a dense
+    # model that is extra data parallelism, for MoE the expert leaves
+    # additionally shard over ep (composes with pp: the ep all_to_all
+    # runs inside each pipeline tick, orthogonal to the stage ring)
     ep_size = mesh_shape_of(mesh).get(ep_axis, 1)
-    if ep_size > 1:
-        # ep > 1 shards the batch too (tokens over ("dp", ep)); for a
-        # dense model that is extra data parallelism, for MoE the expert
-        # leaves additionally shard over ep
-        if pipelined:
-            raise ValueError("ep > 1 with pp > 1 is not supported")
     if cfg.num_experts:
         # fail at build time, not mid-trace (the model raises too, but
         # deep inside the first step)

@@ -211,23 +211,35 @@ def forward_backward_single_stage(
     *,
     n_chunks: int = 1,
     axis: str = AXIS_PP,
+    with_aux: bool = False,
 ):
     """pp=1 schedule with the pipelined signature: microbatches run
     sequentially through all chunks on the one stage (the selector's
     no-pipelining branch; for explicit grad accumulation over a loss_fn
-    use :func:`forward_backward_no_pipelining`)."""
+    use :func:`forward_backward_no_pipelining`). ``with_aux`` matches
+    :func:`pipelined_loss`: chunk_fn returns ``(y, aux)`` and the result
+    is ``(loss, aux_sum)``."""
     del axis
 
-    def body(_, m):
+    def body(aux_acc, m):
         # same stage-entry cast the pipelined path applies (schedules.py
         # pipeline_spmd) so pp=1 and pp>1 run identical numerics
         x = inject_fn(m).astype(item.dtype)
         for c in range(n_chunks):
-            x = chunk_fn(c, x)
-        return None, x
+            out = chunk_fn(c, x)
+            if with_aux:
+                x, aux = out
+                aux_acc = aux_acc + aux
+            else:
+                x = out
+        return aux_acc, x
 
-    _, outs = lax.scan(body, None, jnp.arange(n_micro, dtype=jnp.int32))
-    return loss_of_outputs(outs.astype(item.dtype))
+    aux_sum, outs = lax.scan(
+        body, jnp.float32(0.0), jnp.arange(n_micro, dtype=jnp.int32))
+    loss = loss_of_outputs(outs.astype(item.dtype))
+    if with_aux:
+        return loss, aux_sum
+    return loss
 
 
 def get_forward_backward_func(
