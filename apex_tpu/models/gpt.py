@@ -143,6 +143,8 @@ class GPTConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
+    #: "auto" | "einsum" | "gather" — see MoEConfig.dispatch
+    moe_dispatch: str = "auto"
     ep_axis: str = AXIS_EP
     compute_dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -416,7 +418,8 @@ def _moe_cfg(cfg: GPTConfig) -> moe_mod.MoEConfig:
         ffn_hidden_size=cfg.ffn, top_k=cfg.moe_top_k,
         capacity_factor=cfg.moe_capacity_factor,
         aux_loss_coef=cfg.moe_aux_coef, param_dtype=cfg.param_dtype,
-        compute_dtype=cfg.compute_dtype, axis=cfg.ep_axis)
+        compute_dtype=cfg.compute_dtype, axis=cfg.ep_axis,
+        dispatch=cfg.moe_dispatch)
 
 
 def _block(cfg: GPTConfig, p, h):

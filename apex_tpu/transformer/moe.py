@@ -56,12 +56,24 @@ class MoEConfig:
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     axis: Optional[str] = AXIS_EP  # None → dense (no expert parallelism)
+    #: "einsum" → GShard one-hot contractions (MXU, O(tokens·E·C·h) —
+    #: quadratic in tokens since C ∝ tokens/E; fine small, dominates the
+    #: experts' own FLOPs at scale); "gather" → scatter-add/take into the
+    #: expert buffers, O(tokens·k·h) (the production-TPU-MoE layout);
+    #: "auto" → gather once the dispatch contraction would out-FLOP the
+    #: expert FFNs. Numerics identical (each buffer cell is written by at
+    #: most one assignment either way).
+    dispatch: str = "auto"
 
     def __post_init__(self):
         if not 1 <= self.top_k <= self.num_experts:
             raise ValueError(
                 f"top_k={self.top_k} must be in [1, num_experts="
                 f"{self.num_experts}]")
+        if self.dispatch not in ("auto", "einsum", "gather"):
+            raise ValueError(
+                f"dispatch={self.dispatch!r} must be 'auto', 'einsum' "
+                "or 'gather'")
 
     @property
     def ffn(self) -> int:
@@ -148,14 +160,35 @@ def moe_ffn(cfg: MoEConfig, params: dict, x):
     keep = pos < C  # every slot is routed (top_k indices are in-range)
 
     cdt = cfg.compute_dtype
-    # dispatch tensor [slots, E, C] — einsum-dispatch, no scatters
-    disp = (ohf.astype(cdt)[:, :, None]
-            * jax.nn.one_hot(pos, C, dtype=cdt)[:, None, :]
-            * keep.astype(cdt)[:, None, None])
-    # collapse slots to token granularity: every (e, c) cell is owned by
-    # at most one (token, slot) assignment, so the slot-sum is exact
-    disp_tok = disp.reshape(cfg.top_k, n, E, C).sum(0)       # [n, E, C]
-    expert_in = jnp.einsum("tec,th->ech", disp_tok, x.astype(cdt))
+    impl = cfg.dispatch
+    if impl == "auto":
+        # dispatch contraction FLOPs 2·k·n·E·C·h vs expert FFN FLOPs
+        # ~4·k·n·h·f: prefer the MXU einsum until it costs more than the
+        # experts themselves
+        impl = "einsum" if E * C <= 2 * cfg.ffn else "gather"
+    gflat = gates.astype(cdt).T.reshape(cfg.top_k * n)      # slot-major
+
+    if impl == "einsum":
+        # dispatch tensor [slots, E, C] — one-hot contractions, no scatters
+        disp = (ohf.astype(cdt)[:, :, None]
+                * jax.nn.one_hot(pos, C, dtype=cdt)[:, None, :]
+                * keep.astype(cdt)[:, None, None])
+        # collapse slots to token granularity: every (e, c) cell is owned
+        # by at most one (token, slot) assignment, so the slot-sum is exact
+        disp_tok = disp.reshape(cfg.top_k, n, E, C).sum(0)   # [n, E, C]
+        expert_in = jnp.einsum("tec,th->ech", disp_tok, x.astype(cdt))
+    elif impl == "gather":
+        # scatter-add into the flat [E*C, h] buffer; dropped slots route
+        # out of bounds and mode="drop" discards them. Each cell receives
+        # at most one slot, so this is a permutation, not a reduction.
+        e_of_slot = idx.T.reshape(cfg.top_k * n)             # [S]
+        slot_cell = jnp.where(keep, e_of_slot * C + pos, E * C)
+        xs = jnp.broadcast_to(x.astype(cdt), (cfg.top_k, n, h)).reshape(
+            cfg.top_k * n, h)
+        expert_in = jnp.zeros((E * C, h), cdt).at[slot_cell].add(
+            xs, mode="drop").reshape(E, C, h)
+    else:
+        raise ValueError(f"unknown dispatch {cfg.dispatch!r}")
 
     if ranks > 1:
         # [E, C, h] → [E_loc, R*C, h]: rank r keeps experts [r*E_loc, ...)
@@ -172,10 +205,15 @@ def moe_ffn(cfg: MoEConfig, params: dict, x):
         out = lax.all_to_all(
             out, cfg.axis, split_axis=1, concat_axis=0, tiled=True)
 
-    gflat = gates.astype(cdt).T.reshape(cfg.top_k * n)      # slot-major
-    comb_tok = (disp * gflat[:, None, None]).reshape(
-        cfg.top_k, n, E, C).sum(0)                           # [n, E, C]
-    y = jnp.einsum("tec,ech->th", comb_tok, out).astype(x.dtype)
+    if impl == "einsum":
+        comb_tok = (disp * gflat[:, None, None]).reshape(
+            cfg.top_k, n, E, C).sum(0)                       # [n, E, C]
+        y = jnp.einsum("tec,ech->th", comb_tok, out).astype(x.dtype)
+    else:
+        picked = out.reshape(E * C, h).at[slot_cell].get(
+            mode="fill", fill_value=0)                       # [S, h]
+        y = (picked * (gflat * keep.astype(cdt))[:, None]).reshape(
+            cfg.top_k, n, h).sum(0).astype(x.dtype)
 
     # Switch load-balance loss over local tokens (pre-capacity fractions).
     f = jnp.mean(ohf.reshape(cfg.top_k, n, E).astype(jnp.float32), axis=(0, 1))

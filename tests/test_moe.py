@@ -118,3 +118,44 @@ def test_moe_shard_mismatch_raises(devices8):
             lambda p, xs: moe.moe_ffn(cfg, p, xs)[0], mesh=mesh,
             in_specs=(moe.moe_pspecs(P), P("ep")), out_specs=P("ep"),
             check_vma=False))(params, x)
+
+
+def test_moe_gather_dispatch_matches_einsum(devices8):
+    """The linear gather/scatter dispatch and the GShard one-hot einsum
+    dispatch are the same permutation — outputs and grads must match."""
+    pe = _cfg(axis=None, dispatch="einsum", capacity_factor=1.0)
+    pg = _cfg(axis=None, dispatch="gather", capacity_factor=1.0)
+    params = moe.init_moe(pe, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (96, pe.hidden_size))
+
+    def loss(cfg_, p):
+        y, aux = moe.moe_ffn(cfg_, p, x)
+        return jnp.sum(y ** 2) + aux, y
+
+    (le, ye), ge = jax.value_and_grad(
+        lambda p: loss(pe, p), has_aux=True)(params)
+    (lg, yg), gg = jax.value_and_grad(
+        lambda p: loss(pg, p), has_aux=True)(params)
+    np.testing.assert_allclose(np.asarray(ye), np.asarray(yg),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(le), float(lg), rtol=1e-6)
+    for (path, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(ge),
+            jax.tree_util.tree_leaves_with_path(gg)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6, err_msg=str(path))
+
+
+def test_moe_gather_dispatch_ep_matches_dense(devices8):
+    """EP all_to_all on top of the gather dispatch (the at-scale path)."""
+    cfg = _cfg(axis="ep", dispatch="gather")
+    params = moe.init_moe(cfg, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (128, cfg.hidden_size))
+    y_dense, _ = moe.moe_ffn(_cfg(axis=None, dispatch="gather"), params, x)
+    mesh = mx.build_mesh(ep=8, devices=devices8)
+    y_ep = jax.jit(jax.shard_map(
+        lambda p, xs: moe.moe_ffn(cfg, p, xs)[0], mesh=mesh,
+        in_specs=(moe.moe_pspecs(P), P("ep")),
+        out_specs=P("ep"), check_vma=False))(params, x)
+    np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_dense),
+                               rtol=1e-5, atol=1e-5)
