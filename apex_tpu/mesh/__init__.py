@@ -15,6 +15,7 @@ from apex_tpu.mesh.topology import (
     AXIS_PP,
     AXIS_TP,
     MeshConfig,
+    build_hybrid_mesh,
     build_mesh,
     mesh_shape_of,
 )
@@ -38,6 +39,7 @@ __all__ = [
     "AXIS_PP",
     "AXIS_TP",
     "MeshConfig",
+    "build_hybrid_mesh",
     "build_mesh",
     "mesh_shape_of",
     "all_gather",

@@ -127,3 +127,98 @@ def build_mesh(
 def mesh_shape_of(mesh: Mesh) -> dict:
     """Axis-name → size mapping of a mesh."""
     return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def build_hybrid_mesh(
+    tp: int = 1,
+    pp: int = 1,
+    dp: Optional[int] = None,
+    cp: int = 1,
+    ep: int = 1,
+    *,
+    dcn_dp: int = 1,
+    dcn_pp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+    num_slices: Optional[int] = None,
+    axis_order: Sequence[str] = DEFAULT_AXIS_ORDER,
+) -> Mesh:
+    """Multi-slice mesh: {dp, pp} may factor across DCN, {tp, cp, ep}
+    stay inside a slice on ICI.
+
+    The SURVEY.md §5 "communication backend" design point: apex pins NCCL
+    process groups per parallel dim; here the *placement* encodes the
+    interconnect. An axis's index is ``dcn_part * ici_size + ici_part``,
+    so any contiguous ici-sized block of ``dp`` (or ``pp``) ranks lives on
+    one slice — gradient psum does a fast ICI stage then one DCN hop, and
+    tp/cp/ep collectives never leave the slice.
+
+    ``tp/pp/dp/cp/ep`` are the *per-slice* (ICI) factors — ``dp=None``
+    infers from the per-slice device count; ``dcn_dp``/``dcn_pp``
+    multiply them across slices (their product must equal the slice
+    count). In production (``num_slices=None``) placement delegates to
+    ``jax.experimental.mesh_utils.create_hybrid_device_mesh`` — it
+    groups by ``device.slice_index`` and does topology-aware placement
+    *within* each slice (a naive reshape cannot guarantee the innermost
+    axes land on physically adjacent chips). ``num_slices`` switches to
+    an explicit contiguous split, for emulating a multi-slice layout on
+    the CPU platform where all virtual devices share one process.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    s_count = dcn_dp * dcn_pp
+    if n % s_count:
+        raise ValueError(
+            f"{n} devices do not split into dcn_dp*dcn_pp = {s_count} "
+            "slices")
+    per_slice = n // s_count
+
+    cfg = MeshConfig(
+        tp=tp, pp=pp, cp=cp, ep=ep, dp=dp, axis_order=tuple(axis_order))
+    try:
+        dp_ici = cfg.resolve_dp(per_slice)
+    except ValueError as e:
+        raise ValueError(
+            f"per-slice factorisation failed ({per_slice} devices per "
+            f"slice after the dcn split of {n}): {e}") from e
+    ici = {AXIS_DP: dp_ici, AXIS_PP: pp, AXIS_TP: tp, AXIS_CP: cp,
+           AXIS_EP: ep}
+    dcn = {AXIS_DP: dcn_dp, AXIS_PP: dcn_pp, AXIS_TP: 1, AXIS_CP: 1,
+           AXIS_EP: 1}
+    unknown = set(cfg.axis_order) - set(ici)
+    if unknown:
+        raise ValueError(f"unknown axis names in axis_order: {sorted(unknown)}")
+    ici_shape = tuple(ici[a] for a in cfg.axis_order)
+    dcn_shape = tuple(dcn[a] for a in cfg.axis_order)
+
+    if num_slices is None:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=np.asarray(devices))
+        return Mesh(arr, tuple(cfg.axis_order))
+
+    # Emulation path: contiguous split into num_slices groups (the CPU
+    # platform has no slice_index and one process — mesh_utils cannot
+    # discover granules there).
+    if n % num_slices:
+        raise ValueError(
+            f"{n} devices do not split into {num_slices} slices")
+    if num_slices != s_count:
+        raise ValueError(
+            f"dcn_dp*dcn_pp = {s_count} != slice count {num_slices}")
+    slices = [devices[i * per_slice:(i + 1) * per_slice]
+              for i in range(num_slices)]
+    total = tuple(i * d for i, d in zip(ici_shape, dcn_shape))
+    arr = np.empty(total, dtype=object)
+    for s_idx, sdevs in enumerate(slices):
+        # slice s sits at dcn coordinates (pp-major over the dcn factors)
+        pp_d, dp_d = divmod(s_idx, dcn_dp)
+        block = np.asarray(sdevs).reshape(ici_shape)
+        sel = tuple(
+            slice(({AXIS_PP: pp_d, AXIS_DP: dp_d}.get(a, 0)) * ici[a],
+                  ({AXIS_PP: pp_d, AXIS_DP: dp_d}.get(a, 0) + 1) * ici[a])
+            for a in cfg.axis_order)
+        arr[sel] = block
+    return Mesh(arr, tuple(cfg.axis_order))

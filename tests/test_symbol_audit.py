@@ -103,7 +103,7 @@ CHECKS = {
     "apex_tpu.profiler": None,
     "apex_tpu.checkpoint": None,
     "apex_tpu.data": None,
-    "apex_tpu.mesh": ["build_mesh"],
+    "apex_tpu.mesh": ["build_mesh", "build_hybrid_mesh"],
     "apex_tpu.transformer.context_parallel": [
         "ring_attention", "ulysses_attention"],
     "apex_tpu.transformer.moe": [
