@@ -36,6 +36,7 @@ from apex_tpu.amp.scaler import (
     all_finite,
     apply_if_finite,
     scale_loss,
+    update_scale_hysteresis,
     unscale,
     update,
     value_and_scaled_grad,
@@ -49,6 +50,7 @@ __all__ = [
     "all_finite",
     "apply_if_finite",
     "scale_loss",
+    "update_scale_hysteresis",
     "unscale",
     "update",
     "value_and_scaled_grad",

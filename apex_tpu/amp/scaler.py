@@ -205,3 +205,44 @@ def value_and_scaled_grad(
         return value, grads, finite
 
     return wrapped
+
+
+def update_scale_hysteresis(
+    current_scale,
+    growth_tracker,
+    hysteresis_tracker,
+    found_inf,
+    growth_factor: float = 2.0,
+    backoff_factor: float = 0.5,
+    growth_interval: int = 2000,
+    hysteresis: int = 1,
+):
+    """csrc/update_scale_hysteresis.cu (U) semantics, branch-free.
+
+    Returns the new ``(scale, growth_tracker, hysteresis_tracker)``
+    triple; ``found_inf`` follows torch GradScaler polarity (nonzero =
+    overflow). Matches the reference kernel exactly: the tracker only
+    *decrements* on overflow and backs off on every overflow once
+    exhausted (no refill — unlike :func:`update`, whose
+    :class:`ScalerState` policy deliberately restores the budget after a
+    backoff so hysteresis is per-incident tolerance), and growth is
+    skipped when it would leave fp32-finite range. ``hysteresis`` is
+    accepted for signature parity (the reference reads only the
+    tracker).
+    """
+    del hysteresis
+    scale = jnp.asarray(current_scale, jnp.float32)
+    growth = jnp.asarray(growth_tracker, jnp.int32)
+    hyst = jnp.asarray(hysteresis_tracker, jnp.int32)
+    finite = jnp.asarray(found_inf) == 0
+
+    hyst_new = jnp.where(finite, hyst, hyst - 1)
+    backoff = (~finite) & (hyst_new <= 0)
+    growth_new = jnp.where(finite, growth + 1, 0).astype(jnp.int32)
+    grown = scale * growth_factor
+    grow = finite & (growth_new >= growth_interval) & jnp.isfinite(grown)
+    new_scale = jnp.where(grow, grown, scale)
+    new_scale = jnp.where(backoff, scale * backoff_factor, new_scale)
+    growth_out = jnp.where(
+        finite & (growth_new >= growth_interval), 0, growth_new)
+    return new_scale, growth_out.astype(jnp.int32), hyst_new.astype(jnp.int32)

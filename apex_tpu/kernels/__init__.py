@@ -8,6 +8,7 @@ the CPU test backbone exercises identical semantics.
 from apex_tpu.kernels.blockwise_attention import blockwise_attention
 from apex_tpu.kernels.layer_norm import layer_norm, rms_norm
 from apex_tpu.kernels.softmax import (
+    generic_scaled_masked_softmax,
     scaled_masked_softmax,
     scaled_upper_triang_masked_softmax,
 )
@@ -26,6 +27,7 @@ __all__ = [
     "blockwise_attention",
     "layer_norm",
     "rms_norm",
+    "generic_scaled_masked_softmax",
     "scaled_masked_softmax",
     "scaled_upper_triang_masked_softmax",
     "softmax_cross_entropy",

@@ -179,3 +179,11 @@ def scaled_upper_triang_masked_softmax(x, *, scale: float = 1.0):
     x3 = x.reshape(-1, sq, sk)
     y = _softmax(x3, None, float(scale), True).reshape(shape)
     return y.astype(jnp.float16) if was16 else y
+
+
+#: generic_scaled_masked_softmax_cuda [era] (U) — the reference's third
+#: variant lifts its seq-len-template and mask-broadcast restrictions;
+#: the Pallas kernel never had them, so the generic name is the same op
+#: (the CamelCase autograd-Function name lives in transformer.functional
+#: with its siblings).
+generic_scaled_masked_softmax = scaled_masked_softmax

@@ -4,10 +4,12 @@ from apex_tpu.transformer.functional.fused_softmax import (  # noqa: F401
     FusedScaleMaskSoftmax,
     ScaledMaskedSoftmax,
     ScaledUpperTriangMaskedSoftmax,
+    GenericScaledMaskedSoftmax,
 )
 
 __all__ = [
     "FusedScaleMaskSoftmax",
     "ScaledMaskedSoftmax",
     "ScaledUpperTriangMaskedSoftmax",
+    "GenericScaledMaskedSoftmax",
 ]

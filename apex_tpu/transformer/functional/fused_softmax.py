@@ -23,6 +23,7 @@ from apex_tpu.transformer.enums import AttnMaskType
 # Direct kernel aliases matching the reference's autograd.Function names.
 ScaledMaskedSoftmax = scaled_masked_softmax
 ScaledUpperTriangMaskedSoftmax = scaled_upper_triang_masked_softmax
+GenericScaledMaskedSoftmax = scaled_masked_softmax  # [era] generic variant
 
 
 def _default_mask_func(scores, mask):

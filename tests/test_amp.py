@@ -224,3 +224,28 @@ class TestEndToEnd:
                 first = float(value)
         assert float(value) < first * 0.05
         assert np.isfinite(float(st.loss_scale))
+
+
+def test_update_scale_hysteresis_call_shape():
+    """csrc/update_scale_hysteresis.cu (U) parity: the tracker only
+    decrements on overflow, backs off on EVERY overflow once exhausted
+    (no refill), growth is fp32-finite-guarded."""
+    from apex_tpu.amp import update_scale_hysteresis
+
+    # overflow with budget: spend one, scale unchanged
+    s, g, h = update_scale_hysteresis(1024.0, 5, 2, 1)
+    assert float(s) == 1024.0 and int(h) == 1 and int(g) == 0
+    # budget exhausted: back off; tracker keeps decrementing, no refill
+    s, g, h = update_scale_hysteresis(s, g, h, 1)
+    assert float(s) == 512.0 and int(h) == 0
+    # sustained overflow: backs off again immediately (reference kernel
+    # semantics — apex_tpu's own ScalerState policy refills instead)
+    s, g, h = update_scale_hysteresis(s, g, h, 1)
+    assert float(s) == 256.0 and int(h) == -1
+    # clean step at the growth interval: double and reset the counter
+    s, g, h = update_scale_hysteresis(512.0, 1999, 2, 0)
+    assert float(s) == 1024.0 and int(g) == 0
+    # growth that would overflow fp32 is skipped, counter still resets
+    s, g, h = update_scale_hysteresis(3e38, 1999, 2, 0)
+    assert np.isfinite(float(s)) and float(s) == np.float32(3e38) \
+        and int(g) == 0

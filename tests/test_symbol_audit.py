@@ -12,7 +12,7 @@ CHECKS = {
         "initialize", "scale_loss", "master_params", "state_dict",
         "load_state_dict", "Policy", "get_policy", "ScalerConfig",
         "ScalerState", "all_finite", "apply_if_finite", "unscale",
-        "value_and_scaled_grad"],
+        "value_and_scaled_grad", "update_scale_hysteresis"],
     # §2.1 fp16_utils
     "apex_tpu.fp16_utils": [
         "network_to_half", "BN_convert_float", "FP16Model",
@@ -72,7 +72,9 @@ CHECKS = {
     "apex_tpu.transformer.microbatches": [
         "setup_microbatch_calculator", "build_num_microbatches_calculator",
         "ConstantNumMicroBatches", "RampupBatchsizeNumMicroBatches"],
-    "apex_tpu.transformer.functional": ["FusedScaleMaskSoftmax"],
+    "apex_tpu.transformer.functional": [
+        "FusedScaleMaskSoftmax", "ScaledMaskedSoftmax",
+        "ScaledUpperTriangMaskedSoftmax", "GenericScaledMaskedSoftmax"],
     "apex_tpu.transformer.enums": ["AttnMaskType", "ModelType", "LayerType"],
     "apex_tpu.transformer.log_util": [
         "set_logging_level", "get_transformer_logger"],
@@ -81,6 +83,7 @@ CHECKS = {
     "apex_tpu.kernels": [
         "flash_attention", "layer_norm", "rms_norm",
         "scaled_masked_softmax", "scaled_upper_triang_masked_softmax",
+        "generic_scaled_masked_softmax",
         "softmax_cross_entropy"],
     "apex_tpu.kernels.flat_ops": [
         "scale_flat", "axpby_flat", "l2norm_flat", "adam_flat", "sgd_flat",
