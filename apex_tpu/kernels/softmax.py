@@ -148,8 +148,10 @@ def scaled_masked_softmax(x, mask: Optional[jnp.ndarray] = None, *,
     """``softmax(scale*x + mask)`` — ``ScaledMaskedSoftmax`` (U).
 
     ``x``: ``[b, h, sq, sk]`` (or any ``[..., sq, sk]``); ``mask``: boolean
-    or 0/1, nonzero = masked out, shape ``[b, 1, sq, sk]`` / ``[b, sq, sk]``
-    broadcasting over heads. Softmax in fp32 regardless of I/O dtype.
+    or 0/1, nonzero = masked out, any shape broadcastable to ``x`` over
+    the leading/head/query dims (``[b, 1, sq, sk]``, ``[b, 1, 1, sk]``
+    padding masks, ``[b, sq, sk]``, …). Softmax in fp32 regardless of
+    I/O dtype.
     """
     shape = x.shape
     sq, sk = shape[-2], shape[-1]
@@ -158,12 +160,9 @@ def scaled_masked_softmax(x, mask: Optional[jnp.ndarray] = None, *,
     m3 = None
     if mask is not None:
         m = jnp.asarray(mask)
-        m3 = m.reshape(-1, sq, sk) if m.ndim != 4 else m.reshape(m.shape[0], sq, sk)
-        if x3.shape[0] % m3.shape[0] != 0:
-            raise ValueError(
-                f"mask batch {m3.shape[0]} does not divide flattened batch "
-                f"{x3.shape[0]}"
-            )
+        if m.ndim == x.ndim - 1:  # [b, sq, sk] over [b, h, sq, sk]: no
+            m = m[:, None]        # head dim — insert it, then broadcast
+        m3 = jnp.broadcast_to(m, shape).reshape(-1, sq, sk)
     y = _softmax(x3, m3, float(scale), False).reshape(shape)
     return y.astype(jnp.float16) if was16 else y
 

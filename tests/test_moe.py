@@ -159,3 +159,24 @@ def test_moe_gather_dispatch_ep_matches_dense(devices8):
         out_specs=P("ep"), check_vma=False))(params, x)
     np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_dense),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_moe_ep_capacity_is_per_source_rank(devices8):
+    """Documented drop semantics under ep: capacity caps each *source
+    rank's* slots. All 16 tokens/rank routed to expert 0 with C=2 →
+    every rank serves exactly its first 2 tokens, drops the rest."""
+    cfg = _cfg(axis="ep", top_k=1, capacity_factor=1.0)  # C = 16/8 = 2
+    params = moe.init_moe(cfg, jax.random.PRNGKey(0))
+    k = params["router"]["kernel"]
+    params["router"]["kernel"] = jnp.zeros_like(k).at[0, 0].set(100.0)
+    x = jnp.ones((128, cfg.hidden_size))  # logit_0 = 100 > 0 everywhere
+
+    mesh = mx.build_mesh(ep=8, devices=devices8)
+    y = jax.jit(jax.shard_map(
+        lambda p, xs: moe.moe_ffn(cfg, p, xs)[0], mesh=mesh,
+        in_specs=(moe.moe_pspecs(P), P("ep")), out_specs=P("ep"),
+        check_vma=False))(params, x)
+    y = np.asarray(y).reshape(8, 16, cfg.hidden_size)  # [rank, token, h]
+    served = np.any(y != 0, axis=-1)
+    np.testing.assert_array_equal(served[:, :2], True)
+    np.testing.assert_array_equal(served[:, 2:], False)

@@ -153,3 +153,28 @@ def test_xentropy_matches_smoothing_formula():
     xt = jnp.take_along_axis(logits, target[:, None], -1)[:, 0]
     manual = lse - (1 - eps) * xt - eps * jnp.mean(logits, -1)
     np.testing.assert_allclose(np.asarray(loss), np.asarray(manual), rtol=1e-5)
+
+
+def test_scaled_masked_softmax_broadcast_masks():
+    """generic variant (U) [era]: padding masks broadcasting over query
+    (and head/batch) dims must work and equal the expanded-mask result."""
+    import jax
+
+    from apex_tpu.kernels import (
+        generic_scaled_masked_softmax,
+        scaled_masked_softmax,
+    )
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 8, 16))
+    pad = jax.random.bernoulli(jax.random.PRNGKey(1), 0.3, (2, 1, 1, 16))
+    full = jnp.broadcast_to(pad, x.shape)
+    got = generic_scaled_masked_softmax(x, pad, scale=0.5)
+    want = scaled_masked_softmax(x, full, scale=0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-7)
+    # legacy [b, sq, sk] head-broadcast form keeps working
+    m3 = jax.random.bernoulli(jax.random.PRNGKey(2), 0.3, (2, 8, 16))
+    got3 = scaled_masked_softmax(x, m3)
+    want3 = scaled_masked_softmax(x, jnp.broadcast_to(m3[:, None], x.shape))
+    np.testing.assert_allclose(np.asarray(got3), np.asarray(want3),
+                               rtol=1e-6, atol=1e-7)
