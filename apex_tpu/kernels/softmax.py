@@ -160,9 +160,24 @@ def scaled_masked_softmax(x, mask: Optional[jnp.ndarray] = None, *,
     m3 = None
     if mask is not None:
         m = jnp.asarray(mask)
-        if m.ndim == x.ndim - 1:  # [b, sq, sk] over [b, h, sq, sk]: no
-            m = m[:, None]        # head dim — insert it, then broadcast
-        m3 = jnp.broadcast_to(m, shape).reshape(-1, sq, sk)
+        if m.ndim == x.ndim - 1 and x.ndim >= 4 and m.shape[0] == shape[0]:
+            m = m[:, None]  # legacy [b, sq, sk] over [b, h, sq, sk]
+        while m.ndim < x.ndim:
+            m = m[None]
+        # Materialise sq/sk (cheap next to the scores) and any interior
+        # broadcast dim, but keep *trailing* size-1 leading dims (head,
+        # ...) unmaterialised: the kernel ratio-tiles them (mask block
+        # index = i // (B_x / B_m)) without the h× mask copy.
+        lead = m.shape[:-2]
+        cut = len(lead)
+        while cut > 0 and lead[cut - 1] == 1:
+            cut -= 1
+        tgt = shape[:cut] + (1,) * (len(lead) - cut) + (sq, sk)
+        m3 = jnp.broadcast_to(m, tgt).reshape(-1, sq, sk)
+        if x3.shape[0] % m3.shape[0] != 0:
+            raise ValueError(
+                f"mask shape {jnp.asarray(mask).shape} does not broadcast "
+                f"against scores {shape}")
     y = _softmax(x3, m3, float(scale), False).reshape(shape)
     return y.astype(jnp.float16) if was16 else y
 

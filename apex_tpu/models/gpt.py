@@ -56,6 +56,7 @@ from apex_tpu.transformer.tensor_parallel.layers import (
 from apex_tpu.transformer.tensor_parallel.mappings import (
     copy_to_tensor_model_parallel_region,
     gather_from_sequence_parallel_region,
+    gather_from_tensor_model_parallel_region,
     scatter_to_sequence_parallel_region,
 )
 
@@ -757,3 +758,121 @@ def pipeline_loss(
     return pipelined_loss(
         chunk_fn, inject, loss_of_outputs, n_micro, item,
         n_chunks=n_chunks, axis=pp_axis)
+
+
+# ---------------------------------------------------------------------------
+# autoregressive decoding (KV cache) — beyond parity: apex ships no
+# inference path at all; the flagship model should be servable
+# ---------------------------------------------------------------------------
+
+def init_cache(cfg: GPTConfig, params, batch: int):
+    """Local KV cache ``[L_local, 2, batch, heads_local, seq_len,
+    head_dim]`` (zeros) sized from this rank's layer/qkv shards — call
+    inside ``shard_map`` like the rest of the model."""
+    qkv_k = params["layers"]["attn"]["qkv"]["kernel"]
+    l_local = qkv_k.shape[0]
+    heads_local = qkv_k.shape[-1] // (3 * cfg.head_dim)
+    return jnp.zeros(
+        (l_local, 2, batch, heads_local, cfg.seq_len, cfg.head_dim),
+        cfg.compute_dtype)
+
+
+def _decode_layer(cfg: GPTConfig, p, x, kv, pos):
+    """One layer for one token: x [b, hidden], kv [2, b, hl, S, d]."""
+    xa = _layer_norm(cfg, x, p["ln1"]["scale"], p["ln1"]["bias"])
+    qkv = column_parallel_linear(
+        xa, p["attn"]["qkv"]["kernel"], p["attn"]["qkv"]["bias"],
+        axis=cfg.axis)
+    b, local3 = qkv.shape
+    d = cfg.head_dim
+    hl = local3 // (3 * d)
+    qkv = qkv.reshape(b, hl, 3, d)
+    q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    k_cache = lax.dynamic_update_slice_in_dim(
+        kv[0], k_new[:, :, None], pos, axis=2)
+    v_cache = lax.dynamic_update_slice_in_dim(
+        kv[1], v_new[:, :, None], pos, axis=2)
+    # scale folded into q BEFORE the einsum: the unscaled dot product
+    # overflows fp16's 65504 range (same guard as the training path's
+    # compute-dtype branch)
+    q = q * jnp.asarray(1.0 / np.sqrt(d), q.dtype)
+    scores = jnp.einsum("bhd,bhsd->bhs", q, k_cache).astype(jnp.float32)
+    valid = jnp.arange(k_cache.shape[2]) <= pos
+    scores = jnp.where(valid[None, None], scores, -1e30)
+    p_attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhs,bhsd->bhd", p_attn, v_cache).reshape(b, hl * d)
+    attn = row_parallel_linear(
+        out, p["attn"]["proj"]["kernel"], p["attn"]["proj"]["bias"],
+        axis=cfg.axis)
+    x = x + attn
+    xb = _layer_norm(cfg, x, p["ln2"]["scale"], p["ln2"]["bias"])
+    if cfg.num_experts:
+        y, _ = moe_mod.moe_ffn(_moe_cfg(cfg), p["moe"], xb)  # aux unused
+    else:
+        y = _mlp(cfg, p["mlp"], xb)
+    return x + y, jnp.stack([k_cache, v_cache])
+
+
+def decode_step(cfg: GPTConfig, params, cache, token, pos):
+    """One decoding step: ``token [b] int32`` at position ``pos`` →
+    (full-vocab fp32 logits ``[b, vocab]``, updated cache).
+
+    Sequence parallelism is stripped: decode has no sequence dim, and the
+    SP gather/scatter would misread the batch dim as one.
+    """
+    if cfg.sequence_parallel:
+        cfg = dataclasses.replace(cfg, sequence_parallel=False)
+    table = params["embedding"]["word"]["table"].astype(cfg.compute_dtype)
+    emb = vocab_parallel_embedding(token[:, None], table, axis=cfg.axis)
+    pos_e = lax.dynamic_index_in_dim(
+        params["embedding"]["position"], pos, 0, keepdims=False)
+    x = (emb[:, 0] + pos_e.astype(cfg.compute_dtype)).astype(
+        cfg.compute_dtype)
+
+    def body(carry, inp):
+        layer_p, kv = inp
+        y, kv = _decode_layer(cfg, _cast_layer(cfg, layer_p), carry, kv, pos)
+        return y, kv
+
+    x, new_cache = lax.scan(body, x, (params["layers"], cache))
+    h = _layer_norm(cfg, x, params["final_ln"]["scale"],
+                    params["final_ln"]["bias"])
+    h = copy_to_tensor_model_parallel_region(h, cfg.axis)
+    lg = jnp.einsum("bh,vh->bv", h, table)  # tied head, vocab-sharded
+    lg = gather_from_tensor_model_parallel_region(lg, cfg.axis)
+    return lg.astype(jnp.float32), new_cache
+
+
+def generate(cfg: GPTConfig, params, prompt, n_new: int):
+    """Greedy continuation: ``prompt [b, p_len] int32`` → ``[b, n_new]``.
+
+    Local semantics (call inside ``shard_map``; composes with tp and,
+    via generous ``moe_capacity_factor``, MoE). One compiled
+    ``lax.scan`` over positions — prompt prefill and generation share
+    the per-token decode path.
+    """
+    b, p_len = prompt.shape
+    if p_len < 1:
+        raise ValueError("generate needs at least one prompt token")
+    total = p_len + n_new
+    if total > cfg.seq_len:
+        raise ValueError(
+            f"prompt {p_len} + n_new {n_new} exceeds seq_len {cfg.seq_len}")
+    if cfg.sequence_parallel:
+        cfg = dataclasses.replace(cfg, sequence_parallel=False)
+    cache0 = init_cache(cfg, params, b)
+    padded = jnp.pad(prompt.astype(jnp.int32), ((0, 0), (0, n_new)))
+
+    def step(carry, t):
+        tok_in, cache = carry
+        logits, cache = decode_step(cfg, params, cache, tok_in, t)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # feed the prompt while it lasts, then the model's own output
+        feed = jnp.where(t + 1 < p_len, padded[:, jnp.minimum(t + 1, total - 1)], nxt)
+        return (feed, cache), nxt
+
+    (_, _), outs = lax.scan(
+        step, (padded[:, 0], cache0), jnp.arange(total - 1, dtype=jnp.int32))
+    # outs[t] is the prediction for position t+1: generations start at the
+    # prediction made from the last prompt token
+    return jnp.transpose(outs[p_len - 1:], (1, 0))

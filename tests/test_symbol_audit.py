@@ -109,6 +109,9 @@ CHECKS = {
     "apex_tpu.mesh": ["build_mesh", "build_hybrid_mesh"],
     "apex_tpu.transformer.context_parallel": [
         "ring_attention", "ulysses_attention"],
+    "apex_tpu.models.gpt": [
+        "GPTConfig", "init", "loss", "logits", "generate", "decode_step",
+        "init_cache", "param_specs", "pipeline_loss"],
     "apex_tpu.transformer.moe": [
         "MoEConfig", "init_moe", "moe_ffn", "moe_pspecs"],
     # §2.2 misc transformer: LN wrapper + testing helpers at canonical paths
