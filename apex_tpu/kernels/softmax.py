@@ -160,6 +160,9 @@ def scaled_masked_softmax(x, mask: Optional[jnp.ndarray] = None, *,
     m3 = None
     if mask is not None:
         m = jnp.asarray(mask)
+        if m.ndim > x.ndim:
+            raise ValueError(
+                f"mask rank {m.ndim} exceeds scores rank {x.ndim}")
         if m.ndim == x.ndim - 1 and x.ndim >= 4 and m.shape[0] == shape[0]:
             m = m[:, None]  # legacy [b, sq, sk] over [b, h, sq, sk]
         while m.ndim < x.ndim:
@@ -173,11 +176,9 @@ def scaled_masked_softmax(x, mask: Optional[jnp.ndarray] = None, *,
         while cut > 0 and lead[cut - 1] == 1:
             cut -= 1
         tgt = shape[:cut] + (1,) * (len(lead) - cut) + (sq, sk)
+        # incompatible masks fail here with jax's broadcast error; the
+        # resulting batch prod(shape[:cut]) always divides x3's
         m3 = jnp.broadcast_to(m, tgt).reshape(-1, sq, sk)
-        if x3.shape[0] % m3.shape[0] != 0:
-            raise ValueError(
-                f"mask shape {jnp.asarray(mask).shape} does not broadcast "
-                f"against scores {shape}")
     y = _softmax(x3, m3, float(scale), False).reshape(shape)
     return y.astype(jnp.float16) if was16 else y
 

@@ -765,15 +765,19 @@ def pipeline_loss(
 # inference path at all; the flagship model should be servable
 # ---------------------------------------------------------------------------
 
-def init_cache(cfg: GPTConfig, params, batch: int):
-    """Local KV cache ``[L_local, 2, batch, heads_local, seq_len,
+def init_cache(cfg: GPTConfig, params, batch: int,
+               max_len: Optional[int] = None):
+    """Local KV cache ``[L_local, 2, batch, heads_local, max_len,
     head_dim]`` (zeros) sized from this rank's layer/qkv shards — call
-    inside ``shard_map`` like the rest of the model."""
+    inside ``shard_map`` like the rest of the model. ``max_len`` defaults
+    to ``cfg.seq_len``; size it to the actual decode horizon (attention
+    runs over every cache slot each step)."""
     qkv_k = params["layers"]["attn"]["qkv"]["kernel"]
     l_local = qkv_k.shape[0]
     heads_local = qkv_k.shape[-1] // (3 * cfg.head_dim)
     return jnp.zeros(
-        (l_local, 2, batch, heads_local, cfg.seq_len, cfg.head_dim),
+        (l_local, 2, batch, heads_local, max_len or cfg.seq_len,
+         cfg.head_dim),
         cfg.compute_dtype)
 
 
@@ -820,6 +824,10 @@ def decode_step(cfg: GPTConfig, params, cache, token, pos):
     Sequence parallelism is stripped: decode has no sequence dim, and the
     SP gather/scatter would misread the batch dim as one.
     """
+    if not cfg.causal:
+        raise ValueError(
+            "decoding is autoregressive; causal=False (the bidirectional "
+            "encoder mode) has no incremental-decode semantics")
     if cfg.sequence_parallel:
         cfg = dataclasses.replace(cfg, sequence_parallel=False)
     table = params["embedding"]["word"]["table"].astype(cfg.compute_dtype)
@@ -843,14 +851,22 @@ def decode_step(cfg: GPTConfig, params, cache, token, pos):
     return lg.astype(jnp.float32), new_cache
 
 
-def generate(cfg: GPTConfig, params, prompt, n_new: int):
-    """Greedy continuation: ``prompt [b, p_len] int32`` → ``[b, n_new]``.
+def generate(cfg: GPTConfig, params, prompt, n_new: int,
+             *, temperature: float = 0.0, key=None):
+    """Continuation: ``prompt [b, p_len] int32`` → ``[b, n_new]``.
+
+    ``temperature=0`` (default) is greedy argmax; > 0 samples from
+    ``softmax(logits / temperature)`` using ``key`` (required then; fold
+    it per tp-replica-identically — every rank must draw the same token,
+    which holds because the gathered logits and the key are replicated).
 
     Local semantics (call inside ``shard_map``; composes with tp and,
     via generous ``moe_capacity_factor``, MoE). One compiled
     ``lax.scan`` over positions — prompt prefill and generation share
     the per-token decode path.
     """
+    if temperature > 0.0 and key is None:
+        raise ValueError("temperature > 0 needs a PRNG key")
     b, p_len = prompt.shape
     if p_len < 1:
         raise ValueError("generate needs at least one prompt token")
@@ -858,15 +874,24 @@ def generate(cfg: GPTConfig, params, prompt, n_new: int):
     if total > cfg.seq_len:
         raise ValueError(
             f"prompt {p_len} + n_new {n_new} exceeds seq_len {cfg.seq_len}")
+    if not cfg.causal:
+        raise ValueError(
+            "decoding is autoregressive; causal=False has no "
+            "incremental-decode semantics")
     if cfg.sequence_parallel:
         cfg = dataclasses.replace(cfg, sequence_parallel=False)
-    cache0 = init_cache(cfg, params, b)
+    cache0 = init_cache(cfg, params, b, max_len=total)
     padded = jnp.pad(prompt.astype(jnp.int32), ((0, 0), (0, n_new)))
 
     def step(carry, t):
         tok_in, cache = carry
         logits, cache = decode_step(cfg, params, cache, tok_in, t)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if temperature > 0.0:
+            nxt = jax.random.categorical(
+                jax.random.fold_in(key, t), logits / temperature, axis=-1
+            ).astype(jnp.int32)
+        else:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         # feed the prompt while it lasts, then the model's own output
         feed = jnp.where(t + 1 < p_len, padded[:, jnp.minimum(t + 1, total - 1)], nxt)
         return (feed, cache), nxt

@@ -81,3 +81,41 @@ def test_generate_rejects_overflow(devices8):
     params = gpt.init(cfg, jax.random.PRNGKey(0))
     with pytest.raises(ValueError, match="seq_len"):
         gpt.generate(cfg, params, jnp.zeros((1, 6), jnp.int32), 5)
+
+
+def test_generate_sampling(devices8):
+    """temperature > 0 samples (reproducibly per key) and stays in-vocab;
+    tiny temperature converges to greedy."""
+    cfg = standalone_gpt_config(vocab_size=96, seq_len=24)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (3, 8), 0, 96)
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    pspecs = gpt.param_specs(cfg)
+
+    def run(temp, seed):
+        return jax.jit(jax.shard_map(
+            lambda p, t: gpt.generate(cfg, p, t, N_NEW, temperature=temp,
+                                      key=jax.random.PRNGKey(seed)),
+            mesh=mesh, in_specs=(pspecs, P(None, None)),
+            out_specs=P(None, None), check_vma=False))(params, prompt)
+
+    a = np.asarray(run(1.0, 7))
+    b = np.asarray(run(1.0, 7))
+    np.testing.assert_array_equal(a, b)  # same key -> same draw
+    assert a.shape == (3, N_NEW) and (a >= 0).all() and (a < 96).all()
+    cold = np.asarray(run(1e-4, 7))
+    greedy = np.asarray(_generate(cfg, params, prompt, mesh))
+    np.testing.assert_array_equal(cold, greedy)
+    import pytest
+
+    with pytest.raises(ValueError, match="PRNG key"):
+        gpt.generate(cfg, params, prompt, N_NEW, temperature=1.0)
+
+
+def test_generate_rejects_bidirectional(devices8):
+    import pytest
+
+    cfg = standalone_gpt_config(causal=False)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="autoregressive"):
+        gpt.generate(cfg, params, jnp.zeros((1, 4), jnp.int32), 2)
