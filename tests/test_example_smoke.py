@@ -115,3 +115,19 @@ def test_gpt_train_moe_example_smoke(tmp_path):
     losses = [float(l.rsplit(" ", 1)[1])
               for l in r.stdout.splitlines() if l.startswith("step ")]
     assert len(losses) == 2 and losses[1] < losses[0]
+
+
+def test_generate_example_smoke(tmp_path):
+    """Decode demo runs greedy over tp=2 and prints a continuation per
+    batch row."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep + env.get("PYTHONPATH", ""))
+    cmd = [sys.executable, os.path.join(repo, "examples", "generate.py"),
+           "--tp", "2", "--n-new", "4", "--batch", "2"]
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines() if l.startswith("prompt ")]
+    assert len(lines) == 2 and all("->" in l for l in lines)
