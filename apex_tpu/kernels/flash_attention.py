@@ -8,8 +8,13 @@ per-seqlen templates, one online-softmax blockwise kernel:
 - forward: streams K/V blocks through VMEM, keeping running (max, sum,
   accumulator) per Q block — O(sq·d) memory, any sequence length;
 - backward: recomputes P = exp(S - lse) per block from the saved per-row
-  log-sum-exp (no sq×sk materialisation), in two sweeps (dQ; dK/dV) so
-  every accumulation is a sequential-grid reduction, never a race.
+  log-sum-exp (no sq×sk materialisation). Two strategies, numerically
+  identical: a fused single sweep that recomputes S/P once per (j, i)
+  block and produces dQ/dK/dV together (dQ accumulates in a full-length
+  VMEM scratch — TPU grids are sequential, so the accumulation is
+  race-free), used whenever that scratch fits VMEM; and a two-sweep
+  fallback (dQ; dK/dV) for very long sequences, which recomputes S/P
+  twice but needs only block-sized scratch.
 
 Supports causal masking and per-batch key-padding lengths (the capability
 behind fmha's var-seqlen batch packing). Softmax statistics are always
@@ -19,6 +24,7 @@ fp32; matmuls run in the input dtype on the MXU with fp32 accumulation.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -39,6 +45,10 @@ _DEFAULT_BLOCK_Q = 512
 _DEFAULT_BLOCK_K = 512
 _DEFAULT_BLOCK_Q_BWD = 512
 _DEFAULT_BLOCK_K_BWD = 512
+# fused-backward dQ scratch budget: the single-sweep kernel keeps the
+# whole (padded_seq, head_dim) fp32 dQ accumulator resident in VMEM;
+# beyond this it falls back to the two-sweep backward
+_FUSED_DQ_VMEM_BYTES = 4 * 1024 * 1024
 
 
 def _row_ids(bq: int, width: int, i):
@@ -65,8 +75,7 @@ def _fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # causal: K blocks entirely above the diagonal contribute nothing
-    compute = (j * bk < (i + 1) * bq) if causal else True
+    compute = _causal_skip(causal, i, j, bq, bk)
 
     @pl.when(compute)
     def _block():
@@ -106,8 +115,42 @@ def _fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 # ---------------------------------------------------------------------------
-# backward: dQ sweep (grid over k blocks innermost), then dK/dV sweep
+# backward: fused single sweep (default), or dQ sweep + dK/dV sweep
 # ---------------------------------------------------------------------------
+
+def _causal_skip(causal, i, j, bq, bk):
+    """Block-level causal skip: K blocks entirely above the diagonal of
+    q block ``i`` contribute nothing (shared by all four kernels)."""
+    return (j * bk < (i + 1) * bq) if causal else True
+
+
+def _bwd_p_ds(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+              i, j, *, scale, causal, bq, bk, sk):
+    """Shared backward block math: recompute P = exp(S - lse) with the
+    composed (padding ∧ length ∧ causal) mask, and dS. Every backward
+    kernel routes through here so the masking lives in one place."""
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, :1]
+    delta = delta_ref[0][:, :1]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    col = _col_ids(bq, bk, j)
+    valid = col < sk
+    if len_ref is not None:
+        valid = valid & (col < len_ref[0, 0])
+    if causal:
+        valid = valid & (col <= _row_ids(bq, bk, i))
+    p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+    dp = jax.lax.dot_general(
+        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    return q, k, do, p, ds
+
 
 def _dq_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                dq_ref, acc_ref, *, scale, causal, bq, bk, sk):
@@ -119,30 +162,13 @@ def _dq_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    compute = (j * bk < (i + 1) * bq) if causal else True
+    compute = _causal_skip(causal, i, j, bq, bk)
 
     @pl.when(compute)
     def _block():
-        q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, :1]
-        delta = delta_ref[0][:, :1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        col = _col_ids(bq, bk, j)
-        valid = col < sk
-        if len_ref is not None:
-            valid = valid & (col < len_ref[0, 0])
-        if causal:
-            valid = valid & (col <= _row_ids(bq, bk, i))
-        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
-        dp = jax.lax.dot_general(
-            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        _, k, _, _, ds = _bwd_p_ds(
+            len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            i, j, scale=scale, causal=causal, bq=bq, bk=bk, sk=sk)
         acc_ref[:] += jax.lax.dot_general(
             ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -163,39 +189,74 @@ def _dkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    compute = (j * bk < (i + 1) * bq) if causal else True
+    compute = _causal_skip(causal, i, j, bq, bk)
 
     @pl.when(compute)
     def _block():
-        q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, :1]
-        delta = delta_ref[0][:, :1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        col = _col_ids(bq, bk, j)
-        valid = col < sk
-        if len_ref is not None:
-            valid = valid & (col < len_ref[0, 0])
-        if causal:
-            valid = valid & (col <= _row_ids(bq, bk, i))
-        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        q, _, do, p, ds = _bwd_p_ds(
+            len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            i, j, scale=scale, causal=causal, bq=bq, bk=bk, sk=sk)
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # (bk, d)
-        dp = jax.lax.dot_general(
-            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
         dk_acc[:] += jax.lax.dot_general(
             ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # (bk, d)
 
     @pl.when(i == nq - 1)
     def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _dqkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                 dq_ref, dk_ref, dv_ref, dq_acc, dk_acc, dv_acc,
+                 *, scale, causal, bq, bk, sk):
+    """Fused backward: one S/P recompute per (j, i) block yields dQ, dK
+    and dV together. Grid (bh, nk, nq) — k block outer, q block inner —
+    so dK/dV reduce in block scratch exactly like ``_dkv_kernel``, while
+    dQ accumulates into a full-length VMEM scratch across the outer k
+    sweep (sequential grid ⇒ no races). Two of the seven per-block
+    matmuls of the two-sweep backward (S and dP in the dQ sweep) are
+    eliminated, and q/do/lse/delta are read once instead of twice."""
+    j = pl.program_id(1)   # k block (outer)
+    i = pl.program_id(2)   # q block (inner)
+    nq = pl.num_programs(2)
+
+    @pl.when((j == 0) & (i == 0))
+    def _init_dq():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    @pl.when(i == 0)
+    def _init_dkv():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    rows = pl.dslice(i * bq, bq)
+    compute = _causal_skip(causal, i, j, bq, bk)
+
+    @pl.when(compute)
+    def _block():
+        q, k, do, p, ds = _bwd_p_ds(
+            len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            i, j, scale=scale, causal=causal, bq=bq, bk=bk, sk=sk)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bk, d)
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bk, d)
+        dq_acc[rows] += jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bq, d)
+
+    # dq out block (b, i) is flushed on every visit (i is the innermost
+    # grid dim); write the running partial so every flush is valid — the
+    # final (j = last k block) flush lands last and is the complete dQ
+    dq_ref[0] = dq_acc[rows].astype(dq_ref.dtype)
+
+    @pl.when(i == nq - 1)
+    def _finish_dkv():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
@@ -309,6 +370,55 @@ def _run_bwd(q, k, v, do, lse, delta, lengths, scale, causal,
     if lengths is not None:
         lens = lengths.reshape(bh, 1).astype(jnp.int32)
 
+    # (b, j, i)-ordered spec family, shared by the fused single sweep and
+    # the two-sweep fallback's dK/dV pass (both run k blocks outermost)
+    qspec2 = pl.BlockSpec((1, bq, dp), lambda b, j, i: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    kspec2 = pl.BlockSpec((1, bk, dp), lambda b, j, i: (b, j, 0),
+                          memory_space=pltpu.VMEM)
+    sspec2 = pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    lenspec2 = pl.BlockSpec((1, 1), lambda b, j, i: (b, 0),
+                            memory_space=pltpu.SMEM)
+
+    mode = os.environ.get("APEX_TPU_FLASH_BWD", "auto")
+    if mode not in ("auto", "fused", "split"):
+        raise ValueError(
+            f"APEX_TPU_FLASH_BWD={mode!r}: expected auto, fused or split")
+    fused = (mode == "fused" or
+             (mode != "split" and sqp * dp * 4 <= _FUSED_DQ_VMEM_BYTES))
+    if fused:
+        # --- fused single sweep: grid (bh, nk, nq) -----------------------
+        in_specs = [qspec2, kspec2, kspec2, qspec2, sspec2, sspec2]
+        operands = [qp, kp, vp, dop, lsep, deltap]
+        if lens is not None:
+            in_specs = [lenspec2] + in_specs
+            operands = [lens] + operands
+            kernel = _dqkv_kernel
+        else:
+            kernel = functools.partial(_drop_len, _dqkv_kernel)
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(kernel, scale=scale, causal=causal,
+                              bq=bq, bk=bk, sk=sk),
+            grid=(bh, skp // bk, sqp // bq),
+            in_specs=in_specs,
+            out_specs=[qspec2, kspec2, kspec2],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, sqp, dp), jnp.float32),
+                jax.ShapeDtypeStruct((bh, skp, dp), jnp.float32),
+                jax.ShapeDtypeStruct((bh, skp, dp), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((sqp, dp), jnp.float32),
+                pltpu.VMEM((bk, dp), jnp.float32),
+                pltpu.VMEM((bk, dp), jnp.float32),
+            ],
+            interpret=use_interpret(),
+        )(*operands)
+        return (dq[:, :sq, :d].astype(q.dtype),
+                dk[:, :sk, :d].astype(k.dtype),
+                dv[:, :sk, :d].astype(v.dtype))
+
     # --- dQ sweep: grid (bh, nq, nk) -------------------------------------
     in_specs = [qspec, kspec, kspec, qspec, sspec, sspec]
     operands = [qp, kp, vp, dop, lsep, deltap]
@@ -330,17 +440,10 @@ def _run_bwd(q, k, v, do, lse, delta, lengths, scale, causal,
     )(*operands)
 
     # --- dK/dV sweep: grid (bh, nk, nq) ----------------------------------
-    qspec2 = pl.BlockSpec((1, bq, dp), lambda b, j, i: (b, i, 0),
-                          memory_space=pltpu.VMEM)
-    kspec2 = pl.BlockSpec((1, bk, dp), lambda b, j, i: (b, j, 0),
-                          memory_space=pltpu.VMEM)
-    sspec2 = pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0),
-                          memory_space=pltpu.VMEM)
     in_specs2 = [qspec2, kspec2, kspec2, qspec2, sspec2, sspec2]
     operands2 = [qp, kp, vp, dop, lsep, deltap]
     if lens is not None:
-        in_specs2 = [pl.BlockSpec((1, 1), lambda b, j, i: (b, 0),
-                                  memory_space=pltpu.SMEM)] + in_specs2
+        in_specs2 = [lenspec2] + in_specs2
         operands2 = [lens] + operands2
         dkv_kernel = _dkv_kernel
     else:

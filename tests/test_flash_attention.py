@@ -135,6 +135,37 @@ def test_long_sequence_multiblock():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=5e-5, atol=5e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_vs_split_backward(monkeypatch, causal):
+    """The single-sweep fused backward (dQ in full-length VMEM scratch)
+    and the two-sweep fallback accumulate in the same block order —
+    gradients must agree to float tolerance, and both must match the
+    reference. Tiny explicit tiles force multi-block accumulation."""
+    b, h, s, d = 2, 2, 20, 8
+    ks = jax.random.split(jax.random.PRNGKey(8), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d))
+    k = jax.random.normal(ks[1], (b, h, s, d))
+    v = jax.random.normal(ks[2], (b, h, s, d))
+    # composed causal ∧ length masking in one predicate when causal
+    lengths = jnp.array([17, 9])
+
+    def grads(mode):
+        monkeypatch.setenv("APEX_TPU_FLASH_BWD", mode)
+        return jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=causal, kv_lengths=lengths,
+            block_q=8, block_k=8) ** 2), argnums=(0, 1, 2))(q, k, v)
+
+    gf, gs = grads("fused"), grads("split")
+    gref = jax.grad(lambda q, k, v: jnp.sum(_ref_attention(
+        q, k, v, causal=causal, kv_lengths=lengths) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b_, r, name in zip(gf, gs, gref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-6, atol=1e-6, err_msg=f"d{name}")
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=1e-4, err_msg=f"d{name}")
+
+
 def test_misaligned_length_default_tiles():
     """A length just past a tile multiple: _fit_block shrinks the tile
     instead of padding by up to a whole masked-out block; fwd+bwd match
