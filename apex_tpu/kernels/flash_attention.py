@@ -14,7 +14,8 @@ per-seqlen templates, one online-softmax blockwise kernel:
   VMEM scratch — TPU grids are sequential, so the accumulation is
   race-free), used whenever that scratch fits VMEM; and a two-sweep
   fallback (dQ; dK/dV) for very long sequences, which recomputes S/P
-  twice but needs only block-sized scratch.
+  twice but needs only block-sized scratch. ``APEX_TPU_FLASH_BWD=
+  fused|split|auto`` overrides the automatic choice (debugging/A-B).
 
 Supports causal masking and per-batch key-padding lengths (the capability
 behind fmha's var-seqlen batch packing). Softmax statistics are always

@@ -110,10 +110,13 @@ class GPTConfig:
     #: factor. True = fully unrolled.
     scan_unroll: Any = 1
     #: "pallas" → fused Pallas LN kernel (opaque to XLA fusion);
-    #: "xla" → jnp LayerNorm that XLA fuses into neighbouring ops —
-    #: faster when the layer scan is unrolled. Numerics identical (fp32
-    #: statistics either way).
-    ln_impl: str = "pallas"
+    #: "xla" → jnp LayerNorm that XLA fuses into neighbouring ops.
+    #: Numerics identical (fp32 statistics either way). Default "xla":
+    #: measured faster in-model on both the GPT and BERT shapes — a
+    #: Pallas call is a fusion barrier inside the layer scan
+    #: (docs/DESIGN.md); the standalone kernel stays the
+    #: apex-normalization parity surface.
+    ln_impl: str = "xla"
     #: Storage dtype of the materialised score matrix — applies ONLY to
     #: the "xla" attention path (flash/xla_chunked never materialise
     #: scores to HBM, so the knob is moot there, including when "auto"
@@ -337,10 +340,14 @@ def _attention(cfg: GPTConfig, p, h):
             impl = "xla_chunked" if s >= 2048 else "xla"
         else:
             # measured on v5e end-to-end (docs/DESIGN.md): tuned flash
-            # beats materialised-scores XLA at 1024 and chunked-XLA by
-            # >2x at 4096; below 1024 the scores are small enough that
-            # XLA's fused path wins on dispatch count
-            impl = "flash" if s >= 1024 else "xla"
+            # beats materialised-scores XLA at 1024 (causal) and
+            # chunked-XLA by >2x at 4096; below that the scores are
+            # small enough that XLA's fused path wins on dispatch
+            # count. Bidirectional attention does 2x the effective
+            # score work, and flash already wins at 512 there
+            # (BERT-large datapoint).
+            flash_from = 1024 if cfg.causal else 512
+            impl = "flash" if s >= flash_from else "xla"
     if impl not in ("flash", "xla", "xla_chunked"):
         raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
     if cfg.context_parallel:
