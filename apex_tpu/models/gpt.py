@@ -95,7 +95,7 @@ class GPTConfig:
     #: kernel per chunk (single-pass lse, backward recomputes softmax
     #: from logits) — requires the vocab unsharded locally (tp == 1).
     ce_impl: str = "xla"
-    #: "flash" → Pallas blockwise kernel (fastest on TPU from ~1k seq —
+    #: "flash" → Pallas blockwise kernel (fastest on TPU from seq 512 —
     #: 2x+ over the XLA paths at 4k, docs/DESIGN.md); "xla" →
     #: materialised-scores attention (fastest at short seq and the only
     #: fast path off-TPU, where Pallas runs interpreted); "xla_chunked"
@@ -339,15 +339,13 @@ def _attention(cfg: GPTConfig, p, h):
             # magnitude slower) — stay on the XLA paths
             impl = "xla_chunked" if s >= 2048 else "xla"
         else:
-            # measured on v5e end-to-end (docs/DESIGN.md): tuned flash
-            # beats materialised-scores XLA at 1024 (causal) and
-            # chunked-XLA by >2x at 4096; below that the scores are
-            # small enough that XLA's fused path wins on dispatch
-            # count. Bidirectional attention does 2x the effective
-            # score work, and flash already wins at 512 there
-            # (BERT-large datapoint).
-            flash_from = 1024 if cfg.causal else 512
-            impl = "flash" if s >= flash_from else "xla"
+            # measured on v5e end-to-end (docs/DESIGN.md): with the
+            # fused backward, flash beats materialised-scores XLA from
+            # seq 512 both causal (34.1k vs 28.5k tok/s) and
+            # bidirectional (BERT-large datapoint), and chunked-XLA by
+            # >2x at 4096; at 256 the scores are small enough that
+            # XLA's fused path still wins (35.5k vs 33.6k).
+            impl = "flash" if s >= 512 else "xla"
     if impl not in ("flash", "xla", "xla_chunked"):
         raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
     if cfg.context_parallel:
