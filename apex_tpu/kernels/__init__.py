@@ -13,7 +13,11 @@ from apex_tpu.kernels.softmax import (
     scaled_upper_triang_masked_softmax,
 )
 from apex_tpu.kernels.xentropy import softmax_cross_entropy
-from apex_tpu.kernels.flash_attention import flash_attention, mha
+from apex_tpu.kernels.flash_attention import (
+    flash_attention,
+    flash_attention_bsh,
+    mha,
+)
 from apex_tpu.kernels.flat_ops import (
     adagrad_flat,
     adam_flat,
@@ -32,6 +36,7 @@ __all__ = [
     "scaled_upper_triang_masked_softmax",
     "softmax_cross_entropy",
     "flash_attention",
+    "flash_attention_bsh",
     "mha",
     "adagrad_flat",
     "adam_flat",

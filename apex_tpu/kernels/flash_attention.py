@@ -20,6 +20,18 @@ per-seqlen templates, one online-softmax blockwise kernel:
 Supports causal masking and per-batch key-padding lengths (the capability
 behind fmha's var-seqlen batch packing). Softmax statistics are always
 fp32; matmuls run in the input dtype on the MXU with fp32 accumulation.
+
+Two data layouts share the block math:
+
+- ``flash_attention`` — head-major ``[b, heads, s, head_dim]`` (the
+  generic public API; any head_dim);
+- ``flash_attention_bsh`` — lane-packed ``[b, s, hidden]`` (the model
+  fast path): each grid cell owns a 128-lane group of ``128 // head_dim``
+  heads, so at head_dim < 128 nothing in HBM is lane-padded and the model
+  never transposes to head-major form. Implements the fused backward
+  only; ``APEX_TPU_FLASH_BWD=split`` routes it through the head-major
+  path so the override contract holds everywhere. Measured on the 355M
+  GPT bench this layout is +15% whole-step (docs/DESIGN.md).
 """
 
 from __future__ import annotations
@@ -64,11 +76,31 @@ def _col_ids(bq: int, bk: int, j):
 # forward
 # ---------------------------------------------------------------------------
 
+def _online_update(s, valid, m_prev, l_prev, acc, v):
+    """One online-softmax block update shared by both forward kernels:
+    fold masked scores ``s`` into running (max, sum, accumulator).
+    Returns (m_new, l_new, acc_new)."""
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    p = jnp.where(valid, p, 0.0)                       # kill all-masked rows
+    l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * corr + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
+
+
 def _fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, scale, causal, bq, bk, sk, sq):
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
+    # SMEM reads + program_id must stay out of pl.when bodies: a traced
+    # predicate becomes lax.cond in interpret mode, where program_id
+    # can't lower
+    blen = None if len_ref is None else len_ref[pl.program_id(0)]
 
     @pl.when(j == 0)
     def _init():
@@ -86,25 +118,11 @@ def _fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # (bq, bk)
-        col = _col_ids(bq, bk, j)
-        valid = col < sk
-        if len_ref is not None:
-            valid = valid & (col < len_ref[0, 0])
-        if causal:
-            valid = valid & (col <= _row_ids(bq, bk, i))
+        valid = _valid_cols(blen, i, j, causal=causal, bq=bq, bk=bk, sk=sk)
         s = jnp.where(valid, s, _NEG)
-
-        m_prev = m_ref[:, :1]                              # (bq, 1)
-        l_prev = l_ref[:, :1]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        corr = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        p = jnp.where(valid, p, 0.0)                       # kill all-masked rows
-        l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        m_new, l_new, acc = _online_update(
+            s, valid, m_ref[:, :1], l_ref[:, :1], acc_ref[:], v)
+        acc_ref[:] = acc
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
@@ -125,31 +143,45 @@ def _causal_skip(causal, i, j, bq, bk):
     return (j * bk < (i + 1) * bq) if causal else True
 
 
-def _bwd_p_ds(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _valid_cols(blen, i, j, *, causal, bq, bk, sk):
+    """The composed (padding ∧ length ∧ causal) column mask for block
+    (i, j) — the single source of masking truth for every kernel in this
+    module (head-major and lane-packed, forward and backward)."""
+    col = _col_ids(bq, bk, j)
+    valid = col < sk
+    if blen is not None:
+        valid = valid & (col < blen)
+    if causal:
+        valid = valid & (col <= _row_ids(bq, bk, i))
+    return valid
+
+
+def _p_ds(q, k, v, do, lse, delta, valid, *, scale):
+    """Shared backward block math on block values: recompute
+    P = exp(S - lse) under ``valid`` and the dS it induces. Every
+    backward kernel (both layouts) routes through here."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+    dp = jax.lax.dot_general(
+        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    return p, ds
+
+
+def _bwd_p_ds(blen, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
               i, j, *, scale, causal, bq, bk, sk):
-    """Shared backward block math: recompute P = exp(S - lse) with the
-    composed (padding ∧ length ∧ causal) mask, and dS. Every backward
-    kernel routes through here so the masking lives in one place."""
+    """Head-major backward block: read refs, apply the shared mask/math."""
     q = q_ref[0]
     k = k_ref[0]
     v = v_ref[0]
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0][:, :1]
     delta = delta_ref[0][:, :1]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale
-    col = _col_ids(bq, bk, j)
-    valid = col < sk
-    if len_ref is not None:
-        valid = valid & (col < len_ref[0, 0])
-    if causal:
-        valid = valid & (col <= _row_ids(bq, bk, i))
-    p = jnp.where(valid, jnp.exp(s - lse), 0.0)
-    dp = jax.lax.dot_general(
-        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale
+    valid = _valid_cols(blen, i, j, causal=causal, bq=bq, bk=bk, sk=sk)
+    p, ds = _p_ds(q, k, v, do, lse, delta, valid, scale=scale)
     return q, k, do, p, ds
 
 
@@ -158,6 +190,7 @@ def _dq_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
+    blen = None if len_ref is None else len_ref[pl.program_id(0)]
 
     @pl.when(j == 0)
     def _init():
@@ -168,7 +201,7 @@ def _dq_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(compute)
     def _block():
         _, k, _, _, ds = _bwd_p_ds(
-            len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            blen, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             i, j, scale=scale, causal=causal, bq=bq, bk=bk, sk=sk)
         acc_ref[:] += jax.lax.dot_general(
             ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
@@ -184,6 +217,7 @@ def _dkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     j = pl.program_id(1)   # k block
     i = pl.program_id(2)   # q block (innermost sweep)
     nq = pl.num_programs(2)
+    blen = None if len_ref is None else len_ref[pl.program_id(0)]
 
     @pl.when(i == 0)
     def _init():
@@ -195,7 +229,7 @@ def _dkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(compute)
     def _block():
         q, _, do, p, ds = _bwd_p_ds(
-            len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            blen, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             i, j, scale=scale, causal=causal, bq=bq, bk=bk, sk=sk)
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -223,6 +257,7 @@ def _dqkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     j = pl.program_id(1)   # k block (outer)
     i = pl.program_id(2)   # q block (inner)
     nq = pl.num_programs(2)
+    blen = None if len_ref is None else len_ref[pl.program_id(0)]
 
     @pl.when((j == 0) & (i == 0))
     def _init_dq():
@@ -239,7 +274,7 @@ def _dqkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(compute)
     def _block():
         q, k, do, p, ds = _bwd_p_ds(
-            len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            blen, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             i, j, scale=scale, causal=causal, bq=bq, bk=bk, sk=sk)
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -296,8 +331,10 @@ def _stat_spec(bq):
 
 
 def _len_spec():
-    return pl.BlockSpec((1, 1), lambda b, i, j: (b, 0),
-                        memory_space=pltpu.SMEM)
+    # whole lengths array in SMEM: per-block scalar specs fail Mosaic's
+    # tile-shape checks on real TPU (only exercised interpreted before);
+    # kernels index it with pl.program_id(0)
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
 def _run_fwd(q, k, v, lengths, scale, causal, block_q=None, block_k=None):
@@ -317,7 +354,7 @@ def _run_fwd(q, k, v, lengths, scale, causal, block_q=None, block_k=None):
     operands = [qp, kp, vp]
     if lengths is not None:
         in_specs = [_len_spec()] + in_specs
-        operands = [lengths.reshape(bh, 1).astype(jnp.int32)] + operands
+        operands = [lengths.reshape(bh).astype(jnp.int32)] + operands
         kernel = _fwd_kernel
     else:
         kernel = functools.partial(_drop_len, _fwd_kernel)
@@ -369,7 +406,7 @@ def _run_bwd(q, k, v, do, lse, delta, lengths, scale, causal,
     sspec = _stat_spec(bq)
     lens = None
     if lengths is not None:
-        lens = lengths.reshape(bh, 1).astype(jnp.int32)
+        lens = lengths.reshape(bh).astype(jnp.int32)
 
     # (b, j, i)-ordered spec family, shared by the fused single sweep and
     # the two-sweep fallback's dK/dV pass (both run k blocks outermost)
@@ -379,8 +416,7 @@ def _run_bwd(q, k, v, do, lse, delta, lengths, scale, causal,
                           memory_space=pltpu.VMEM)
     sspec2 = pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0),
                           memory_space=pltpu.VMEM)
-    lenspec2 = pl.BlockSpec((1, 1), lambda b, j, i: (b, 0),
-                            memory_space=pltpu.SMEM)
+    lenspec2 = _len_spec()
 
     mode = os.environ.get("APEX_TPU_FLASH_BWD", "auto")
     if mode not in ("auto", "fused", "split"):
@@ -554,3 +590,356 @@ def mha(q, k, v, *, causal=False, scale=None, kv_lengths=None):
         jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2),
         causal=causal, scale=scale, kv_lengths=kv_lengths)
     return jnp.swapaxes(out, 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# lane-packed [batch, seq, hidden] layout (model-native fast path)
+# ---------------------------------------------------------------------------
+#
+# The [b, h, s, d] kernels above force the model to transpose activations
+# into head-major form, and at head_dim < 128 every HBM tensor they touch
+# (q/k/v, out, dq/dk/dv) is laid out 2x padded (64 lanes in a 128-lane
+# tile); the lane-replicated stats buffers are worse. The packed variant
+# removes all of it: operands stay in the model's [b, s, hidden] layout
+# (hidden minormost — tile-exact), each grid cell owns one 128-lane GROUP
+# of ``128 // head_dim`` heads and lane-slices the sub-heads in VMEM, and
+# the softmax stats travel as [b*groups, G, seq] (seq on lanes, no
+# replication). Measured on the 355M bench this removes ~2 GB of pure
+# layout traffic per layer-step (see docs/DESIGN.md).
+
+def _group_geometry(hidden: int, num_heads: int):
+    """(head_dim, heads_per_group, n_groups) or None if ineligible."""
+    if hidden % num_heads:
+        return None
+    d = hidden // num_heads
+    if d > LANE or LANE % d or hidden % LANE:
+        return None
+    g = LANE // d
+    return d, g, hidden // LANE
+
+
+def _fwd_kernel_bsh(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                    acc_ref, m_ref, l_ref, *, scale, causal, bq, bk, sk,
+                    d, g, n_grp):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+    blen = None if len_ref is None else len_ref[pl.program_id(0) // n_grp]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    compute = _causal_skip(causal, i, j, bq, bk)
+
+    @pl.when(compute)
+    def _block():
+        valid = _valid_cols(blen, i, j, causal=causal, bq=bq, bk=bk, sk=sk)
+        for sub in range(g):
+            lanes = slice(sub * d, (sub + 1) * d)
+            q = q_ref[0][:, lanes]
+            k = k_ref[0][:, lanes]
+            v = v_ref[0][:, lanes]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale   # (bq, bk)
+            s = jnp.where(valid, s, _NEG)
+            m_new, l_new, acc = _online_update(
+                s, valid, m_ref[:, sub:sub + 1], l_ref[:, sub:sub + 1],
+                acc_ref[:, lanes], v)
+            acc_ref[:, lanes] = acc
+            m_ref[:, sub:sub + 1] = m_new
+            l_ref[:, sub:sub + 1] = l_new
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        for sub in range(g):
+            lanes = slice(sub * d, (sub + 1) * d)
+            l = l_ref[:, sub:sub + 1]
+            o_ref[0, :, lanes] = (
+                acc_ref[:, lanes] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+            lse = m_ref[:, sub:sub + 1] + jnp.log(jnp.maximum(l, 1e-30))
+            lse_ref[0, sub:sub + 1, :] = jnp.transpose(lse)   # (1, bq)
+
+
+def _dqkv_kernel_bsh(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                     delta_ref, dq_ref, dk_ref, dv_ref,
+                     dq_acc, dk_acc, dv_acc, *, scale, causal, bq, bk, sk,
+                     d, g, n_grp):
+    """Packed-layout fused backward — the ``_dqkv_kernel`` strategy (one
+    S/P recompute per (j, i) block yields dQ/dK/dV; dQ rides a
+    full-length VMEM scratch across the outer k sweep) applied per
+    lane-group sub-head."""
+    j = pl.program_id(1)   # k block (outer)
+    i = pl.program_id(2)   # q block (inner)
+    nq = pl.num_programs(2)
+
+    @pl.when((j == 0) & (i == 0))
+    def _init_dq():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    @pl.when(i == 0)
+    def _init_dkv():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    rows = pl.dslice(i * bq, bq)
+    blen = None if len_ref is None else len_ref[pl.program_id(0) // n_grp]
+    compute = _causal_skip(causal, i, j, bq, bk)
+
+    @pl.when(compute)
+    def _block():
+        valid = _valid_cols(blen, i, j, causal=causal, bq=bq, bk=bk, sk=sk)
+        for sub in range(g):
+            lanes = slice(sub * d, (sub + 1) * d)
+            q = q_ref[0][:, lanes]
+            k = k_ref[0][:, lanes]
+            v = v_ref[0][:, lanes]
+            do = do_ref[0][:, lanes].astype(jnp.float32)
+            lse = jnp.transpose(lse_ref[0][sub:sub + 1, :])    # (bq, 1)
+            delta = jnp.transpose(delta_ref[0][sub:sub + 1, :])
+            p, ds = _p_ds(q, k, v, do, lse, delta, valid, scale=scale)
+            dv_acc[:, lanes] += jax.lax.dot_general(
+                p, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)           # (bk, d)
+            dk_acc[:, lanes] += jax.lax.dot_general(
+                ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)           # (bk, d)
+            dq_acc[rows, lanes] += jax.lax.dot_general(
+                ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)           # (bq, d)
+
+    # dq out block (bg, i) is flushed on every visit (i innermost); the
+    # final (j = last) flush writes the complete dQ — see _dqkv_kernel
+    dq_ref[0] = dq_acc[rows].astype(dq_ref.dtype)
+
+    @pl.when(i == nq - 1)
+    def _finish_dkv():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _pad_seq(x, sp):
+    b, s, h = x.shape
+    if s == sp:
+        return x
+    return jnp.pad(x, ((0, 0), (0, sp - s), (0, 0)))
+
+
+def _div(a, n):
+    """Truncating div/rem for index maps (indices are non-negative;
+    Python ``//`` lowers to a floor-division select chain Pallas index
+    maps reject)."""
+    return lax.div(a, jnp.int32(n))
+
+
+def _rem(a, n):
+    return lax.rem(a, jnp.int32(n))
+
+
+def _bsh_specs(bq, bk, n_grp):
+    """Block specs over [b, s, hidden] operands and [b*n_grp, G, sq]
+    stats, grid (b*n_grp, nq, nk) — dim0 picks (batch, lane-group)."""
+    qspec = pl.BlockSpec(
+        (1, bq, LANE), lambda bg, i, j: (_div(bg, n_grp), i, _rem(bg, n_grp)),
+        memory_space=pltpu.VMEM)
+    kspec = pl.BlockSpec(
+        (1, bk, LANE), lambda bg, i, j: (_div(bg, n_grp), j, _rem(bg, n_grp)),
+        memory_space=pltpu.VMEM)
+    lenspec = _len_spec()
+    return qspec, kspec, lenspec
+
+
+def _run_fwd_bsh(q, k, v, lengths, scale, causal, d, g, n_grp,
+                 block_q=None, block_k=None):
+    b, sq, hidden = q.shape
+    sk = k.shape[1]
+    bq = _fit_block(block_q or _DEFAULT_BLOCK_Q, sq)
+    bk = _fit_block(block_k or _DEFAULT_BLOCK_K, sk)
+    sqp, skp = round_up(sq, bq), round_up(sk, bk)
+    qp = _pad_seq(q, sqp)
+    kp, vp = _pad_seq(k, skp), _pad_seq(v, skp)
+    qspec, kspec, lenspec = _bsh_specs(bq, bk, n_grp)
+    lse_spec = pl.BlockSpec((1, g, bq), lambda bg, i, j: (bg, 0, i),
+                            memory_space=pltpu.VMEM)
+    in_specs = [qspec, kspec, kspec]
+    operands = [qp, kp, vp]
+    if lengths is not None:
+        in_specs = [lenspec] + in_specs
+        operands = [lengths.reshape(b).astype(jnp.int32)] + operands
+        kernel = _fwd_kernel_bsh
+    else:
+        kernel = functools.partial(_drop_len, _fwd_kernel_bsh)
+    out, lse = pl.pallas_call(
+        functools.partial(kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, sk=sk, d=d, g=g, n_grp=n_grp),
+        grid=(b * n_grp, sqp // bq, skp // bk),
+        in_specs=in_specs,
+        out_specs=[qspec, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sqp, hidden), q.dtype),
+            jax.ShapeDtypeStruct((b * n_grp, g, sqp), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, LANE), jnp.float32),
+            pltpu.VMEM((bq, g), jnp.float32),
+            pltpu.VMEM((bq, g), jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(*operands)
+    return out[:, :sq], lse[:, :, :sq]
+
+
+def _run_bwd_bsh(q, k, v, do, lse, delta, lengths, scale, causal,
+                 d, g, n_grp, block_q=None, block_k=None):
+    b, sq, hidden = q.shape
+    sk = k.shape[1]
+    bq = _fit_block(block_q or _DEFAULT_BLOCK_Q_BWD, sq)
+    bk = _fit_block(block_k or _DEFAULT_BLOCK_K_BWD, sk)
+    sqp, skp = round_up(sq, bq), round_up(sk, bk)
+    qp, dop = _pad_seq(q, sqp), _pad_seq(do, sqp)
+    kp, vp = _pad_seq(k, skp), _pad_seq(v, skp)
+    lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, sqp - sq)))
+    deltap = jnp.pad(delta, ((0, 0), (0, 0), (0, sqp - sq)))
+
+    # (bg, j, i)-ordered specs: k blocks outer (dK/dV reduce in block
+    # scratch), q blocks inner (dQ rides the full-length scratch)
+    qspec2 = pl.BlockSpec(
+        (1, bq, LANE), lambda bg, j, i: (_div(bg, n_grp), i, _rem(bg, n_grp)),
+        memory_space=pltpu.VMEM)
+    kspec2 = pl.BlockSpec(
+        (1, bk, LANE), lambda bg, j, i: (_div(bg, n_grp), j, _rem(bg, n_grp)),
+        memory_space=pltpu.VMEM)
+    sspec2 = pl.BlockSpec((1, g, bq), lambda bg, j, i: (bg, 0, i),
+                          memory_space=pltpu.VMEM)
+    lenspec2 = _len_spec()
+    in_specs = [qspec2, kspec2, kspec2, qspec2, sspec2, sspec2]
+    operands = [qp, kp, vp, dop, lsep, deltap]
+    if lengths is not None:
+        in_specs = [lenspec2] + in_specs
+        operands = [lengths.reshape(b).astype(jnp.int32)] + operands
+        kernel = _dqkv_kernel_bsh
+    else:
+        kernel = functools.partial(_drop_len, _dqkv_kernel_bsh)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, sk=sk, d=d, g=g, n_grp=n_grp),
+        grid=(b * n_grp, skp // bk, sqp // bq),
+        in_specs=in_specs,
+        out_specs=[qspec2, kspec2, kspec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sqp, hidden), q.dtype),
+            jax.ShapeDtypeStruct((b, skp, hidden), k.dtype),
+            jax.ShapeDtypeStruct((b, skp, hidden), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((sqp, LANE), jnp.float32),
+            pltpu.VMEM((bk, LANE), jnp.float32),
+            pltpu.VMEM((bk, LANE), jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(*operands)
+    return dq[:, :sq], dk[:, :sk], dv[:, :sk]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_bsh(q, k, v, lengths, scale, causal, geom, block_q, block_k):
+    out, _ = _run_fwd_bsh(q, k, v, lengths, scale, causal, *geom,
+                          block_q=block_q, block_k=block_k)
+    return out
+
+
+def _flash_bsh_fwd(q, k, v, lengths, scale, causal, geom, block_q, block_k):
+    out, lse = _run_fwd_bsh(q, k, v, lengths, scale, causal, *geom,
+                            block_q=block_q, block_k=block_k)
+    # same residual names as the [b,h,s,d] path so remat policies
+    # (save_only_these_names) pin them identically
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    return out, (q, k, v, out, lse, lengths)
+
+
+def _flash_bsh_bwd(scale, causal, geom, block_q, block_k, res, do):
+    q, k, v, out, lse, lengths = res
+    d, g, n_grp = geom
+    b, sq, hidden = q.shape
+    # per-head delta = sum_d(out * do): [b, s, n_grp, g] → [b*n_grp, g, s]
+    prod = (out.astype(jnp.float32) * do.astype(jnp.float32)).reshape(
+        b, sq, n_grp * g, d).sum(axis=-1)
+    delta = jnp.transpose(prod.reshape(b, sq, n_grp, g), (0, 2, 3, 1))
+    delta = delta.reshape(b * n_grp, g, sq)
+    dq, dk, dv = _run_bwd_bsh(q, k, v, do, lse, delta, lengths, scale,
+                              causal, d, g, n_grp, block_q, block_k)
+    dlen = None
+    if lengths is not None:
+        import numpy as np
+
+        dlen = np.zeros(lengths.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dlen
+
+
+_flash_bsh.defvjp(_flash_bsh_fwd, _flash_bsh_bwd)
+
+
+def flash_attention_bsh(
+    q, k, v, *,
+    num_heads: int,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    kv_lengths: Optional[jnp.ndarray] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+):
+    """Blockwise attention over ``[batch, seq, hidden]`` inputs — the
+    layout-native fast path (no head-major transposes, no head_dim < 128
+    lane padding). ``hidden = num_heads * head_dim`` with heads laid out
+    contiguously (head-major lanes). Falls back to the [b, h, s, d]
+    kernel for geometries the lane-group packing can't express
+    (head_dim > 128 or not a power-of-two divisor of 128, hidden not a
+    multiple of 128) and for sequences whose fused-backward dQ scratch
+    exceeds VMEM budget.
+
+    Returns attention output of the same shape/dtype as ``q``.
+    """
+    if q.ndim != 3:
+        raise ValueError(f"expected [b, s, hidden], got {q.shape}")
+    b, sq, hidden = q.shape
+    sk = k.shape[1]
+    if causal and sq != sk:
+        raise ValueError("causal attention requires sq == sk")
+    if hidden % num_heads:
+        raise ValueError(
+            f"hidden={hidden} not divisible by num_heads={num_heads}")
+    geom = _group_geometry(hidden, num_heads)
+    d_head = hidden // num_heads
+    s = float(scale) if scale is not None else 1.0 / d_head ** 0.5
+    bq_eff = _fit_block(block_q or _DEFAULT_BLOCK_Q_BWD, sq)
+    sqp = round_up(sq, bq_eff)
+    mode = os.environ.get("APEX_TPU_FLASH_BWD", "auto")
+    if mode not in ("auto", "fused", "split"):
+        raise ValueError(
+            f"APEX_TPU_FLASH_BWD={mode!r}: expected auto, fused or split")
+    # the packed kernels implement only the fused single-sweep backward;
+    # an explicit =split override routes through the head-major path
+    # (where _run_bwd honours it), keeping the documented A/B contract
+    if (geom is None or mode == "split"
+            or sqp * LANE * 4 > _FUSED_DQ_VMEM_BYTES):
+        # reshape to head-major and use the generic path
+        def split(x):
+            return jnp.transpose(
+                x.reshape(x.shape[0], x.shape[1], num_heads, d_head),
+                (0, 2, 1, 3))
+        out = flash_attention(
+            split(q), split(k), split(v), causal=causal, scale=s,
+            kv_lengths=kv_lengths, block_q=block_q, block_k=block_k)
+        return jnp.transpose(out, (0, 2, 1, 3)).reshape(b, sq, hidden)
+    q, was16 = widen_f16(q)
+    k, _ = widen_f16(k)
+    v, _ = widen_f16(v)
+    lens = None
+    if kv_lengths is not None:
+        lens = jnp.asarray(kv_lengths, jnp.int32)
+    out = _flash_bsh(q, k, v, lens, s, causal, geom, block_q, block_k)
+    return out.astype(jnp.float16) if was16 else out

@@ -49,6 +49,7 @@ class BertConfig:
     # as GPT — docs/DESIGN.md "Performance engineering")
     remat_policy: Any = None
     attn_impl: str = "auto"   # auto → flash at seq ≥512 on TPU
+    attn_layout: str = "auto"  # auto → lane-packed flash; "bhsd" opts out
     ln_impl: str = "xla"      # measured winner in-model (docs/DESIGN.md)
     attn_score_dtype: str = "f32"
     scan_unroll: Any = 1
@@ -63,7 +64,8 @@ class BertConfig:
             layernorm_epsilon=self.layernorm_epsilon,
             init_std=self.init_std, axis=self.axis, causal=False,
             remat_policy=self.remat_policy, attn_impl=self.attn_impl,
-            ln_impl=self.ln_impl, attn_score_dtype=self.attn_score_dtype,
+            attn_layout=self.attn_layout, ln_impl=self.ln_impl,
+            attn_score_dtype=self.attn_score_dtype,
             scan_unroll=self.scan_unroll)
 
 

@@ -36,7 +36,7 @@ from jax.sharding import PartitionSpec as P
 
 import numpy as np
 
-from apex_tpu.kernels import flash_attention, layer_norm
+from apex_tpu.kernels import flash_attention, flash_attention_bsh, layer_norm
 from apex_tpu.kernels.blockwise_attention import blockwise_attention
 from apex_tpu.mesh.topology import AXIS_CP, AXIS_EP, AXIS_PP, AXIS_TP
 from apex_tpu.transformer import moe as moe_mod
@@ -109,6 +109,14 @@ class GPTConfig:
     #: and removes per-iteration overhead; compile time grows with the
     #: factor. True = fully unrolled.
     scan_unroll: Any = 1
+    #: Flash-path data layout. "auto" → the lane-packed [b, s, hidden]
+    #: kernel whenever the geometry allows (head_dim a power-of-two
+    #: divisor of 128, hidden a multiple of 128): operands stay in the
+    #: model layout, so the per-layer head-major transposes AND the 2x
+    #: lane padding of head_dim < 128 tensors (q/k/v, out, dq/dk/dv all
+    #: [.., 64]-minor before) disappear. "bhsd" forces the head-major
+    #: kernel (A/B + shapes the packed kernel can't express).
+    attn_layout: str = "auto"
     #: "pallas" → fused Pallas LN kernel (opaque to XLA fusion);
     #: "xla" → jnp LayerNorm that XLA fuses into neighbouring ops.
     #: Numerics identical (fp32 statistics either way). Default "xla":
@@ -328,8 +336,6 @@ def _attention(cfg: GPTConfig, p, h):
     d = cfg.head_dim
     heads_local = local3 // (3 * d)
     qkv = qkv.reshape(s, b, heads_local, 3, d)
-    # [b, heads_local, s, d] each
-    q, k, v = (jnp.transpose(qkv[:, :, :, i, :], (1, 2, 0, 3)) for i in range(3))
     impl = cfg.attn_impl
     if impl == "auto":
         from apex_tpu.kernels._utils import use_interpret
@@ -348,6 +354,27 @@ def _attention(cfg: GPTConfig, p, h):
             impl = "flash" if s >= 512 else "xla"
     if impl not in ("flash", "xla", "xla_chunked"):
         raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
+    if cfg.attn_layout not in ("auto", "bhsd"):
+        raise ValueError(f"unknown attn_layout {cfg.attn_layout!r}")
+    if (impl == "flash" and not cfg.context_parallel
+            and cfg.attn_layout == "auto"):
+        # layout-native fast path: q/k/v stay [b, s, hidden] (one
+        # transposing de-interleave of the fused-QKV projection, no
+        # head-major form, no head_dim<128 lane padding anywhere)
+        q, k, v = (
+            jnp.transpose(qkv[:, :, :, i, :], (1, 0, 2, 3)).reshape(
+                b, s, heads_local * d)
+            for i in range(3))
+        out = flash_attention_bsh(
+            q, k, v, num_heads=heads_local, causal=cfg.causal)
+        out = jnp.transpose(out, (1, 0, 2))  # [s, b, hidden_local]
+        return row_parallel_linear(
+            out, p["proj"]["kernel"], p["proj"]["bias"], axis=cfg.axis,
+            sequence_parallel=sp,
+        )
+    # [b, heads_local, s, d] each
+    q, k, v = (jnp.transpose(qkv[:, :, :, i, :], (1, 2, 0, 3))
+               for i in range(3))
     if cfg.context_parallel:
         out = ring_attention(q, k, v, axis=cfg.cp_axis, causal=cfg.causal)
     elif impl == "flash":

@@ -33,7 +33,8 @@ def main():
             vocab_size=50304, hidden_size=1024, num_layers=24, num_heads=16,
             seq_len=1024, remat=True, ce_chunk=512,
             compute_dtype=jnp.bfloat16,
-            # measured on v5e: Pallas flash (512x512 tiles) beats both XLA
+            # measured on v5e: Pallas flash (512x512 tiles, lane-packed
+            # [b, s, hidden] layout — attn_layout="auto") beats both XLA
             # attention variants once the whole step is jitted; XLA-fused
             # LN beats the opaque Pallas LN call inside the layer scan;
             # pinning qkv/fc1 projections AND the flash kernel's (out,

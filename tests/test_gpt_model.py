@@ -109,6 +109,23 @@ def test_remat_policies_match_full_remat(devices8, policy):
     np.testing.assert_allclose(ref, sel, rtol=1e-5)
 
 
+def test_packed_attn_layout_matches_bhsd(devices8):
+    """The lane-packed [b, s, hidden] flash path (hidden a multiple of
+    128 → eligible, the production-shape route) is the same model as the
+    head-major layout, including under pinned-residual remat — exercises
+    the packed custom_vjp and its packed-shape flash_out/flash_lse
+    residuals inside the scanned layer stack on the CPU backbone."""
+    kw = dict(hidden_size=128, num_heads=2, attn_impl="flash",
+              remat_policy="qkv_fc1_attn")
+    _, packed = _run(devices8, tp=1, sp=False, steps=2, **kw)
+    _, bhsd = _run(devices8, tp=1, sp=False, steps=2,
+                   attn_layout="bhsd", **kw)
+    np.testing.assert_allclose(packed, bhsd, rtol=1e-5)
+    _, full = _run(devices8, tp=1, sp=False, steps=2, hidden_size=128,
+                   num_heads=2, attn_impl="flash")
+    np.testing.assert_allclose(packed, full, rtol=1e-5)
+
+
 def test_attn_pinning_requires_flash(devices8):
     with pytest.raises(ValueError, match="flash"):
         _run(devices8, tp=2, sp=False, steps=1, remat_policy="fc1_attn")
