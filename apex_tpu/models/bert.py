@@ -48,7 +48,7 @@ class BertConfig:
     # perf knobs, forwarded to the core stack (same measured v5e guidance
     # as GPT — docs/DESIGN.md "Performance engineering")
     remat_policy: Any = None
-    attn_impl: str = "auto"   # auto → flash at seq ≥512 on TPU
+    attn_impl: str = "auto"   # auto → flash at seq ≥256 on TPU
     attn_layout: str = "auto"  # auto → lane-packed flash; "bhsd" opts out
     ln_impl: str = "xla"      # measured winner in-model (docs/DESIGN.md)
     attn_score_dtype: str = "f32"

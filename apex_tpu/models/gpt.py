@@ -95,8 +95,8 @@ class GPTConfig:
     #: kernel per chunk (single-pass lse, backward recomputes softmax
     #: from logits) — requires the vocab unsharded locally (tp == 1).
     ce_impl: str = "xla"
-    #: "flash" → Pallas blockwise kernel (fastest on TPU from seq 512 —
-    #: 2x+ over the XLA paths at 4k, docs/DESIGN.md); "xla" →
+    #: "flash" → Pallas blockwise kernel (fastest on TPU from seq 256 —
+    #: 2.5x+ over the XLA paths at 4k, docs/DESIGN.md); "xla" →
     #: materialised-scores attention (fastest at short seq and the only
     #: fast path off-TPU, where Pallas runs interpreted); "xla_chunked"
     #: → q-chunk scanned attention with flash's O(chunk·s) memory but
@@ -346,12 +346,20 @@ def _attention(cfg: GPTConfig, p, h):
             impl = "xla_chunked" if s >= 2048 else "xla"
         else:
             # measured on v5e end-to-end (docs/DESIGN.md): with the
-            # fused backward, flash beats materialised-scores XLA from
-            # seq 512 both causal (34.1k vs 28.5k tok/s) and
-            # bidirectional (BERT-large datapoint), and chunked-XLA by
-            # >2x at 4096; at 256 the scores are small enough that
-            # XLA's fused path still wins (35.5k vs 33.6k).
-            impl = "flash" if s >= 512 else "xla"
+            # lane-packed layout + fused backward, flash beats
+            # materialised-scores XLA from seq 256 (37.1k vs 35.6k
+            # tok/s; at 512+ the gap widens, 2.5x+ over chunked-XLA at
+            # 4096); only at 128 do the tiny scores keep XLA ahead
+            # (39.6k vs 35.8k). The 256 datapoint is packed-layout-only:
+            # geometries the packing can't express (and forced "bhsd")
+            # run the head-major kernel, which still loses to XLA at 256
+            # (33.6k vs 35.5k) — those keep the 512 crossover.
+            from apex_tpu.kernels.flash_attention import _group_geometry
+
+            packed_ok = (cfg.attn_layout == "auto" and not
+                         cfg.context_parallel and _group_geometry(
+                             heads_local * d, heads_local) is not None)
+            impl = "flash" if s >= (256 if packed_ok else 512) else "xla"
     if impl not in ("flash", "xla", "xla_chunked"):
         raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
     if cfg.attn_layout not in ("auto", "bhsd"):
