@@ -16,6 +16,8 @@ from apex_tpu.kernels.xentropy import softmax_cross_entropy
 from apex_tpu.kernels.flash_attention import (
     flash_attention,
     flash_attention_bsh,
+    flash_attention_with_lse,
+    flash_bsh_eligible,
     mha,
 )
 from apex_tpu.kernels.flat_ops import (
@@ -37,6 +39,8 @@ __all__ = [
     "softmax_cross_entropy",
     "flash_attention",
     "flash_attention_bsh",
+    "flash_attention_with_lse",
+    "flash_bsh_eligible",
     "mha",
     "adagrad_flat",
     "adam_flat",

@@ -543,6 +543,77 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_with_lse(q3, k3, v3, lengths, scale, causal, block_q, block_k):
+    return _run_fwd(q3, k3, v3, lengths, scale, causal, block_q, block_k)
+
+
+def _flash_with_lse_fwd(q3, k3, v3, lengths, scale, causal, block_q,
+                        block_k):
+    out, lse = _run_fwd(q3, k3, v3, lengths, scale, causal, block_q, block_k)
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    return (out, lse), (q3, k3, v3, out, lse, lengths)
+
+
+def _flash_with_lse_bwd(scale, causal, block_q, block_k, res, cts):
+    """Like ``_flash_bwd`` but the log-sum-exp is a live output with its
+    own cotangent. Since d(lse)/ds_j = p_j, the dlse term folds into the
+    existing kernel as ds_j = p_j (dp_j - (delta - dlse)) — the backward
+    kernels run unchanged on an adjusted delta."""
+    q3, k3, v3, out, lse, lengths = res
+    do, dlse = cts
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    delta = delta - dlse.astype(jnp.float32)
+    dq, dk, dv = _run_bwd(q3, k3, v3, do, lse, delta, lengths, scale, causal,
+                          block_q, block_k)
+    dlen = None
+    if lengths is not None:
+        import numpy as np
+
+        dlen = np.zeros(lengths.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dlen
+
+
+_flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
+
+
+def flash_attention_with_lse(
+    q, k, v, *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    kv_lengths: Optional[jnp.ndarray] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+):
+    """Like :func:`flash_attention` but also returns the per-row
+    log-sum-exp ``[b, heads, sq]`` (fp32) — the mergeable form blockwise/
+    ring consumers need: partials ``(out_i, lse_i)`` over disjoint K/V
+    shards combine exactly via softmax-weighted averaging on ``lse``.
+    Fully differentiable in both outputs (the lse cotangent rides the
+    same backward kernels)."""
+    if q.ndim != 4:
+        raise ValueError(f"expected [b, h, s, d], got {q.shape}")
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if causal and sq != sk:
+        raise ValueError("causal attention requires sq == sk")
+    s = float(scale) if scale is not None else 1.0 / d ** 0.5
+    q, was16 = widen_f16(q)
+    k, _ = widen_f16(k)
+    v, _ = widen_f16(v)
+    lens = None
+    if kv_lengths is not None:
+        lens = jnp.repeat(jnp.asarray(kv_lengths, jnp.int32), h)
+    out, lse = _flash_with_lse(
+        q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
+        v.reshape(b * h, sk, d), lens, s, causal, block_q, block_k)
+    out = out.reshape(b, h, sq, d)
+    lse = lse.reshape(b, h, sq)
+    return (out.astype(jnp.float16) if was16 else out), lse
+
+
 def flash_attention(
     q, k, v, *,
     causal: bool = False,
@@ -616,6 +687,29 @@ def _group_geometry(hidden: int, num_heads: int):
         return None
     g = LANE // d
     return d, g, hidden // LANE
+
+
+def _bwd_mode() -> str:
+    mode = os.environ.get("APEX_TPU_FLASH_BWD", "auto")
+    if mode not in ("auto", "fused", "split"):
+        raise ValueError(
+            f"APEX_TPU_FLASH_BWD={mode!r}: expected auto, fused or split")
+    return mode
+
+
+def flash_bsh_eligible(hidden: int, num_heads: int, seq: int,
+                       block_q: Optional[int] = None) -> bool:
+    """True iff ``flash_attention_bsh`` will actually run the lane-packed
+    kernels for this shape — the single source of truth for every
+    fallback condition (geometry, the fused-dQ VMEM budget, and an
+    explicit ``APEX_TPU_FLASH_BWD=split`` override). Model-level
+    dispatchers should consult this instead of re-deriving eligibility."""
+    if _group_geometry(hidden, num_heads) is None:
+        return False
+    if _bwd_mode() == "split":
+        return False
+    bq = _fit_block(block_q or _DEFAULT_BLOCK_Q_BWD, seq)
+    return round_up(seq, bq) * LANE * 4 <= _FUSED_DQ_VMEM_BYTES
 
 
 def _fwd_kernel_bsh(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -912,20 +1006,12 @@ def flash_attention_bsh(
     if hidden % num_heads:
         raise ValueError(
             f"hidden={hidden} not divisible by num_heads={num_heads}")
-    geom = _group_geometry(hidden, num_heads)
     d_head = hidden // num_heads
     s = float(scale) if scale is not None else 1.0 / d_head ** 0.5
-    bq_eff = _fit_block(block_q or _DEFAULT_BLOCK_Q_BWD, sq)
-    sqp = round_up(sq, bq_eff)
-    mode = os.environ.get("APEX_TPU_FLASH_BWD", "auto")
-    if mode not in ("auto", "fused", "split"):
-        raise ValueError(
-            f"APEX_TPU_FLASH_BWD={mode!r}: expected auto, fused or split")
     # the packed kernels implement only the fused single-sweep backward;
     # an explicit =split override routes through the head-major path
     # (where _run_bwd honours it), keeping the documented A/B contract
-    if (geom is None or mode == "split"
-            or sqp * LANE * 4 > _FUSED_DQ_VMEM_BYTES):
+    if not flash_bsh_eligible(hidden, num_heads, sq, block_q):
         # reshape to head-major and use the generic path
         def split(x):
             return jnp.transpose(
@@ -935,6 +1021,7 @@ def flash_attention_bsh(
             split(q), split(k), split(v), causal=causal, scale=s,
             kv_lengths=kv_lengths, block_q=block_q, block_k=block_k)
         return jnp.transpose(out, (0, 2, 1, 3)).reshape(b, sq, hidden)
+    geom = _group_geometry(hidden, num_heads)  # non-None: eligible above
     q, was16 = widen_f16(q)
     k, _ = widen_f16(k)
     v, _ = widen_f16(v)

@@ -351,14 +351,15 @@ def _attention(cfg: GPTConfig, p, h):
             # tok/s; at 512+ the gap widens, 2.5x+ over chunked-XLA at
             # 4096); only at 128 do the tiny scores keep XLA ahead
             # (39.6k vs 35.8k). The 256 datapoint is packed-layout-only:
-            # geometries the packing can't express (and forced "bhsd")
-            # run the head-major kernel, which still loses to XLA at 256
+            # shapes the packing won't take (and forced "bhsd") run the
+            # head-major kernel, which still loses to XLA at 256
             # (33.6k vs 35.5k) — those keep the 512 crossover.
-            from apex_tpu.kernels.flash_attention import _group_geometry
+            from apex_tpu.kernels import flash_bsh_eligible
 
-            packed_ok = (cfg.attn_layout == "auto" and not
-                         cfg.context_parallel and _group_geometry(
-                             heads_local * d, heads_local) is not None)
+            packed_ok = (cfg.attn_layout == "auto"
+                         and not cfg.context_parallel
+                         and flash_bsh_eligible(heads_local * d,
+                                                heads_local, s))
             impl = "flash" if s >= (256 if packed_ok else 512) else "xla"
     if impl not in ("flash", "xla", "xla_chunked"):
         raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")

@@ -7,11 +7,15 @@ apex/contrib/bottleneck (U)). Long context is first-class here, with both
 standard strategies over the ``cp`` mesh axis:
 
 - :func:`ring_attention` — K/V chunks rotate around the ICI ring
-  (``ppermute``); each rank folds one block per hop into flash-style
-  online-softmax state (fp32 running max / sum / accumulator). Exact: the
-  final normalisation equals attention over the full sequence. Backward is
-  the autodiff transpose — the ring rotates the other way. O(s_local²)
-  score blocks live only inside each (optionally rematted) hop.
+  (``ppermute``); each hop produces a normalised partial + log-sum-exp
+  and hops merge by softmax-weighting on the lse mass. Exact: the merged
+  result equals attention over the full sequence. Backward is the
+  autodiff transpose — the ring rotates the other way, and the lse
+  cotangent through the merge weights rides the kernel backward (the
+  delta adjustment in ``flash_attention_with_lse``). On TPU each hop IS
+  the Pallas flash kernel (O(s_local·d) live memory per hop); off-TPU a
+  materialised-scores XLA hop with fp32 running (max, sum, acc) state
+  keeps O(s_local²) blocks only inside each (optionally rematted) hop.
 - :func:`ulysses_attention` — ``all_to_all`` reshards [seq-sharded, all
   heads] ↔ [all seq, head-sharded], runs full-sequence attention for the
   local heads (the Pallas flash kernel by default on TPU, chunked-XLA
@@ -35,7 +39,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from apex_tpu.kernels import blockwise_attention, flash_attention
+from apex_tpu.kernels import (
+    blockwise_attention,
+    flash_attention,
+    flash_attention_with_lse,
+)
 from apex_tpu.mesh.collectives import all_to_all, ppermute_shift
 from apex_tpu.mesh.topology import AXIS_CP
 
@@ -77,12 +85,35 @@ def _merge(state, part):
     return m, l0 * w0 + l1 * w1, a0 * w0[..., None] + a1 * w1[..., None]
 
 
+def _flash_hop(q, k, v, sc, causal_diag):
+    """One ring hop through the Pallas blockwise kernel: normalised
+    partial + its log-sum-exp — O(s_local·d) live memory instead of the
+    einsum hop's O(s_local²) score block, and the kernel's speed."""
+    out, lse = flash_attention_with_lse(q, k, v, causal=causal_diag,
+                                        scale=sc)
+    return out.astype(jnp.float32), lse
+
+
+def _merge_lse(s1, s2):
+    """Exact combine of two normalised partials over disjoint K/V shards:
+    softmax-weighted average on the lse mass."""
+    o1, l1 = s1
+    o2, l2 = s2
+    m = jnp.maximum(l1, l2)
+    w1 = jnp.exp(l1 - m)
+    w2 = jnp.exp(l2 - m)
+    denom = w1 + w2
+    o = (o1 * w1[..., None] + o2 * w2[..., None]) / denom[..., None]
+    return o, m + jnp.log(denom)
+
+
 def ring_attention(
     q, k, v, *,
     axis: str = AXIS_CP,
     causal: bool = False,
     scale: Optional[float] = None,
     remat: bool = True,
+    impl: str = "auto",
 ):
     """Exact attention with K/V ring-rotating over ``axis``.
 
@@ -90,6 +121,13 @@ def ring_attention(
     sharded contiguously over the cp axis (rank r holds positions
     ``[r*s_local, (r+1)*s_local)``). Returns the local output chunk in
     q's dtype. Call inside shard_map.
+
+    ``impl``: "flash" — each hop runs the Pallas blockwise kernel and
+    hops merge on (out, lse) (O(s_local·d) memory per hop; the TPU
+    default); "xla" — materialised per-hop score blocks (the off-TPU
+    default, where Pallas runs interpreted); "auto" picks by backend.
+    Fully-masked ring-causal hops are folded out via lse = -inf, so both
+    impls compute identical results.
     """
     if q.ndim != 4:
         raise ValueError(f"expected [b, h, s_local, d], got {q.shape}")
@@ -97,6 +135,31 @@ def ring_attention(
     rank = lax.axis_index(axis)
     d = q.shape[-1]
     sc = float(scale) if scale is not None else 1.0 / d ** 0.5
+    if impl == "auto":
+        from apex_tpu.kernels._utils import use_interpret
+
+        impl = "xla" if use_interpret() else "flash"
+    if impl not in ("flash", "xla"):
+        raise ValueError(f"unknown impl {impl!r}")
+
+    if impl == "flash":
+        hop = _flash_hop
+        if remat:
+            # scale is a kernel compile-time parameter — keep it static
+            hop = jax.checkpoint(_flash_hop, static_argnums=(3, 4))
+        state = hop(q, k, v, sc, causal)
+        kv = (k, v)
+        for step in range(1, cp):
+            kv = jax.tree.map(
+                functools.partial(ppermute_shift, axis=axis, shift=1,
+                                  wrap=True), kv)
+            out, lse = hop(q, kv[0], kv[1], sc, False)
+            if causal:
+                # K/V block came from rank (rank - step) mod cp; a later
+                # chunk contributes nothing — zero its mass via lse
+                lse = jnp.where(rank >= step, lse, _NEG)
+            state = _merge_lse(state, (out, lse))
+        return state[0].astype(q.dtype)
 
     block = _block_attn
     if remat:

@@ -42,7 +42,8 @@ def _ref(q, k, v, causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("impl", ["ring", "ulysses", "ulysses_flash"])
+@pytest.mark.parametrize(
+    "impl", ["ring", "ring_flash", "ulysses", "ulysses_flash"])
 def test_cp_attention_matches_full(devices8, causal, impl):
     mesh = mx.build_mesh(cp=4, devices=devices8[:4])
     q, k, v = _qkv(jax.random.PRNGKey(0))
@@ -51,6 +52,11 @@ def test_cp_attention_matches_full(devices8, causal, impl):
     if impl == "ring":
         def local(q, k, v):
             return ring_attention(q, k, v, causal=causal)
+    elif impl == "ring_flash":
+        # the TPU-default per-hop kernel path with (out, lse) hop merge —
+        # including the lse cotangent through the merge weights
+        def local(q, k, v):
+            return ring_attention(q, k, v, causal=causal, impl="flash")
     elif impl == "ulysses":
         def local(q, k, v):
             return ulysses_attention(q, k, v, causal=causal)

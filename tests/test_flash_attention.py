@@ -348,3 +348,76 @@ def test_flash_bsh_matches_bhsd_kernel():
     out2 = jnp.transpose(out2, (0, 2, 1, 3)).reshape(b, s, hid)
     np.testing.assert_allclose(np.asarray(out), np.asarray(out2),
                                rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# (out, lse) variant — the mergeable form ring attention consumes
+# ---------------------------------------------------------------------------
+
+def _ref_with_lse(q, k, v, causal=False):
+    d = q.shape[-1]
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(
+        jnp.float32) / d ** 0.5
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        logits = jnp.where(jnp.tril(jnp.ones((sq, sk), bool)), logits, -1e30)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    p = jnp.exp(logits - lse[..., None])
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v), lse
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_with_lse_values_and_grads(causal):
+    """out and lse match the reference, and a loss consuming BOTH outputs
+    differentiates correctly — the dlse cotangent folds into the backward
+    kernels via the delta adjustment."""
+    from apex_tpu.kernels.flash_attention import flash_attention_with_lse
+
+    b, h, s, d = 2, 2, 24, 16
+    ks = jax.random.split(jax.random.PRNGKey(20), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d))
+    k = jax.random.normal(ks[1], (b, h, s, d))
+    v = jax.random.normal(ks[2], (b, h, s, d))
+
+    out, lse = flash_attention_with_lse(q, k, v, causal=causal)
+    ro, rl = _ref_with_lse(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ro),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(rl),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss(f):
+        def g(q, k, v):
+            o, l = f(q, k, v)
+            return jnp.sum(jnp.sin(o)) + jnp.sum(jnp.cos(l))
+        return g
+
+    gf = jax.grad(loss(lambda q, k, v: flash_attention_with_lse(
+        q, k, v, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(lambda q, k, v: _ref_with_lse(
+        q, k, v, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_flash_with_lse_split_merge_identity():
+    """Partials over two disjoint K/V halves, softmax-merged on lse,
+    reconstruct full attention exactly (the ring-hop algebra)."""
+    from apex_tpu.kernels.flash_attention import flash_attention_with_lse
+
+    b, h, s, d = 1, 2, 32, 16
+    ks = jax.random.split(jax.random.PRNGKey(21), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d))
+    k = jax.random.normal(ks[1], (b, h, s, d))
+    v = jax.random.normal(ks[2], (b, h, s, d))
+
+    full, _ = flash_attention_with_lse(q, k, v)
+    o1, l1 = flash_attention_with_lse(q, k[:, :, :16], v[:, :, :16])
+    o2, l2 = flash_attention_with_lse(q, k[:, :, 16:], v[:, :, 16:])
+    m = jnp.maximum(l1, l2)
+    w1, w2 = jnp.exp(l1 - m), jnp.exp(l2 - m)
+    merged = (o1 * w1[..., None] + o2 * w2[..., None]) / (
+        w1 + w2)[..., None]
+    np.testing.assert_allclose(np.asarray(merged), np.asarray(full),
+                               rtol=2e-5, atol=2e-5)
