@@ -324,8 +324,11 @@ def seq_partial_grad_mask(cfg: GPTConfig) -> Any:
 # forward (local-shard semantics — inside shard_map over cfg.axis)
 # ---------------------------------------------------------------------------
 
-def _attention(cfg: GPTConfig, p, h):
-    """h: [s(_local under SP), b, hidden] → same shape."""
+def _attention(cfg: GPTConfig, p, h, *, return_kv: bool = False):
+    """h: [s(_local under SP), b, hidden] → same shape. With
+    ``return_kv`` also returns the per-head (k, v) ``[b, heads_local, s,
+    head_dim]`` — the cache entries bulk prefill captures — so the
+    projection/layout logic stays single-sourced."""
     sp = cfg.sequence_parallel
     qkv = column_parallel_linear(
         h, p["qkv"]["kernel"], p["qkv"]["bias"], axis=cfg.axis,
@@ -336,6 +339,23 @@ def _attention(cfg: GPTConfig, p, h):
     d = cfg.head_dim
     heads_local = local3 // (3 * d)
     qkv = qkv.reshape(s, b, heads_local, 3, d)
+    out = _attention_ctx(cfg, qkv)
+    proj = row_parallel_linear(
+        out, p["proj"]["kernel"], p["proj"]["bias"], axis=cfg.axis,
+        sequence_parallel=sp,
+    )
+    if return_kv:
+        k = jnp.transpose(qkv[:, :, :, 1, :], (1, 2, 0, 3))
+        v = jnp.transpose(qkv[:, :, :, 2, :], (1, 2, 0, 3))
+        return proj, (k, v)
+    return proj
+
+
+def _attention_ctx(cfg: GPTConfig, qkv):
+    """Core attention from the reshaped fused-QKV ``[s, b, heads_local,
+    3, head_dim]`` to the pre-projection context ``[s, b, hidden_local]``
+    — the impl/layout dispatch shared by training and bulk prefill."""
+    s, b, heads_local, _, d = qkv.shape
     impl = cfg.attn_impl
     if impl == "auto":
         from apex_tpu.kernels._utils import use_interpret
@@ -376,11 +396,7 @@ def _attention(cfg: GPTConfig, p, h):
             for i in range(3))
         out = flash_attention_bsh(
             q, k, v, num_heads=heads_local, causal=cfg.causal)
-        out = jnp.transpose(out, (1, 0, 2))  # [s, b, hidden_local]
-        return row_parallel_linear(
-            out, p["proj"]["kernel"], p["proj"]["bias"], axis=cfg.axis,
-            sequence_parallel=sp,
-        )
+        return jnp.transpose(out, (1, 0, 2))  # [s, b, hidden_local]
     # [b, heads_local, s, d] each
     q, k, v = (jnp.transpose(qkv[:, :, :, i, :], (1, 2, 0, 3))
                for i in range(3))
@@ -419,11 +435,7 @@ def _attention(cfg: GPTConfig, p, h):
                 f"unknown attn_score_dtype {cfg.attn_score_dtype!r} "
                 "(expected 'f32' or 'compute')")
         out = jnp.einsum("bhqk,bhkd->bhqd", p_attn, v)
-    out = jnp.transpose(out, (2, 0, 1, 3)).reshape(s, b, heads_local * d)
-    return row_parallel_linear(
-        out, p["proj"]["kernel"], p["proj"]["bias"], axis=cfg.axis,
-        sequence_parallel=sp,
-    )
+    return jnp.transpose(out, (2, 0, 1, 3)).reshape(s, b, heads_local * d)
 
 
 def _mlp(cfg: GPTConfig, p, h):
@@ -464,11 +476,16 @@ def _moe_cfg(cfg: GPTConfig) -> moe_mod.MoEConfig:
         dispatch=cfg.moe_dispatch)
 
 
-def _block(cfg: GPTConfig, p, h):
+def _block(cfg: GPTConfig, p, h, *, return_kv: bool = False):
     """One transformer layer; returns ``(h, aux)`` — aux is the MoE
-    load-balance term, 0 for the dense MLP."""
+    load-balance term, 0 for the dense MLP — plus the attention (k, v)
+    when ``return_kv`` (bulk prefill's cache capture)."""
     x = _layer_norm(cfg, h, p["ln1"]["scale"], p["ln1"]["bias"])
-    h = h + _attention(cfg, p["attn"], x)
+    attn = _attention(cfg, p["attn"], x, return_kv=return_kv)
+    kv = None
+    if return_kv:
+        attn, kv = attn
+    h = h + attn
     x = _layer_norm(cfg, h, p["ln2"]["scale"], p["ln2"]["bias"])
     if cfg.num_experts:
         if cfg.sequence_parallel:
@@ -479,8 +496,12 @@ def _block(cfg: GPTConfig, p, h):
         s, b, hd = x.shape
         y, aux = moe_mod.moe_ffn(
             _moe_cfg(cfg), p["moe"], x.reshape(s * b, hd))
-        return h + y.reshape(s, b, hd), aux
-    return h + _mlp(cfg, p["mlp"], x), jnp.float32(0.0)
+        h = h + y.reshape(s, b, hd)
+    else:
+        h, aux = h + _mlp(cfg, p["mlp"], x), jnp.float32(0.0)
+    if return_kv:
+        return h, aux, kv
+    return h, aux
 
 
 def _cp_slice(cfg: GPTConfig, x, dim: int):
@@ -892,6 +913,51 @@ def decode_step(cfg: GPTConfig, params, cache, token, pos):
     return lg.astype(jnp.float32), new_cache
 
 
+def prefill(cfg: GPTConfig, params, prompt, *, max_len: Optional[int] = None):
+    """Bulk prompt ingestion: ONE forward over ``prompt [b, p_len]``
+    (the training-path attention — packed flash/XLA by ``attn_impl``)
+    fills the KV cache and returns ``(cache, logits)`` where ``logits``
+    ``[b, vocab]`` (fp32) predict position ``p_len``. Replaces p_len
+    sequential decode steps; decoding then starts at position ``p_len``.
+
+    Local semantics (call inside ``shard_map``). SP is stripped like
+    :func:`decode_step`; ``max_len`` sizes the cache (default
+    ``cfg.seq_len``).
+    """
+    if not cfg.causal:
+        raise ValueError(
+            "decoding is autoregressive; causal=False has no "
+            "incremental-decode semantics")
+    # decode is sequence-dim-local: strip both sequence shardings (the
+    # params are replicated over cp, so the stripped forward is exact —
+    # matching decode_step, which is likewise cp-oblivious)
+    if cfg.sequence_parallel or cfg.context_parallel:
+        cfg = dataclasses.replace(
+            cfg, sequence_parallel=False, context_parallel=False)
+    b, p_len = prompt.shape
+    max_len = max_len or cfg.seq_len
+    if p_len > max_len:
+        raise ValueError(f"prompt {p_len} exceeds cache max_len {max_len}")
+    h = _embed(cfg, params, prompt.astype(jnp.int32))
+
+    def body(carry, layer_p):
+        hh, _, kv = _block(cfg, _cast_layer(cfg, layer_p), carry,
+                           return_kv=True)
+        return hh, kv
+
+    h, (ks, vs) = lax.scan(body, h, params["layers"])
+    # ks/vs [l_local, b, heads_local, p_len, d] → cache [l, 2, b, hl, S, d]
+    pad = ((0, 0),) * 3 + ((0, max_len - p_len), (0, 0))
+    cache = jnp.stack([jnp.pad(ks, pad), jnp.pad(vs, pad)], axis=1)
+    h_last = _layer_norm(cfg, h[-1], params["final_ln"]["scale"],
+                         params["final_ln"]["bias"])
+    h_last = copy_to_tensor_model_parallel_region(h_last, cfg.axis)
+    table = params["embedding"]["word"]["table"].astype(cfg.compute_dtype)
+    lg = jnp.einsum("bh,vh->bv", h_last, table)
+    lg = gather_from_tensor_model_parallel_region(lg, cfg.axis)
+    return cache, lg.astype(jnp.float32)
+
+
 def generate(cfg: GPTConfig, params, prompt, n_new: int,
              *, temperature: float = 0.0, key=None):
     """Continuation: ``prompt [b, p_len] int32`` → ``[b, n_new]``.
@@ -902,9 +968,10 @@ def generate(cfg: GPTConfig, params, prompt, n_new: int,
     which holds because the gathered logits and the key are replicated).
 
     Local semantics (call inside ``shard_map``; composes with tp and,
-    via generous ``moe_capacity_factor``, MoE). One compiled
-    ``lax.scan`` over positions — prompt prefill and generation share
-    the per-token decode path.
+    via generous ``moe_capacity_factor``, MoE). The prompt is ingested
+    in ONE bulk forward (:func:`prefill` — the training-path attention,
+    p_len times fewer dispatches than per-token prefill); generation is
+    one compiled ``lax.scan`` over the remaining positions.
     """
     if temperature > 0.0 and key is None:
         raise ValueError("temperature > 0 needs a PRNG key")
@@ -919,26 +986,30 @@ def generate(cfg: GPTConfig, params, prompt, n_new: int,
         raise ValueError(
             "decoding is autoregressive; causal=False has no "
             "incremental-decode semantics")
-    if cfg.sequence_parallel:
-        cfg = dataclasses.replace(cfg, sequence_parallel=False)
-    cache0 = init_cache(cfg, params, b, max_len=total)
-    padded = jnp.pad(prompt.astype(jnp.int32), ((0, 0), (0, n_new)))
+    if cfg.sequence_parallel or cfg.context_parallel:
+        cfg = dataclasses.replace(
+            cfg, sequence_parallel=False, context_parallel=False)
+    if n_new < 1:
+        return jnp.zeros((b, 0), jnp.int32)
+
+    def draw(logits, t):
+        if temperature > 0.0:
+            return jax.random.categorical(
+                jax.random.fold_in(key, t), logits / temperature, axis=-1
+            ).astype(jnp.int32)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    cache0, logits0 = prefill(cfg, params, prompt, max_len=total)
+    first = draw(logits0, p_len - 1)
 
     def step(carry, t):
         tok_in, cache = carry
         logits, cache = decode_step(cfg, params, cache, tok_in, t)
-        if temperature > 0.0:
-            nxt = jax.random.categorical(
-                jax.random.fold_in(key, t), logits / temperature, axis=-1
-            ).astype(jnp.int32)
-        else:
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        # feed the prompt while it lasts, then the model's own output
-        feed = jnp.where(t + 1 < p_len, padded[:, jnp.minimum(t + 1, total - 1)], nxt)
-        return (feed, cache), nxt
+        nxt = draw(logits, t)
+        return (nxt, cache), nxt
 
     (_, _), outs = lax.scan(
-        step, (padded[:, 0], cache0), jnp.arange(total - 1, dtype=jnp.int32))
-    # outs[t] is the prediction for position t+1: generations start at the
-    # prediction made from the last prompt token
-    return jnp.transpose(outs[p_len - 1:], (1, 0))
+        step, (first, cache0),
+        jnp.arange(p_len, total - 1, dtype=jnp.int32))
+    outs = jnp.concatenate([first[None], outs], axis=0)
+    return jnp.transpose(outs, (1, 0))

@@ -119,3 +119,79 @@ def test_generate_rejects_bidirectional(devices8):
     params = gpt.init(cfg, jax.random.PRNGKey(0))
     with pytest.raises(ValueError, match="autoregressive"):
         gpt.generate(cfg, params, jnp.zeros((1, 4), jnp.int32), 2)
+
+
+def test_prefill_logits_match_full_forward(devices8):
+    """Bulk prefill's last-position logits equal the training forward's —
+    and its cache continues decoding identically to the from-scratch
+    per-token path (covered transitively by the teacher-forced oracle)."""
+    cfg = standalone_gpt_config()
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    pspecs = gpt.param_specs(cfg)
+    params = jax.jit(jax.shard_map(
+        lambda k: gpt.init(cfg, k), mesh=mesh, in_specs=P(),
+        out_specs=pspecs, check_vma=False))(jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 7), 0,
+                                cfg.vocab_size)
+
+    _, pre_lg = jax.jit(jax.shard_map(
+        lambda p, t: gpt.prefill(cfg, p, t, max_len=8), mesh=mesh,
+        in_specs=(pspecs, P(None, None)),
+        out_specs=(P(), P(None, None)), check_vma=False))(params, prompt)
+    full_lg = jax.jit(jax.shard_map(
+        lambda p, t: gpt.logits(cfg, p, t), mesh=mesh,
+        in_specs=(pspecs, P(None, None)),
+        out_specs=P(None, None, "tp"), check_vma=False))(params, prompt)
+    np.testing.assert_allclose(
+        np.asarray(pre_lg), np.asarray(full_lg[-1], np.float32),
+        rtol=2e-5, atol=2e-5)
+
+
+def test_generate_single_new_token(devices8):
+    """n_new=1 is pure prefill (empty decode scan)."""
+    cfg = standalone_gpt_config()
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    pspecs = gpt.param_specs(cfg)
+    params = jax.jit(jax.shard_map(
+        lambda k: gpt.init(cfg, k), mesh=mesh, in_specs=P(),
+        out_specs=pspecs, check_vma=False))(jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 6), 0,
+                                cfg.vocab_size)
+    out = jax.jit(jax.shard_map(
+        lambda p, t: gpt.generate(cfg, p, t, 1), mesh=mesh,
+        in_specs=(pspecs, P(None, None)), out_specs=P(None, None),
+        check_vma=False))(params, prompt)
+    lg = jax.jit(jax.shard_map(
+        lambda p, t: gpt.logits(cfg, p, t), mesh=mesh,
+        in_specs=(pspecs, P(None, None)),
+        out_specs=P(None, None, "tp"), check_vma=False))(params, prompt)
+    exp = jnp.argmax(lg[-1].astype(jnp.float32), -1).astype(jnp.int32)
+    np.testing.assert_array_equal(np.asarray(out[:, 0]), np.asarray(exp))
+
+
+def test_generate_under_cp_config(devices8):
+    """A cp training config reused for generation: prefill/decode strip
+    the sequence shardings (params are cp-replicated, so the stripped
+    forward is exact) — output must equal the cp-free reference."""
+    import dataclasses
+
+    cfg = standalone_gpt_config()
+    cfg_cp = dataclasses.replace(cfg, context_parallel=True)
+    pspecs = gpt.param_specs(cfg)
+    mesh1 = mx.build_mesh(tp=1, devices=devices8[:1])
+    params = jax.jit(jax.shard_map(
+        lambda k: gpt.init(cfg, k), mesh=mesh1, in_specs=P(),
+        out_specs=pspecs, check_vma=False))(jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 4), 0,
+                                cfg.vocab_size)
+    ref = _generate(cfg, params, prompt, mesh1)
+
+    mesh_cp = mx.build_mesh(cp=2, devices=devices8[:2])
+    params_cp = jax.jit(jax.shard_map(
+        lambda k: gpt.init(cfg, k), mesh=mesh_cp, in_specs=P(),
+        out_specs=pspecs, check_vma=False))(jax.random.PRNGKey(0))
+    out = jax.jit(jax.shard_map(
+        lambda p, t: gpt.generate(cfg_cp, p, t, N_NEW), mesh=mesh_cp,
+        in_specs=(pspecs, P(None, None)), out_specs=P(None, None),
+        check_vma=False))(params_cp, prompt)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
