@@ -122,3 +122,48 @@ def test_cp_with_sp_rejected():
     import pytest
     with pytest.raises(ValueError, match="sequence"):
         step_fn(state, tok, tgt)
+
+
+def test_cp_zigzag_loss_matches_unsharded():
+    """cp_zigzag: the balanced chunk assignment is a permutation of the
+    sequence — the (token-mean) loss equals the unsharded model's."""
+    cfg0 = gpt.GPTConfig(**CFG)
+    cfg_z = gpt.GPTConfig(context_parallel=True, cp_zigzag=True, **CFG)
+    params = jax.jit(lambda k: gpt.init(cfg0, k))(jax.random.PRNGKey(0))
+    tok, tgt = _data()
+    pspec = gpt.param_specs(cfg0)
+
+    mesh1 = mx.build_mesh(tp=1, devices=jax.devices()[:1])
+    base = jax.jit(jax.shard_map(
+        lambda p: gpt.loss(cfg0, p, tok, tgt), mesh=mesh1,
+        in_specs=(pspec,), out_specs=P(), check_vma=False))(params)
+
+    mesh = mx.build_mesh(tp=1, cp=4, dp=1, devices=jax.devices()[:4])
+    z_loss = jax.jit(jax.shard_map(
+        lambda p: jax.lax.pmean(gpt.loss(cfg_z, p, tok, tgt), "cp"),
+        mesh=mesh, in_specs=(pspec,), out_specs=P(), check_vma=False))(
+            params)
+    np.testing.assert_allclose(float(z_loss), float(base), rtol=2e-5)
+
+
+def test_cp_zigzag_train_step_matches_contiguous():
+    """One full train step under zigzag == contiguous cp (same params,
+    same data): gradients are permutation-invariant."""
+    from apex_tpu.optimizers import fused_sgd
+
+    tok, tgt = _data()
+    outs = {}
+    for name, zig in (("contig", False), ("zigzag", True)):
+        cfg = gpt.GPTConfig(context_parallel=True, cp_zigzag=zig, **CFG)
+        mesh = mx.build_mesh(tp=1, cp=4, dp=1, devices=jax.devices()[:4])
+        init_fn, step_fn = training.make_train_step(
+            cfg, mesh, fused_sgd(0.1), ScalerConfig(enabled=False))
+        state = init_fn(jax.random.PRNGKey(0))
+        state, m = step_fn(state, tok, tgt)
+        outs[name] = (float(m["loss"]), jax.device_get(state.params))
+    np.testing.assert_allclose(outs["contig"][0], outs["zigzag"][0],
+                               rtol=2e-5)
+    for a, b in zip(jax.tree.leaves(outs["contig"][1]),
+                    jax.tree.leaves(outs["zigzag"][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
