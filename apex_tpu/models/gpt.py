@@ -931,12 +931,7 @@ def decode_step(cfg: GPTConfig, params, cache, token, pos):
         return y, kv
 
     x, new_cache = lax.scan(body, x, (params["layers"], cache))
-    h = _layer_norm(cfg, x, params["final_ln"]["scale"],
-                    params["final_ln"]["bias"])
-    h = copy_to_tensor_model_parallel_region(h, cfg.axis)
-    lg = jnp.einsum("bh,vh->bv", h, table)  # tied head, vocab-sharded
-    lg = gather_from_tensor_model_parallel_region(lg, cfg.axis)
-    return lg.astype(jnp.float32), new_cache
+    return _lm_head(cfg, params, x), new_cache
 
 
 def prefill(cfg: GPTConfig, params, prompt, *, max_len: Optional[int] = None):

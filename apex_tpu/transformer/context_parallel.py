@@ -309,3 +309,46 @@ def ulysses_attention(
     attn = flash_attention if impl == "flash" else blockwise_attention
     out = attn(fwd(q), fwd(k), fwd(v), causal=causal, scale=scale)
     return rev(out)
+
+
+def ulysses_attention_bsh(
+    q, k, v, *,
+    num_heads: int,
+    axis: str = AXIS_CP,
+    causal: bool = False,
+    scale: Optional[float] = None,
+):
+    """Ulysses in the lane-packed model layout: ``q/k/v [b, s_local,
+    hidden]`` (seq sharded over ``axis``, head-major lanes). The
+    all-to-alls move whole 128-lane head GROUPS instead of head-major
+    tensors, so — like :func:`apex_tpu.kernels.flash_attention_bsh`,
+    which runs the local attention — nothing is ever transposed to
+    ``[b, h, s, d]`` form or lane-padded. ``num_heads`` must divide by
+    the axis size with the per-rank lane group staying a multiple of
+    128 for the packed kernel (smaller groups fall back head-major
+    inside the kernel wrapper, still correct)."""
+    cp = lax.axis_size(axis)
+    b, s_local, hidden = q.shape
+    if num_heads % cp:
+        raise ValueError(
+            f"num heads {num_heads} must divide by cp={cp} for Ulysses")
+    if hidden % cp:
+        raise ValueError(f"hidden {hidden} must divide by cp={cp}")
+    hl = hidden // cp
+
+    def fwd(x):  # [b, s_local, hidden] -> [b, s, hidden/cp]
+        x = x.reshape(b, s_local, cp, hl)
+        x = all_to_all(x, axis, split_axis=2, concat_axis=1)
+        return x.reshape(b, s_local * cp, hl)
+
+    def rev(x):  # [b, s, hidden/cp] -> [b, s_local, hidden]
+        x = x.reshape(b, cp, s_local, hl)
+        x = all_to_all(x, axis, split_axis=1, concat_axis=3)
+        return x.reshape(b, s_local, hidden)
+
+    from apex_tpu.kernels import flash_attention_bsh
+
+    out = flash_attention_bsh(
+        fwd(q), fwd(k), fwd(v), num_heads=num_heads // cp,
+        causal=causal, scale=scale)
+    return rev(out)

@@ -43,7 +43,8 @@ def _ref(q, k, v, causal):
 
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize(
-    "impl", ["ring", "ring_flash", "ulysses", "ulysses_flash"])
+    "impl",
+    ["ring", "ring_flash", "ulysses", "ulysses_flash", "ulysses_bsh"])
 def test_cp_attention_matches_full(devices8, causal, impl):
     mesh = mx.build_mesh(cp=4, devices=devices8[:4])
     q, k, v = _qkv(jax.random.PRNGKey(0))
@@ -60,6 +61,20 @@ def test_cp_attention_matches_full(devices8, causal, impl):
     elif impl == "ulysses":
         def local(q, k, v):
             return ulysses_attention(q, k, v, causal=causal)
+    elif impl == "ulysses_bsh":
+        # lane-packed layout: [b, h, s, d] shard ↔ [b, s, hidden]
+        from apex_tpu.transformer.context_parallel import (
+            ulysses_attention_bsh,
+        )
+
+        def local(q, k, v):
+            to_bsh = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(
+                x.shape[0], x.shape[2], -1)
+            o = ulysses_attention_bsh(
+                to_bsh(q), to_bsh(k), to_bsh(v), num_heads=H,
+                causal=causal)
+            return jnp.transpose(
+                o.reshape(o.shape[0], o.shape[1], H, D), (0, 2, 1, 3))
     else:  # the Pallas-kernel branch must stay covered
         def local(q, k, v):
             return ulysses_attention(q, k, v, causal=causal, impl="flash")
