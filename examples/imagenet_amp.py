@@ -3,8 +3,11 @@
 The TPU-native form of examples/imagenet/main_amp.py (U): amp O1 ≈ bf16
 compute policy (no loss scaling needed), apex DDP ≈ batch sharded on the
 dp mesh axis with grad pmean, FusedSGD with momentum, SyncBatchNorm
-optional (config #3's RetinaNet pairing). Data is synthetic — the
-reference script's dataloader is orthogonal to the framework.
+optional (config #3's RetinaNet pairing). Data: ``--data file.bin``
+streams packed uint8 records through the native prefetch loader
+(``apex_tpu.data.ImageLoader`` — the role the reference leaves to the
+torch DataLoader + DistributedSampler), normalized on device; without
+it, synthetic tensors.
 
 Run (CPU simulation):
   PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
@@ -19,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from apex_tpu import data
 from apex_tpu import mesh as mx
 from apex_tpu.models import resnet
 from apex_tpu.optimizers import fused_sgd
@@ -32,6 +36,8 @@ def main():
     ap.add_argument("--depth", type=int, default=50)
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--syncbn", action="store_true")
+    ap.add_argument("--data", default=None,
+                    help="packed image file (apex_tpu.data.write_image_file)")
     args = ap.parse_args()
 
     mesh = mx.build_mesh(tp=1)  # pure data parallelism
@@ -46,6 +52,9 @@ def main():
     opt_state = jax.jit(opt.init)(params)
 
     def local_step(params, bn_state, opt_state, images, labels):
+        if images.dtype == jnp.uint8:  # native-loader batches: uint8 over
+            # the wire, dequant+normalize fused into the first conv read
+            images = data.normalize_images(images, jnp.float32)
         (l, ns), g = jax.value_and_grad(
             lambda p: resnet.loss(cfg, p, bn_state, images, labels),
             has_aux=True)(params)
@@ -68,18 +77,31 @@ def main():
         out_specs=(pspec, sspec, ospec, P()),
         check_vma=False), donate_argnums=(0, 1, 2))
 
-    img = jax.random.normal(
-        jax.random.PRNGKey(1), (args.batch, args.image, args.image, 3))
-    lbl = jax.random.randint(jax.random.PRNGKey(2), (args.batch,), 0, 1000)
+    if args.data:
+        # mesh=: multi-host runs stride records per process and place
+        # batches dp-sharded (the DistributedSampler contract)
+        loader = data.ImageLoader(
+            args.data, (args.image, args.image), args.batch, mesh=mesh,
+            shuffle=True)
+        batches = iter(loader)
+    else:
+        img = jax.random.normal(
+            jax.random.PRNGKey(1), (args.batch, args.image, args.image, 3))
+        lbl = jax.random.randint(
+            jax.random.PRNGKey(2), (args.batch,), 0, 1000)
+        batches = iter(lambda: (img, lbl), None)
 
     t0 = time.perf_counter()
     for i in range(args.steps):
+        im, lb = next(batches)
         params, bn_state, opt_state, loss = step(
-            params, bn_state, opt_state, img, lbl)
+            params, bn_state, opt_state, im, lb)
         print(f"step {i} loss {float(loss):.4f}")
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
     print(f"{args.steps * args.batch / dt:.1f} images/s over {dp} devices")
+    if args.data:
+        loader.close()
 
 
 if __name__ == "__main__":

@@ -79,6 +79,32 @@ def test_imagenet_example_smoke(tmp_path):
     assert len(losses) == 2 and losses[1] < losses[0]
 
 
+def test_imagenet_example_native_loader(tmp_path):
+    """Config #1 with the native ImageLoader path: packed uint8 records →
+    prefetch thread → on-device normalization (different batches per step,
+    so only completion is asserted)."""
+    import numpy as np
+
+    from apex_tpu import data as atdata
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep + env.get("PYTHONPATH", ""))
+    rng = np.random.default_rng(3)
+    img_file = str(tmp_path / "train.bin")
+    atdata.write_image_file(
+        img_file, rng.integers(0, 256, (24, 32, 32, 3), dtype=np.uint8),
+        rng.integers(0, 1000, 24))
+    cmd = [sys.executable, os.path.join(repo, "examples", "imagenet_amp.py"),
+           "--steps", "2", "--batch", "8", "--image", "32", "--depth", "26",
+           "--data", img_file]
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "images/s" in r.stdout
+
+
 def test_simple_distributed_example_smoke(tmp_path):
     """The reference's examples/simple/distributed demo (U): amp O2
     fp16 + dynamic scaler + DDP grad reduce, smallest-possible loop;
