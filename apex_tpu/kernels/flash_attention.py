@@ -159,16 +159,23 @@ def _valid_cols(blen, i, j, *, causal, bq, bk, sk):
 def _p_ds(q, k, v, do, lse, delta, valid, *, scale):
     """Shared backward block math on block values: recompute
     P = exp(S - lse) under ``valid`` and the dS it induces. Every
-    backward kernel (both layouts) routes through here."""
+    backward kernel (both layouts) routes through here.
+
+    P and dS are computed in fp32 on the VPU but returned in the input
+    dtype: the four downstream MXU dots (dP, dV, dK, dQ) then run at the
+    native bf16 rate with fp32 accumulation (``preferred_element_type``)
+    instead of as multi-pass fp32-emulated matmuls — the standard
+    flash-attention backward numerics (fmha/flash-attn round P/dS to the
+    IO dtype for exactly these products)."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
     p = jnp.where(valid, jnp.exp(s - lse), 0.0)
     dp = jax.lax.dot_general(
-        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale
-    return p, ds
+    ds = (p * (dp - delta) * scale).astype(q.dtype)
+    return p.astype(q.dtype), ds
 
 
 def _bwd_p_ds(blen, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -177,7 +184,7 @@ def _bwd_p_ds(blen, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     q = q_ref[0]
     k = k_ref[0]
     v = v_ref[0]
-    do = do_ref[0].astype(jnp.float32)
+    do = do_ref[0]
     lse = lse_ref[0][:, :1]
     delta = delta_ref[0][:, :1]
     valid = _valid_cols(blen, i, j, causal=causal, bq=bq, bk=bk, sk=sk)
@@ -204,7 +211,7 @@ def _dq_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             blen, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             i, j, scale=scale, causal=causal, bq=bq, bk=bk, sk=sk)
         acc_ref[:] += jax.lax.dot_general(
-            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(j == nk - 1)
@@ -235,7 +242,7 @@ def _dkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # (bk, d)
         dk_acc[:] += jax.lax.dot_general(
-            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # (bk, d)
 
     @pl.when(i == nq - 1)
@@ -280,10 +287,10 @@ def _dqkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # (bk, d)
         dk_acc[:] += jax.lax.dot_general(
-            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # (bk, d)
         dq_acc[rows] += jax.lax.dot_general(
-            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # (bq, d)
 
     # dq out block (b, i) is flushed on every visit (i is the innermost
@@ -791,7 +798,7 @@ def _dqkv_kernel_bsh(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             q = q_ref[0][:, lanes]
             k = k_ref[0][:, lanes]
             v = v_ref[0][:, lanes]
-            do = do_ref[0][:, lanes].astype(jnp.float32)
+            do = do_ref[0][:, lanes]
             lse = jnp.transpose(lse_ref[0][sub:sub + 1, :])    # (bq, 1)
             delta = jnp.transpose(delta_ref[0][sub:sub + 1, :])
             p, ds = _p_ds(q, k, v, do, lse, delta, valid, scale=scale)
@@ -799,10 +806,10 @@ def _dqkv_kernel_bsh(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                 p, do, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)           # (bk, d)
             dk_acc[:, lanes] += jax.lax.dot_general(
-                ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+                ds, q, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)           # (bk, d)
             dq_acc[rows, lanes] += jax.lax.dot_general(
-                ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+                ds, k, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)           # (bq, d)
 
     # dq out block (bg, i) is flushed on every visit (i innermost); the
