@@ -23,17 +23,29 @@ _SRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)),
                     "csrc", "host_runtime.cpp")
 
 _lib = None
+#: must match kAbiVersion in csrc/host_runtime.cpp
+_ABI_VERSION = 2
 
 
 def _build() -> bool:
     if not os.path.exists(_SRC):
         return False
+    # link to a private temp then atomically replace: a concurrent builder
+    # in another process never sees a half-written library, and a rebuild
+    # over an already-dlopen'ed .so swaps the inode instead of truncating
+    # the mapped file (the re-CDLL below then really loads the new build)
+    tmp = f"{_SO}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-pthread",
-           "-shared", "-o", _SO, _SRC]
+           "-shared", "-o", tmp, _SRC]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
         return True
     except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 
@@ -50,6 +62,44 @@ def _load() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(_SO)
     except OSError:
         return None
+
+    def _abi_ok(candidate) -> bool:
+        # a cached .so may predate the current C ABI (failed rebuild, or
+        # copied artifacts whose mtimes defeat the rebuild gate above);
+        # loading it would silently misread arguments
+        try:
+            candidate.at_abi_version.restype = ctypes.c_int32
+            return int(candidate.at_abi_version()) == _ABI_VERSION
+        except AttributeError:
+            return False
+
+    if not _abi_ok(lib):
+        # one forced rebuild before degrading to the numpy fallback (the
+        # stale mapping leaks — harmless, it is never called). dlopen
+        # caches by pathname, so re-opening _SO would hand back the stale
+        # library; load the fresh build through a unique hardlink instead
+        if not _build():
+            return None
+        reload_path = f"{_SO}.{os.getpid()}.reload"
+        try:
+            os.link(_SO, reload_path)
+        except OSError:
+            import shutil
+            try:
+                shutil.copy2(_SO, reload_path)
+            except OSError:
+                return None
+        try:
+            lib = ctypes.CDLL(reload_path)
+        except OSError:
+            return None
+        finally:
+            try:
+                os.unlink(reload_path)
+            except OSError:
+                pass
+        if not _abi_ok(lib):
+            return None
     i64p = ctypes.POINTER(ctypes.c_int64)
     vpp = ctypes.POINTER(ctypes.c_void_p)
     lib.at_pack.argtypes = [vpp, i64p, i64p, ctypes.c_int64,
@@ -60,7 +110,8 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.at_crc32.restype = ctypes.c_uint32
     lib.at_loader_open.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
-        ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64, ctypes.c_int32]
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64, ctypes.c_int32,
+        ctypes.c_int64]
     lib.at_loader_open.restype = ctypes.c_void_p
     lib.at_loader_next.argtypes = [ctypes.c_void_p, vpp]
     lib.at_loader_next.restype = ctypes.c_int32
@@ -148,7 +199,8 @@ class RecordLoader:
 
     def __init__(self, path: str, record_shape: Tuple[int, ...], dtype,
                  batch: int, *, rank: int = 0, world: int = 1,
-                 seed: int = 0, shuffle: bool = True, n_slots: int = 3):
+                 seed: int = 0, shuffle: bool = True, n_slots: int = 3,
+                 header_bytes: int = 0):
         self._shape = tuple(record_shape)
         self._dtype = np.dtype(dtype)
         self._batch = int(batch)
@@ -159,11 +211,12 @@ class RecordLoader:
         if self._lib is not None:
             self._handle = self._lib.at_loader_open(
                 path.encode(), rec_bytes, batch, n_slots, rank, world,
-                seed, int(shuffle))
+                seed, int(shuffle), int(header_bytes))
         if self._handle is None:
             # numpy fallback: synchronous strided reads
             self._lib = None
-            data = np.fromfile(path, dtype=self._dtype)
+            data = np.fromfile(path, dtype=self._dtype,
+                               offset=int(header_bytes))
             per = int(np.prod(self._shape))
             total = data.size // per
             n_local = total // world

@@ -10,7 +10,9 @@ batch is already being read on the worker thread.
 
 File format: flat binary, one fixed-size record after another (tokens for
 LM, image+label structs for vision) — the layout Megatron-style indexed
-datasets use for the hot path.
+datasets use for the hot path. Token files are headerless (interop with
+raw tokenizer ``.bin`` streams); image files carry a 16-byte geometry
+header so the loader verifies H×W exactly.
 """
 
 from __future__ import annotations
@@ -105,9 +107,11 @@ class ImageLoader:
 
     The vision counterpart of :class:`TokenLoader` (the role the
     reference's example leaves to a multi-worker torch ``DataLoader`` +
-    ``DistributedSampler`` — examples/imagenet/main_amp.py (U)). One
-    record = ``H*W*3`` uint8 pixels followed by a little-endian int32
-    label, prefetched by the native loader thread. Pixels cross
+    ``DistributedSampler`` — examples/imagenet/main_amp.py (U)). The file
+    opens with a 16-byte geometry header (validated against
+    ``image_size``); one record = ``H*W*3`` uint8 pixels followed by a
+    little-endian int32 label, prefetched by the native loader thread.
+    Pixels cross
     host→device as uint8 — 4x less transfer than fp32; normalize on
     device (:func:`normalize_images`) where it fuses into the first conv.
     """
@@ -120,16 +124,36 @@ class ImageLoader:
             mesh, batch, P(AXIS_DP, None, None, None))
         if self._sharding is not None:
             self._lbl_sharding = NamedSharding(mesh, P(AXIS_DP))
+        with open(path, "rb") as f:
+            header = f.read(_IMG_HEADER_BYTES)
+        if len(header) < _IMG_HEADER_BYTES:
+            raise ValueError(
+                f"{path}: {len(header)} bytes is shorter than the "
+                f"{_IMG_HEADER_BYTES}-byte header — file truncated?")
+        if header[:4] != _IMG_MAGIC:
+            raise ValueError(
+                f"{path}: not an apex_tpu image file (missing "
+                f"{_IMG_MAGIC!r} header — was it written by "
+                f"write_image_file?)")
+        version = int(np.frombuffer(header[4:8], "<u4")[0])
+        if version != _IMG_VERSION:
+            raise ValueError(
+                f"{path}: image-file format version {version}, this "
+                f"loader reads version {_IMG_VERSION}")
+        h, w = np.frombuffer(header[8:16], "<u4")
+        if (int(h), int(w)) != self._hw:
+            raise ValueError(
+                f"{path} stores {int(h)}x{int(w)} images, loader asked "
+                f"for {self._hw[0]}x{self._hw[1]}")
         rec = self._hw[0] * self._hw[1] * 3 + 4
-        size = os.path.getsize(path)
+        size = os.path.getsize(path) - _IMG_HEADER_BYTES
         if size % rec:
             raise ValueError(
-                f"{path}: size {size} is not a multiple of the "
-                f"{self._hw[0]}x{self._hw[1]} record ({rec} bytes) — "
-                f"image_size doesn't match what write_image_file packed")
+                f"{path}: {size} payload bytes is not a multiple of the "
+                f"{rec}-byte record — file truncated?")
         self._loader = RecordLoader(
-            path, (rec,), np.uint8, batch,
-            rank=rank, world=world, seed=seed, shuffle=shuffle)
+            path, (rec,), np.uint8, batch, rank=rank, world=world,
+            seed=seed, shuffle=shuffle, header_bytes=_IMG_HEADER_BYTES)
 
     @property
     def num_records(self) -> int:
@@ -177,10 +201,21 @@ def normalize_images(images: jnp.ndarray, dtype=jnp.float32,
     return (x - m) / s
 
 
+#: Image-file header: magic, version, H, W (little-endian u32 each).
+#: Token files stay headerless flat streams for interop with the raw
+#: ``.bin`` convention tokenizer pipelines emit; the image format is ours
+#: alone, so it carries its geometry and the loader can verify it exactly
+#: instead of inferring from divisibility.
+_IMG_MAGIC = b"ATIM"
+_IMG_VERSION = 1
+_IMG_HEADER_BYTES = 16
+
+
 def write_image_file(path: str, images: np.ndarray,
                      labels: np.ndarray) -> int:
     """Pack ``[n, H, W, 3]`` uint8 images + ``[n]`` int labels into the
-    fixed-record binary file :class:`ImageLoader` reads."""
+    fixed-record binary file :class:`ImageLoader` reads (16-byte geometry
+    header, then ``H*W*3 + 4``-byte records)."""
     images = np.ascontiguousarray(images, dtype=np.uint8)
     n, h, w, c = images.shape
     if c != 3:
@@ -189,5 +224,8 @@ def write_image_file(path: str, images: np.ndarray,
     rec = np.empty((n, h * w * 3 + 4), dtype=np.uint8)
     rec[:, : h * w * 3] = images.reshape(n, -1)
     rec[:, h * w * 3:] = labels.astype("<i4")[:, None].view(np.uint8)
-    rec.tofile(path)
+    with open(path, "wb") as f:
+        f.write(_IMG_MAGIC)
+        f.write(np.array([_IMG_VERSION, h, w], "<u4").tobytes())
+        rec.tofile(f)
     return n

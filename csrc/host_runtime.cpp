@@ -31,6 +31,14 @@
 
 extern "C" {
 
+// Bumped on any C-ABI change (arguments, semantics). The ctypes loader
+// refuses a library reporting a different version (or none), so a stale
+// cached .so that survived a failed rebuild degrades to the numpy
+// fallback instead of silently misreading arguments.
+static const int32_t kAbiVersion = 2;  // 2: at_loader_open header_bytes
+
+int32_t at_abi_version() { return kAbiVersion; }
+
 // ---------------------------------------------------------------------------
 // pack / unpack
 // ---------------------------------------------------------------------------
@@ -178,6 +186,7 @@ uint32_t at_crc32(const void* data, int64_t nbytes, uint32_t seed) {
 struct Loader {
   FILE* f = nullptr;
   int64_t record_bytes = 0;
+  int64_t header_bytes = 0;    // fixed prefix before the first record
   int64_t n_records = 0;       // records this shard owns
   int64_t batch = 0;
   int32_t n_slots = 0;
@@ -219,7 +228,8 @@ struct Loader {
       }
       int64_t local = order[cursor++];
       int64_t global = local * world + rank;   // strided shard layout
-      if (std::fseek(f, global * record_bytes, SEEK_SET) != 0 ||
+      if (std::fseek(f, header_bytes + global * record_bytes,
+                     SEEK_SET) != 0 ||
           std::fread(dst + b * record_bytes, 1,
                      static_cast<size_t>(record_bytes),
                      f) != static_cast<size_t>(record_bytes)) {
@@ -261,11 +271,13 @@ struct Loader {
 
 void* at_loader_open(const char* path, int64_t record_bytes, int64_t batch,
                      int32_t n_slots, int64_t rank, int64_t world,
-                     uint64_t seed, int32_t shuffle) {
+                     uint64_t seed, int32_t shuffle,
+                     int64_t header_bytes) {
   FILE* f = std::fopen(path, "rb");
   if (!f) return nullptr;
   std::fseek(f, 0, SEEK_END);
-  int64_t fsize = std::ftell(f);
+  int64_t fsize = std::ftell(f) - header_bytes;
+  if (fsize < record_bytes) { std::fclose(f); return nullptr; }
   int64_t total = fsize / record_bytes;
   if (world < 1) world = 1;
   if (rank < 0 || rank >= world) { std::fclose(f); return nullptr; }
@@ -275,6 +287,7 @@ void* at_loader_open(const char* path, int64_t record_bytes, int64_t batch,
   Loader* L = new Loader();
   L->f = f;
   L->record_bytes = record_bytes;
+  L->header_bytes = header_bytes;
   L->n_records = n_local;
   L->batch = batch;
   L->n_slots = n_slots < 2 ? 2 : n_slots;

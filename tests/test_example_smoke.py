@@ -26,6 +26,7 @@ def test_gpt_train_example_end_to_end(tmp_path):
                PYTHONPATH=repo + os.pathsep + env.get("PYTHONPATH", ""))
     cmd = [sys.executable, os.path.join(repo, "examples", "gpt_train.py"),
            "--preset", "tiny", "--tp", "2", "--steps", "2",
+           "--clip-grad-norm", "1.0",
            "--data", data, "--ckpt", ckpt, "--metrics", metrics]
     r = subprocess.run(cmd, env=env, capture_output=True, text=True,
                        timeout=900)
@@ -33,6 +34,7 @@ def test_gpt_train_example_end_to_end(tmp_path):
     assert "saved" in r.stdout
     lines = [json.loads(l) for l in open(metrics)]
     assert len(lines) == 2 and np.isfinite(lines[-1]["loss"])
+    assert lines[-1]["grad_norm"] > 0  # clip flag flows through the step
 
     # resume leg: picks up the saved step counter
     cmd2 = list(cmd)
@@ -83,8 +85,6 @@ def test_imagenet_example_native_loader(tmp_path):
     """Config #1 with the native ImageLoader path: packed uint8 records →
     prefetch thread → on-device normalization (different batches per step,
     so only completion is asserted)."""
-    import numpy as np
-
     from apex_tpu import data as atdata
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

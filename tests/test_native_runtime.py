@@ -125,8 +125,73 @@ def test_image_loader_size_mismatch(tmp_path):
     path = str(tmp_path / "images.bin")
     atdata.write_image_file(
         path, np.zeros((3, 8, 8, 3), np.uint8), np.arange(3))
-    with pytest.raises(ValueError, match="not a multiple"):
+    with pytest.raises(ValueError, match="stores 8x8"):
         atdata.ImageLoader(path, (16, 16), batch=1)
+    # 148 8x8 records (29008 payload bytes) coincidentally divide into
+    # 49 592-byte 14x14 records — the geometry header must still reject
+    atdata.write_image_file(
+        path, np.zeros((148, 8, 8, 3), np.uint8), np.arange(148))
+    with pytest.raises(ValueError, match="stores 8x8"):
+        atdata.ImageLoader(path, (14, 14), batch=1)
+
+
+def test_stale_abi_library_triggers_rebuild(monkeypatch, tmp_path):
+    """A cached .so missing at_abi_version (pre-header ABI) must be
+    rebuilt from source, not loaded."""
+    if not nat.available():
+        pytest.skip("no toolchain")
+    import subprocess
+    stale_src = tmp_path / "stale.cpp"
+    stale_src.write_text('extern "C" { int not_the_abi() { return 0; } }')
+    so = str(tmp_path / "libapex_tpu_host.so")
+    subprocess.run(["g++", "-shared", "-fPIC", "-o", so, str(stale_src)],
+                   check=True, capture_output=True)
+    monkeypatch.setattr(nat, "_lib", None)
+    monkeypatch.setattr(nat, "_SO", so)
+    lib = nat._load()
+    assert lib is not None  # rebuilt from _SRC and reloaded
+    assert int(lib.at_abi_version()) == nat._ABI_VERSION
+
+
+def test_record_loader_header_native_vs_fallback(token_file, monkeypatch,
+                                                 tmp_path):
+    """header_bytes skips the same prefix through both backends."""
+    if not nat.available():
+        pytest.skip("native runtime unavailable; fallback covered alone")
+    path = str(tmp_path / "hdr.bin")
+    with open(path, "wb") as f:
+        f.write(b"\xff" * 8)                 # 8-byte junk header
+        f.write(open(token_file, "rb").read())
+    native = nat.RecordLoader(path, (16,), np.int32, batch=4,
+                              shuffle=False, header_bytes=8)
+    monkeypatch.setattr(nat, "_load", lambda: None)
+    fallback = nat.RecordLoader(path, (16,), np.int32, batch=4,
+                                shuffle=False, header_bytes=8)
+    assert fallback._lib is None and native._lib is not None
+    assert native.num_records == fallback.num_records == 64
+    for _ in range(4):
+        assert np.array_equal(native.next(), fallback.next())
+    native.close()
+
+
+def test_image_loader_rejects_headerless(tmp_path):
+    """A raw byte blob (or a pre-header-format file) is not silently
+    reinterpreted as images."""
+    path = str(tmp_path / "raw.bin")
+    np.zeros(16 + 196 * 4, np.uint8).tofile(path)
+    with pytest.raises(ValueError, match="not an apex_tpu image file"):
+        atdata.ImageLoader(path, (8, 8), batch=1)
+
+
+def test_image_loader_rejects_future_version(tmp_path):
+    path = str(tmp_path / "v9.bin")
+    atdata.write_image_file(
+        path, np.zeros((2, 8, 8, 3), np.uint8), np.arange(2))
+    raw = bytearray(open(path, "rb").read())
+    raw[4:8] = np.array([9], "<u4").tobytes()
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(ValueError, match="version 9"):
+        atdata.ImageLoader(path, (8, 8), batch=1)
 
 
 def test_image_loader_sharded(devices8, tmp_path):
