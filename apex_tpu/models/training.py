@@ -104,6 +104,7 @@ def make_train_step(
     *,
     n_micro: int = 1,
     n_chunks: int = 1,
+    clip_grad_norm: Optional[float] = None,
 ):
     """Build ``(init_fn, step_fn)`` for GPT training over ``mesh``.
 
@@ -115,6 +116,14 @@ def make_train_step(
     A mesh with a nontrivial ``pp`` axis switches to the pipelined loss:
     ``n_micro`` microbatches stream through the stage ring, ``n_chunks``
     virtual stages per rank (apex interleaved 1F1B).
+
+    ``clip_grad_norm`` clips to a global L2 norm between the grad sync
+    and the optimizer step — the role ``clip_grad_norm_(amp.
+    master_params(opt))`` plays in the reference loop, with Megatron's
+    model-parallel norm semantics: leaves sharded over tp/pp/ep
+    contribute their shard's sum-of-squares psum'd over those axes,
+    replicated leaves count once (``param_is_not_tensor_parallel_
+    duplicate`` (U)). Adds a ``grad_norm`` metric (the pre-clip norm).
     """
     scaler_cfg = scaler_cfg or ScalerConfig(enabled=False)
     axes_present = set(mesh.axis_names)
@@ -141,6 +150,12 @@ def make_train_step(
             raise ValueError(
                 "num_experts > 0 does not compose with sequence_parallel; "
                 "shard the batch over ep instead")
+    if clip_grad_norm is not None and isinstance(
+            optimizer, DistributedFusedOptimizer):
+        raise ValueError(
+            "clip_grad_norm composes with the tree/flat fused optimizers; "
+            "the ZeRO optimizers own their dp reduction (clip there would "
+            "see pre-reduce partial grads)")
     pspecs = gpt.param_specs(cfg, pipeline=pipelined)
     sp_mask = gpt.seq_partial_grad_mask(cfg)
 
@@ -148,6 +163,16 @@ def make_train_step(
         return any(
             a == axis or (isinstance(a, (tuple, list)) and axis in a)
             for a in spec if a is not None)
+
+    # per-leaf model-parallel axes for the clip norm: a leaf sharded over
+    # an axis contributes its shard's sum-of-squares psum'd over it;
+    # replicated leaves count once (leaf order = params treedef order)
+    _norm_axes = tuple(a for a in (AXIS_TP, AXIS_PP, ep_axis)
+                       if a in axes_present)
+    clip_leaf_axes = [
+        tuple(a for a in _norm_axes if _mentions(s, a))
+        for s in jax.tree.leaves(
+            pspecs, is_leaf=lambda x: isinstance(x, P))]
 
     # params NOT sharded over pp see only their stage's loss contribution —
     # psum over pp reassembles them (embedding / position / final LN);
@@ -261,6 +286,23 @@ def make_train_step(
         # every rank must agree on finiteness (skip decision when the
         # scaler is on; replicated metric either way)
         finite = lax.pmin(finite.astype(jnp.int32), sync_axes) > 0
+        grad_norm = None
+        if clip_grad_norm is not None:
+            # global L2 norm after the sync (grads here ARE the applied
+            # update direction); group leaves by their model-parallel
+            # axis set so each group costs one psum
+            sq = {}
+            for g, axes in zip(jax.tree.leaves(grads), clip_leaf_axes):
+                s = jnp.sum(jnp.square(g.astype(jnp.float32)))
+                sq[axes] = sq.get(axes, jnp.float32(0.0)) + s
+            total = jnp.float32(0.0)
+            for axes, s in sq.items():
+                total = total + (lax.psum(s, axes) if axes else s)
+            grad_norm = jnp.sqrt(total)
+            coeff = jnp.minimum(
+                1.0, jnp.float32(clip_grad_norm) / (grad_norm + 1e-6))
+            grads = jax.tree.map(
+                lambda g: (g * coeff.astype(g.dtype)), grads)
         new_params, new_opt = optimizer.step(grads, state.opt_state, params)
         if scaler_cfg.enabled:
             # a single rank overflowing skips the step everywhere
@@ -282,6 +324,8 @@ def make_train_step(
             "grads_finite": finite.astype(jnp.int32),
             "loss_scale": new_scaler.loss_scale,
         }
+        if grad_norm is not None:
+            metrics["grad_norm"] = grad_norm
         new_state = TrainState(
             state.step + jnp.int32(1), new_params, new_opt, new_scaler)
         return new_state, metrics
@@ -292,12 +336,14 @@ def make_train_step(
         a for a, on in ((AXIS_DP, AXIS_DP in axes_present),
                         (ep_axis, ep_size > 1)) if on)
     data_spec = P(batch_axes, None) if batch_axes else P(None, None)
+    metric_specs = {"loss": P(), "grads_finite": P(), "loss_scale": P()}
+    if clip_grad_norm is not None:
+        metric_specs["grad_norm"] = P()
     step_fn = jax.jit(
         jax.shard_map(
             _local_step, mesh=mesh,
             in_specs=(state_specs, data_spec, data_spec),
-            out_specs=(state_specs,
-                       {"loss": P(), "grads_finite": P(), "loss_scale": P()}),
+            out_specs=(state_specs, metric_specs),
             check_vma=False,
         ),
         donate_argnums=(0,),

@@ -20,6 +20,7 @@ ep=2 mesh axis (Switch/GShard routing, aux loss folded into the loss).
 """
 
 import argparse
+import itertools
 import os
 
 import jax
@@ -64,6 +65,10 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--clip-grad-norm", type=float, default=None,
+                    help="global-L2 grad clip inside the fused step "
+                    "(the reference loop's clip_grad_norm_ between "
+                    "unscale and optimizer.step)")
     ap.add_argument("--no-sp", action="store_true")
     ap.add_argument("--data", help="binary token file (apex_tpu.data "
                     "format); synthetic tokens if omitted")
@@ -114,7 +119,8 @@ def main():
     init_fn, step_fn = training.make_train_step(
         cfg, mesh, fused_adam(args.lr, layout=args.opt_layout),
         ScalerConfig(enabled=False),
-        n_micro=args.n_micro, n_chunks=args.vpp)
+        n_micro=args.n_micro, n_chunks=args.vpp,
+        clip_grad_norm=args.clip_grad_norm)
 
     state = init_fn(jax.random.PRNGKey(0))
     if args.ckpt and ckpt.checkpoint_exists(args.ckpt):
@@ -136,7 +142,7 @@ def main():
         tok = jax.random.randint(
             jax.random.PRNGKey(1), (args.batch, cfg.seq_len), 0,
             cfg.vocab_size)
-        batches = iter(lambda: (tok, jnp.roll(tok, -1, axis=1)), None)
+        batches = itertools.repeat((tok, jnp.roll(tok, -1, axis=1)))
 
     timer = profiler.StepTimer(tokens_per_step=args.batch * cfg.seq_len)
     log = profiler.MetricsLogger(jsonl_path=args.metrics)

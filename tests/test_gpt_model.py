@@ -163,3 +163,68 @@ def test_ce_impl_fused_unchunked_matches_xla(devices8):
 def test_ce_impl_fused_rejects_sharded_vocab(devices8):
     with pytest.raises(ValueError, match="unsharded"):
         _run(devices8, tp=2, sp=False, steps=1, ce_impl="fused")
+
+
+# --- clip_grad_norm: global-norm clipping inside the fused step ---------
+
+def _run_clip(devices, tp, clip, *, pp=1, n_micro=1, sp=False, steps=2):
+    cfg = gpt.GPTConfig(sequence_parallel=sp, remat=True, **CFG)
+    mesh = mx.build_mesh(tp=tp, pp=pp, devices=devices)
+    init_fn, step_fn = training.make_train_step(
+        cfg, mesh, fused_sgd(0.1), ScalerConfig(enabled=False),
+        clip_grad_norm=clip, n_micro=n_micro,
+    )
+    state = init_fn(jax.random.PRNGKey(0))
+    tok, tgt = _data(jax.random.PRNGKey(1))
+    losses, norms = [], []
+    for _ in range(steps):
+        state, m = step_fn(state, tok, tgt)
+        losses.append(float(m["loss"]))
+        norms.append(float(m["grad_norm"]) if "grad_norm" in m
+                     else float("nan"))
+    return jax.device_get(state.params), losses, norms
+
+
+def test_clip_grad_norm_sharded_matches_unsharded(devices8):
+    """The model-parallel norm (tp-sharded leaves psum'd, replicated
+    leaves counted once) must equal the tp=1 norm, so a *biting* clip
+    produces the same trajectory on both meshes."""
+    # clip=1e6 never bites (coeff clamps at 1): unclipped trajectory,
+    # but the pre-clip norm metric is reported
+    _, ref_losses, ref_norms = _run_clip(devices8, tp=1, clip=1e6)
+    clip = ref_norms[0] / 2  # bites on every step
+    _, l1, n1 = _run_clip(devices8, tp=1, clip=clip)
+    _, l4, n4 = _run_clip(devices8, tp=4, clip=clip, sp=True)
+    np.testing.assert_allclose(n1, n4, rtol=2e-4)
+    np.testing.assert_allclose(l1, l4, rtol=2e-4)
+    # clipping changed the trajectory (step 2 sees different params)...
+    assert abs(l1[1] - ref_losses[1]) > 1e-6
+    # ...but the reported norm is pre-clip, so step 1's matches unclipped
+    np.testing.assert_allclose(n1[0], ref_norms[0], rtol=1e-5)
+
+
+def test_clip_grad_norm_loose_is_identity(devices8):
+    ref_params, ref_losses, _ = _run_clip(devices8, tp=2, clip=None)
+    par, losses, norms = _run_clip(devices8, tp=2, clip=1e6)
+    np.testing.assert_allclose(ref_losses, losses, rtol=1e-6)
+    for r, t in zip(jax.tree.leaves(ref_params), jax.tree.leaves(par)):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(t), rtol=1e-6)
+    assert norms[0] > 0
+
+
+def test_clip_grad_norm_pipelined(devices8):
+    """pp-sharded leaves contribute once per stage: the pp=2 norm equals
+    the flat-mesh norm."""
+    _, _, ref_norms = _run_clip(devices8, tp=1, clip=1e6)
+    _, _, pp_norms = _run_clip(devices8, tp=1, pp=2, n_micro=2, clip=1e6)
+    np.testing.assert_allclose(ref_norms[0], pp_norms[0], rtol=2e-4)
+
+
+def test_clip_grad_norm_rejects_zero_optimizer(devices8):
+    from apex_tpu.optimizers import distributed_fused_adam
+    cfg = gpt.GPTConfig(remat=True, **CFG)
+    mesh = mx.build_mesh(tp=1, devices=devices8)
+    with pytest.raises(ValueError, match="ZeRO"):
+        training.make_train_step(
+            cfg, mesh, distributed_fused_adam(1e-3),
+            ScalerConfig(enabled=False), clip_grad_norm=1.0)
