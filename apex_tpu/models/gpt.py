@@ -973,14 +973,57 @@ def prefill(cfg: GPTConfig, params, prompt, *, max_len: Optional[int] = None):
     return cache, _lm_head(cfg, params, h[-1])
 
 
+def _filter_logits(logits, top_k: int, top_p: float):
+    """Nucleus/top-k logit filtering: positions outside the top-k (by
+    value), or outside the smallest set whose softmax mass reaches
+    top_p, are masked to -inf. Filters compose in the mainstream
+    (HF/Megatron warper) order — top-k first, nucleus mass measured on
+    the renormalized top-k distribution — and the caller applies
+    temperature *before* this, so the nucleus is that of the actual
+    sampling distribution. One sort; static shapes throughout (the form
+    ``lax.scan`` and jit need — no dynamic vocabulary slicing)."""
+    vocab = logits.shape[-1]
+    kk = top_k if 0 < top_k < vocab else 0
+    pp = top_p if 0.0 < top_p < 1.0 else 0.0
+    if not kk and not pp:
+        return logits
+    neg = jnp.finfo(logits.dtype).min
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    if kk:
+        # masking the sorted tail IS the top-k filter (no second sort)
+        sorted_desc = jnp.where(
+            jnp.arange(vocab) < kk, sorted_desc, neg)
+        thresh = sorted_desc[..., kk - 1][..., None]
+    else:
+        thresh = None
+    if pp:
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep every position whose *preceding* cumulative mass is below
+        # top_p (the first token is always kept)
+        keep = jnp.concatenate(
+            [jnp.ones_like(cum[..., :1], bool), cum[..., :-1] < pp],
+            axis=-1)
+        # threshold value = smallest kept logit
+        pthresh = jnp.min(
+            jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True)
+        thresh = pthresh if thresh is None else jnp.maximum(thresh, pthresh)
+    return jnp.where(logits < thresh, neg, logits)
+
+
 def generate(cfg: GPTConfig, params, prompt, n_new: int,
-             *, temperature: float = 0.0, key=None):
+             *, temperature: float = 0.0, top_k: int = 0,
+             top_p: float = 1.0, key=None):
     """Continuation: ``prompt [b, p_len] int32`` → ``[b, n_new]``.
 
     ``temperature=0`` (default) is greedy argmax; > 0 samples from
     ``softmax(logits / temperature)`` using ``key`` (required then; fold
     it per tp-replica-identically — every rank must draw the same token,
     which holds because the gathered logits and the key are replicated).
+    ``top_k`` / ``top_p`` restrict sampling to the k highest-value /
+    smallest nucleus-mass logits (0 / 1.0 disable; sampling only),
+    composed in the standard warper order: temperature, then top-k,
+    then nucleus mass on the renormalized remainder.
 
     Local semantics (call inside ``shard_map``; composes with tp and,
     via generous ``moe_capacity_factor``, MoE). The prompt is ingested
@@ -990,6 +1033,11 @@ def generate(cfg: GPTConfig, params, prompt, n_new: int,
     """
     if temperature > 0.0 and key is None:
         raise ValueError("temperature > 0 needs a PRNG key")
+    if (top_k > 0 or top_p < 1.0) and temperature <= 0.0:
+        raise ValueError("top_k/top_p filter sampled draws; set "
+                         "temperature > 0")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     b, p_len = prompt.shape
     if p_len < 1:
         raise ValueError("generate needs at least one prompt token")
@@ -1009,8 +1057,11 @@ def generate(cfg: GPTConfig, params, prompt, n_new: int,
 
     def draw(logits, t):
         if temperature > 0.0:
+            # temperature first: top_p must see the distribution actually
+            # being sampled (standard warper order)
+            scaled = _filter_logits(logits / temperature, top_k, top_p)
             return jax.random.categorical(
-                jax.random.fold_in(key, t), logits / temperature, axis=-1
+                jax.random.fold_in(key, t), scaled, axis=-1
             ).astype(jnp.int32)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 

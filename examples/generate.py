@@ -30,6 +30,10 @@ def main():
     ap.add_argument("--prompt-len", type=int, default=8)
     ap.add_argument("--n-new", type=int, default=16)
     ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-k", type=int, default=0,
+                    help="sample only among the k best logits (0 = off)")
+    ap.add_argument("--top-p", type=float, default=1.0,
+                    help="nucleus sampling mass (1.0 = off)")
     ap.add_argument("--ckpt", help=".atck from examples/gpt_train.py "
                     "(--preset tiny); random init if omitted")
     args = ap.parse_args()
@@ -57,8 +61,9 @@ def main():
     key = jax.random.PRNGKey(2)
     out = jax.jit(jax.shard_map(
         lambda p, t: gpt.generate(
-            cfg, p, t, args.n_new, temperature=args.temperature, key=key
-            if args.temperature > 0 else None),
+            cfg, p, t, args.n_new, temperature=args.temperature,
+            top_k=args.top_k, top_p=args.top_p,
+            key=key if args.temperature > 0 else None),
         mesh=mesh, in_specs=(gpt.param_specs(cfg), P(None, None)),
         out_specs=P(None, None), check_vma=False))(params, prompt)
     for i in range(args.batch):

@@ -112,6 +112,70 @@ def test_generate_sampling(devices8):
         gpt.generate(cfg, params, prompt, N_NEW, temperature=1.0)
 
 
+def test_filter_logits_top_k():
+    logits = jnp.asarray([[1.0, 4.0, 2.0, 3.0, 0.0]])
+    out = np.asarray(gpt._filter_logits(logits, top_k=2, top_p=1.0))
+    neg = np.finfo(np.float32).min
+    np.testing.assert_array_equal(out[0], [neg, 4.0, neg, 3.0, neg])
+    # top_k >= vocab is a no-op
+    np.testing.assert_array_equal(
+        np.asarray(gpt._filter_logits(logits, top_k=5, top_p=1.0)), logits)
+
+
+def test_filter_logits_top_p():
+    # softmax of [2, 1, 0, -9] ≈ [.665, .245, .090, ~0]: top_p=0.7 keeps
+    # {2.0} plus the first token past the boundary rule's mass check
+    logits = jnp.asarray([[2.0, 1.0, 0.0, -9.0]])
+    out = np.asarray(gpt._filter_logits(logits, top_k=0, top_p=0.7))
+    neg = np.finfo(np.float32).min
+    np.testing.assert_array_equal(out[0], [2.0, 1.0, neg, neg])
+    # p=0.99 admits the 0.090-mass token but still drops the ~1e-5 tail
+    out = np.asarray(gpt._filter_logits(logits, top_k=0, top_p=0.99))
+    np.testing.assert_array_equal(out[0], [2.0, 1.0, 0.0, neg])
+    # top_p=1.0 disables the filter entirely
+    out = np.asarray(gpt._filter_logits(logits, top_k=0, top_p=1.0))
+    np.testing.assert_array_equal(out[0], logits[0])
+
+
+def test_filter_logits_warper_order():
+    """Combined k+p measures nucleus mass on the RENORMALIZED top-k
+    distribution (HF warper order): over {2.0, 1.0} the leader holds
+    0.731 > 0.7, so p=0.7 keeps it alone — measuring on the full
+    distribution (leader mass 0.665 < 0.7) would keep both."""
+    logits = jnp.asarray([[2.0, 1.0, 0.0, -9.0]])
+    out = np.asarray(gpt._filter_logits(logits, top_k=2, top_p=0.7))
+    neg = np.finfo(np.float32).min
+    np.testing.assert_array_equal(out[0], [2.0, neg, neg, neg])
+
+
+def test_generate_top_k1_equals_greedy(devices8):
+    """top_k=1 sampling collapses to argmax regardless of temperature."""
+    cfg = standalone_gpt_config(vocab_size=96, seq_len=24)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (3, 8), 0, 96)
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    pspecs = gpt.param_specs(cfg)
+    sampled = jax.jit(jax.shard_map(
+        lambda p, t: gpt.generate(cfg, p, t, N_NEW, temperature=1.3,
+                                  top_k=1, key=jax.random.PRNGKey(5)),
+        mesh=mesh, in_specs=(pspecs, P(None, None)),
+        out_specs=P(None, None), check_vma=False))(params, prompt)
+    greedy = _generate(cfg, params, prompt, mesh)
+    np.testing.assert_array_equal(np.asarray(sampled), np.asarray(greedy))
+
+
+def test_generate_top_filters_validated(devices8):
+    import pytest
+    cfg = standalone_gpt_config(vocab_size=96, seq_len=24)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="temperature"):
+        gpt.generate(cfg, params, prompt, 2, top_k=4)
+    with pytest.raises(ValueError, match="top_p"):
+        gpt.generate(cfg, params, prompt, 2, temperature=1.0, top_p=0.0,
+                     key=jax.random.PRNGKey(0))
+
+
 def test_generate_rejects_bidirectional(devices8):
     import pytest
 
