@@ -39,6 +39,12 @@ def main():
     ap.add_argument("--syncbn", action="store_true")
     ap.add_argument("--data", default=None,
                     help="packed image file (apex_tpu.data.write_image_file)")
+    ap.add_argument("--val-data", default=None,
+                    help="packed validation image file; reports prec@1/5 "
+                    "after training (main_amp.py's validate() (U))")
+    ap.add_argument("--val-batches", type=int, default=0,
+                    help="cap on eval batches (0 = one full pass; never "
+                    "wraps, so every image counts at most once)")
     args = ap.parse_args()
 
     mesh = mx.build_mesh(tp=1)  # pure data parallelism
@@ -110,6 +116,46 @@ def main():
     print(f"{args.steps * args.batch / dt:.1f} images/s over {dp} devices")
     if args.data:
         loader.close()
+
+    if args.val_data:
+        # eval pass: frozen BN statistics, top-1/top-5 over the val stream
+        def local_eval(params, bn_state, images, labels):
+            if images.dtype == jnp.uint8:
+                images = data.normalize_images(images, jnp.float32)
+            logits, _ = resnet.forward(
+                cfg, params, bn_state, images, training=False)
+            top5 = jax.lax.top_k(logits, 5)[1]
+            hit1 = (top5[:, 0] == labels).sum()
+            hit5 = (top5 == labels[:, None]).any(axis=1).sum()
+            return (jax.lax.psum(hit1, "dp"), jax.lax.psum(hit5, "dp"))
+
+        evaluate = jax.jit(jax.shard_map(
+            local_eval, mesh=mesh,
+            in_specs=(pspec, sspec, P("dp"), P("dp")),
+            out_specs=(P(), P()), check_vma=False))
+        val = data.ImageLoader(args.val_data, (args.image, args.image),
+                               args.batch, mesh=mesh, shuffle=False)
+        # sequential unshuffled reads: capping at num_records/batch means
+        # every image is seen at most once (the loader wraps past that,
+        # which would silently resample — the reference's validate()
+        # iterates the set exactly once)
+        avail = val.num_records // args.batch
+        n_batches = avail if args.val_batches <= 0 \
+            else min(args.val_batches, avail)
+        if n_batches < 1:
+            raise SystemExit(
+                f"--val-data holds {val.num_records} records — fewer than "
+                f"one --batch {args.batch}")
+        n = h1 = h5 = 0
+        for _ in range(n_batches):
+            im, lb = val.next()
+            a, b = evaluate(params, bn_state, im, lb)
+            h1 += int(a)
+            h5 += int(b)
+            n += args.batch
+        val.close()
+        print(f"prec@1 {100.0 * h1 / n:.2f}%  prec@5 {100.0 * h5 / n:.2f}% "
+              f"over {n} images")
 
 
 if __name__ == "__main__":

@@ -98,11 +98,12 @@ def test_imagenet_example_native_loader(tmp_path):
         rng.integers(0, 1000, 24))
     cmd = [sys.executable, os.path.join(repo, "examples", "imagenet_amp.py"),
            "--steps", "2", "--batch", "8", "--image", "32", "--depth", "26",
-           "--data", img_file]
+           "--data", img_file, "--val-data", img_file, "--val-batches", "2"]
     r = subprocess.run(cmd, env=env, capture_output=True, text=True,
                        timeout=600)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "images/s" in r.stdout
+    assert "prec@1" in r.stdout and "over 16 images" in r.stdout
 
 
 def test_simple_distributed_example_smoke(tmp_path):
