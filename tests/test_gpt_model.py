@@ -220,6 +220,39 @@ def test_clip_grad_norm_pipelined(devices8):
     np.testing.assert_allclose(ref_norms[0], pp_norms[0], rtol=2e-4)
 
 
+def test_clip_grad_norm_overflow_still_skips_step(devices8):
+    """An overflowing fp16 step must skip the update even though the
+    clip coefficient computed from the nan norm is nan — apply_if_finite
+    guards the params, and the next step recovers at the backed-off
+    scale."""
+    cfg = gpt.GPTConfig(remat=True, **{**CFG,
+                                       "compute_dtype": jnp.float16})
+    mesh = mx.build_mesh(tp=2, devices=devices8)
+    # fp16 max ≈ 65504: an init_scale beyond 2^24 overflows the scaled
+    # loss itself, guaranteeing non-finite grads on step one
+    init_fn, step_fn = training.make_train_step(
+        cfg, mesh, fused_sgd(0.1),
+        ScalerConfig(enabled=True, init_scale=2.0 ** 30,
+                     max_scale=2.0 ** 30),
+        clip_grad_norm=1.0)
+    state = init_fn(jax.random.PRNGKey(0))
+    params_before = jax.device_get(state.params)
+    tok, tgt = _data(jax.random.PRNGKey(1))
+    state, m = step_fn(state, tok, tgt)
+    assert int(m["grads_finite"]) == 0
+    assert float(m["loss_scale"]) == 2.0 ** 29  # backed off
+    for r, t in zip(jax.tree.leaves(params_before),
+                    jax.tree.leaves(jax.device_get(state.params))):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(t))
+    # scale keeps halving until a clean step lands and trains normally
+    for _ in range(12):
+        state, m = step_fn(state, tok, tgt)
+        if int(m["grads_finite"]):
+            break
+    assert int(m["grads_finite"]) == 1
+    assert np.isfinite(float(m["grad_norm"]))
+
+
 def test_clip_grad_norm_rejects_zero_optimizer(devices8):
     from apex_tpu.optimizers import distributed_fused_adam
     cfg = gpt.GPTConfig(remat=True, **CFG)

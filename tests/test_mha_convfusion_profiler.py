@@ -183,6 +183,22 @@ def test_step_timer_and_metrics(tmp_path):
     assert log.history[0]["step"] == 0
 
 
+def test_metrics_tensorboard_sink(tmp_path):
+    """The optional TensorBoard sink writes real event files when the
+    (gated) writer import succeeds — live in this image via torch."""
+    pytest.importorskip("torch.utils.tensorboard")
+    tb_dir = str(tmp_path / "tb")
+    log = profiler.MetricsLogger(tensorboard_dir=tb_dir)
+    assert log._tb is not None
+    log.log(0, {"loss": jnp.float32(3.5)})
+    log.log(1, {"loss": jnp.float32(3.2)})
+    log.close()
+    import glob
+    import os
+    events = glob.glob(tb_dir + "/events.out.tfevents.*")
+    assert events and os.path.getsize(events[0]) > 0
+
+
 def test_annotate_and_sync():
     with profiler.annotate("test-range"):
         y = jnp.sum(jnp.arange(10.0))
