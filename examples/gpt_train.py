@@ -46,6 +46,12 @@ PRESETS = {
 
 
 def main():
+    # repo-local persistent compile cache (JAX_COMPILATION_CACHE_DIR
+    # overrides; empty disables); measured 4x faster warm start on TPU
+    from apex_tpu._capabilities import enable_compilation_cache
+    enable_compilation_cache(os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--preset", default="tiny", choices=sorted(PRESETS))
     ap.add_argument("--tp", type=int, default=1)

@@ -147,6 +147,37 @@ class TestScaledGrad:
         st2 = ctx.update_scaler(st, finite)
         assert float(st2.loss_scale) == 2.0 ** 15
 
+    def test_multiple_losses_independent_scalers(self):
+        """apex's num_losses/loss_id pattern (run_amp
+        test_multiple_models_optimizers_losses (U)): each loss carries
+        its own scaler state — one overflowing loss backs only its own
+        scale off while the healthy loss's scaler grows on schedule."""
+        ctx, _ = amp.initialize(opt_level="O1", half_dtype=jnp.float16)
+        st_a = ctx.init_scaler_state()
+        st_b = ctx.init_scaler_state()
+        w = jnp.array([1.0, 2.0])
+
+        def loss_a(w):
+            return jnp.sum(w ** 2)
+
+        def loss_b(w):
+            return jnp.sum(w * jnp.float32(jnp.inf))
+
+        _, g_a, fin_a = ctx.value_and_grad(loss_a)(w, scaler_state=st_a)
+        _, g_b, fin_b = ctx.value_and_grad(loss_b)(w, scaler_state=st_b)
+        assert bool(fin_a) and not bool(fin_b)
+        # per-loss update keeps the scalers independent
+        st_a = ctx.update_scaler(st_a, fin_a)
+        st_b = ctx.update_scaler(st_b, fin_b)
+        assert float(st_a.loss_scale) == 2.0 ** 16  # clean: unchanged
+        assert float(st_b.loss_scale) == 2.0 ** 15  # overflow: backed off
+        # the combined step applies only the finite loss's grads
+        combined = jax.tree.map(
+            lambda ga, gb: ga + amp.apply_if_finite(gb, jnp.zeros_like(gb),
+                                                    fin_b), g_a, g_b)
+        np.testing.assert_allclose(np.asarray(combined), [2.0, 4.0],
+                                   rtol=1e-6)
+
     def test_has_aux(self):
         ctx, _ = amp.initialize(opt_level="O1", half_dtype=jnp.float16)
         st = ctx.init_scaler_state()
