@@ -64,13 +64,21 @@ def has_capability(name: str) -> bool:
     return bool(capabilities().get(name, False))
 
 
-def enable_compilation_cache(default_dir: str) -> str:
-    """Point JAX's persistent compile cache at ``default_dir`` unless the
-    user already chose via ``JAX_COMPILATION_CACHE_DIR`` (empty value
-    disables). Measured 4x faster warm start through the remote-TPU
-    tunnel. Returns the directory in effect ('' when disabled)."""
+def enable_compilation_cache(default_dir: str = None) -> str:
+    """Point JAX's persistent compile cache at ``default_dir`` —
+    ``<package parent>/.jax_cache`` when omitted, so every caller shares
+    one location — unless the user already chose via
+    ``JAX_COMPILATION_CACHE_DIR`` (empty value disables). Measured 4x
+    faster warm start through the remote-TPU tunnel. Returns the
+    directory in effect ('' when disabled)."""
     import os
 
+    if default_dir is None:
+        import apex_tpu
+
+        default_dir = os.path.join(
+            os.path.dirname(os.path.dirname(
+                os.path.abspath(apex_tpu.__file__))), ".jax_cache")
     cache = os.environ.get("JAX_COMPILATION_CACHE_DIR", default_dir)
     if cache:
         import jax

@@ -202,7 +202,7 @@ def l2norm_flat(bufs: Sequence[jnp.ndarray]) -> jnp.ndarray:
 
 def _adam_kernel(s_ref, p_ref, g_ref, m_ref, v_ref,
                  np_ref, nm_ref, nv_ref, *, adam_w_mode: bool,
-                 out_is_delta: bool):
+                 out_is_delta: bool, grad_averaging: bool = True):
     lr = s_ref[0, 0]
     b1 = s_ref[0, 1]
     b2 = s_ref[0, 2]
@@ -216,7 +216,9 @@ def _adam_kernel(s_ref, p_ref, g_ref, m_ref, v_ref,
     g = g_ref[:].astype(jnp.float32) * gscale
     if not adam_w_mode:
         g = g + wd * p  # classic L2 regularization (apex adam_w_mode=False)
-    m = b1 * m_ref[:] + (1.0 - b1) * g
+    # grad_averaging=False (LAMB stage-1 option (U)): accumulate the raw
+    # grad into m instead of the (1-b1)-weighted average
+    m = b1 * m_ref[:] + ((1.0 - b1) if grad_averaging else 1.0) * g
     v = b2 * v_ref[:] + (1.0 - b2) * g * g
     mhat = m / bc1
     vhat = v / bc2
@@ -232,7 +234,7 @@ def _adam_kernel(s_ref, p_ref, g_ref, m_ref, v_ref,
 def adam_flat(p_bufs, g_bufs, m_bufs, v_bufs, *, lr, b1, b2, eps, weight_decay,
               bias_correction1, bias_correction2, grad_scale=1.0,
               adam_w_mode: bool = True, out_is_delta: bool = False,
-              out_dtype=None):
+              out_dtype=None, grad_averaging: bool = True):
     """``amp_C.multi_tensor_adam`` (U): one fused sweep updating params and
     both moments. All scalar hyperparams are traced (schedules compile into
     the same program)."""
@@ -245,7 +247,8 @@ def adam_flat(p_bufs, g_bufs, m_bufs, v_bufs, *, lr, b1, b2, eps, weight_decay,
         jnp.asarray(grad_scale, jnp.float32),
     ]).reshape(1, 8)
     kernel = functools.partial(_adam_kernel, adam_w_mode=adam_w_mode,
-                               out_is_delta=out_is_delta)
+                               out_is_delta=out_is_delta,
+                               grad_averaging=grad_averaging)
     new_p, new_m, new_v = [], [], []
     for pb, gb, mb, vb in zip(p_bufs, g_bufs, m_bufs, v_bufs):
         want = jnp.dtype(out_dtype) if out_dtype else pb.dtype

@@ -171,10 +171,12 @@ def distributed_fused_lamb(
     bias_correction: bool = True,
     max_grad_norm: Optional[float] = 1.0,
     always_adapt: bool = False,
+    grad_averaging: bool = True,
     axis: str = AXIS_DP,
 ) -> DistributedFusedOptimizer:
     """ZeRO-sharded two-phase NVLAMB (``DistributedFusedLAMB`` (U), the
-    MLPerf BERT recipe optimizer)."""
+    MLPerf BERT recipe optimizer). ``grad_averaging`` as in
+    :func:`~apex_tpu.optimizers.fused_lamb`."""
 
     def init(params, dp: Optional[int] = None) -> ShardedLAMBState:
         _, layout = mt.pack(params)
@@ -226,6 +228,7 @@ def distributed_fused_lamb(
             lr=1.0, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
             bias_correction1=bc1, bias_correction2=bc2, grad_scale=gscale,
             adam_w_mode=True, out_is_delta=True, out_dtype=jnp.float32,
+            grad_averaging=grad_averaging,
         )
         u_shards = [-d for d in delta_shards]
 

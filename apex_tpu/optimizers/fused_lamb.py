@@ -53,9 +53,14 @@ def fused_lamb(
     bias_correction: bool = True,
     max_grad_norm: Optional[float] = 1.0,
     always_adapt: bool = False,
+    grad_averaging: bool = True,
     layout: str = "flat",
 ) -> FusedOptimizer:
     """apex FusedLAMB defaults: eps=1e-6, wd=0.01, global clip at 1.0.
+
+    ``grad_averaging=False`` accumulates the raw grad into the first
+    moment (``m = b1*m + g``) instead of the (1-b1)-weighted average —
+    apex's ``grad_averaging`` ctor arg (U).
 
     ``always_adapt`` follows apex's ``use_nvlamb``: with ``False``, the
     trust ratio is only applied when weight decay is active (apex skips
@@ -69,7 +74,8 @@ def fused_lamb(
         raise ValueError(f"unknown layout {layout!r}")
     if layout == "tree":
         return _tree_lamb(learning_rate, b1, b2, eps, weight_decay,
-                          bias_correction, max_grad_norm, always_adapt)
+                          bias_correction, max_grad_norm, always_adapt,
+                          grad_averaging)
 
     def init(params) -> FusedLAMBState:
         _, layout = mt.pack(params)
@@ -100,6 +106,7 @@ def fused_lamb(
             lr=1.0, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
             bias_correction1=bc1, bias_correction2=bc2, grad_scale=gscale,
             adam_w_mode=True, out_is_delta=True, out_dtype=jnp.float32,
+            grad_averaging=grad_averaging,
         )
         u_bufs = [-d for d in delta_bufs]
 
@@ -143,7 +150,7 @@ class TreeLAMBState(NamedTuple):
 
 
 def _tree_lamb(learning_rate, b1, b2, eps, weight_decay, bias_correction,
-               max_grad_norm, always_adapt):
+               max_grad_norm, always_adapt, grad_averaging=True):
     """Leafwise NVLAMB: same two-phase math, per-leaf trust ratios."""
 
     def init(params) -> TreeLAMBState:
@@ -169,7 +176,7 @@ def _tree_lamb(learning_rate, b1, b2, eps, weight_decay, bias_correction,
         def leaf(p, g, m, v):
             g32 = g.astype(jnp.float32) * gscale
             p32 = p.astype(jnp.float32)
-            m_new = b1 * m + (1.0 - b1) * g32
+            m_new = b1 * m + ((1.0 - b1) if grad_averaging else 1.0) * g32
             v_new = b2 * v + (1.0 - b2) * g32 * g32
             u = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
             if weight_decay:

@@ -13,7 +13,6 @@ measured / 58600.
 """
 
 import json
-import os
 import time
 
 import jax
@@ -23,8 +22,7 @@ from apex_tpu._capabilities import enable_compilation_cache
 
 # repo-local persistent compile cache (JAX_COMPILATION_CACHE_DIR
 # overrides; empty disables): warm starts skip the 20-40s compile
-enable_compilation_cache(
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+enable_compilation_cache()
 
 from apex_tpu import mesh as mx
 from apex_tpu.amp import ScalerConfig

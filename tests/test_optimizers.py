@@ -152,7 +152,7 @@ class TestFusedAdagrad:
 
 
 def ref_lamb_step(params, grads, m, v, count, *, lr, b1, b2, eps, wd,
-                  max_grad_norm):
+                  max_grad_norm, grad_averaging=True):
     """Hand-written NVLAMB reference (apex FusedLAMB semantics)."""
     leaves = jax.tree.leaves(params)
     gleaves = jax.tree.leaves(grads)
@@ -165,7 +165,7 @@ def ref_lamb_step(params, grads, m, v, count, *, lr, b1, b2, eps, wd,
     for p, g, mi, vi in zip(leaves, gleaves, m, v):
         p = np.asarray(p, np.float64)
         g = np.asarray(g, np.float64) * clip
-        mi = b1 * mi + (1 - b1) * g
+        mi = b1 * mi + ((1 - b1) if grad_averaging else 1.0) * g
         vi = b2 * vi + (1 - b2) * g * g
         u = (mi / bc1) / (np.sqrt(vi / bc2) + eps) + wd * p
         pn = np.linalg.norm(p)
@@ -199,6 +199,55 @@ class TestFusedLAMB:
                 lr=1e-2, b1=0.9, b2=0.999, eps=1e-6, wd=0.01, max_grad_norm=1.0)
         for got, want in zip(jax.tree.leaves(params), ref_p):
             np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.parametrize("lay", ["flat", "tree"])
+    def test_grad_averaging_off_matches_reference(self, lay):
+        """apex FusedLAMB(grad_averaging=False): m = b1*m + g, both
+        layouts."""
+        key = jax.random.PRNGKey(15)
+        params = make_tree(key)
+        tx = opt.fused_lamb(1e-2, weight_decay=0.01, max_grad_norm=1.0,
+                            grad_averaging=False, layout=lay)
+        state = tx.init(params)
+        leaves = jax.tree.leaves(params)
+        m = [np.zeros(np.asarray(l).shape) for l in leaves]
+        v = [np.zeros(np.asarray(l).shape) for l in leaves]
+        ref_p = [np.asarray(l, np.float64) for l in leaves]
+        step = jax.jit(lambda g, s, p: tx.step(g, s, p))
+        for i in range(2):
+            gkey = jax.random.fold_in(key, 300 + i)
+            grads = jax.tree.map(
+                lambda p, k=gkey: jax.random.normal(k, p.shape, p.dtype),
+                params)
+            params, state = step(grads, state, params)
+            ref_tree = jax.tree.unflatten(jax.tree.structure(grads), ref_p)
+            ref_p, m, v = ref_lamb_step(
+                ref_tree, grads, m, v, i + 1, lr=1e-2, b1=0.9, b2=0.999,
+                eps=1e-6, wd=0.01, max_grad_norm=1.0, grad_averaging=False)
+        for got, want in zip(jax.tree.leaves(params), ref_p):
+            np.testing.assert_allclose(np.asarray(got), want,
+                                       rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.parametrize("lay", ["flat", "tree"])
+    def test_grad_averaging_knob_is_live(self, lay):
+        """ONE step from the same fresh state with the knob on vs off
+        must differ: the trust ratio cancels the uniform 1/(1-b1)
+        scaling, but the wd*p term keeps the directions distinct."""
+        params = make_tree(jax.random.PRNGKey(16))
+        grads = jax.tree.map(
+            lambda p: jax.random.normal(
+                jax.random.PRNGKey(17), p.shape, p.dtype), params)
+        outs = {}
+        for ga in (True, False):
+            tx = opt.fused_lamb(1e-2, weight_decay=0.01,
+                                grad_averaging=ga, layout=lay)
+            outs[ga], _ = jax.jit(
+                lambda g, s, p, t=tx: t.step(g, s, p))(
+                    grads, tx.init(params), params)
+        assert any(
+            not np.allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+            for a, b in zip(jax.tree.leaves(outs[True]),
+                            jax.tree.leaves(outs[False])))
 
 
 class TestFusedNovoGrad:
