@@ -76,9 +76,15 @@ def enable_compilation_cache(default_dir: str = None) -> str:
     if default_dir is None:
         import apex_tpu
 
-        default_dir = os.path.join(
-            os.path.dirname(os.path.dirname(
-                os.path.abspath(apex_tpu.__file__))), ".jax_cache")
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(apex_tpu.__file__)))
+        if os.path.exists(os.path.join(root, "pyproject.toml")):
+            # source checkout: repo-local cache, shared by bench/examples
+            default_dir = os.path.join(root, ".jax_cache")
+        else:
+            # installed package: never write into site-packages
+            default_dir = os.path.join(
+                os.path.expanduser("~"), ".cache", "apex_tpu", "jax_cache")
     cache = os.environ.get("JAX_COMPILATION_CACHE_DIR", default_dir)
     if cache:
         import jax

@@ -163,8 +163,8 @@ def main():
               f"{dict(mesh.shape)} (median {s['median_step_s']*1e3:.1f} "
               f"ms/step)")
     if args.ckpt:
-        ckpt.save_checkpoint(args.ckpt, state)
-        print(f"saved {args.ckpt}")
+        written = ckpt.save_checkpoint(args.ckpt, state)
+        print(f"saved {written}")
     if loader is not None:
         loader.close()
     log.close()

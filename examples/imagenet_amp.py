@@ -7,7 +7,8 @@ optional (config #3's RetinaNet pairing). Data: ``--data file.bin``
 streams packed uint8 records through the native prefetch loader
 (``apex_tpu.data.ImageLoader`` — the role the reference leaves to the
 torch DataLoader + DistributedSampler), normalized on device; without
-it, synthetic tensors.
+it, synthetic tensors. ``--val-data`` adds the validate() prec@1/5 leg;
+``--ckpt`` the torch.save/--resume round trip.
 
 Run (CPU simulation):
   PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
@@ -23,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from apex_tpu import checkpoint as ckpt
 from apex_tpu import data
 from apex_tpu import mesh as mx
 from apex_tpu.models import resnet
@@ -45,6 +47,9 @@ def main():
     ap.add_argument("--val-batches", type=int, default=0,
                     help="cap on eval batches (0 = one full pass; never "
                     "wraps, so every image counts at most once)")
+    ap.add_argument("--ckpt", default=None,
+                    help=".atck path to save/resume (main_amp.py's "
+                    "--resume/torch.save round trip (U))")
     args = ap.parse_args()
 
     mesh = mx.build_mesh(tp=1)  # pure data parallelism
@@ -57,6 +62,14 @@ def main():
     # interpreted — minutes per step — on the CPU simulation backend)
     opt = fused_sgd(args.lr, momentum=0.9, weight_decay=1e-4, layout="tree")
     opt_state = jax.jit(opt.init)(params)
+
+    start_step = 0
+    if args.ckpt and ckpt.checkpoint_exists(args.ckpt):
+        params, bn_state, opt_state, start_step = ckpt.load_checkpoint(
+            args.ckpt,
+            (params, bn_state, opt_state, jnp.zeros((), jnp.int32)))
+        start_step = int(start_step)
+        print(f"resumed from {args.ckpt} at step {start_step}")
 
     def local_step(params, bn_state, opt_state, images, labels):
         if images.dtype == jnp.uint8:  # native-loader batches: uint8 over
@@ -103,7 +116,7 @@ def main():
     # prefetch overlap; the lagged fetch syncs on an already-finished step
     t0 = time.perf_counter()
     prev = None
-    for i in range(args.steps):
+    for i in range(start_step, start_step + args.steps):
         im, lb = next(batches)
         params, bn_state, opt_state, loss = step(
             params, bn_state, opt_state, im, lb)
@@ -111,11 +124,18 @@ def main():
             print(f"step {i - 1} loss {float(prev):.4f}")
         prev = loss
     if prev is not None:
-        print(f"step {args.steps - 1} loss {float(prev):.4f}")  # sync barrier
+        print(f"step {start_step + args.steps - 1} loss "
+              f"{float(prev):.4f}")  # sync barrier
     dt = time.perf_counter() - t0
     print(f"{args.steps * args.batch / dt:.1f} images/s over {dp} devices")
     if args.data:
         loader.close()
+    if args.ckpt:
+        written = ckpt.save_checkpoint(
+            args.ckpt,
+            (params, bn_state, opt_state,
+             jnp.asarray(start_step + args.steps, jnp.int32)))
+        print(f"saved {written}")
 
     if args.val_data:
         # eval pass: frozen BN statistics, top-1/top-5 over the val stream

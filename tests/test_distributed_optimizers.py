@@ -8,6 +8,7 @@ one given identical gradients, while holding only 1/dp of the moments.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu import mesh as mx
@@ -69,12 +70,17 @@ def test_distributed_adam_matches_fused_adam(devices8):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
 
 
-def test_distributed_lamb_matches_fused_lamb(devices8):
+@pytest.mark.parametrize("grad_averaging", [True, False])
+def test_distributed_lamb_matches_fused_lamb(devices8, grad_averaging):
+    """ZeRO LAMB == unsharded LAMB, with and without grad averaging (the
+    latter pins the kwarg threading into the sharded adam sweep)."""
     mesh = mx.build_mesh(tp=1, devices=devices8[:4])
     params = _tree(jax.random.PRNGKey(2))
     grads = _tree(jax.random.PRNGKey(3))
     ref, out = _run_steps(
-        fused_lamb(1e-2), distributed_fused_lamb(1e-2), mesh, params, grads)
+        fused_lamb(1e-2, grad_averaging=grad_averaging),
+        distributed_fused_lamb(1e-2, grad_averaging=grad_averaging),
+        mesh, params, grads)
     for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(out)):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
 

@@ -96,14 +96,23 @@ def test_imagenet_example_native_loader(tmp_path):
     atdata.write_image_file(
         img_file, rng.integers(0, 256, (24, 32, 32, 3), dtype=np.uint8),
         rng.integers(0, 1000, 24))
+    ck = str(tmp_path / "rn.atck")
     cmd = [sys.executable, os.path.join(repo, "examples", "imagenet_amp.py"),
            "--steps", "2", "--batch", "8", "--image", "32", "--depth", "26",
-           "--data", img_file, "--val-data", img_file, "--val-batches", "2"]
+           "--data", img_file, "--val-data", img_file, "--val-batches", "2",
+           "--ckpt", ck]
     r = subprocess.run(cmd, env=env, capture_output=True, text=True,
                        timeout=600)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "images/s" in r.stdout
     assert "prec@1" in r.stdout and "over 16 images" in r.stdout
+    assert "saved" in r.stdout
+
+    r2 = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                        timeout=600)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    assert "resumed" in r2.stdout and "at step 2" in r2.stdout
+    assert "step 3 loss" in r2.stdout  # counter continues past the resume
 
 
 def test_simple_distributed_example_smoke(tmp_path):

@@ -178,10 +178,13 @@ def ref_lamb_step(params, grads, m, v, count, *, lr, b1, b2, eps, wd,
 
 
 class TestFusedLAMB:
-    def test_matches_reference(self):
+    @pytest.mark.parametrize("grad_averaging,lay", [
+        (True, "flat"), (True, "tree"), (False, "flat"), (False, "tree")])
+    def test_matches_reference(self, grad_averaging, lay):
         key = jax.random.PRNGKey(5)
         params = make_tree(key)
-        tx = opt.fused_lamb(1e-2, weight_decay=0.01, max_grad_norm=1.0)
+        tx = opt.fused_lamb(1e-2, weight_decay=0.01, max_grad_norm=1.0,
+                            grad_averaging=grad_averaging, layout=lay)
         state = tx.init(params)
         leaves = jax.tree.leaves(params)
         m = [np.zeros(np.asarray(l).shape) for l in leaves]
@@ -196,37 +199,10 @@ class TestFusedLAMB:
             ref_tree = jax.tree.unflatten(jax.tree.structure(grads), ref_p)
             ref_p, m, v = ref_lamb_step(
                 ref_tree, grads, m, v, i + 1,
-                lr=1e-2, b1=0.9, b2=0.999, eps=1e-6, wd=0.01, max_grad_norm=1.0)
+                lr=1e-2, b1=0.9, b2=0.999, eps=1e-6, wd=0.01,
+                max_grad_norm=1.0, grad_averaging=grad_averaging)
         for got, want in zip(jax.tree.leaves(params), ref_p):
             np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-5)
-
-    @pytest.mark.parametrize("lay", ["flat", "tree"])
-    def test_grad_averaging_off_matches_reference(self, lay):
-        """apex FusedLAMB(grad_averaging=False): m = b1*m + g, both
-        layouts."""
-        key = jax.random.PRNGKey(15)
-        params = make_tree(key)
-        tx = opt.fused_lamb(1e-2, weight_decay=0.01, max_grad_norm=1.0,
-                            grad_averaging=False, layout=lay)
-        state = tx.init(params)
-        leaves = jax.tree.leaves(params)
-        m = [np.zeros(np.asarray(l).shape) for l in leaves]
-        v = [np.zeros(np.asarray(l).shape) for l in leaves]
-        ref_p = [np.asarray(l, np.float64) for l in leaves]
-        step = jax.jit(lambda g, s, p: tx.step(g, s, p))
-        for i in range(2):
-            gkey = jax.random.fold_in(key, 300 + i)
-            grads = jax.tree.map(
-                lambda p, k=gkey: jax.random.normal(k, p.shape, p.dtype),
-                params)
-            params, state = step(grads, state, params)
-            ref_tree = jax.tree.unflatten(jax.tree.structure(grads), ref_p)
-            ref_p, m, v = ref_lamb_step(
-                ref_tree, grads, m, v, i + 1, lr=1e-2, b1=0.9, b2=0.999,
-                eps=1e-6, wd=0.01, max_grad_norm=1.0, grad_averaging=False)
-        for got, want in zip(jax.tree.leaves(params), ref_p):
-            np.testing.assert_allclose(np.asarray(got), want,
-                                       rtol=2e-4, atol=2e-5)
 
     @pytest.mark.parametrize("lay", ["flat", "tree"])
     def test_grad_averaging_knob_is_live(self, lay):
