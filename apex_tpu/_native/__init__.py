@@ -195,6 +195,9 @@ class RecordLoader:
     per-epoch shuffle of the local shard by a C++ worker thread into a
     double-buffered slot pool, so ``next()`` is a memcpy-free pointer
     handoff in steady state. Falls back to a synchronous numpy reader.
+    Each backend's shuffle is deterministic per seed, but the two
+    backends use different RNGs — the same seed yields different orders
+    native vs fallback (same set of records per epoch either way).
     """
 
     def __init__(self, path: str, record_shape: Tuple[int, ...], dtype,
