@@ -144,17 +144,22 @@ _softmax.defvjp(_softmax_fwd, _softmax_bwd)
 
 
 def scaled_masked_softmax(x, mask: Optional[jnp.ndarray] = None, *,
-                          scale: float = 1.0):
+                          scale: float = 1.0, causal: bool = False):
     """``softmax(scale*x + mask)`` — ``ScaledMaskedSoftmax`` (U).
 
     ``x``: ``[b, h, sq, sk]`` (or any ``[..., sq, sk]``); ``mask``: boolean
     or 0/1, nonzero = masked out, any shape broadcastable to ``x`` over
     the leading/head/query dims (``[b, 1, sq, sk]``, ``[b, 1, 1, sk]``
     padding masks, ``[b, sq, sk]``, …). Softmax in fp32 regardless of
-    I/O dtype.
+    I/O dtype. ``causal=True`` additionally composes the upper-triangular
+    mask inside the kernel (no materialised triangle; square scores only,
+    like the dedicated causal variant).
     """
     shape = x.shape
     sq, sk = shape[-2], shape[-1]
+    if causal and sq != sk:
+        raise ValueError(
+            f"causal softmax requires square scores, got {sq}x{sk}")
     x, was16 = widen_f16(x)
     x3 = x.reshape(-1, sq, sk)
     m3 = None
@@ -179,7 +184,7 @@ def scaled_masked_softmax(x, mask: Optional[jnp.ndarray] = None, *,
         # incompatible masks fail here with jax's broadcast error; the
         # resulting batch prod(shape[:cut]) always divides x3's
         m3 = jnp.broadcast_to(m, tgt).reshape(-1, sq, sk)
-    y = _softmax(x3, m3, float(scale), False).reshape(shape)
+    y = _softmax(x3, m3, float(scale), bool(causal)).reshape(shape)
     return y.astype(jnp.float16) if was16 else y
 
 

@@ -49,7 +49,16 @@ class FusedScaleMaskSoftmax:
         if self.scaled_masked_softmax_fusion:
             if self.attn_mask_type == AttnMaskType.causal:
                 if mask is not None:
-                    return scaled_masked_softmax(scores, mask, scale=scale)
+                    # compose causal ∧ padding inside the kernel (the
+                    # unfused path's semantics; the reference's fused
+                    # causal branch silently IGNORES an extra mask —
+                    # composing is the strictly-safer reading). Square
+                    # scores only, like the mask-less causal path. The
+                    # paths still differ on one degenerate input: a row
+                    # with every position masked is all-zeros here,
+                    # uniform 1/sk through the -10000 additive fallback.
+                    return scaled_masked_softmax(
+                        scores, mask, scale=scale, causal=True)
                 return scaled_upper_triang_masked_softmax(scores, scale=scale)
             return scaled_masked_softmax(scores, mask, scale=scale)
         # unfused fallback (reference: forward_torch_softmax)

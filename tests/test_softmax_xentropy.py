@@ -109,6 +109,21 @@ def test_fused_scale_mask_softmax_dispatch():
     np.testing.assert_allclose(np.asarray(fc(x)), np.asarray(uc(x)),
                                rtol=1e-4, atol=1e-5)
 
+    # causal + padding mask composes (triangle AND mask) on BOTH paths —
+    # the fused branch must not silently drop causality
+    pad = jnp.zeros((2, 1, 1, 8), bool).at[..., -2:].set(True)
+    got = np.asarray(fc(x, pad))
+    want = np.asarray(uc(x, pad))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    # row 0 attends only to col 0 (causal), and padded cols are dead
+    assert np.allclose(got[..., 0, 1:], 0.0, atol=1e-6)
+    assert np.allclose(got[..., -2:], 0.0, atol=1e-6)
+    # non-square causal+mask is rejected, not silently misaligned (the
+    # mask-less causal path already raises for sq != sk)
+    with pytest.raises(ValueError, match="square"):
+        fc(jax.random.normal(jax.random.PRNGKey(5), (2, 2, 1, 8)),
+           jnp.zeros((2, 1, 1, 8), bool))
+
 
 # -- fused cross entropy ---------------------------------------------------
 def _ref_xent(logits, target, smoothing, ignore_index=-100):
