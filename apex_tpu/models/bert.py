@@ -103,16 +103,16 @@ def param_specs(cfg: BertConfig) -> Any:
 
 def _embed(cfg: BertConfig, params, tokens, token_type_ids):
     core = cfg.core()
-    h = gpt._embed(core, params, tokens)  # [s(_local), b, h] post-scatter
+    h = gpt._embed(core, params, tokens)  # [b, s(_local), h] post-scatter
     # token-type + embedding LN ride on top; under SP they apply to the
     # seq-sharded activations (type embedding is position-independent)
     tt = jnp.take(params["embedding"]["token_type"], token_type_ids, axis=0)
-    tt = jnp.transpose(tt, (1, 0, 2)).astype(cfg.compute_dtype)
+    tt = tt.astype(cfg.compute_dtype)  # [b, s, h]
     if cfg.sequence_parallel:
         from apex_tpu.transformer.tensor_parallel.mappings import (
             scatter_to_sequence_parallel_region,
         )
-        tt = scatter_to_sequence_parallel_region(tt, cfg.axis)
+        tt = scatter_to_sequence_parallel_region(tt, cfg.axis, 1)
     h = h + tt
     return layer_norm(h, params["embedding"]["ln"]["scale"],
                       params["embedding"]["ln"]["bias"],
@@ -120,7 +120,7 @@ def _embed(cfg: BertConfig, params, tokens, token_type_ids):
 
 
 def hidden_states(cfg: BertConfig, params, tokens, token_type_ids=None):
-    """[b, s] ids → [s(_local), b, h] final hidden (post final-LN)."""
+    """[b, s] ids → [b, s(_local), h] final hidden (post final-LN)."""
     from jax import lax as _lax
 
     core = cfg.core()
@@ -142,10 +142,10 @@ def hidden_states(cfg: BertConfig, params, tokens, token_type_ids=None):
 
 
 def mlm_logits(cfg: BertConfig, params, tokens, token_type_ids=None):
-    """Vocab-sharded MLM logits [s, b, vocab/tp]."""
+    """Vocab-sharded MLM logits [b, s, vocab/tp]."""
     h = hidden_states(cfg, params, tokens, token_type_ids)
     if cfg.sequence_parallel:
-        h = gather_from_sequence_parallel_region(h, cfg.axis, True)
+        h = gather_from_sequence_parallel_region(h, cfg.axis, True, 1)
     else:
         h = copy_to_tensor_model_parallel_region(h, cfg.axis)
     head = params["mlm_head"]
@@ -155,7 +155,7 @@ def mlm_logits(cfg: BertConfig, params, tokens, token_type_ids=None):
     h = layer_norm(h, head["ln"]["scale"], head["ln"]["bias"],
                    eps=cfg.layernorm_epsilon)
     table = params["embedding"]["word"]["table"].astype(cfg.compute_dtype)
-    lg = jnp.einsum("sbh,vh->sbv", h, table)
+    lg = jnp.einsum("bsh,vh->bsv", h, table)
     return lg + head["bias"].astype(cfg.compute_dtype)
 
 
@@ -167,7 +167,6 @@ def mlm_loss(cfg: BertConfig, params, tokens, targets, mlm_mask,
     at masked positions (ignored elsewhere).
     """
     lg = mlm_logits(cfg, params, tokens, token_type_ids).astype(jnp.float32)
-    per_tok = vocab_parallel_cross_entropy(
-        lg, jnp.transpose(targets, (1, 0)), 0.0, cfg.axis)
-    w = jnp.transpose(mlm_mask, (1, 0)).astype(jnp.float32)
+    per_tok = vocab_parallel_cross_entropy(lg, targets, 0.0, cfg.axis)
+    w = mlm_mask.astype(jnp.float32)
     return jnp.sum(per_tok * w) / jnp.maximum(jnp.sum(w), 1.0)

@@ -16,8 +16,11 @@ from apex_tpu's own parity pieces:
   activations sharded on the seq dim between TP blocks,
 - activation recompute via ``jax.checkpoint`` per layer.
 
-Layout is Megatron's ``[seq, batch, hidden]`` so the SP mappings (which act
-on dim 0) apply directly. All functions have *local-shard* semantics: call
+Layout is batch-major ``[batch, seq, hidden]`` — the Pallas flash
+kernel's native operand layout, so attention needs no layout copies at
+all (Megatron's [s, b, h] convention exists for NCCL-era reasons that
+don't apply here; the SP mappings take ``dim=1``). All functions have
+*local-shard* semantics: call
 inside ``shard_map`` over a mesh with a ``tp`` axis (``tp=1`` is fine).
 Layer parameters are stacked on a leading layer axis and scanned, so
 compile time is O(1) in depth.
@@ -85,7 +88,7 @@ class GPTConfig:
     #: Selective-recompute modes the reference's checkpoint() can't
     #: express.
     remat_policy: Optional[str] = None
-    #: CE sequence-chunk size: the [s, b, vocab] logits tensor never
+    #: CE sequence-chunk size: the [b, s, vocab] logits tensor never
     #: materialises — each chunk's logits are computed, reduced to per-token
     #: losses, and rematerialised in backward. 0 = unchunked. The memory
     #: shape of the reference's fused xentropy kernel (apex/contrib/
@@ -205,11 +208,16 @@ def _layer_init(cfg: GPTConfig, key):
     p = {
         "ln1": {"scale": jnp.ones((h,), dt), "bias": jnp.zeros((h,), dt)},
         "attn": {
-            # fused QKV, head-major [h, heads * 3 * head_dim] so a TP shard
-            # of the out dim keeps whole (q, k, v) triples per head
-            # (Megatron's interleaved fused-QKV layout, not plain concat)
-            "qkv": {"kernel": init(k[0], (h, 3 * h), dt),
-                    "bias": jnp.zeros((3 * h,), dt)},
+            # fused QKV as [h, 3, h]: the last dim is TP-sharded, so every
+            # rank holds whole heads and its (q | k | v) slabs are
+            # CONTIGUOUS — the three slab matmuls produce q/k/v directly
+            # in the flash kernel's [b, s, hidden] operand layout, with no
+            # per-head de-interleave in either direction. (Megatron
+            # interleaves per-head triples into a 2-D [h, 3h] weight (U)
+            # only because torch Linear demands 2-D; a 3-D param is the
+            # TPU-native form of the same TP-divisibility contract.)
+            "qkv": {"kernel": init(k[0], (h, 3, h), dt),
+                    "bias": jnp.zeros((3, h), dt)},
             "proj": {"kernel": out_init(k[1], (h, h), dt),
                      "bias": jnp.zeros((h,), dt)},
         },
@@ -268,7 +276,8 @@ def param_specs(cfg: GPTConfig, *, pipeline: bool = False) -> Any:
     lay = {
         "ln1": {"scale": P(None), "bias": P(None)},
         "attn": {
-            "qkv": {"kernel": P(None, None, t), "bias": P(None, t)},
+            "qkv": {"kernel": P(None, None, None, t),
+                    "bias": P(None, None, t)},
             "proj": {"kernel": P(None, t, None), "bias": P(None)},
         },
         "ln2": {"scale": P(None), "bias": P(None)},
@@ -331,38 +340,49 @@ def seq_partial_grad_mask(cfg: GPTConfig) -> Any:
 # forward (local-shard semantics — inside shard_map over cfg.axis)
 # ---------------------------------------------------------------------------
 
+def _qkv_project(cfg: GPTConfig, p, x, *, sequence_parallel=False):
+    """TP entry mapping + the three slab matmuls of the ``[h, 3,
+    h_local]`` fused-QKV param → ``(q, k, v)``, each ``[..., h_local]``
+    in the flash kernel's operand layout. One mapping shared by the
+    three matmuls (its VJP accumulates the three dx cotangents into a
+    single psum); single-sourced so the training and decode paths can
+    never diverge."""
+    w, bias = p["kernel"], p["bias"]
+    if sequence_parallel:
+        x = gather_from_sequence_parallel_region(x, cfg.axis, True, 1)
+    else:
+        x = copy_to_tensor_model_parallel_region(x, cfg.axis)
+    return tuple(jnp.matmul(x, w[:, i]) + bias[i] for i in range(3))
+
+
 def _attention(cfg: GPTConfig, p, h, *, return_kv: bool = False):
-    """h: [s(_local under SP), b, hidden] → same shape. With
+    """h: [b, s(_local under SP), hidden] → same shape. With
     ``return_kv`` also returns the per-head (k, v) ``[b, heads_local, s,
     head_dim]`` — the cache entries bulk prefill captures — so the
     projection/layout logic stays single-sourced."""
     sp = cfg.sequence_parallel
-    qkv = column_parallel_linear(
-        h, p["qkv"]["kernel"], p["qkv"]["bias"], axis=cfg.axis,
-        sequence_parallel=sp,
-    )  # [s_full, b, 3h/tp]
-    qkv = checkpoint_name(qkv, "attn_qkv")
-    s, b, local3 = qkv.shape
+    q, k, v = _qkv_project(cfg, p["qkv"], h, sequence_parallel=sp)
+    b, s, hl = q.shape           # [b, s_full, h_local] each
     d = cfg.head_dim
-    heads_local = local3 // (3 * d)
-    qkv = qkv.reshape(s, b, heads_local, 3, d)
-    out = _attention_ctx(cfg, qkv)
+    heads_local = hl // d
+    out = _attention_ctx(cfg, q, k, v, heads_local)
     proj = row_parallel_linear(
         out, p["proj"]["kernel"], p["proj"]["bias"], axis=cfg.axis,
-        sequence_parallel=sp,
+        sequence_parallel=sp, sequence_dim=1,
     )
     if return_kv:
-        k = jnp.transpose(qkv[:, :, :, 1, :], (1, 2, 0, 3))
-        v = jnp.transpose(qkv[:, :, :, 2, :], (1, 2, 0, 3))
-        return proj, (k, v)
+        split = lambda t: jnp.transpose(
+            t.reshape(b, s, heads_local, d), (0, 2, 1, 3))
+        return proj, (split(k), split(v))
     return proj
 
 
-def _attention_ctx(cfg: GPTConfig, qkv):
-    """Core attention from the reshaped fused-QKV ``[s, b, heads_local,
-    3, head_dim]`` to the pre-projection context ``[s, b, hidden_local]``
-    — the impl/layout dispatch shared by training and bulk prefill."""
-    s, b, heads_local, _, d = qkv.shape
+def _attention_ctx(cfg: GPTConfig, q, k, v, heads_local: int):
+    """Core attention from the projected ``q/k/v [b, s, hidden_local]``
+    slabs to the pre-projection context ``[b, s, hidden_local]`` — the
+    impl/layout dispatch shared by training and bulk prefill."""
+    b, s, hl = q.shape
+    d = hl // heads_local
     impl = cfg.attn_impl
     if impl == "auto":
         from apex_tpu.kernels._utils import use_interpret
@@ -392,21 +412,21 @@ def _attention_ctx(cfg: GPTConfig, qkv):
         raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
     if cfg.attn_layout not in ("auto", "bhsd"):
         raise ValueError(f"unknown attn_layout {cfg.attn_layout!r}")
+    q = checkpoint_name(q, "attn_qkv")
+    k = checkpoint_name(k, "attn_qkv")
+    v = checkpoint_name(v, "attn_qkv")
     if (impl == "flash" and not cfg.context_parallel
             and cfg.attn_layout == "auto"):
-        # layout-native fast path: q/k/v stay [b, s, hidden] (one
-        # transposing de-interleave of the fused-QKV projection, no
-        # head-major form, no head_dim<128 lane padding anywhere)
-        q, k, v = (
-            jnp.transpose(qkv[:, :, :, i, :], (1, 0, 2, 3)).reshape(
-                b, s, heads_local * d)
-            for i in range(3))
+        # layout-native fast path: the slab projections are already in
+        # the kernel's [b, s, hidden] operand layout — call straight in,
+        # zero layout copies in either direction; the remat saves are the
+        # kernel-ready tensors themselves.
         out = flash_attention_bsh(
             q, k, v, num_heads=heads_local, causal=cfg.causal)
-        return jnp.transpose(out, (1, 0, 2))  # [s, b, hidden_local]
+        return out  # [b, s, hidden_local]
     # [b, heads_local, s, d] each
-    q, k, v = (jnp.transpose(qkv[:, :, :, i, :], (1, 2, 0, 3))
-               for i in range(3))
+    q, k, v = (jnp.transpose(t.reshape(b, s, heads_local, d), (0, 2, 1, 3))
+               for t in (q, k, v))
     if cfg.context_parallel:
         out = ring_attention(q, k, v, axis=cfg.cp_axis, causal=cfg.causal,
                              zigzag=cfg.cp_zigzag)
@@ -443,20 +463,20 @@ def _attention_ctx(cfg: GPTConfig, qkv):
                 f"unknown attn_score_dtype {cfg.attn_score_dtype!r} "
                 "(expected 'f32' or 'compute')")
         out = jnp.einsum("bhqk,bhkd->bhqd", p_attn, v)
-    return jnp.transpose(out, (2, 0, 1, 3)).reshape(s, b, heads_local * d)
+    return jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, heads_local * d)
 
 
 def _mlp(cfg: GPTConfig, p, h):
     sp = cfg.sequence_parallel
     y = column_parallel_linear(
         h, p["fc1"]["kernel"], p["fc1"]["bias"], axis=cfg.axis,
-        sequence_parallel=sp,
+        sequence_parallel=sp, sequence_dim=1,
     )
     y = checkpoint_name(y, "mlp_fc1")  # pre-gelu: gelu replays cheaply
     y = jax.nn.gelu(y, approximate=True)
     return row_parallel_linear(
         y, p["fc2"]["kernel"], p["fc2"]["bias"], axis=cfg.axis,
-        sequence_parallel=sp,
+        sequence_parallel=sp, sequence_dim=1,
     )
 
 
@@ -501,10 +521,10 @@ def _block(cfg: GPTConfig, p, h, *, return_kv: bool = False):
                 "num_experts > 0 does not compose with sequence_parallel "
                 "(MoE routes over full-h activations); shard the batch "
                 "over ep instead")
-        s, b, hd = x.shape
+        b, s, hd = x.shape
         y, aux = moe_mod.moe_ffn(
-            _moe_cfg(cfg), p["moe"], x.reshape(s * b, hd))
-        h = h + y.reshape(s, b, hd)
+            _moe_cfg(cfg), p["moe"], x.reshape(b * s, hd))
+        h = h + y.reshape(b, s, hd)
     else:
         h, aux = h + _mlp(cfg, p["mlp"], x), jnp.float32(0.0)
     if return_kv:
@@ -530,7 +550,7 @@ def _cp_slice(cfg: GPTConfig, x, dim: int):
 
 
 def _embed(cfg: GPTConfig, params, tokens):
-    """tokens [b, s] → entry activation [s(_local under SP/CP), b,
+    """tokens [b, s] → entry activation [b, s(_local under SP/CP),
     hidden]."""
     if cfg.context_parallel and cfg.sequence_parallel:
         raise ValueError(
@@ -544,10 +564,9 @@ def _embed(cfg: GPTConfig, params, tokens):
         tokens, params["embedding"]["word"]["table"].astype(cfg.compute_dtype),
         axis=cfg.axis,
     )  # [b, s_local, h]
-    h = emb + pos[None].astype(cfg.compute_dtype)
-    h = jnp.transpose(h, (1, 0, 2))  # [s, b, h]
+    h = emb + pos[None].astype(cfg.compute_dtype)  # [b, s_local, h]
     if cfg.sequence_parallel:
-        h = scatter_to_sequence_parallel_region(h, cfg.axis)
+        h = scatter_to_sequence_parallel_region(h, cfg.axis, 1)
     return h
 
 
@@ -570,7 +589,7 @@ def _scan_blocks(cfg: GPTConfig, h, layers):
 
 def hidden_states_and_aux(cfg: GPTConfig, params, tokens):
     """tokens [b, s] (global ids, dp-local batch) → (final-LN hidden
-    [s(_local under SP), b, hidden] in compute dtype, summed MoE aux
+    [b, s(_local under SP), hidden] in compute dtype, summed MoE aux
     loss — 0 for dense models)."""
     h, aux = _scan_blocks(cfg, _embed(cfg, params, tokens),
                           params["layers"])
@@ -582,36 +601,36 @@ def hidden_states_and_aux(cfg: GPTConfig, params, tokens):
 
 def hidden_states(cfg: GPTConfig, params, tokens):
     """tokens [b, s] (global ids, dp-local batch) → final-LN hidden
-    [s(_local under SP), b, hidden] in compute dtype."""
+    [b, s(_local under SP), hidden] in compute dtype."""
     return hidden_states_and_aux(cfg, params, tokens)[0]
 
 
 def logits(cfg: GPTConfig, params, tokens):
-    """Vocab-sharded logits [s, b, vocab/tp] with the output head tied to
+    """Vocab-sharded logits [b, s, vocab/tp] with the output head tied to
     the word embedding (Megatron weight tying)."""
     h = hidden_states(cfg, params, tokens)
     if cfg.sequence_parallel:
         # gather fwd / reduce-scatter bwd: sums each rank's partial dL/dh
-        h = gather_from_sequence_parallel_region(h, cfg.axis, True)
+        h = gather_from_sequence_parallel_region(h, cfg.axis, True, 1)
     else:
         # identity fwd / psum bwd — without this, each rank's dL/dh carries
         # only its vocab shard's contribution into the replicated backbone
         # (Megatron's parallel_lm_logits does the same (U))
         h = copy_to_tensor_model_parallel_region(h, cfg.axis)
     table = params["embedding"]["word"]["table"].astype(cfg.compute_dtype)
-    return jnp.einsum("sbh,vh->sbv", h, table)
+    return jnp.einsum("bsh,vh->bsv", h, table)
 
 
-def _ce_of_hidden(cfg: GPTConfig, params, h, targets_sb):
-    """Mean CE from final hidden states ``h [s, b, hid]`` (already
-    SP-gathered / copy-region'd) against ``targets_sb [s, b]``.
+def _ce_of_hidden(cfg: GPTConfig, params, h, targets_bs):
+    """Mean CE from final hidden states ``h [b, s, hid]`` (already
+    SP-gathered / copy-region'd) against ``targets_bs [b, s]``.
 
     With ``cfg.ce_chunk`` the sequence dim is scanned in chunks under
     ``jax.checkpoint``: forward keeps only per-token losses, backward
     recomputes each chunk's logits — peak memory drops from
     O(s·b·vocab) to O(chunk·b·vocab)."""
     table = params["embedding"]["word"]["table"].astype(cfg.compute_dtype)
-    s, b = targets_sb.shape
+    b, s = targets_bs.shape
     chunk = cfg.ce_chunk
     if chunk > 0 and s % chunk:
         raise ValueError(
@@ -641,15 +660,18 @@ def _ce_of_hidden(cfg: GPTConfig, params, h, targets_sb):
         raise ValueError(f"unknown ce_impl {cfg.ce_impl!r}")
 
     if chunk <= 0:
-        lg = jnp.einsum("sbh,vh->sbv", h, table).astype(jnp.float32)
-        return ce_sum(lg, targets_sb) / (s * b)
+        lg = jnp.einsum("bsh,vh->bsv", h, table).astype(jnp.float32)
+        return ce_sum(lg, targets_bs) / (s * b)
 
-    hs = h.reshape(s // chunk, chunk, b, h.shape[-1])
-    ts = targets_sb.reshape(s // chunk, chunk, b)
+    # chunk the seq dim: scan axis leads, so each [b, chunk] chunk slab
+    # is a strided view — the per-chunk slices stay contiguous in s
+    hs = jnp.moveaxis(
+        h.reshape(b, s // chunk, chunk, h.shape[-1]), 1, 0)
+    ts = jnp.moveaxis(targets_bs.reshape(b, s // chunk, chunk), 1, 0)
 
     @jax.checkpoint
     def ce_block(hb, tb):
-        lg = jnp.einsum("sbh,vh->sbv", hb, table).astype(jnp.float32)
+        lg = jnp.einsum("bsh,vh->bsv", hb, table).astype(jnp.float32)
         return ce_sum(lg, tb)
 
     def body(acc, xt):
@@ -669,14 +691,14 @@ def loss(cfg: GPTConfig, params, tokens, targets):
     """
     h, aux = hidden_states_and_aux(cfg, params, tokens)
     if cfg.sequence_parallel:
-        h = gather_from_sequence_parallel_region(h, cfg.axis, True)
+        h = gather_from_sequence_parallel_region(h, cfg.axis, True, 1)
     else:
         h = copy_to_tensor_model_parallel_region(h, cfg.axis)
-    tgt = jnp.transpose(targets, (1, 0))
+    tgt = targets
     if cfg.context_parallel:
         # local mean over this rank's chunk; shards are equal-sized so the
         # global mean is the cp-pmean the train step applies
-        tgt = _cp_slice(cfg, tgt, 0)
+        tgt = _cp_slice(cfg, tgt, 1)
     ce = _ce_of_hidden(cfg, params, h, tgt)
     if cfg.num_experts:
         ce = ce + jnp.float32(cfg.moe_aux_coef) * aux
@@ -805,22 +827,22 @@ def pipeline_loss(
         seq_local = s // lax.axis_size(cfg.axis)
     if cfg.context_parallel:
         seq_local = s // lax.axis_size(cfg.cp_axis)
-    item = jax.ShapeDtypeStruct((seq_local, mb, cfg.hidden_size),
+    item = jax.ShapeDtypeStruct((mb, seq_local, cfg.hidden_size),
                                 cfg.compute_dtype)
 
     def loss_of_outputs(outs):
-        # outs [n_micro, s_local, mb, h] → final LN + tied head + CE
-        h = jnp.transpose(outs, (1, 0, 2, 3)).reshape(
-            outs.shape[1], n_micro * mb, cfg.hidden_size)
+        # outs [n_micro, mb, s_local, h] → final LN + tied head + CE
+        # (microbatch dims merge contiguously in the batch-major layout)
+        h = outs.reshape(n_micro * mb, outs.shape[2], cfg.hidden_size)
         h = _layer_norm(cfg, h, params["final_ln"]["scale"],
                         params["final_ln"]["bias"])
         if cfg.sequence_parallel:
-            h = gather_from_sequence_parallel_region(h, cfg.axis, True)
+            h = gather_from_sequence_parallel_region(h, cfg.axis, True, 1)
         else:
             h = copy_to_tensor_model_parallel_region(h, cfg.axis)
-        tgt = jnp.transpose(targets.reshape(n_micro * mb, s), (1, 0))
+        tgt = targets.reshape(n_micro * mb, s)
         if cfg.context_parallel:
-            tgt = _cp_slice(cfg, tgt, 0)
+            tgt = _cp_slice(cfg, tgt, 1)
         return _ce_of_hidden(cfg, params, h, tgt)
 
     if cfg.num_experts:
@@ -847,9 +869,9 @@ def init_cache(cfg: GPTConfig, params, batch: int,
     inside ``shard_map`` like the rest of the model. ``max_len`` defaults
     to ``cfg.seq_len``; size it to the actual decode horizon (attention
     runs over every cache slot each step)."""
-    qkv_k = params["layers"]["attn"]["qkv"]["kernel"]
+    qkv_k = params["layers"]["attn"]["qkv"]["kernel"]  # [L, h, 3, hl]
     l_local = qkv_k.shape[0]
-    heads_local = qkv_k.shape[-1] // (3 * cfg.head_dim)
+    heads_local = qkv_k.shape[-1] // cfg.head_dim
     return jnp.zeros(
         (l_local, 2, batch, heads_local, max_len or cfg.seq_len,
          cfg.head_dim),
@@ -859,14 +881,12 @@ def init_cache(cfg: GPTConfig, params, batch: int,
 def _decode_layer(cfg: GPTConfig, p, x, kv, pos):
     """One layer for one token: x [b, hidden], kv [2, b, hl, S, d]."""
     xa = _layer_norm(cfg, x, p["ln1"]["scale"], p["ln1"]["bias"])
-    qkv = column_parallel_linear(
-        xa, p["attn"]["qkv"]["kernel"], p["attn"]["qkv"]["bias"],
-        axis=cfg.axis)
-    b, local3 = qkv.shape
     d = cfg.head_dim
-    hl = local3 // (3 * d)
-    qkv = qkv.reshape(b, hl, 3, d)
-    q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    b = xa.shape[0]
+    hl = p["attn"]["qkv"]["kernel"].shape[-1]
+    q, k_new, v_new = (
+        t.reshape(b, hl // d, d)
+        for t in _qkv_project(cfg, p["attn"]["qkv"], xa))
     k_cache = lax.dynamic_update_slice_in_dim(
         kv[0], k_new[:, :, None], pos, axis=2)
     v_cache = lax.dynamic_update_slice_in_dim(
@@ -879,7 +899,7 @@ def _decode_layer(cfg: GPTConfig, p, x, kv, pos):
     valid = jnp.arange(k_cache.shape[2]) <= pos
     scores = jnp.where(valid[None, None], scores, -1e30)
     p_attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhs,bhsd->bhd", p_attn, v_cache).reshape(b, hl * d)
+    out = jnp.einsum("bhs,bhsd->bhd", p_attn, v_cache).reshape(b, hl)
     attn = row_parallel_linear(
         out, p["attn"]["proj"]["kernel"], p["attn"]["proj"]["bias"],
         axis=cfg.axis)
@@ -970,7 +990,7 @@ def prefill(cfg: GPTConfig, params, prompt, *, max_len: Optional[int] = None):
     # ks/vs [l_local, b, heads_local, p_len, d] → cache [l, 2, b, hl, S, d]
     pad = ((0, 0),) * 3 + ((0, max_len - p_len), (0, 0))
     cache = jnp.stack([jnp.pad(ks, pad), jnp.pad(vs, pad)], axis=1)
-    return cache, _lm_head(cfg, params, h[-1])
+    return cache, _lm_head(cfg, params, h[:, -1])
 
 
 def _filter_logits(logits, top_k: int, top_p: float):

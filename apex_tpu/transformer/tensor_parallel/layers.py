@@ -58,17 +58,18 @@ def column_parallel_linear(
     axis: str = AXIS_TP,
     gather_output: bool = False,
     sequence_parallel: bool = False,
+    sequence_dim: int = 0,
 ):
     """Y = X·A with A column-sharded: ``kernel`` is the local ``[in,
     out/tp]`` shard (``ColumnParallelLinear.forward`` (U)).
 
-    ``sequence_parallel`` expects ``x`` sharded on dim 0 (seq) and
+    ``sequence_parallel`` expects ``x`` sharded on ``sequence_dim`` and
     all-gathers it forward / reduce-scatters its grad backward; otherwise
     ``x`` is replicated and the backward all-reduce comes from the copy
     mapping.
     """
     if sequence_parallel:
-        x = gather_from_sequence_parallel_region(x, axis, True)
+        x = gather_from_sequence_parallel_region(x, axis, True, sequence_dim)
     else:
         x = copy_to_tensor_model_parallel_region(x, axis)
     y = jnp.matmul(x, kernel)
@@ -89,6 +90,7 @@ def row_parallel_linear(
     axis: str = AXIS_TP,
     input_is_parallel: bool = True,
     sequence_parallel: bool = False,
+    sequence_dim: int = 0,
 ):
     """Y = X·A with A row-sharded: ``kernel`` is the local ``[in/tp, out]``
     shard; partial products are summed across the axis
@@ -106,7 +108,7 @@ def row_parallel_linear(
         x = scatter_to_tensor_model_parallel_region(x, axis)
     y = jnp.matmul(x, kernel)
     if sequence_parallel:
-        y = reduce_scatter_to_sequence_parallel_region(y, axis)
+        y = reduce_scatter_to_sequence_parallel_region(y, axis, sequence_dim)
     else:
         y = reduce_from_tensor_model_parallel_region(y, axis)
     if bias is not None:

@@ -18,7 +18,10 @@ gather_from_sequence_parallel_region  all-gather seq      reduce-scatter seq
 reduce_scatter_to_sequence_parallel…  reduce-scatter seq  all-gather seq
 ====================================  ==================  ==================
 
-The sequence dimension is dim 0 ([s, b, h] layout, as in Megatron).
+The sequence dimension defaults to dim 0 (Megatron's [s, b, h] layout);
+consumers using a batch-major [b, s, h] layout pass ``dim=1`` (the TPU
+models do — the flash kernel's native operand layout is [b, s, hidden],
+and keeping the model batch-major removes every layout copy around it).
 """
 
 from __future__ import annotations
@@ -132,27 +135,29 @@ gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
 
 
 # -- sequence-parallel mappings along the seq (first) dim ------------------
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
-def scatter_to_sequence_parallel_region(x, axis: str = AXIS_TP):
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def scatter_to_sequence_parallel_region(x, axis: str = AXIS_TP,
+                                        dim: int = _SEQ_DIM):
     """Shard the sequence dim across the TP ranks (SP entry;
     ``_ScatterToSequenceParallelRegion`` (U))."""
-    return _local_chunk(x, axis, _SEQ_DIM)
+    return _local_chunk(x, axis, dim)
 
 
-def _seq_scatter_fwd(x, axis):
-    return _local_chunk(x, axis, _SEQ_DIM), None
+def _seq_scatter_fwd(x, axis, dim):
+    return _local_chunk(x, axis, dim), None
 
 
-def _seq_scatter_bwd(axis, _, g):
-    return (_all_gather(g, axis, _SEQ_DIM),)
+def _seq_scatter_bwd(axis, dim, _, g):
+    return (_all_gather(g, axis, dim),)
 
 
 scatter_to_sequence_parallel_region.defvjp(_seq_scatter_fwd, _seq_scatter_bwd)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
 def gather_from_sequence_parallel_region(
-    x, axis: str = AXIS_TP, tensor_parallel_output_grad: bool = True
+    x, axis: str = AXIS_TP, tensor_parallel_output_grad: bool = True,
+    dim: int = _SEQ_DIM,
 ):
     """All-gather the sequence dim before a ColumnParallelLinear.
 
@@ -160,35 +165,36 @@ def gather_from_sequence_parallel_region(
     rank contributes a partial grad for the full sequence — the SP core
     trick), else a plain split (``_GatherFromSequenceParallelRegion`` (U)).
     """
-    return _all_gather(x, axis, _SEQ_DIM)
+    return _all_gather(x, axis, dim)
 
 
-def _seq_gather_fwd(x, axis, tp_grad):
-    return _all_gather(x, axis, _SEQ_DIM), None
+def _seq_gather_fwd(x, axis, tp_grad, dim):
+    return _all_gather(x, axis, dim), None
 
 
-def _seq_gather_bwd(axis, tp_grad, _, g):
+def _seq_gather_bwd(axis, tp_grad, dim, _, g):
     if tp_grad:
-        return (_reduce_scatter(g, axis, _SEQ_DIM),)
-    return (_local_chunk(g, axis, _SEQ_DIM),)
+        return (_reduce_scatter(g, axis, dim),)
+    return (_local_chunk(g, axis, dim),)
 
 
 gather_from_sequence_parallel_region.defvjp(_seq_gather_fwd, _seq_gather_bwd)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
-def reduce_scatter_to_sequence_parallel_region(x, axis: str = AXIS_TP):
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def reduce_scatter_to_sequence_parallel_region(x, axis: str = AXIS_TP,
+                                               dim: int = _SEQ_DIM):
     """Reduce partial sums and shard the sequence dim after a
     RowParallelLinear (``_ReduceScatterToSequenceParallelRegion`` (U))."""
-    return _reduce_scatter(x, axis, _SEQ_DIM)
+    return _reduce_scatter(x, axis, dim)
 
 
-def _seq_rs_fwd(x, axis):
-    return _reduce_scatter(x, axis, _SEQ_DIM), None
+def _seq_rs_fwd(x, axis, dim):
+    return _reduce_scatter(x, axis, dim), None
 
 
-def _seq_rs_bwd(axis, _, g):
-    return (_all_gather(g, axis, _SEQ_DIM),)
+def _seq_rs_bwd(axis, dim, _, g):
+    return (_all_gather(g, axis, dim),)
 
 
 reduce_scatter_to_sequence_parallel_region.defvjp(_seq_rs_fwd, _seq_rs_bwd)

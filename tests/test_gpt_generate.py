@@ -33,8 +33,9 @@ def _teacher_forced(cfg, params, prompt, mesh):
     toks = prompt
     out = []
     for _ in range(N_NEW):
-        lg = logits_fn(params, toks)  # [s, b, vocab]
-        nxt = jnp.argmax(lg[-1].astype(jnp.float32), -1).astype(jnp.int32)
+        lg = logits_fn(params, toks)  # [b, s, vocab]
+        nxt = jnp.argmax(
+            lg[:, -1].astype(jnp.float32), -1).astype(jnp.int32)
         out.append(nxt)
         toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
     return jnp.stack(out, axis=1)  # [b, n_new]
@@ -207,7 +208,7 @@ def test_prefill_logits_match_full_forward(devices8):
         in_specs=(pspecs, P(None, None)),
         out_specs=P(None, None, "tp"), check_vma=False))(params, prompt)
     np.testing.assert_allclose(
-        np.asarray(pre_lg), np.asarray(full_lg[-1], np.float32),
+        np.asarray(pre_lg), np.asarray(full_lg[:, -1], np.float32),
         rtol=2e-5, atol=2e-5)
 
 
@@ -229,7 +230,7 @@ def test_generate_single_new_token(devices8):
         lambda p, t: gpt.logits(cfg, p, t), mesh=mesh,
         in_specs=(pspecs, P(None, None)),
         out_specs=P(None, None, "tp"), check_vma=False))(params, prompt)
-    exp = jnp.argmax(lg[-1].astype(jnp.float32), -1).astype(jnp.int32)
+    exp = jnp.argmax(lg[:, -1].astype(jnp.float32), -1).astype(jnp.int32)
     np.testing.assert_array_equal(np.asarray(out[:, 0]), np.asarray(exp))
 
 

@@ -245,7 +245,10 @@ def test_clip_grad_norm_overflow_still_skips_step(devices8):
                     jax.tree.leaves(jax.device_get(state.params))):
         np.testing.assert_array_equal(np.asarray(r), np.asarray(t))
     # scale keeps halving until a clean step lands and trains normally
-    for _ in range(12):
+    # (the recovery scale is layout/reduction-order sensitive within a
+    # factor of ~2 — the window covers the 2^17 the batch-major layout
+    # lands on)
+    for _ in range(14):
         state, m = step_fn(state, tok, tgt)
         if int(m["grads_finite"]):
             break

@@ -200,18 +200,18 @@ def test_single_stage_with_aux_matches_flat_forward(devices8):
             return gpt._scan_blocks(cfg, x, p["layers"])
 
         def loss_of(outs):
-            h = jnp.transpose(outs, (1, 0, 2, 3)).reshape(
-                outs.shape[1], t.shape[0], cfg.hidden_size)
+            # outs [n_micro, mb, s, h]: microbatches merge contiguously
+            h = outs.reshape(t.shape[0], outs.shape[2], cfg.hidden_size)
             h = gpt._layer_norm(cfg, h, p["final_ln"]["scale"],
                                 p["final_ln"]["bias"])
             from apex_tpu.transformer.tensor_parallel.mappings import (
                 copy_to_tensor_model_parallel_region,
             )
             h = copy_to_tensor_model_parallel_region(h, cfg.axis)
-            tgt_sb = jnp.transpose(y.reshape(t.shape[0], -1), (1, 0))
-            return gpt._ce_of_hidden(cfg, p, h, tgt_sb)
+            return gpt._ce_of_hidden(cfg, p, h,
+                                     y.reshape(t.shape[0], -1))
 
-        item = jax.ShapeDtypeStruct((32, mb, cfg.hidden_size),
+        item = jax.ShapeDtypeStruct((mb, 32, cfg.hidden_size),
                                     cfg.compute_dtype)
         ce, aux = forward_backward_single_stage(
             chunk_fn, inject, loss_of, n_micro, item, with_aux=True)
