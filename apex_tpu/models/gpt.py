@@ -41,7 +41,7 @@ import numpy as np
 
 from apex_tpu.kernels import flash_attention, flash_attention_bsh, layer_norm
 from apex_tpu.kernels.blockwise_attention import blockwise_attention
-from apex_tpu.mesh.topology import AXIS_CP, AXIS_EP, AXIS_PP, AXIS_TP
+from apex_tpu.mesh.topology import AXIS_CP, AXIS_DP, AXIS_EP, AXIS_PP, AXIS_TP
 from apex_tpu.transformer import moe as moe_mod
 from apex_tpu.transformer.context_parallel import ring_attention
 from apex_tpu.transformer.pipeline_parallel.schedules import pipelined_loss
@@ -168,6 +168,19 @@ class GPTConfig:
     #: "auto" | "einsum" | "gather" — see MoEConfig.dispatch
     moe_dispatch: str = "auto"
     ep_axis: str = AXIS_EP
+    #: ZeRO-3 / FSDP analogue (beyond the reference's ZeRO-1/2
+    #: ``distributed_fused_{adam,lamb}`` (U)): the four big layer matmul
+    #: kernels (qkv/proj/fc1/fc2) live dp-sharded on their replicated
+    #: h-dim between steps; each layer all-gathers them over dp at use
+    #: (inside the remat boundary, so backward re-gathers instead of
+    #: holding full weights), and the gather's VJP is the ZeRO
+    #: reduce-scatter — gradients and (tree-layout) optimizer state
+    #: stay dp-sharded. Requires ``hidden_size % dp == 0``, a
+    #: tree-layout optimizer, and a dense model (no MoE). Param memory
+    #: per rank drops ~1/dp for the layer stack; comm per step is one
+    #: extra all-gather per kernel per layer (2x under remat), riding
+    #: ICI. LN/bias leaves and the embedding stay replicated.
+    fsdp: bool = False
     compute_dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     layernorm_epsilon: float = 1e-5
@@ -294,6 +307,19 @@ def param_specs(cfg: GPTConfig, *, pipeline: bool = False) -> Any:
             "fc1": {"kernel": P(None, None, t), "bias": P(None, t)},
             "fc2": {"kernel": P(None, t, None), "bias": P(None)},
         }
+    if cfg.fsdp:
+        # overlay dp on each kernel's fsdp dim (fsdp_layer_dims is the
+        # single source; +1 for the stacked-L axis)
+        def overlay(s, d):
+            if d < 0:
+                return s
+            t_ = tuple(s)
+            assert t_[d + 1] is None, "fsdp dim collides with tp"
+            return P(*t_[:d + 1], AXIS_DP, *t_[d + 2:])
+
+        lay = jax.tree.map(
+            overlay, lay, fsdp_layer_dims(cfg),
+            is_leaf=lambda x: isinstance(x, P))
     if pipeline:
         # the leading spec entry is the stacked layer dim — shard it on pp
         lay = jax.tree.map(
@@ -304,6 +330,31 @@ def param_specs(cfg: GPTConfig, *, pipeline: bool = False) -> Any:
         "layers": lay,
         "final_ln": {"scale": P(None), "bias": P(None)},
     }
+
+
+def fsdp_layer_dims(cfg: GPTConfig) -> Any:
+    """Per-layer tree of the dim (layer coords, no stacked-L axis) each
+    leaf is dp-sharded on under ``cfg.fsdp`` — ``-1`` = replicated (a
+    sentinel rather than None, which jax.tree treats as structure).
+    Single source for :func:`param_specs` and the in-model gather, so
+    the two can never disagree. Only the four big matmul kernels shard
+    (their h-dim, never the tp-sharded dim); LN/bias leaves are < 0.1%
+    of layer params and stay replicated."""
+    lay = {
+        "ln1": {"scale": -1, "bias": -1},
+        "attn": {
+            "qkv": {"kernel": 0, "bias": -1},       # [h, 3, hl]
+            "proj": {"kernel": 1, "bias": -1},      # [hl, h]
+        },
+        "ln2": {"scale": -1, "bias": -1},
+    }
+    if cfg.num_experts:
+        raise ValueError("fsdp does not compose with num_experts (v1)")
+    lay["mlp"] = {
+        "fc1": {"kernel": 0, "bias": -1},           # [h, f/tp]
+        "fc2": {"kernel": 1, "bias": -1},           # [f/tp, h]
+    }
+    return lay
 
 
 def seq_partial_grad_mask(cfg: GPTConfig) -> Any:
@@ -771,7 +822,17 @@ def _remat_policy(cfg: GPTConfig):
 
 def _cast_layer(cfg: GPTConfig, layer_p):
     """Matmul weights to compute dtype; LN affine stays fp32 (MixedFused
-    behaviour (U))."""
+    behaviour (U)). Under ``cfg.fsdp`` the dp-sharded kernels are
+    all-gathered here first — inside the remat boundary, so backward
+    re-gathers rather than keeping full weights live, and the gather's
+    VJP (``psum_scatter``) IS the ZeRO gradient reduce-scatter. The
+    gather runs in param dtype so the grad reduction stays fp32
+    (apex DDP's ``allreduce_always_fp32`` semantics (U))."""
+    if cfg.fsdp and lax.axis_size(AXIS_DP) > 1:
+        layer_p = jax.tree.map(
+            lambda x, d: x if d < 0 else lax.all_gather(
+                x, AXIS_DP, axis=d, tiled=True),
+            layer_p, fsdp_layer_dims(cfg))
     cast = lambda t: jax.tree.map(
         lambda x: x.astype(cfg.compute_dtype)
         if jnp.issubdtype(x.dtype, jnp.floating) else x, t)

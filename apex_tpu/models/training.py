@@ -150,6 +150,35 @@ def make_train_step(
             raise ValueError(
                 "num_experts > 0 does not compose with sequence_parallel; "
                 "shard the batch over ep instead")
+    dp_size = mesh_shape_of(mesh).get(AXIS_DP, 1)
+    if cfg.fsdp:
+        # ZeRO-3: params dp-sharded between steps; grads arrive as the
+        # all-gather VJP's psum_scatter (already dp-summed)
+        if isinstance(optimizer, DistributedFusedOptimizer):
+            raise ValueError(
+                "fsdp already shards params/grads/state over dp; the "
+                "ZeRO-1/2 optimizers would shard them a second time — "
+                "use a tree-layout fused optimizer")
+        if getattr(optimizer, "state_pspecs", None) is None:
+            raise ValueError(
+                "fsdp needs a tree-layout optimizer (state mirrors the "
+                "dp-sharded params); pass layout='tree'")
+        if getattr(optimizer, "per_leaf_norms", False):
+            raise ValueError(
+                "fsdp shards each kernel over dp, but this optimizer's "
+                "update depends on whole-leaf norms (LAMB trust ratios / "
+                "NovoGrad layer moments) — computed on a shard they "
+                "diverge per rank; use Adam/SGD/Adagrad, or ZeRO-1/2 "
+                "distributed_fused_lamb without fsdp")
+        if not cfg.remat:
+            raise ValueError(
+                "fsdp requires remat=True: without recompute the "
+                "all-gathered full kernels are saved as backward "
+                "residuals, costing MORE memory than fsdp=False")
+        if dp_size > 1 and cfg.hidden_size % dp_size:
+            raise ValueError(
+                f"fsdp shards the kernels' h-dim: hidden_size "
+                f"{cfg.hidden_size} must divide by dp={dp_size}")
     if clip_grad_norm is not None and isinstance(
             optimizer, DistributedFusedOptimizer):
         raise ValueError(
@@ -167,7 +196,9 @@ def make_train_step(
     # per-leaf model-parallel axes for the clip norm: a leaf sharded over
     # an axis contributes its shard's sum-of-squares psum'd over it;
     # replicated leaves count once (leaf order = params treedef order)
-    _norm_axes = tuple(a for a in (AXIS_TP, AXIS_PP, ep_axis)
+    # AXIS_DP appears in pspecs only for fsdp-sharded leaves — their
+    # shard's sum-of-squares needs the dp psum like any sharded leaf
+    _norm_axes = tuple(a for a in (AXIS_TP, AXIS_PP, ep_axis, AXIS_DP)
                        if a in axes_present)
     clip_leaf_axes = [
         tuple(a for a in _norm_axes if _mentions(s, a))
@@ -186,6 +217,10 @@ def make_train_step(
     # semantics); everything else is replicated over ep and pmeans
     ep_mask = jax.tree.map(
         lambda s: _mentions(s, ep_axis), pspecs,
+        is_leaf=lambda x: isinstance(x, P))
+    # fsdp-sharded leaves: pspec mentions dp (only possible via fsdp)
+    fsdp_mask = jax.tree.map(
+        lambda s: _mentions(s, AXIS_DP), pspecs,
         is_leaf=lambda x: isinstance(x, P))
     if ep_size > 1 and any(jax.tree.leaves(ep_mask)) and getattr(
             optimizer, "state_pspecs", None) is None:
@@ -261,7 +296,17 @@ def make_train_step(
         # ZeRO optimizers own the dp reduction (reduce-scatter inside step)
         if AXIS_DP in axes_present and not isinstance(
                 optimizer, DistributedFusedOptimizer):
-            grads = lax.pmean(grads, AXIS_DP)
+            if cfg.fsdp:
+                # fsdp-sharded leaves already hold the dp-SUM (the
+                # all-gather VJP is a psum_scatter): scale to the mean;
+                # replicated leaves pmean as usual
+                inv_dp = 1.0 / dp_size
+                grads = jax.tree.map(
+                    lambda g, m: g * jnp.asarray(inv_dp, g.dtype) if m
+                    else lax.pmean(g, AXIS_DP),
+                    grads, fsdp_mask)
+            else:
+                grads = lax.pmean(grads, AXIS_DP)
         if ep_size > 1:
             inv = 1.0 / ep_size
             grads = jax.tree.map(

@@ -25,6 +25,10 @@ class FusedOptimizer(NamedTuple):
     - ``state_pspecs(param_pspecs) -> state pytree of PartitionSpecs`` —
       optional; optimizers whose state mirrors the param tree (tree
       layout) provide it so train steps can shard state like params.
+    - ``per_leaf_norms`` — True for optimizers whose update depends on
+      whole-leaf norms (LAMB trust ratios, NovoGrad per-layer second
+      moments). Such updates are wrong on a *shard* of a leaf, so
+      ZeRO-3/FSDP param sharding rejects them.
 
     Both entry points accept ``grad_scale`` so amp's unscale fuses into the
     sweep (SURVEY.md §3.2).
@@ -34,6 +38,7 @@ class FusedOptimizer(NamedTuple):
     update: Callable
     step: Callable
     state_pspecs: Any = None
+    per_leaf_norms: bool = False
 
 
 def resolve_lr(learning_rate: Schedule, count) -> jnp.ndarray:
@@ -62,7 +67,8 @@ def zeros_like_tree(params):
 
 
 def finish_tree_optimizer(init: Callable, sweep: Callable,
-                          state_pspecs: Callable) -> FusedOptimizer:
+                          state_pspecs: Callable,
+                          per_leaf_norms: bool = False) -> FusedOptimizer:
     """Wrap a tree-layout ``sweep(grads, state, params, grad_scale,
     out_is_delta)`` into the FusedOptimizer update/step contract — the
     shared tail of every ``layout="tree"`` optimizer."""
@@ -74,6 +80,7 @@ def finish_tree_optimizer(init: Callable, sweep: Callable,
         return sweep(grads, state, params, grad_scale, False)
 
     return FusedOptimizer(init=init, update=update, step=step,
+                          per_leaf_norms=per_leaf_norms,
                           state_pspecs=state_pspecs)
 
 

@@ -200,4 +200,5 @@ def _tree_lamb(learning_rate, b1, b2, eps, weight_decay, bias_correction,
 
         return TreeLAMBState(count=P(), m=param_pspecs, v=param_pspecs)
 
-    return finish_tree_optimizer(init, _sweep, state_pspecs)
+    return finish_tree_optimizer(init, _sweep, state_pspecs,
+                                 per_leaf_norms=True)

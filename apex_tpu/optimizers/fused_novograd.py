@@ -148,4 +148,5 @@ def _tree_novograd(learning_rate, b1, b2, eps, weight_decay,
             v=jax.tree.map(lambda _: P(), param_pspecs,
                            is_leaf=lambda x: isinstance(x, P)))
 
-    return finish_tree_optimizer(init, _sweep, state_pspecs)
+    return finish_tree_optimizer(init, _sweep, state_pspecs,
+                                 per_leaf_norms=True)
