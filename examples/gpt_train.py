@@ -75,6 +75,10 @@ def main():
                     "(the reference loop's clip_grad_norm_ between "
                     "unscale and optimizer.step)")
     ap.add_argument("--no-sp", action="store_true")
+    ap.add_argument("--fsdp", action="store_true",
+                    help="ZeRO-3: dp-shard the layer kernels between "
+                    "steps (per-layer all-gather; needs --opt-layout "
+                    "tree and hidden %% dp == 0)")
     ap.add_argument("--data", help="binary token file (apex_tpu.data "
                     "format); synthetic tokens if omitted")
     ap.add_argument("--ckpt", help=".atck checkpoint path to save/resume")
@@ -116,7 +120,7 @@ def main():
         sequence_parallel=(args.tp > 1 and args.cp == 1 and not args.no_sp
                            and args.experts == 0),
         context_parallel=(args.cp > 1),
-        remat=True, compute_dtype=jnp.bfloat16,
+        remat=True, compute_dtype=jnp.bfloat16, fsdp=args.fsdp,
         remat_policy=args.remat_policy, ln_impl=args.ln_impl,
         attn_impl=attn_impl, ce_chunk=ce_chunk,
         num_experts=args.experts, **PRESETS[args.preset])

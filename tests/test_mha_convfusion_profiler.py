@@ -204,3 +204,94 @@ def test_annotate_and_sync():
         y = jnp.sum(jnp.arange(10.0))
     profiler._sync(y)
     assert float(y) == 45.0
+
+
+def test_op_profile_self_times(tmp_path):
+    """op_profile parses a trace capture into nested-aware self-times:
+    a while containing two fusions self-times to its remainder, and
+    category/source attribution survives aggregation."""
+    import gzip
+    import json
+    import os
+
+    d = tmp_path / "plugins" / "profile" / "2026_01_01_00_00_00"
+    os.makedirs(d)
+    events = [
+        {"ph": "M", "pid": 3, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 3, "tid": 1, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        # host-side event must be ignored
+        {"ph": "M", "pid": 9, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "pid": 9, "tid": 1, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "X", "pid": 9, "tid": 1, "name": "hostjunk",
+         "ts": 0, "dur": 999},
+        # while.1 [0, 100) containing fusion.1 [10, 40) and fusion.2
+        # [50, 90) -> self 30
+        {"ph": "X", "pid": 3, "tid": 1, "name": "while.1", "ts": 0,
+         "dur": 100, "args": {"hlo_category": "while"}},
+        {"ph": "X", "pid": 3, "tid": 1, "name": "fusion.1", "ts": 10,
+         "dur": 30, "args": {"hlo_category": "convolution fusion",
+                             "source": "model.py:42"}},
+        {"ph": "X", "pid": 3, "tid": 1, "name": "fusion.2", "ts": 50,
+         "dur": 40, "args": {"hlo_category": "loop fusion"}},
+        # top-level copy after the while
+        {"ph": "X", "pid": 3, "tid": 1, "name": "copy.1", "ts": 120,
+         "dur": 10, "args": {"hlo_category": "data formatting",
+                             "source": "model.py:99"}},
+    ]
+    with gzip.open(d / "vm.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+    prof = profiler.op_profile(str(tmp_path))
+    by_name = {o["name"]: o for o in prof["top_ops"]}
+    assert by_name["while.1"]["seconds"] == pytest.approx(30e-6)
+    assert by_name["fusion.1"]["seconds"] == pytest.approx(30e-6)
+    assert by_name["fusion.2"]["seconds"] == pytest.approx(40e-6)
+    assert by_name["copy.1"]["seconds"] == pytest.approx(10e-6)
+    assert "hostjunk" not in by_name
+    assert prof["total_s"] == pytest.approx(110e-6)
+    assert prof["by_category"]["data formatting"] == pytest.approx(10e-6)
+    assert by_name["fusion.1"]["source"] == "model.py:42"
+    assert by_name["fusion.1"]["count"] == 1
+
+
+def test_op_profile_missing_trace(tmp_path):
+    with pytest.raises(FileNotFoundError, match="trace.json.gz"):
+        profiler.op_profile(str(tmp_path))
+
+
+def test_op_profile_multi_device_streams(tmp_path):
+    """Concurrent ops on different cores must NOT nest: each (pid, tid)
+    stream gets its own stack, so overlapping-in-time ops on two devices
+    keep their full self-times."""
+    import gzip
+    import json
+    import os
+
+    d = tmp_path / "plugins" / "profile" / "2026_01_01_00_00_01"
+    os.makedirs(d)
+    events = []
+    for pid in (3, 4):
+        events += [
+            {"ph": "M", "pid": pid, "name": "process_name",
+             "args": {"name": f"/device:TPU:{pid - 3}"}},
+            {"ph": "M", "pid": pid, "tid": 1, "name": "thread_name",
+             "args": {"name": "XLA Ops"}},
+        ]
+    # core0 op [0, 100) and core1 op [10, 40) overlap in wall time
+    events += [
+        {"ph": "X", "pid": 3, "tid": 1, "name": "fusion.a", "ts": 0,
+         "dur": 100, "args": {"hlo_category": "loop fusion"}},
+        {"ph": "X", "pid": 4, "tid": 1, "name": "fusion.b", "ts": 10,
+         "dur": 30, "args": {"hlo_category": "loop fusion"}},
+    ]
+    with gzip.open(d / "vm.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    prof = profiler.op_profile(str(tmp_path))
+    by_name = {o["name"]: o for o in prof["top_ops"]}
+    assert by_name["fusion.a"]["seconds"] == pytest.approx(100e-6)
+    assert by_name["fusion.b"]["seconds"] == pytest.approx(30e-6)
+    assert prof["total_s"] == pytest.approx(130e-6)
