@@ -53,6 +53,9 @@ class BertConfig:
     ln_impl: str = "xla"      # measured winner in-model (docs/DESIGN.md)
     attn_score_dtype: str = "f32"
     scan_unroll: Any = 1
+    #: ZeRO-3 param sharding of the encoder stack (see GPTConfig.fsdp);
+    #: the BERT-specific leaves (token-type/mlm head) stay replicated
+    fsdp: bool = False
 
     def core(self) -> gpt.GPTConfig:
         return gpt.GPTConfig(
@@ -66,7 +69,7 @@ class BertConfig:
             remat_policy=self.remat_policy, attn_impl=self.attn_impl,
             attn_layout=self.attn_layout, ln_impl=self.ln_impl,
             attn_score_dtype=self.attn_score_dtype,
-            scan_unroll=self.scan_unroll)
+            scan_unroll=self.scan_unroll, fsdp=self.fsdp)
 
 
 def init(cfg: BertConfig, key) -> Any:
@@ -170,3 +173,63 @@ def mlm_loss(cfg: BertConfig, params, tokens, targets, mlm_mask,
     per_tok = vocab_parallel_cross_entropy(lg, targets, 0.0, cfg.axis)
     w = mlm_mask.astype(jnp.float32)
     return jnp.sum(per_tok * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def seq_partial_grad_mask(cfg: BertConfig) -> Any:
+    """BERT's sequence-parallel tp-psum mask: the core stack's mask plus
+    the embedding LN (applied to seq-sharded activations, so its grads
+    are tp-partial) — the mlm head runs after the SP gather and is
+    already full."""
+    mask = gpt.seq_partial_grad_mask(cfg.core())
+    mask["embedding"]["token_type"] = False
+    mask["embedding"]["ln"] = {"scale": True, "bias": True}
+    mask["mlm_head"] = {
+        "dense": {"kernel": False, "bias": False},
+        "ln": {"scale": False, "bias": False},
+        "bias": False,
+    }
+    return mask
+
+
+def make_mlm_train_step(cfg: BertConfig, mesh, optimizer,
+                        scaler_cfg=None, *, clip_grad_norm=None):
+    """(init_fn, step_fn) for MLM pretraining — BASELINE config #2's
+    trainer role, the BERT analogue of
+    :func:`apex_tpu.models.training.make_train_step`.
+
+    ``step_fn(state, tokens, targets, mlm_mask) -> (state, metrics)``;
+    composes dp / tp / SP / fsdp, amp loss scaling, and the global-L2
+    clip through :func:`training.make_loss_train_step`.
+    """
+    from apex_tpu.mesh.topology import mesh_shape_of
+    from apex_tpu.models import training as _training
+
+    if cfg.fsdp:
+        # same build-time guards as the GPT builder (training.py): the
+        # constraints are model-shaped, so the generic core can't check
+        if not cfg.remat:
+            raise ValueError(
+                "fsdp requires remat=True: without recompute the "
+                "all-gathered full kernels are saved as backward "
+                "residuals, costing MORE memory than fsdp=False")
+        dp = mesh_shape_of(mesh).get("dp", 1)
+        if dp > 1 and cfg.hidden_size % dp:
+            raise ValueError(
+                f"fsdp shards the kernels' h-dim: hidden_size "
+                f"{cfg.hidden_size} must divide by dp={dp}")
+
+    def loss_fn(p, tokens, targets, mlm_mask):
+        return mlm_loss(cfg, p, tokens, targets, mlm_mask)
+
+    return _training.make_loss_train_step(
+        loss_fn, mesh, optimizer,
+        init_params=lambda key: init(cfg, key),
+        pspecs=param_specs(cfg),
+        scaler_cfg=scaler_cfg,
+        clip_grad_norm=clip_grad_norm,
+        sp_psum_mask=(seq_partial_grad_mask(cfg)
+                      if cfg.sequence_parallel else None),
+        model_axis=cfg.axis,
+        fsdp=cfg.fsdp,
+        n_batch_args=3,
+    )

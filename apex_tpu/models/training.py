@@ -96,6 +96,95 @@ def _opt_state_specs(optimizer: FusedOptimizer, params, pspecs, mesh: Mesh):
         lambda x: P() if x.ndim == 0 else buf_spec, shapes)
 
 
+def _mentions(spec, axis):
+    """True when ``axis`` appears in the PartitionSpec (incl. tuples)."""
+    return any(
+        a == axis or (isinstance(a, (tuple, list)) and axis in a)
+        for a in spec if a is not None)
+
+
+def _validate_fsdp_optimizer(optimizer):
+    """The optimizer constraints ZeRO-3 param sharding imposes."""
+    if isinstance(optimizer, DistributedFusedOptimizer):
+        raise ValueError(
+            "fsdp already shards params/grads/state over dp; the "
+            "ZeRO-1/2 optimizers would shard them a second time — "
+            "use a tree-layout fused optimizer")
+    if getattr(optimizer, "state_pspecs", None) is None:
+        raise ValueError(
+            "fsdp needs a tree-layout optimizer (state mirrors the "
+            "dp-sharded params); pass layout='tree'")
+    if getattr(optimizer, "per_leaf_norms", False):
+        raise ValueError(
+            "fsdp shards each kernel over dp, but this optimizer's "
+            "update depends on whole-leaf norms (LAMB trust ratios / "
+            "NovoGrad layer moments) — computed on a shard they "
+            "diverge per rank; use Adam/SGD/Adagrad, or ZeRO-1/2 "
+            "distributed_fused_lamb without fsdp")
+
+
+def _clip_leaf_axes(pspecs, norm_axes):
+    """Per-leaf model-parallel axis sets for the global-norm psum
+    (leaf order = pspecs treedef order)."""
+    return [
+        tuple(a for a in norm_axes if _mentions(sp, a))
+        for sp in jax.tree.leaves(
+            pspecs, is_leaf=lambda x: isinstance(x, P))]
+
+
+def _clip_by_global_norm(grads, leaf_axes, clip):
+    """(clipped grads, pre-clip global L2 norm): each leaf's shard
+    sum-of-squares is psum'd over its sharded axes so every rank clips
+    by the same global norm; one psum per distinct axis set."""
+    sq = {}
+    for g, axes in zip(jax.tree.leaves(grads), leaf_axes):
+        v = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        sq[axes] = sq.get(axes, jnp.float32(0.0)) + v
+    total = jnp.float32(0.0)
+    for axes, v in sq.items():
+        total = total + (lax.psum(v, axes) if axes else v)
+    norm = jnp.sqrt(total)
+    coeff = jnp.minimum(1.0, jnp.float32(clip) / (norm + 1e-6))
+    return jax.tree.map(lambda g: g * coeff.astype(g.dtype), grads), norm
+
+
+def _dp_grad_sync(grads, optimizer, axes_present, *, fsdp, fsdp_mask,
+                  dp_size):
+    """DP gradient averaging (apex DDP allreduce + 1/world_size (U));
+    ZeRO optimizers own the dp reduction, fsdp leaves already hold the
+    dp-SUM (the all-gather VJP is a psum_scatter) and scale to the
+    mean."""
+    if AXIS_DP not in axes_present or isinstance(
+            optimizer, DistributedFusedOptimizer):
+        return grads
+    if fsdp:
+        inv_dp = 1.0 / dp_size
+        return jax.tree.map(
+            lambda g, m: g * jnp.asarray(inv_dp, g.dtype) if m
+            else lax.pmean(g, AXIS_DP),
+            grads, fsdp_mask)
+    return lax.pmean(grads, AXIS_DP)
+
+
+def _make_init_fn(init_params, pspecs, opt_specs, optimizer, scaler_cfg,
+                  mesh):
+    def init_fn(key) -> TrainState:
+        params = jax.jit(
+            init_params,
+            out_shardings=jax.tree.map(
+                lambda sp: NamedSharding(mesh, sp), pspecs),
+        )(key)
+        opt_state = jax.jit(
+            jax.shard_map(optimizer.init, mesh=mesh, in_specs=(pspecs,),
+                          out_specs=opt_specs, check_vma=False)
+        )(params)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32), params=params,
+            opt_state=opt_state, scaler=scaler_cfg.init())
+
+    return init_fn
+
+
 def make_train_step(
     cfg: gpt.GPTConfig,
     mesh: Mesh,
@@ -154,22 +243,7 @@ def make_train_step(
     if cfg.fsdp:
         # ZeRO-3: params dp-sharded between steps; grads arrive as the
         # all-gather VJP's psum_scatter (already dp-summed)
-        if isinstance(optimizer, DistributedFusedOptimizer):
-            raise ValueError(
-                "fsdp already shards params/grads/state over dp; the "
-                "ZeRO-1/2 optimizers would shard them a second time — "
-                "use a tree-layout fused optimizer")
-        if getattr(optimizer, "state_pspecs", None) is None:
-            raise ValueError(
-                "fsdp needs a tree-layout optimizer (state mirrors the "
-                "dp-sharded params); pass layout='tree'")
-        if getattr(optimizer, "per_leaf_norms", False):
-            raise ValueError(
-                "fsdp shards each kernel over dp, but this optimizer's "
-                "update depends on whole-leaf norms (LAMB trust ratios / "
-                "NovoGrad layer moments) — computed on a shard they "
-                "diverge per rank; use Adam/SGD/Adagrad, or ZeRO-1/2 "
-                "distributed_fused_lamb without fsdp")
+        _validate_fsdp_optimizer(optimizer)
         if not cfg.remat:
             raise ValueError(
                 "fsdp requires remat=True: without recompute the "
@@ -188,22 +262,12 @@ def make_train_step(
     pspecs = gpt.param_specs(cfg, pipeline=pipelined)
     sp_mask = gpt.seq_partial_grad_mask(cfg)
 
-    def _mentions(spec, axis):
-        return any(
-            a == axis or (isinstance(a, (tuple, list)) and axis in a)
-            for a in spec if a is not None)
-
-    # per-leaf model-parallel axes for the clip norm: a leaf sharded over
-    # an axis contributes its shard's sum-of-squares psum'd over it;
-    # replicated leaves count once (leaf order = params treedef order)
-    # AXIS_DP appears in pspecs only for fsdp-sharded leaves — their
-    # shard's sum-of-squares needs the dp psum like any sharded leaf
+    # per-leaf model-parallel axes for the clip norm (AXIS_DP appears
+    # in pspecs only for fsdp-sharded leaves — their shard needs the dp
+    # psum like any sharded leaf)
     _norm_axes = tuple(a for a in (AXIS_TP, AXIS_PP, ep_axis, AXIS_DP)
                        if a in axes_present)
-    clip_leaf_axes = [
-        tuple(a for a in _norm_axes if _mentions(s, a))
-        for s in jax.tree.leaves(
-            pspecs, is_leaf=lambda x: isinstance(x, P))]
+    clip_leaf_axes = _clip_leaf_axes(pspecs, _norm_axes)
 
     # params NOT sharded over pp see only their stage's loss contribution —
     # psum over pp reassembles them (embedding / position / final LN);
@@ -229,9 +293,6 @@ def make_train_step(
             "mirrors the ep-sharded params); pass layout='tree'")
     scaler_specs = jax.tree.map(lambda _: P(), ScalerState(*[0] * 3))
 
-    def sharding(spec):
-        return NamedSharding(mesh, spec)
-
     def _global_init(key):
         params = gpt.init(cfg, key)
         if pipelined:
@@ -243,21 +304,8 @@ def make_train_step(
         lambda: _global_init(jax.random.PRNGKey(0)))
     opt_specs = _opt_state_specs(optimizer, param_shapes, pspecs, mesh)
 
-    def init_fn(key) -> TrainState:
-        params = jax.jit(
-            _global_init,
-            out_shardings=jax.tree.map(sharding, pspecs),
-        )(key)
-        opt_state = jax.jit(
-            jax.shard_map(optimizer.init, mesh=mesh, in_specs=(pspecs,),
-                          out_specs=opt_specs, check_vma=False)
-        )(params)
-        return TrainState(
-            step=jnp.zeros((), jnp.int32),
-            params=params,
-            opt_state=opt_state,
-            scaler=scaler_cfg.init(),
-        )
+    init_fn = _make_init_fn(_global_init, pspecs, opt_specs, optimizer,
+                            scaler_cfg, mesh)
 
     def _local_loss(p, tokens, targets):
         if pipelined:
@@ -292,21 +340,9 @@ def make_train_step(
             lambda p: _local_loss(p, tokens, targets), scaler_cfg)
         value, grads, finite = vag(params, scaler_state=state.scaler)
 
-        # DP gradient averaging (apex DDP allreduce + 1/world_size (U));
-        # ZeRO optimizers own the dp reduction (reduce-scatter inside step)
-        if AXIS_DP in axes_present and not isinstance(
-                optimizer, DistributedFusedOptimizer):
-            if cfg.fsdp:
-                # fsdp-sharded leaves already hold the dp-SUM (the
-                # all-gather VJP is a psum_scatter): scale to the mean;
-                # replicated leaves pmean as usual
-                inv_dp = 1.0 / dp_size
-                grads = jax.tree.map(
-                    lambda g, m: g * jnp.asarray(inv_dp, g.dtype) if m
-                    else lax.pmean(g, AXIS_DP),
-                    grads, fsdp_mask)
-            else:
-                grads = lax.pmean(grads, AXIS_DP)
+        grads = _dp_grad_sync(grads, optimizer, axes_present,
+                              fsdp=cfg.fsdp, fsdp_mask=fsdp_mask,
+                              dp_size=dp_size)
         if ep_size > 1:
             inv = 1.0 / ep_size
             grads = jax.tree.map(
@@ -334,20 +370,9 @@ def make_train_step(
         grad_norm = None
         if clip_grad_norm is not None:
             # global L2 norm after the sync (grads here ARE the applied
-            # update direction); group leaves by their model-parallel
-            # axis set so each group costs one psum
-            sq = {}
-            for g, axes in zip(jax.tree.leaves(grads), clip_leaf_axes):
-                s = jnp.sum(jnp.square(g.astype(jnp.float32)))
-                sq[axes] = sq.get(axes, jnp.float32(0.0)) + s
-            total = jnp.float32(0.0)
-            for axes, s in sq.items():
-                total = total + (lax.psum(s, axes) if axes else s)
-            grad_norm = jnp.sqrt(total)
-            coeff = jnp.minimum(
-                1.0, jnp.float32(clip_grad_norm) / (grad_norm + 1e-6))
-            grads = jax.tree.map(
-                lambda g: (g * coeff.astype(g.dtype)), grads)
+            # update direction)
+            grads, grad_norm = _clip_by_global_norm(
+                grads, clip_leaf_axes, clip_grad_norm)
         new_params, new_opt = optimizer.step(grads, state.opt_state, params)
         if scaler_cfg.enabled:
             # a single rank overflowing skips the step everywhere
@@ -394,4 +419,120 @@ def make_train_step(
         donate_argnums=(0,),
     )
 
+    return init_fn, step_fn
+
+
+def make_loss_train_step(
+    loss_fn,
+    mesh: Mesh,
+    optimizer: FusedOptimizer,
+    *,
+    init_params,
+    pspecs,
+    scaler_cfg: Optional[ScalerConfig] = None,
+    clip_grad_norm: Optional[float] = None,
+    sp_psum_mask=None,
+    model_axis: str = AXIS_TP,
+    fsdp: bool = False,
+    n_batch_args: int = 2,
+):
+    """Generic (non-pipelined) fused train step over an arbitrary local
+    loss — the machinery of :func:`make_train_step` for models that are
+    not the flagship GPT (BERT uses it via
+    :func:`apex_tpu.models.bert.make_mlm_train_step`).
+
+    - ``loss_fn(params, *batch) -> scalar`` with local-shard semantics
+      (called inside shard_map); ``batch`` is ``n_batch_args`` arrays
+      whose leading dim shards on dp.
+    - ``init_params(key) -> global param pytree``; ``pspecs`` mirrors it.
+    - ``sp_psum_mask``: sequence-parallel psum mask (over
+      ``model_axis``) for replicated params consumed on seq-sharded
+      activations (None = SP off).
+    - ``model_axis``: the tensor-parallel mesh axis name — the SP psum,
+      the finite-skip sync, and the clip-norm psums all honour it.
+    - ``fsdp``: the model gathers dp-sharded leaves itself (pspecs
+      mention dp on them); their grads arrive dp-summed via the gather's
+      psum_scatter VJP and are scaled to the mean here.
+
+    Covers dp / tp / SP / fsdp + amp + clip. Pipeline/context/expert
+    parallelism remain :func:`make_train_step` (they are model-shaped).
+    """
+    scaler_cfg = scaler_cfg or ScalerConfig(enabled=False)
+    axes_present = set(mesh.axis_names)
+    dp_size = mesh_shape_of(mesh).get(AXIS_DP, 1)
+    if fsdp:
+        _validate_fsdp_optimizer(optimizer)
+    if clip_grad_norm is not None and isinstance(
+            optimizer, DistributedFusedOptimizer):
+        raise ValueError(
+            "clip_grad_norm composes with the tree/flat fused optimizers")
+
+    _norm_axes = tuple(a for a in (model_axis, AXIS_DP)
+                       if a in axes_present)
+    clip_leaf_axes = _clip_leaf_axes(pspecs, _norm_axes)
+    fsdp_mask = jax.tree.map(
+        lambda s: _mentions(s, AXIS_DP), pspecs,
+        is_leaf=lambda x: isinstance(x, P))
+    scaler_specs = jax.tree.map(lambda _: P(), ScalerState(*[0] * 3))
+
+    param_shapes = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0)))
+    opt_specs = _opt_state_specs(optimizer, param_shapes, pspecs, mesh)
+
+    init_fn = _make_init_fn(init_params, pspecs, opt_specs, optimizer,
+                            scaler_cfg, mesh)
+
+    def _local_step(state: TrainState, *batch):
+        params = state.params
+        vag = value_and_scaled_grad(
+            lambda p: loss_fn(p, *batch), scaler_cfg)
+        value, grads, finite = vag(params, scaler_state=state.scaler)
+
+        grads = _dp_grad_sync(grads, optimizer, axes_present,
+                              fsdp=fsdp, fsdp_mask=fsdp_mask,
+                              dp_size=dp_size)
+        if sp_psum_mask is not None:
+            grads = jax.tree.map(
+                lambda g, m: lax.psum(g, model_axis) if m else g,
+                grads, sp_psum_mask)
+        sync_axes = tuple(
+            a for a in (AXIS_DP, model_axis) if a in axes_present)
+        finite = lax.pmin(finite.astype(jnp.int32), sync_axes) > 0
+        grad_norm = None
+        if clip_grad_norm is not None:
+            grads, grad_norm = _clip_by_global_norm(
+                grads, clip_leaf_axes, clip_grad_norm)
+        new_params, new_opt = optimizer.step(grads, state.opt_state, params)
+        if scaler_cfg.enabled:
+            new_params = apply_if_finite(new_params, params, finite)
+            new_opt = apply_if_finite(new_opt, state.opt_state, finite)
+        new_scaler = scaler_update(scaler_cfg, state.scaler, finite)
+        loss_out = value
+        if AXIS_DP in axes_present:
+            loss_out = lax.pmean(loss_out, AXIS_DP)
+        metrics = {
+            "loss": loss_out,
+            "grads_finite": finite.astype(jnp.int32),
+            "loss_scale": new_scaler.loss_scale,
+        }
+        if grad_norm is not None:
+            metrics["grad_norm"] = grad_norm
+        return TrainState(state.step + jnp.int32(1), new_params, new_opt,
+                          new_scaler), metrics
+
+    state_specs = TrainState(
+        step=P(), params=pspecs, opt_state=opt_specs, scaler=scaler_specs)
+    data_spec = (P(AXIS_DP) if AXIS_DP in axes_present else P())
+    metric_specs = {"loss": P(), "grads_finite": P(), "loss_scale": P()}
+    if clip_grad_norm is not None:
+        metric_specs["grad_norm"] = P()
+    step_fn = jax.jit(
+        jax.shard_map(
+            _local_step, mesh=mesh,
+            in_specs=(state_specs,) + (data_spec,) * n_batch_args,
+            out_specs=(state_specs, metric_specs),
+            check_vma=False,
+        ),
+        donate_argnums=(0,),
+    )
     return init_fn, step_fn

@@ -36,62 +36,36 @@ def main():
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--fp16", action="store_true")
-    ap.add_argument("--zero", action="store_true")
+    ap.add_argument("--zero", action="store_true",
+                    help="ZeRO-1/2: DistributedFusedLAMB shards grads + "
+                    "optimizer state over dp")
+    ap.add_argument("--fsdp", action="store_true",
+                    help="ZeRO-3: dp-shard the encoder kernels between "
+                    "steps (FusedLAMB is whole-leaf-norm, so --fsdp "
+                    "needs an elementwise optimizer — it switches the "
+                    "run to tree-layout FusedAdam)")
     args = ap.parse_args()
 
     cfg = bert.BertConfig(
         hidden_size=args.hidden, num_layers=args.layers,
-        num_heads=args.heads, seq_len=args.seq,
+        num_heads=args.heads, seq_len=args.seq, fsdp=args.fsdp,
         compute_dtype=jnp.float16 if args.fp16 else jnp.bfloat16)
     mesh = mx.build_mesh(tp=args.tp)
     scaler = (ScalerConfig() if args.fp16 else ScalerConfig(enabled=False))
     # tree layout off the ZeRO path: leafwise XLA-fused update (the flat
     # Pallas sweep runs interpreted — minutes/step — off-TPU)
-    opt = (distributed_fused_lamb(args.lr) if args.zero
-           else fused_lamb(args.lr, layout="tree"))
-
-    params = jax.jit(lambda k: bert.init(cfg, k))(jax.random.PRNGKey(0))
-    pspecs = bert.param_specs(cfg)
-
-    state_pspecs = getattr(opt, "state_pspecs", None)
-    if state_pspecs is not None:
-        # tree layout: optimizer state mirrors the param tree
-        opt_specs = state_pspecs(pspecs)
+    if args.fsdp and args.zero:
+        raise SystemExit("--fsdp (ZeRO-3) and --zero (ZeRO-1/2) are "
+                         "alternative sharding strategies; pick one")
+    if args.fsdp:
+        from apex_tpu.optimizers import fused_adam
+        opt = fused_adam(args.lr, layout="tree")
     else:
-        # flat layouts: scalars replicated, buffers sharded over the
-        # model (+dp for ZeRO) axes
-        opt_specs = jax.tree.map(
-            lambda x: P() if x.ndim == 0 else P(("dp", "tp") if args.zero
-                                                else ("tp",)),
-            jax.eval_shape((lambda p: opt.init(p, dp=mesh.shape["dp"]))
-                           if args.zero else opt.init,
-                           jax.eval_shape(lambda: bert.init(
-                               cfg, jax.random.PRNGKey(0)))))
+        opt = (distributed_fused_lamb(args.lr) if args.zero
+               else fused_lamb(args.lr, layout="tree"))
 
-    def local_step(params, opt_state, sc_state, tok, tgt, mask):
-        vag = value_and_scaled_grad(
-            lambda p: bert.mlm_loss(cfg, p, tok, tgt, mask), scaler)
-        loss, grads, finite = vag(params, scaler_state=sc_state)
-        if not args.zero:
-            grads = jax.lax.pmean(grads, "dp")
-        finite = jax.lax.pmin(finite.astype(jnp.int32), ("dp", "tp")) > 0
-        new_p, new_o = opt.step(grads, opt_state, params)
-        new_p = apply_if_finite(new_p, params, finite)
-        new_o = apply_if_finite(new_o, opt_state, finite)
-        return new_p, new_o, scaler_update(scaler, sc_state, finite), \
-            jax.lax.pmean(loss, "dp")
-
-    sc_specs = jax.tree.map(lambda _: P(), scaler.init())
-    step = jax.jit(jax.shard_map(
-        local_step, mesh=mesh,
-        in_specs=(pspecs, opt_specs, sc_specs, P("dp"), P("dp"), P("dp")),
-        out_specs=(pspecs, opt_specs, sc_specs, P()),
-        check_vma=False), donate_argnums=(0, 1))
-
-    opt_state = jax.jit(jax.shard_map(
-        opt.init, mesh=mesh, in_specs=(pspecs,), out_specs=opt_specs,
-        check_vma=False))(params)
-    sc_state = scaler.init()
+    init_fn, step_fn = bert.make_mlm_train_step(cfg, mesh, opt, scaler)
+    state = init_fn(jax.random.PRNGKey(0))
 
     rng = np.random.RandomState(0)
     tok = jnp.asarray(rng.randint(0, cfg.vocab_size, (args.batch, args.seq)))
@@ -99,10 +73,9 @@ def main():
     tgt = tok  # "reconstruct the original ids at masked positions"
 
     for i in range(args.steps):
-        params, opt_state, sc_state, loss = step(
-            params, opt_state, sc_state, tok, tgt, mask)
-        print(f"step {i} mlm_loss {float(loss):.4f} "
-              f"scale {float(sc_state.loss_scale):.0f}")
+        state, m = step_fn(state, tok, tgt, mask)
+        print(f"step {i} mlm_loss {float(m['loss']):.4f} "
+              f"scale {float(m['loss_scale']):.0f}")
 
 
 if __name__ == "__main__":
