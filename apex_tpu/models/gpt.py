@@ -1015,6 +1015,29 @@ def decode_step(cfg: GPTConfig, params, cache, token, pos):
     return _lm_head(cfg, params, x), new_cache
 
 
+def _decode_entry_cfg(cfg: GPTConfig, p_len: int,
+                      n_new: Optional[int] = None) -> GPTConfig:
+    """Shared decode-entry validation (+ SP/CP strip) for prefill /
+    generate / beam_search: autoregressive-only, at least one prompt
+    token, horizon within seq_len, and the sequence shardings stripped
+    (decode is sequence-dim-local; params are replicated over cp, so the
+    stripped forward is exact)."""
+    if not cfg.causal:
+        raise ValueError(
+            "decoding is autoregressive; causal=False (the bidirectional "
+            "encoder mode) has no incremental-decode semantics")
+    if p_len < 1:
+        raise ValueError("decoding needs at least one prompt token")
+    if n_new is not None and p_len + n_new > cfg.seq_len:
+        raise ValueError(
+            f"prompt {p_len} + n_new {n_new} exceeds seq_len "
+            f"{cfg.seq_len}")
+    if cfg.sequence_parallel or cfg.context_parallel:
+        cfg = dataclasses.replace(
+            cfg, sequence_parallel=False, context_parallel=False)
+    return cfg
+
+
 def prefill(cfg: GPTConfig, params, prompt, *, max_len: Optional[int] = None):
     """Bulk prompt ingestion: ONE forward over ``prompt [b, p_len]``
     (the training-path attention — packed flash/XLA by ``attn_impl``)
@@ -1026,17 +1049,8 @@ def prefill(cfg: GPTConfig, params, prompt, *, max_len: Optional[int] = None):
     :func:`decode_step`; ``max_len`` sizes the cache (default
     ``cfg.seq_len``).
     """
-    if not cfg.causal:
-        raise ValueError(
-            "decoding is autoregressive; causal=False has no "
-            "incremental-decode semantics")
-    # decode is sequence-dim-local: strip both sequence shardings (the
-    # params are replicated over cp, so the stripped forward is exact —
-    # matching decode_step, which is likewise cp-oblivious)
-    if cfg.sequence_parallel or cfg.context_parallel:
-        cfg = dataclasses.replace(
-            cfg, sequence_parallel=False, context_parallel=False)
     b, p_len = prompt.shape
+    cfg = _decode_entry_cfg(cfg, p_len)
     max_len = max_len or cfg.seq_len
     if p_len > max_len:
         raise ValueError(f"prompt {p_len} exceeds cache max_len {max_len}")
@@ -1120,19 +1134,8 @@ def generate(cfg: GPTConfig, params, prompt, n_new: int,
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     b, p_len = prompt.shape
-    if p_len < 1:
-        raise ValueError("generate needs at least one prompt token")
+    cfg = _decode_entry_cfg(cfg, p_len, n_new)
     total = p_len + n_new
-    if total > cfg.seq_len:
-        raise ValueError(
-            f"prompt {p_len} + n_new {n_new} exceeds seq_len {cfg.seq_len}")
-    if not cfg.causal:
-        raise ValueError(
-            "decoding is autoregressive; causal=False has no "
-            "incremental-decode semantics")
-    if cfg.sequence_parallel or cfg.context_parallel:
-        cfg = dataclasses.replace(
-            cfg, sequence_parallel=False, context_parallel=False)
     if n_new < 1:
         return jnp.zeros((b, 0), jnp.int32)
 
@@ -1160,3 +1163,78 @@ def generate(cfg: GPTConfig, params, prompt, n_new: int,
         jnp.arange(p_len, total - 1, dtype=jnp.int32))
     outs = jnp.concatenate([first[None], outs], axis=0)
     return jnp.transpose(outs, (1, 0))
+
+
+def beam_search(cfg: GPTConfig, params, prompt, n_new: int,
+                *, num_beams: int):
+    """Fixed-length beam search: ``prompt [b, p_len] int32`` →
+    ``(sequences [b, num_beams, n_new] int32, scores [b, num_beams]
+    fp32)``, beams sorted by total log-probability (descending).
+
+    Built on the same bulk prefill + KV-cache decode as
+    :func:`generate`: the prompt costs ONE forward, beams ride a
+    ``b·num_beams`` decode batch, and the cache is reordered by beam
+    parent each step (``jnp.take`` on the batch dim — static shapes, so
+    the whole search is one compiled ``lax.scan``). The search is exact
+    over its frontier: whenever ``num_beams ≥`` the number of reachable
+    prefixes, the top beam IS the global argmax sequence (pinned by the
+    exhaustive oracle test). Fixed horizon: every beam decodes exactly
+    ``n_new`` tokens (no EOS early-exit — a finished-beam mask is a
+    documented extension), so a length penalty would rescale all beams
+    equally and is omitted.
+
+    Local semantics (call inside ``shard_map``): the gathered fp32
+    logits are replicated over tp, so ``top_k`` picks identical beams on
+    every rank; composes with tp and, via generous
+    ``moe_capacity_factor``, MoE — like :func:`generate`.
+    """
+    b, p_len = prompt.shape
+    k = int(num_beams)
+    if k < 1:
+        raise ValueError("num_beams must be >= 1")
+    if k > cfg.vocab_size:
+        raise ValueError(
+            f"num_beams {k} exceeds vocab_size {cfg.vocab_size} (the "
+            "first step has only vocab_size distinct continuations)")
+    if n_new < 1:
+        raise ValueError("beam_search needs n_new >= 1")
+    cfg = _decode_entry_cfg(cfg, p_len, n_new)
+    total = p_len + n_new
+
+    cache0, logits0 = prefill(cfg, params, prompt, max_len=total)
+    logp0 = jax.nn.log_softmax(logits0.astype(jnp.float32), axis=-1)
+    scores, first = lax.top_k(logp0, k)            # [b, k] each
+    first = first.astype(jnp.int32)
+    # beams become the decode batch: row (i, j) = batch i, beam j
+    cache = jnp.repeat(cache0, k, axis=2)          # [l, 2, b*k, hl, S, d]
+
+    def step(carry, t):
+        tok_in, cache, scores = carry
+        logits, cache = decode_step(cfg, params, cache, tok_in, t)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        vocab = logp.shape[-1]
+        cand = scores[:, :, None] + logp.reshape(b, k, vocab)
+        scores, flat = lax.top_k(cand.reshape(b, k * vocab), k)
+        parent = flat // vocab                     # [b, k]
+        tok = (flat % vocab).astype(jnp.int32)
+        gather = (jnp.arange(b)[:, None] * k + parent).reshape(b * k)
+        cache = jnp.take(cache, gather, axis=2)
+        return (tok.reshape(b * k), cache, scores), (tok, parent)
+
+    (_, _, scores), (toks, parents) = lax.scan(
+        step, (first.reshape(b * k), cache, scores),
+        jnp.arange(p_len, total - 1, dtype=jnp.int32))
+
+    # backtrace: walk parents from the final beam order to the root
+    def back(beam_idx, sp):
+        tok_s, parent_s = sp
+        emitted = jnp.take_along_axis(tok_s, beam_idx, axis=1)
+        return jnp.take_along_axis(parent_s, beam_idx, axis=1), emitted
+
+    root_idx, tail_toks = lax.scan(
+        back, jnp.broadcast_to(jnp.arange(k)[None], (b, k)),
+        (toks, parents), reverse=True)
+    head = jnp.take_along_axis(first, root_idx, axis=1)  # [b, k]
+    seq = jnp.concatenate(
+        [head[None], tail_toks], axis=0)           # [n_new, b, k]
+    return jnp.transpose(seq, (1, 2, 0)), scores

@@ -32,6 +32,10 @@ def main():
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--top-k", type=int, default=0,
                     help="sample only among the k best logits (0 = off)")
+    ap.add_argument("--beams", type=int, default=0,
+                    help="beam search width (0 = greedy/sampled "
+                    "generate); prints each batch row's best beam "
+                    "and its total log-prob")
     ap.add_argument("--top-p", type=float, default=1.0,
                     help="nucleus sampling mass (1.0 = off)")
     ap.add_argument("--ckpt", help=".atck from examples/gpt_train.py "
@@ -59,6 +63,22 @@ def main():
         jax.random.PRNGKey(1), (args.batch, args.prompt_len), 0,
         cfg.vocab_size)
     key = jax.random.PRNGKey(2)
+    if args.beams > 0:
+        if args.temperature > 0 or args.top_k or args.top_p < 1.0:
+            raise SystemExit(
+                "--beams is deterministic max-probability search; "
+                "--temperature/--top-k/--top-p apply to generate only")
+        seqs, scores = jax.jit(jax.shard_map(
+            lambda p, t: gpt.beam_search(
+                cfg, p, t, args.n_new, num_beams=args.beams),
+            mesh=mesh, in_specs=(gpt.param_specs(cfg), P(None, None)),
+            out_specs=(P(None, None, None), P(None, None)),
+            check_vma=False))(params, prompt)
+        for i in range(args.batch):
+            print(f"prompt {list(map(int, prompt[i]))} -> "
+                  f"{list(map(int, seqs[i, 0]))} "
+                  f"(logp {float(scores[i, 0]):.3f})")
+        return
     out = jax.jit(jax.shard_map(
         lambda p, t: gpt.generate(
             cfg, p, t, args.n_new, temperature=args.temperature,

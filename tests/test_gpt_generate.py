@@ -260,3 +260,87 @@ def test_generate_under_cp_config(devices8):
         in_specs=(pspecs, P(None, None)), out_specs=P(None, None),
         check_vma=False))(params_cp, prompt)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def _beam(cfg, params, prompt, mesh, n_new, k):
+    pspecs = gpt.param_specs(cfg)
+    return jax.jit(jax.shard_map(
+        lambda p, t: gpt.beam_search(cfg, p, t, n_new, num_beams=k),
+        mesh=mesh, in_specs=(pspecs, P(None, None)),
+        out_specs=(P(None, None, None), P(None, None)),
+        check_vma=False))(params, prompt)
+
+
+def test_beam_search_k1_equals_greedy(devices8):
+    cfg = standalone_gpt_config(vocab_size=96, seq_len=24)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (3, 8), 0, 96)
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    seqs, scores = _beam(cfg, params, prompt, mesh, N_NEW, 1)
+    greedy = _generate(cfg, params, prompt, mesh)
+    np.testing.assert_array_equal(np.asarray(seqs[:, 0]),
+                                  np.asarray(greedy))
+    assert np.all(np.isfinite(np.asarray(scores)))
+
+
+def test_beam_search_exhaustive_oracle(devices8):
+    """With num_beams == vocab and a 2-token horizon the frontier covers
+    every reachable prefix, so the top beam must be the global argmax
+    sequence — checked against brute-force teacher-forced scoring of
+    all vocab^2 continuations."""
+    V, n_new = 8, 2
+    cfg = standalone_gpt_config(vocab_size=V, seq_len=12)
+    params = gpt.init(cfg, jax.random.PRNGKey(3))
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 4), 0, V)
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    seqs, scores = _beam(cfg, params, prompt, mesh, n_new, V)
+
+    pspecs = gpt.param_specs(cfg)
+    logits_fn = jax.jit(jax.shard_map(
+        lambda p, t: gpt.logits(cfg, p, t), mesh=mesh,
+        in_specs=(pspecs, P(None, None)),
+        out_specs=P(None, None, "tp"), check_vma=False))
+    b, p_len = prompt.shape
+    best_score = np.full((b,), -np.inf)
+    best_seq = np.zeros((b, n_new), np.int64)
+    for t0 in range(V):
+        for t1 in range(V):
+            cont = jnp.tile(jnp.asarray([[t0, t1]], jnp.int32), (b, 1))
+            toks = jnp.concatenate([prompt, cont], axis=1)
+            lg = np.asarray(logits_fn(params, toks), np.float32)
+            lp = jax.nn.log_softmax(jnp.asarray(lg), axis=-1)
+            s = (np.asarray(lp[:, p_len - 1, t0])
+                 + np.asarray(lp[:, p_len, t1]))
+            for i in range(b):
+                if s[i] > best_score[i]:
+                    best_score[i] = s[i]
+                    best_seq[i] = (t0, t1)
+    np.testing.assert_array_equal(np.asarray(seqs[:, 0]), best_seq)
+    np.testing.assert_allclose(np.asarray(scores[:, 0]), best_score,
+                               rtol=1e-4, atol=1e-5)
+    # beams come back sorted
+    assert np.all(np.diff(np.asarray(scores), axis=1) <= 1e-6)
+
+
+def test_beam_search_tp2_matches_tp1(devices8):
+    cfg = standalone_gpt_config(vocab_size=96, seq_len=24)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, 96)
+    s1, sc1 = _beam(cfg, params, prompt,
+                    mx.build_mesh(tp=1, devices=devices8[:1]), 4, 3)
+    s2, sc2 = _beam(cfg, params, prompt,
+                    mx.build_mesh(tp=2, devices=devices8[:2]), 4, 3)
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    np.testing.assert_allclose(np.asarray(sc1), np.asarray(sc2),
+                               rtol=2e-5)
+
+
+def test_beam_search_validation():
+    import pytest
+    cfg = standalone_gpt_config(vocab_size=16, seq_len=8)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="num_beams"):
+        gpt.beam_search(cfg, params, prompt, 2, num_beams=17)
+    with pytest.raises(ValueError, match="seq_len"):
+        gpt.beam_search(cfg, params, prompt, 6, num_beams=2)
