@@ -1015,6 +1015,14 @@ def decode_step(cfg: GPTConfig, params, cache, token, pos):
     return _lm_head(cfg, params, x), new_cache
 
 
+def _check_stop_tokens(cfg: GPTConfig, eos_token_id, pad_token_id):
+    for name, tok_id in (("eos_token_id", eos_token_id),
+                         ("pad_token_id", pad_token_id)):
+        if tok_id is not None and not 0 <= tok_id < cfg.vocab_size:
+            raise ValueError(
+                f"{name} {tok_id} outside vocab [0, {cfg.vocab_size})")
+
+
 def _decode_entry_cfg(cfg: GPTConfig, p_len: int,
                       n_new: Optional[int] = None) -> GPTConfig:
     """Shared decode-entry validation (+ SP/CP strip) for prefill /
@@ -1108,8 +1116,14 @@ def _filter_logits(logits, top_k: int, top_p: float):
 
 def generate(cfg: GPTConfig, params, prompt, n_new: int,
              *, temperature: float = 0.0, top_k: int = 0,
-             top_p: float = 1.0, key=None):
+             top_p: float = 1.0, key=None,
+             eos_token_id: Optional[int] = None, pad_token_id: int = 0):
     """Continuation: ``prompt [b, p_len] int32`` → ``[b, n_new]``.
+
+    ``eos_token_id`` enables early stopping: once a row emits it, every
+    later position is ``pad_token_id`` (the scan length is static under
+    jit, so "stopping" = masking — the emitted sequence is identical to
+    a dynamic stop). The eos token itself is kept.
 
     ``temperature=0`` (default) is greedy argmax; > 0 samples from
     ``softmax(logits / temperature)`` using ``key`` (required then; fold
@@ -1133,6 +1147,7 @@ def generate(cfg: GPTConfig, params, prompt, n_new: int,
                          "temperature > 0")
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    _check_stop_tokens(cfg, eos_token_id, pad_token_id)
     b, p_len = prompt.shape
     cfg = _decode_entry_cfg(cfg, p_len, n_new)
     total = p_len + n_new
@@ -1151,22 +1166,28 @@ def generate(cfg: GPTConfig, params, prompt, n_new: int,
 
     cache0, logits0 = prefill(cfg, params, prompt, max_len=total)
     first = draw(logits0, p_len - 1)
+    eos = eos_token_id
+    done0 = (first == eos) if eos is not None else jnp.zeros((b,), bool)
 
     def step(carry, t):
-        tok_in, cache = carry
+        tok_in, cache, done = carry
         logits, cache = decode_step(cfg, params, cache, tok_in, t)
         nxt = draw(logits, t)
-        return (nxt, cache), nxt
+        if eos is not None:
+            nxt = jnp.where(done, jnp.int32(pad_token_id), nxt)
+            done = done | (nxt == eos)
+        return (nxt, cache, done), nxt
 
-    (_, _), outs = lax.scan(
-        step, (first, cache0),
+    (_, _, _), outs = lax.scan(
+        step, (first, cache0, done0),
         jnp.arange(p_len, total - 1, dtype=jnp.int32))
     outs = jnp.concatenate([first[None], outs], axis=0)
     return jnp.transpose(outs, (1, 0))
 
 
 def beam_search(cfg: GPTConfig, params, prompt, n_new: int,
-                *, num_beams: int):
+                *, num_beams: int,
+                eos_token_id: Optional[int] = None, pad_token_id: int = 0):
     """Fixed-length beam search: ``prompt [b, p_len] int32`` →
     ``(sequences [b, num_beams, n_new] int32, scores [b, num_beams]
     fp32)``, beams sorted by total log-probability (descending).
@@ -1179,9 +1200,14 @@ def beam_search(cfg: GPTConfig, params, prompt, n_new: int,
     over its frontier: whenever ``num_beams ≥`` the number of reachable
     prefixes, the top beam IS the global argmax sequence (pinned by the
     exhaustive oracle test). Fixed horizon: every beam decodes exactly
-    ``n_new`` tokens (no EOS early-exit — a finished-beam mask is a
-    documented extension), so a length penalty would rescale all beams
-    equally and is omitted.
+    ``n_new`` positions; with ``eos_token_id`` a beam that emits it is
+    FROZEN — its only continuation is ``pad_token_id`` at unchanged
+    score, so finished hypotheses compete with live ones on total
+    log-probability while keeping the frontier static-shaped. (A frozen
+    beam keeps occupying its slot; HF's growing hypothesis-set variant
+    trades that for dynamic bookkeeping jit can't express.) Without eos
+    every beam runs the full horizon, where a length penalty would
+    rescale all beams equally and is omitted.
 
     Local semantics (call inside ``shard_map``): the gathered fp32
     logits are replicated over tp, so ``top_k`` picks identical beams on
@@ -1196,6 +1222,7 @@ def beam_search(cfg: GPTConfig, params, prompt, n_new: int,
         raise ValueError(
             f"num_beams {k} exceeds vocab_size {cfg.vocab_size} (the "
             "first step has only vocab_size distinct continuations)")
+    _check_stop_tokens(cfg, eos_token_id, pad_token_id)
     if n_new < 1:
         raise ValueError("beam_search needs n_new >= 1")
     cfg = _decode_entry_cfg(cfg, p_len, n_new)
@@ -1207,22 +1234,33 @@ def beam_search(cfg: GPTConfig, params, prompt, n_new: int,
     first = first.astype(jnp.int32)
     # beams become the decode batch: row (i, j) = batch i, beam j
     cache = jnp.repeat(cache0, k, axis=2)          # [l, 2, b*k, hl, S, d]
+    eos = eos_token_id
+    done0 = ((first == eos) if eos is not None
+             else jnp.zeros((b, k), bool))
 
     def step(carry, t):
-        tok_in, cache, scores = carry
+        tok_in, cache, scores, done = carry
         logits, cache = decode_step(cfg, params, cache, tok_in, t)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         vocab = logp.shape[-1]
-        cand = scores[:, :, None] + logp.reshape(b, k, vocab)
+        logp = logp.reshape(b, k, vocab)
+        if eos is not None:
+            # frozen beams extend only with pad, at unchanged score
+            frozen = jnp.full((vocab,), -jnp.inf).at[pad_token_id].set(0.0)
+            logp = jnp.where(done[:, :, None], frozen[None, None], logp)
+        cand = scores[:, :, None] + logp
         scores, flat = lax.top_k(cand.reshape(b, k * vocab), k)
         parent = flat // vocab                     # [b, k]
         tok = (flat % vocab).astype(jnp.int32)
+        if eos is not None:
+            done = (jnp.take_along_axis(done, parent, axis=1)
+                    | (tok == eos))
         gather = (jnp.arange(b)[:, None] * k + parent).reshape(b * k)
         cache = jnp.take(cache, gather, axis=2)
-        return (tok.reshape(b * k), cache, scores), (tok, parent)
+        return (tok.reshape(b * k), cache, scores, done), (tok, parent)
 
-    (_, _, scores), (toks, parents) = lax.scan(
-        step, (first.reshape(b * k), cache, scores),
+    (_, _, scores, _), (toks, parents) = lax.scan(
+        step, (first.reshape(b * k), cache, scores, done0),
         jnp.arange(p_len, total - 1, dtype=jnp.int32))
 
     # backtrace: walk parents from the final beam order to the root

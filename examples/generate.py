@@ -64,7 +64,7 @@ def main():
         cfg.vocab_size)
     key = jax.random.PRNGKey(2)
     if args.beams > 0:
-        if args.temperature > 0 or args.top_k or args.top_p < 1.0:
+        if args.temperature > 0 or args.top_k or args.top_p != 1.0:
             raise SystemExit(
                 "--beams is deterministic max-probability search; "
                 "--temperature/--top-k/--top-p apply to generate only")

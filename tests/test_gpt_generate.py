@@ -344,3 +344,61 @@ def test_beam_search_validation():
         gpt.beam_search(cfg, params, prompt, 2, num_beams=17)
     with pytest.raises(ValueError, match="seq_len"):
         gpt.beam_search(cfg, params, prompt, 6, num_beams=2)
+
+
+def test_generate_eos_early_stop(devices8):
+    """Once a row emits eos, every later position is pad; positions up
+    to and including the eos match the unconstrained greedy run."""
+    cfg = standalone_gpt_config(vocab_size=96, seq_len=24)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (3, 8), 0, 96)
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    base = np.asarray(_generate(cfg, params, prompt, mesh))
+    eos = int(base[0, 1])  # row 0's second token becomes the stop token
+    pspecs = gpt.param_specs(cfg)
+    out = np.asarray(jax.jit(jax.shard_map(
+        lambda p, t: gpt.generate(cfg, p, t, N_NEW, eos_token_id=eos,
+                                  pad_token_id=0),
+        mesh=mesh, in_specs=(pspecs, P(None, None)),
+        out_specs=P(None, None), check_vma=False))(params, prompt))
+    for i in range(base.shape[0]):
+        hits = np.where(base[i] == eos)[0]
+        stop = hits[0] if hits.size else N_NEW - 1
+        np.testing.assert_array_equal(out[i, :stop + 1],
+                                      base[i, :stop + 1])
+        assert np.all(out[i, stop + 1:] == 0)
+    assert np.any(base[0] == eos)  # the forcing actually triggered
+
+
+def test_beam_search_eos_freezes_beam(devices8):
+    """k=1 beam search with eos equals greedy generate with eos, and a
+    frozen beam's score stops changing at the eos position."""
+    cfg = standalone_gpt_config(vocab_size=96, seq_len=24)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (3, 8), 0, 96)
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    base = np.asarray(_generate(cfg, params, prompt, mesh))
+    eos = int(base[0, 1])
+    pspecs = gpt.param_specs(cfg)
+
+    def run(n_new):
+        return jax.jit(jax.shard_map(
+            lambda p, t: gpt.beam_search(cfg, p, t, n_new, num_beams=1,
+                                         eos_token_id=eos,
+                                         pad_token_id=0),
+            mesh=mesh, in_specs=(pspecs, P(None, None)),
+            out_specs=(P(None, None, None), P(None, None)),
+            check_vma=False))(params, prompt)
+
+    seqs, scores = run(N_NEW)
+    greedy_eos = np.asarray(jax.jit(jax.shard_map(
+        lambda p, t: gpt.generate(cfg, p, t, N_NEW, eos_token_id=eos,
+                                  pad_token_id=0),
+        mesh=mesh, in_specs=(pspecs, P(None, None)),
+        out_specs=P(None, None), check_vma=False))(params, prompt))
+    np.testing.assert_array_equal(np.asarray(seqs[:, 0]), greedy_eos)
+    # row 0 finished at position 1: the score with a longer horizon is
+    # identical (pad extensions are free)
+    _, scores_short = run(2)
+    np.testing.assert_allclose(float(scores[0, 0]),
+                               float(scores_short[0, 0]), rtol=1e-6)
