@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from jax.sharding import PartitionSpec as P
+
 from apex_tpu.parallel.sync_batchnorm import sync_batch_norm
 
 #: depth 26 = one bottleneck per stage — the smallest member of the
@@ -179,3 +181,45 @@ def loss(cfg: ResNetConfig, params, state, images, labels, *,
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
     return jnp.mean(nll), ns
+
+
+def make_train_step(cfg: ResNetConfig, mesh, optimizer, scaler_cfg=None,
+                    *, clip_grad_norm=None):
+    """(init_fn, step_fn) for classification training — BASELINE config
+    #1's trainer role, the ResNet analogue of
+    :func:`apex_tpu.models.training.make_train_step`.
+
+    ``step_fn(state, images, labels) -> (state, metrics)`` with the BN
+    running stats riding ``TrainState.extra``. ``cfg.bn_axis="dp"``
+    (SyncBatchNorm) syncs the batch statistics inside the forward, so
+    the trainer skips its own dp-pmean of the stats; local BN instead
+    gets the torch-DDP broadcast-buffers behaviour (stats dp-pmeaned
+    each step). uint8 image batches (the native loader's wire format)
+    are dequantized+normalized on device.
+    """
+    from apex_tpu import data as _data
+    from apex_tpu.models import training as _training
+
+    def loss_fn(p, bn_state, images, labels):
+        if images.dtype == jnp.uint8:
+            images = _data.normalize_images(images, jnp.float32)
+        return loss(cfg, p, bn_state, images, labels)
+
+    p_shapes, _ = jax.eval_shape(
+        lambda: init(cfg, jax.random.PRNGKey(0)))
+    # "already synced" only if the BN reduction axis covers dp — a
+    # bn_axis of e.g. "tp" still leaves stats dp-divergent and needing
+    # the trainer's pmean (torch DDP's broadcast-buffers role)
+    bn_axes = (() if cfg.bn_axis is None
+               else (cfg.bn_axis,) if isinstance(cfg.bn_axis, str)
+               else tuple(cfg.bn_axis))
+    return _training.make_loss_train_step(
+        loss_fn, mesh, optimizer,
+        init_params=lambda key: init(cfg, key),
+        pspecs=jax.tree.map(lambda _: P(), p_shapes),
+        scaler_cfg=scaler_cfg,
+        clip_grad_norm=clip_grad_norm,
+        init_extra="with_params",
+        extra_sync_dp=("dp" not in bn_axes),
+        n_batch_args=2,
+    )

@@ -44,6 +44,9 @@ class TrainState(NamedTuple):
     params: Any
     opt_state: Any
     scaler: ScalerState
+    #: non-trainable model state threaded through the loss (BatchNorm
+    #: running stats — torch's "buffers"); () when the model has none
+    extra: Any = ()
 
 
 def _local_shape(shape, spec, axis_sizes):
@@ -167,20 +170,37 @@ def _dp_grad_sync(grads, optimizer, axes_present, *, fsdp, fsdp_mask,
 
 
 def _make_init_fn(init_params, pspecs, opt_specs, optimizer, scaler_cfg,
-                  mesh):
+                  mesh, init_extra=None, extra_pspecs=None):
+    """``init_extra`` is a separate ``key -> extra`` callable, or the
+    string ``"with_params"`` meaning ``init_params(key)`` returns the
+    ``(params, extra)`` pair in one pass (models whose init builds both,
+    e.g. ResNet's params + BN state — avoids running the param RNG
+    twice)."""
+    combined = init_extra == "with_params"
+
+    def place(sp_tree):
+        return jax.tree.map(lambda sp: NamedSharding(mesh, sp), sp_tree)
+
     def init_fn(key) -> TrainState:
-        params = jax.jit(
-            init_params,
-            out_shardings=jax.tree.map(
-                lambda sp: NamedSharding(mesh, sp), pspecs),
-        )(key)
+        if combined:
+            params, extra = jax.jit(
+                init_params,
+                out_shardings=(place(pspecs), place(extra_pspecs)),
+            )(key)
+        else:
+            params = jax.jit(
+                init_params, out_shardings=place(pspecs))(key)
+            extra = ()
+            if init_extra is not None:
+                extra = jax.jit(
+                    init_extra, out_shardings=place(extra_pspecs))(key)
         opt_state = jax.jit(
             jax.shard_map(optimizer.init, mesh=mesh, in_specs=(pspecs,),
                           out_specs=opt_specs, check_vma=False)
         )(params)
         return TrainState(
             step=jnp.zeros((), jnp.int32), params=params,
-            opt_state=opt_state, scaler=scaler_cfg.init())
+            opt_state=opt_state, scaler=scaler_cfg.init(), extra=extra)
 
     return init_fn
 
@@ -435,6 +455,9 @@ def make_loss_train_step(
     model_axis: str = AXIS_TP,
     fsdp: bool = False,
     n_batch_args: int = 2,
+    init_extra=None,
+    extra_pspecs=None,
+    extra_sync_dp: bool = True,
 ):
     """Generic (non-pipelined) fused train step over an arbitrary local
     loss — the machinery of :func:`make_train_step` for models that are
@@ -453,6 +476,15 @@ def make_loss_train_step(
     - ``fsdp``: the model gathers dp-sharded leaves itself (pspecs
       mention dp on them); their grads arrive dp-summed via the gather's
       psum_scatter VJP and are scaled to the mean here.
+    - ``init_extra(key) -> pytree`` (or the string ``"with_params"``,
+      meaning ``init_params(key)`` returns ``(params, extra)`` in one
+      pass) enables non-trainable model state
+      (BatchNorm running stats — torch "buffers"): the loss contract
+      becomes ``loss_fn(params, extra, *batch) -> (loss, new_extra)``,
+      the state rides ``TrainState.extra``, reverts with the params on
+      an overflow-skipped step, and (with ``extra_sync_dp``, the torch
+      DDP broadcast-buffers role) is dp-pmeaned each step — pass
+      ``extra_sync_dp=False`` when the loss already syncs it (SyncBN).
 
     Covers dp / tp / SP / fsdp + amp + clip. Pipeline/context/expert
     parallelism remain :func:`make_train_step` (they are model-shaped).
@@ -475,18 +507,38 @@ def make_loss_train_step(
         is_leaf=lambda x: isinstance(x, P))
     scaler_specs = jax.tree.map(lambda _: P(), ScalerState(*[0] * 3))
 
-    param_shapes = jax.eval_shape(
-        lambda: init_params(jax.random.PRNGKey(0)))
+    has_extra = init_extra is not None
+    combined_init = init_extra == "with_params"
+    shapes = jax.eval_shape(lambda: init_params(jax.random.PRNGKey(0)))
+    if combined_init:
+        param_shapes, extra_shapes = shapes
+    else:
+        param_shapes = shapes
+        extra_shapes = (jax.eval_shape(
+            lambda: init_extra(jax.random.PRNGKey(0)))
+            if has_extra else None)
     opt_specs = _opt_state_specs(optimizer, param_shapes, pspecs, mesh)
+    if has_extra and extra_pspecs is None:
+        extra_pspecs = jax.tree.map(lambda _: P(), extra_shapes)
 
     init_fn = _make_init_fn(init_params, pspecs, opt_specs, optimizer,
-                            scaler_cfg, mesh)
+                            scaler_cfg, mesh, init_extra, extra_pspecs)
 
     def _local_step(state: TrainState, *batch):
         params = state.params
-        vag = value_and_scaled_grad(
-            lambda p: loss_fn(p, *batch), scaler_cfg)
-        value, grads, finite = vag(params, scaler_state=state.scaler)
+        if has_extra:
+            vag = value_and_scaled_grad(
+                lambda p: loss_fn(p, state.extra, *batch), scaler_cfg,
+                has_aux=True)
+            (value, new_extra), grads, finite = vag(
+                params, scaler_state=state.scaler)
+            if extra_sync_dp and AXIS_DP in axes_present:
+                new_extra = lax.pmean(new_extra, AXIS_DP)
+        else:
+            new_extra = state.extra
+            vag = value_and_scaled_grad(
+                lambda p: loss_fn(p, *batch), scaler_cfg)
+            value, grads, finite = vag(params, scaler_state=state.scaler)
 
         grads = _dp_grad_sync(grads, optimizer, axes_present,
                               fsdp=fsdp, fsdp_mask=fsdp_mask,
@@ -506,6 +558,8 @@ def make_loss_train_step(
         if scaler_cfg.enabled:
             new_params = apply_if_finite(new_params, params, finite)
             new_opt = apply_if_finite(new_opt, state.opt_state, finite)
+            if has_extra:
+                new_extra = apply_if_finite(new_extra, state.extra, finite)
         new_scaler = scaler_update(scaler_cfg, state.scaler, finite)
         loss_out = value
         if AXIS_DP in axes_present:
@@ -518,10 +572,11 @@ def make_loss_train_step(
         if grad_norm is not None:
             metrics["grad_norm"] = grad_norm
         return TrainState(state.step + jnp.int32(1), new_params, new_opt,
-                          new_scaler), metrics
+                          new_scaler, new_extra), metrics
 
     state_specs = TrainState(
-        step=P(), params=pspecs, opt_state=opt_specs, scaler=scaler_specs)
+        step=P(), params=pspecs, opt_state=opt_specs, scaler=scaler_specs,
+        extra=(extra_pspecs if has_extra else ()))
     data_spec = (P(AXIS_DP) if AXIS_DP in axes_present else P())
     metric_specs = {"loss": P(), "grads_finite": P(), "loss_scale": P()}
     if clip_grad_norm is not None:

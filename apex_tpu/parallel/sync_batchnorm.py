@@ -3,11 +3,15 @@
 TPU-native re-design of apex/parallel/{optimized_sync_batchnorm*,
 sync_batchnorm*}.py + csrc/syncbn.cpp, welford.cu (U). The reference ships
 two impls (pure-torch allgather-of-stats and Welford-merge CUDA kernels);
-on TPU one suffices: per-shard moment sums reduced with a single ``psum``
-of the ``(Σx, Σx², n)`` triple over the data-parallel axis — numerically
-the Welford merge at fp32, without the bespoke kernels. Ragged last
-batches (apex's varying-count merge) are handled exactly: ``n`` rides in
-the same psum, so shards may carry different batch sizes.
+on TPU one suffices: TWO-PASS cross-replica moments — psum ``(Σx, n)``
+for the global mean, then psum the globally-centered square sum. The
+one-pass ``E[x²] − mean²`` triple was measured to cancel catastrophically
+in fp32 on real activation maps (docs/DESIGN.md "SyncBN statistics are
+two-pass"); the two-pass form is the numerically faithful equivalent of
+the reference's Welford kernels. Ragged last batches (apex's
+varying-count merge) ride the same psums: ``batch_weight`` overrides the
+element count of a zero-padded shard, and the padded elements'
+``mean²`` contribution is subtracted from the centered sum exactly.
 
 Channels-last vs channels-first is a ``channel_axis`` argument — layout is
 metadata under XLA, not a kernel variant.
@@ -28,7 +32,19 @@ Axis = Union[str, Sequence[str]]
 
 
 def _moments(x, reduce_dims, axis: Optional[Axis], batch_weight=None):
-    """Cross-replica (mean, var, count) in fp32 via one fused psum."""
+    """Cross-replica (mean, var, count) in fp32, two-pass.
+
+    The naive one-pass ``E[x²] − mean²`` form cancels catastrophically
+    in fp32 whenever ``|mean| ≫ std`` — measured on an untrained
+    ResNet the cancellation noise amplifies through the stacked
+    ``rsqrt(var)`` backwards into %-level gradient error (fp64 is
+    exact, pinning it as pure conditioning). The two-pass form
+    ``E[(x − mean)²]`` is the numerically faithful equivalent of the
+    reference's Welford kernels (csrc/welford.cu (U)): pass 1 psums
+    ``(Σx, n)`` for the global mean, pass 2 psums the globally-centered
+    square sum — two small collectives instead of one, bought back many
+    times over in gradient fidelity.
+    """
     xf = x.astype(jnp.float32)
     if batch_weight is None:
         n = jnp.array(1.0, jnp.float32)
@@ -36,16 +52,27 @@ def _moments(x, reduce_dims, axis: Optional[Axis], batch_weight=None):
             n = n * x.shape[d]
     else:
         n = batch_weight.astype(jnp.float32)
+    n_elems = jnp.array(1.0, jnp.float32)
+    for d in reduce_dims:
+        n_elems = n_elems * x.shape[d]
     s1 = jnp.sum(xf, axis=reduce_dims)
-    s2 = jnp.sum(xf * xf, axis=reduce_dims)
     if axis is not None:
-        # one collective for the whole (Σx, Σx², n) triple, not three
-        packed = jnp.concatenate([s1, s2, jnp.broadcast_to(n, (1,))])
+        packed = jnp.concatenate([s1, jnp.broadcast_to(n, (1,))])
         packed = lax.psum(packed, axis)
-        m = s1.shape[0]
-        s1, s2, n = packed[:m], packed[m : 2 * m], packed[2 * m]
+        s1, n = packed[:-1], packed[-1]
     mean = s1 / n
-    var = jnp.maximum(s2 / n - mean * mean, 0.0)
+    bshape = tuple(
+        x.shape[d] if d not in reduce_dims else 1 for d in range(x.ndim))
+    d2 = jnp.sum(jnp.square(xf - mean.reshape(bshape)), axis=reduce_dims)
+    if batch_weight is not None:
+        # zero-padded shard (batch_weight < local element count): each
+        # padded zero contributed (0 - mean)^2; remove it exactly. (The
+        # same zero-padding contract the one-pass form relied on.)
+        pad = n_elems - batch_weight.astype(jnp.float32)
+        d2 = d2 - pad * jnp.square(mean)
+    if axis is not None:
+        d2 = lax.psum(d2, axis)
+    var = jnp.maximum(d2 / n, 0.0)
     return mean, var, n
 
 

@@ -209,3 +209,25 @@ def test_syncbn_grads_match_full_batch(dp8):
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(g[1]), np.asarray(gref[1]),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_syncbn_batch_weight_ragged(dp8):
+    """A zero-padded shard with batch_weight == the unpadded statistics:
+    the padded elements' mean² contribution is subtracted exactly from
+    the two-pass centered sum."""
+    import numpy as np
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (6, 3)) + 2.0  # mean>>0
+    ref_mean = jnp.mean(x, axis=0)
+    ref_var = jnp.mean((x - ref_mean) ** 2, axis=0)
+
+    xp = jnp.concatenate([x, jnp.zeros((2, 3))])  # pad to 8 rows
+    y, _, _ = sync_batch_norm(
+        xp, None, None, axis=None, training=True, channel_axis=-1,
+        batch_weight=jnp.float32(6.0))
+    # recover the (mean, var) the call used from its normalized output
+    got = (xp[:6] - y[:6] * jnp.sqrt(ref_var + 1e-5))
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(jnp.broadcast_to(ref_mean,
+                                                           (6, 3))),
+                               rtol=1e-4, atol=1e-4)
