@@ -1,0 +1,95 @@
+"""In-process A/B: our flash kernels vs stock pallas flash/splash at the
+355M bench attention shape (b=16, h=16, s=1024, d=64, causal, bf16).
+
+Each candidate: jit of lax.scan over ITERS chained calls (out feeds next
+q), value-fetch sync. Ratios within this process are the signal.
+"""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu._capabilities import enable_compilation_cache
+
+enable_compilation_cache()
+
+import importlib
+fa = importlib.import_module("apex_tpu.kernels.flash_attention")
+
+B, H, S, D = 16, 16, 1024, 64
+HID = H * D
+ITERS = 30
+key = jax.random.PRNGKey(0)
+kq, kk, kv = jax.random.split(key, 3)
+q_bsh = jax.random.normal(kq, (B, S, HID), jnp.bfloat16)
+k_bsh = jax.random.normal(kk, (B, S, HID), jnp.bfloat16)
+v_bsh = jax.random.normal(kv, (B, S, HID), jnp.bfloat16)
+
+q4 = q_bsh.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+k4 = k_bsh.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+v4 = v_bsh.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+
+
+def timeit(name, fn, *args):
+    r = fn(*args)
+    _ = float(jnp.asarray(r).ravel()[0])  # compile+warm
+    t0 = time.perf_counter()
+    r = fn(*args)
+    _ = float(jnp.asarray(r).ravel()[0])
+    dt = time.perf_counter() - t0
+    per = dt / ITERS * 1e3
+    print(f"{name:28} {per:8.3f} ms/call")
+    return per
+
+
+def chain(call):
+    def body(q, _):
+        o = call(q)
+        return o.astype(q.dtype), ()
+    @jax.jit
+    def run(q):
+        out, _ = lax.scan(body, q, None, length=ITERS)
+        return out.astype(jnp.float32).sum()
+    return run
+
+
+# ---- ours, bsh layout (the bench path) ----
+ours_bsh = chain(lambda q: fa.flash_attention_bsh(
+    q, k_bsh, v_bsh, num_heads=H, causal=True))
+timeit("ours bsh fwd", ours_bsh, q_bsh)
+
+# ---- ours, head-major ----
+ours_bhsd = chain(lambda q: fa.flash_attention(q, k4, v4, causal=True))
+timeit("ours bhsd fwd", ours_bhsd, q4)
+
+# ---- stock flash_attention ----
+from jax.experimental.pallas.ops.tpu import flash_attention as stock
+
+stock_fn = chain(lambda q: stock.flash_attention(
+    q, k4, v4, causal=True, sm_scale=1.0 / D ** 0.5))
+timeit("stock flash fwd", stock_fn, q4)
+
+# ---- stock splash attention ----
+try:
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+        splash_attention_mask as sm,
+    )
+
+    mask = sm.CausalMask((S, S))
+    mgrid = sm.MultiHeadMask([mask] * H)
+    kernel = sk.make_splash_mha(
+        mask=mgrid, head_shards=1, q_seq_shards=1)
+    kernel = jax.vmap(kernel)   # over batch
+
+    def splash_call(q):
+        return kernel(q * (1.0 / D ** 0.5), k4, v4)
+
+    splash_fn = chain(splash_call)
+    timeit("stock splash fwd", splash_fn, q4)
+except Exception as e:
+    print("splash failed:", type(e).__name__, str(e)[:200])
+EOF

@@ -1,0 +1,57 @@
+"""In-process A/B: ce_dtype='f32' vs 'compute' at the 355M bench config."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu._capabilities import enable_compilation_cache
+
+enable_compilation_cache()
+
+from apex_tpu import mesh as mx
+from apex_tpu.amp import ScalerConfig
+from apex_tpu.models import gpt, training
+from apex_tpu.optimizers import fused_adam
+
+STEPS = 15
+
+
+def build(ce_dtype):
+    cfg = gpt.GPTConfig(
+        vocab_size=50304, hidden_size=1024, num_layers=24, num_heads=16,
+        seq_len=1024, remat=True, ce_chunk=512, compute_dtype=jnp.bfloat16,
+        attn_impl="flash", ln_impl="xla", remat_policy="qkv_fc1_attn",
+        ce_dtype=ce_dtype,
+    )
+    mesh = mx.build_mesh(tp=1, devices=jax.devices()[:1])
+    init_fn, step_fn = training.make_train_step(
+        cfg, mesh, fused_adam(1e-4, layout="tree"),
+        ScalerConfig(enabled=False))
+    return cfg, init_fn, step_fn
+
+
+def run(ce_dtype):
+    cfg, init_fn, step_fn = build(ce_dtype)
+    state = init_fn(jax.random.PRNGKey(0))
+    tok = jax.random.randint(
+        jax.random.PRNGKey(1), (16, cfg.seq_len), 0, cfg.vocab_size)
+    tgt = jnp.roll(tok, -1, axis=1)
+    state, m = step_fn(state, tok, tgt)
+    loss0 = float(m["loss"])
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            state, m = step_fn(state, tok, tgt)
+        _ = float(m["loss"])
+        best = min(best, time.perf_counter() - t0)
+    tps = 16 * cfg.seq_len * STEPS / best
+    print(f"ce_dtype={ce_dtype:8} first-step loss {loss0:.6f}  "
+          f"{best / STEPS * 1e3:7.1f} ms/step  {tps / 1e3:6.1f}k tok/s")
+    return tps
+
+
+a = run("f32")
+b = run("compute")
+print(f"compute/f32 speedup: {b / a:.4f}")

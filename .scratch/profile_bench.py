@@ -1,0 +1,57 @@
+"""Capture an xprof trace of the tuned 355M bench step and print the
+op_profile category table + top self-time ops."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu._capabilities import enable_compilation_cache
+
+enable_compilation_cache()
+
+from apex_tpu import mesh as mx
+from apex_tpu import profiler
+from apex_tpu.amp import ScalerConfig
+from apex_tpu.models import gpt, training
+from apex_tpu.optimizers import fused_adam
+
+cfg = gpt.GPTConfig(
+    vocab_size=50304, hidden_size=1024, num_layers=24, num_heads=16,
+    seq_len=1024, remat=True, ce_chunk=512, compute_dtype=jnp.bfloat16,
+    attn_impl="flash", ln_impl="xla", remat_policy="qkv_fc1_attn",
+)
+batch = 16
+
+mesh = mx.build_mesh(tp=1, devices=jax.devices()[:1])
+init_fn, step_fn = training.make_train_step(
+    cfg, mesh, fused_adam(1e-4, layout="tree"), ScalerConfig(enabled=False))
+state = init_fn(jax.random.PRNGKey(0))
+tok = jax.random.randint(
+    jax.random.PRNGKey(1), (batch, cfg.seq_len), 0, cfg.vocab_size)
+tgt = jnp.roll(tok, -1, axis=1)
+
+state, m = step_fn(state, tok, tgt)
+_ = float(m["loss"])  # warm
+
+logdir = "/root/repo/.scratch/trace"
+opts = __import__("jax").profiler.ProfileOptions()
+opts.host_tracer_level = 0
+opts.python_tracer_level = 0
+import jax.profiler as _jp
+_jp.start_trace(logdir, profiler_options=opts)
+if True:
+    for _ in range(3):
+        state, m = step_fn(state, tok, tgt)
+    _ = float(m["loss"])
+
+_jp.stop_trace()
+prof = profiler.op_profile(logdir, top=30)
+print("TOTAL", round(prof["total_s"], 4))
+cats = sorted(prof["by_category"].items(), key=lambda kv: -kv[1])
+for c, s in cats:
+    print(f"{s:9.4f}  {c}")
+print("---- top ops ----")
+for o in prof["top_ops"]:
+    print(f"{o['seconds']:8.4f} x{o['count']:<4} {o['category'][:22]:22} "
+          f"{o['name'][:60]:60} {o.get('source','')}")

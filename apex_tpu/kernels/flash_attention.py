@@ -92,8 +92,9 @@ def _online_update(s, valid, m_prev, l_prev, acc, v):
     return m_new, l_new, acc_new
 
 
-def _fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale, causal, bq, bk, sk, sq):
+def _fwd_kernel(len_ref, segq_ref, segk_ref, q_ref, k_ref, v_ref, o_ref,
+                lse_ref, acc_ref, m_ref, l_ref, *, scale, causal, bq, bk,
+                sk, sq):
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -118,7 +119,10 @@ def _fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # (bq, bk)
-        valid = _valid_cols(blen, i, j, causal=causal, bq=bq, bk=bk, sk=sk)
+        segs = (None if segq_ref is None
+                else (segq_ref[:], segk_ref[:]))
+        valid = _valid_cols(blen, i, j, causal=causal, bq=bq, bk=bk, sk=sk,
+                            segs=segs)
         s = jnp.where(valid, s, _NEG)
         m_new, l_new, acc = _online_update(
             s, valid, m_ref[:, :1], l_ref[:, :1], acc_ref[:], v)
@@ -143,14 +147,21 @@ def _causal_skip(causal, i, j, bq, bk):
     return (j * bk < (i + 1) * bq) if causal else True
 
 
-def _valid_cols(blen, i, j, *, causal, bq, bk, sk):
-    """The composed (padding ∧ length ∧ causal) column mask for block
-    (i, j) — the single source of masking truth for every kernel in this
-    module (head-major and lane-packed, forward and backward)."""
+def _valid_cols(blen, i, j, *, causal, bq, bk, sk, segs=None):
+    """The composed (padding ∧ length ∧ segment ∧ causal) column mask
+    for block (i, j) — the single source of masking truth for every
+    kernel in this module (head-major and lane-packed, forward and
+    backward). ``segs`` is an optional ``((1, bq), (1, bk))`` int32 pair
+    of per-row/per-column segment ids: rows attend only to columns of
+    the same segment (the cu_seqlens-style packed-batch masking of the
+    reference's fmha var-seqlen path, apex/contrib/fmha (U))."""
     col = _col_ids(bq, bk, j)
     valid = col < sk
     if blen is not None:
         valid = valid & (col < blen)
+    if segs is not None:
+        seg_q, seg_k = segs
+        valid = valid & (jnp.transpose(seg_q) == seg_k)
     if causal:
         valid = valid & (col <= _row_ids(bq, bk, i))
     return valid
@@ -178,7 +189,7 @@ def _p_ds(q, k, v, do, lse, delta, valid, *, scale):
     return p.astype(q.dtype), ds
 
 
-def _bwd_p_ds(blen, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_p_ds(blen, segs, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
               i, j, *, scale, causal, bq, bk, sk):
     """Head-major backward block: read refs, apply the shared mask/math."""
     q = q_ref[0]
@@ -187,13 +198,15 @@ def _bwd_p_ds(blen, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     do = do_ref[0]
     lse = lse_ref[0][:, :1]
     delta = delta_ref[0][:, :1]
-    valid = _valid_cols(blen, i, j, causal=causal, bq=bq, bk=bk, sk=sk)
+    valid = _valid_cols(blen, i, j, causal=causal, bq=bq, bk=bk, sk=sk,
+                        segs=segs)
     p, ds = _p_ds(q, k, v, do, lse, delta, valid, scale=scale)
     return q, k, do, p, ds
 
 
-def _dq_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, acc_ref, *, scale, causal, bq, bk, sk):
+def _dq_kernel(len_ref, segq_ref, segk_ref, q_ref, k_ref, v_ref, do_ref,
+               lse_ref, delta_ref, dq_ref, acc_ref, *, scale, causal, bq,
+               bk, sk):
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -207,8 +220,10 @@ def _dq_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(compute)
     def _block():
+        segs = (None if segq_ref is None
+                else (segq_ref[:], segk_ref[:]))
         _, k, _, _, ds = _bwd_p_ds(
-            blen, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            blen, segs, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             i, j, scale=scale, causal=causal, bq=bq, bk=bk, sk=sk)
         acc_ref[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -219,8 +234,9 @@ def _dq_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk, sk):
+def _dkv_kernel(len_ref, segq_ref, segk_ref, q_ref, k_ref, v_ref, do_ref,
+                lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                scale, causal, bq, bk, sk):
     j = pl.program_id(1)   # k block
     i = pl.program_id(2)   # q block (innermost sweep)
     nq = pl.num_programs(2)
@@ -235,8 +251,10 @@ def _dkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(compute)
     def _block():
+        segs = (None if segq_ref is None
+                else (segq_ref[:], segk_ref[:]))
         q, _, do, p, ds = _bwd_p_ds(
-            blen, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            blen, segs, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             i, j, scale=scale, causal=causal, bq=bq, bk=bk, sk=sk)
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -251,9 +269,9 @@ def _dkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _dqkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                 dq_ref, dk_ref, dv_ref, dq_acc, dk_acc, dv_acc,
-                 *, scale, causal, bq, bk, sk):
+def _dqkv_kernel(len_ref, segq_ref, segk_ref, q_ref, k_ref, v_ref, do_ref,
+                 lse_ref, delta_ref, dq_ref, dk_ref, dv_ref, dq_acc,
+                 dk_acc, dv_acc, *, scale, causal, bq, bk, sk):
     """Fused backward: one S/P recompute per (j, i) block yields dQ, dK
     and dV together. Grid (bh, nk, nq) — k block outer, q block inner —
     so dK/dV reduce in block scratch exactly like ``_dkv_kernel``, while
@@ -280,8 +298,10 @@ def _dqkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(compute)
     def _block():
+        segs = (None if segq_ref is None
+                else (segq_ref[:], segk_ref[:]))
         q, k, do, p, ds = _bwd_p_ds(
-            blen, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            blen, segs, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             i, j, scale=scale, causal=causal, bq=bq, bk=bk, sk=sk)
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -344,7 +364,8 @@ def _len_spec():
     return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
-def _run_fwd(q, k, v, lengths, scale, causal, block_q=None, block_k=None):
+def _run_fwd(q, k, v, lengths, segments, scale, causal, block_q=None,
+             block_k=None, n_rep=1):
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk, dp = _blocks(sq, sk, d, block_q=block_q, block_k=block_k)
@@ -359,12 +380,16 @@ def _run_fwd(q, k, v, lengths, scale, causal, block_q=None, block_k=None):
                          memory_space=pltpu.VMEM)
     in_specs = [qspec, kspec, kspec]
     operands = [qp, kp, vp]
+    if segments is not None:
+        seg_q, seg_k = segments
+        sqs, sks = _seg_specs(bq, bk, n_rep, "bij")
+        in_specs = [sqs, sks] + in_specs
+        operands = [_pad_seg(seg_q, sqp), _pad_seg(seg_k, skp)] + operands
     if lengths is not None:
         in_specs = [_len_spec()] + in_specs
         operands = [lengths.reshape(bh).astype(jnp.int32)] + operands
-        kernel = _fwd_kernel
-    else:
-        kernel = functools.partial(_drop_len, _fwd_kernel)
+    kernel = _bind_aux(_fwd_kernel, lengths is not None,
+                       segments is not None)
     out, lse = pl.pallas_call(
         functools.partial(kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, sk=sk, sq=sq),
@@ -385,12 +410,49 @@ def _run_fwd(q, k, v, lengths, scale, causal, block_q=None, block_k=None):
     return out[:, :sq, :d], lse[:, :sq, :1]
 
 
-def _drop_len(kernel, *refs, **kw):
-    return kernel(None, *refs, **kw)
+def _bind_aux(kernel, has_len, has_seg):
+    """Adapt a ``(len_ref, segq_ref, segk_ref, *refs)`` kernel to the
+    subset of aux operands actually passed. Operand order when present:
+    lengths first, then seg_q, seg_k, then the tensor refs."""
+    if has_len and has_seg:
+        return kernel
+    if has_len:
+        return lambda len_ref, *refs, **kw: kernel(
+            len_ref, None, None, *refs, **kw)
+    if has_seg:
+        return lambda sq_ref, sk_ref, *refs, **kw: kernel(
+            None, sq_ref, sk_ref, *refs, **kw)
+    return lambda *refs, **kw: kernel(None, None, None, *refs, **kw)
 
 
-def _run_bwd(q, k, v, do, lse, delta, lengths, scale, causal,
-             block_q=None, block_k=None):
+def _seg_specs(bq, bk, n_rep, order):
+    """Block specs for the per-row / per-column segment-id operands.
+    The id arrays are ``[b, s]``; grid dim 0 runs over ``b * n_rep``
+    (heads or lane-groups), so the index map divides it back down.
+    ``order`` is "bij" for (b, q-block, k-block) grids and "bji" for
+    (b, k-block, q-block) grids."""
+    if order == "bij":
+        qmap = lambda b, i, j: (_div(b, n_rep), i)     # noqa: E731
+        kmap = lambda b, i, j: (_div(b, n_rep), j)     # noqa: E731
+    else:
+        qmap = lambda b, j, i: (_div(b, n_rep), i)     # noqa: E731
+        kmap = lambda b, j, i: (_div(b, n_rep), j)     # noqa: E731
+    return (pl.BlockSpec((1, bq), qmap, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk), kmap, memory_space=pltpu.VMEM))
+
+
+def _pad_seg(seg, sp):
+    """Pad a [b, s] segment-id array to [b, sp] with -1 (matches no
+    real segment; padded columns are additionally masked by col < sk)."""
+    b, s = seg.shape
+    seg = seg.astype(jnp.int32)
+    if s == sp:
+        return seg
+    return jnp.pad(seg, ((0, 0), (0, sp - s)), constant_values=-1)
+
+
+def _run_bwd(q, k, v, do, lse, delta, lengths, segments, scale, causal,
+             block_q=None, block_k=None, n_rep=1):
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk, dp = _blocks(sq, sk, d,
@@ -431,16 +493,24 @@ def _run_bwd(q, k, v, do, lse, delta, lengths, scale, causal,
             f"APEX_TPU_FLASH_BWD={mode!r}: expected auto, fused or split")
     fused = (mode == "fused" or
              (mode != "split" and sqp * dp * 4 <= _FUSED_DQ_VMEM_BYTES))
+    segp = None
+    if segments is not None:
+        seg_q, seg_k = segments
+        segp = (_pad_seg(seg_q, sqp), _pad_seg(seg_k, skp))
+
     if fused:
         # --- fused single sweep: grid (bh, nk, nq) -----------------------
         in_specs = [qspec2, kspec2, kspec2, qspec2, sspec2, sspec2]
         operands = [qp, kp, vp, dop, lsep, deltap]
+        if segp is not None:
+            sqs, sks = _seg_specs(bq, bk, n_rep, "bji")
+            in_specs = [sqs, sks] + in_specs
+            operands = list(segp) + operands
         if lens is not None:
             in_specs = [lenspec2] + in_specs
             operands = [lens] + operands
-            kernel = _dqkv_kernel
-        else:
-            kernel = functools.partial(_drop_len, _dqkv_kernel)
+        kernel = _bind_aux(_dqkv_kernel, lens is not None,
+                           segp is not None)
         dq, dk, dv = pl.pallas_call(
             functools.partial(kernel, scale=scale, causal=causal,
                               bq=bq, bk=bk, sk=sk),
@@ -466,12 +536,14 @@ def _run_bwd(q, k, v, do, lse, delta, lengths, scale, causal,
     # --- dQ sweep: grid (bh, nq, nk) -------------------------------------
     in_specs = [qspec, kspec, kspec, qspec, sspec, sspec]
     operands = [qp, kp, vp, dop, lsep, deltap]
+    if segp is not None:
+        sqs, sks = _seg_specs(bq, bk, n_rep, "bij")
+        in_specs = [sqs, sks] + in_specs
+        operands = list(segp) + operands
     if lens is not None:
         in_specs = [_len_spec()] + in_specs
         operands = [lens] + operands
-        dq_kernel = _dq_kernel
-    else:
-        dq_kernel = functools.partial(_drop_len, _dq_kernel)
+    dq_kernel = _bind_aux(_dq_kernel, lens is not None, segp is not None)
     dq = pl.pallas_call(
         functools.partial(dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, sk=sk),
@@ -486,12 +558,14 @@ def _run_bwd(q, k, v, do, lse, delta, lengths, scale, causal,
     # --- dK/dV sweep: grid (bh, nk, nq) ----------------------------------
     in_specs2 = [qspec2, kspec2, kspec2, qspec2, sspec2, sspec2]
     operands2 = [qp, kp, vp, dop, lsep, deltap]
+    if segp is not None:
+        sqs, sks = _seg_specs(bq, bk, n_rep, "bji")
+        in_specs2 = [sqs, sks] + in_specs2
+        operands2 = list(segp) + operands2
     if lens is not None:
         in_specs2 = [lenspec2] + in_specs2
         operands2 = [lens] + operands2
-        dkv_kernel = _dkv_kernel
-    else:
-        dkv_kernel = functools.partial(_drop_len, _dkv_kernel)
+    dkv_kernel = _bind_aux(_dkv_kernel, lens is not None, segp is not None)
     dk, dv = pl.pallas_call(
         functools.partial(dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, sk=sk),
@@ -517,73 +591,104 @@ def _run_bwd(q, k, v, do, lse, delta, lengths, scale, causal,
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q3, k3, v3, lengths, scale, causal, block_q, block_k):
-    out, _ = _run_fwd(q3, k3, v3, lengths, scale, causal, block_q, block_k)
+def _aux_zeros(lengths, segments):
+    """float0 cotangents for the integer aux operands (lengths, segs)."""
+    import numpy as np
+
+    dlen = None
+    if lengths is not None:
+        dlen = np.zeros(lengths.shape, dtype=jax.dtypes.float0)
+    dseg = None
+    if segments is not None:
+        dseg = tuple(np.zeros(s.shape, dtype=jax.dtypes.float0)
+                     for s in segments)
+    return dlen, dseg
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q3, k3, v3, lengths, segs, scale, causal, block_q, block_k,
+           n_rep):
+    out, _ = _run_fwd(q3, k3, v3, lengths, segs, scale, causal, block_q,
+                      block_k, n_rep)
     return out
 
 
-def _flash_fwd(q3, k3, v3, lengths, scale, causal, block_q, block_k):
-    out, lse = _run_fwd(q3, k3, v3, lengths, scale, causal, block_q, block_k)
+def _flash_fwd(q3, k3, v3, lengths, segs, scale, causal, block_q, block_k,
+               n_rep):
+    out, lse = _run_fwd(q3, k3, v3, lengths, segs, scale, causal, block_q,
+                        block_k, n_rep)
     # named so remat policies can pin the kernel's residuals: with
     # save_only_these_names("flash_out", "flash_lse") the backward replay
     # restores (out, lse) instead of re-running the forward kernel
     out = checkpoint_name(out, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
-    return out, (q3, k3, v3, out, lse, lengths)
+    return out, (q3, k3, v3, out, lse, lengths, segs)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, res, do):
-    q3, k3, v3, out, lse, lengths = res
+def _flash_bwd(scale, causal, block_q, block_k, n_rep, res, do):
+    q3, k3, v3, out, lse, lengths, segs = res
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1, keepdims=True)
-    dq, dk, dv = _run_bwd(q3, k3, v3, do, lse, delta, lengths, scale, causal,
-                          block_q, block_k)
-    dlen = None
-    if lengths is not None:
-        import numpy as np
-
-        dlen = np.zeros(lengths.shape, dtype=jax.dtypes.float0)
-    return dq, dk, dv, dlen
+    dq, dk, dv = _run_bwd(q3, k3, v3, do, lse, delta, lengths, segs, scale,
+                          causal, block_q, block_k, n_rep)
+    dlen, dseg = _aux_zeros(lengths, segs)
+    return dq, dk, dv, dlen, dseg
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_with_lse(q3, k3, v3, lengths, scale, causal, block_q, block_k):
-    return _run_fwd(q3, k3, v3, lengths, scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_with_lse(q3, k3, v3, lengths, segs, scale, causal, block_q,
+                    block_k, n_rep):
+    return _run_fwd(q3, k3, v3, lengths, segs, scale, causal, block_q,
+                    block_k, n_rep)
 
 
-def _flash_with_lse_fwd(q3, k3, v3, lengths, scale, causal, block_q,
-                        block_k):
-    out, lse = _run_fwd(q3, k3, v3, lengths, scale, causal, block_q, block_k)
+def _flash_with_lse_fwd(q3, k3, v3, lengths, segs, scale, causal, block_q,
+                        block_k, n_rep):
+    out, lse = _run_fwd(q3, k3, v3, lengths, segs, scale, causal, block_q,
+                        block_k, n_rep)
     out = checkpoint_name(out, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
-    return (out, lse), (q3, k3, v3, out, lse, lengths)
+    return (out, lse), (q3, k3, v3, out, lse, lengths, segs)
 
 
-def _flash_with_lse_bwd(scale, causal, block_q, block_k, res, cts):
+def _flash_with_lse_bwd(scale, causal, block_q, block_k, n_rep, res, cts):
     """Like ``_flash_bwd`` but the log-sum-exp is a live output with its
     own cotangent. Since d(lse)/ds_j = p_j, the dlse term folds into the
     existing kernel as ds_j = p_j (dp_j - (delta - dlse)) — the backward
     kernels run unchanged on an adjusted delta."""
-    q3, k3, v3, out, lse, lengths = res
+    q3, k3, v3, out, lse, lengths, segs = res
     do, dlse = cts
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1, keepdims=True)
     delta = delta - dlse.astype(jnp.float32)
-    dq, dk, dv = _run_bwd(q3, k3, v3, do, lse, delta, lengths, scale, causal,
-                          block_q, block_k)
-    dlen = None
-    if lengths is not None:
-        import numpy as np
-
-        dlen = np.zeros(lengths.shape, dtype=jax.dtypes.float0)
-    return dq, dk, dv, dlen
+    dq, dk, dv = _run_bwd(q3, k3, v3, do, lse, delta, lengths, segs, scale,
+                          causal, block_q, block_k, n_rep)
+    dlen, dseg = _aux_zeros(lengths, segs)
+    return dq, dk, dv, dlen, dseg
 
 
 _flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
+
+
+def _seg_pair(segment_ids, kv_segment_ids, b, sq, sk):
+    """Normalise the public segment-id arguments to an int32
+    ``([b, sq], [b, sk])`` pair (or None)."""
+    if segment_ids is None and kv_segment_ids is None:
+        return None
+    seg_q = jnp.asarray(
+        segment_ids if segment_ids is not None else kv_segment_ids,
+        jnp.int32)
+    seg_k = jnp.asarray(
+        kv_segment_ids if kv_segment_ids is not None else segment_ids,
+        jnp.int32)
+    if seg_q.shape != (b, sq) or seg_k.shape != (b, sk):
+        raise ValueError(
+            f"segment_ids {seg_q.shape} / kv_segment_ids {seg_k.shape} "
+            f"must be [batch, seq] = ({b}, {sq}) / ({b}, {sk})")
+    return seg_q, seg_k
 
 
 def flash_attention_with_lse(
@@ -591,6 +696,8 @@ def flash_attention_with_lse(
     causal: bool = False,
     scale: Optional[float] = None,
     kv_lengths: Optional[jnp.ndarray] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+    kv_segment_ids: Optional[jnp.ndarray] = None,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
 ):
@@ -613,9 +720,10 @@ def flash_attention_with_lse(
     lens = None
     if kv_lengths is not None:
         lens = jnp.repeat(jnp.asarray(kv_lengths, jnp.int32), h)
+    segs = _seg_pair(segment_ids, kv_segment_ids, b, sq, sk)
     out, lse = _flash_with_lse(
         q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
-        v.reshape(b * h, sk, d), lens, s, causal, block_q, block_k)
+        v.reshape(b * h, sk, d), lens, segs, s, causal, block_q, block_k, h)
     out = out.reshape(b, h, sq, d)
     lse = lse.reshape(b, h, sq)
     return (out.astype(jnp.float16) if was16 else out), lse
@@ -626,6 +734,8 @@ def flash_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     kv_lengths: Optional[jnp.ndarray] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+    kv_segment_ids: Optional[jnp.ndarray] = None,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
 ):
@@ -635,6 +745,12 @@ def flash_attention(
     - ``scale``: softmax temperature; default ``1/sqrt(head_dim)``.
     - ``kv_lengths``: optional ``[batch]`` int — keys/values beyond the
       per-example length are masked (fmha var-seqlen capability (U)).
+    - ``segment_ids`` (+ optional ``kv_segment_ids``): ``[batch, seq]``
+      int — rows attend only to keys with the same id, i.e. several
+      packed sequences per batch row are isolated from each other (the
+      reference fmha's cu_seqlens var-seqlen batch packing (U)).
+      Composes with ``causal`` (per-document causal) and
+      ``kv_lengths``.
     - ``block_q``/``block_k``: tile-size overrides (defaults tuned for
       v5e; shrink for tiny VMEM budgets or very small head_dim).
 
@@ -656,17 +772,20 @@ def flash_attention(
     lens = None
     if kv_lengths is not None:
         lens = jnp.repeat(jnp.asarray(kv_lengths, jnp.int32), h)
-    out = _flash(q3, k3, v3, lens, s, causal, block_q, block_k)
+    segs = _seg_pair(segment_ids, kv_segment_ids, b, sq, sk)
+    out = _flash(q3, k3, v3, lens, segs, s, causal, block_q, block_k, h)
     out = out.reshape(b, h, sq, d)
     return out.astype(jnp.float16) if was16 else out
 
 
-def mha(q, k, v, *, causal=False, scale=None, kv_lengths=None):
+def mha(q, k, v, *, causal=False, scale=None, kv_lengths=None,
+        segment_ids=None):
     """[b, s, h, d] layout convenience wrapper (fast_multihead_attn's
     self-attn data layout (U))."""
     out = flash_attention(
         jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2),
-        causal=causal, scale=scale, kv_lengths=kv_lengths)
+        causal=causal, scale=scale, kv_lengths=kv_lengths,
+        segment_ids=segment_ids)
     return jnp.swapaxes(out, 1, 2)
 
 
@@ -719,9 +838,9 @@ def flash_bsh_eligible(hidden: int, num_heads: int, seq: int,
     return round_up(seq, bq) * LANE * 4 <= _FUSED_DQ_VMEM_BYTES
 
 
-def _fwd_kernel_bsh(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                    acc_ref, m_ref, l_ref, *, scale, causal, bq, bk, sk,
-                    d, g, n_grp):
+def _fwd_kernel_bsh(len_ref, segq_ref, segk_ref, q_ref, k_ref, v_ref,
+                    o_ref, lse_ref, acc_ref, m_ref, l_ref, *, scale,
+                    causal, bq, bk, sk, d, g, n_grp):
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -737,7 +856,10 @@ def _fwd_kernel_bsh(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(compute)
     def _block():
-        valid = _valid_cols(blen, i, j, causal=causal, bq=bq, bk=bk, sk=sk)
+        segs = (None if segq_ref is None
+                else (segq_ref[:], segk_ref[:]))
+        valid = _valid_cols(blen, i, j, causal=causal, bq=bq, bk=bk, sk=sk,
+                            segs=segs)
         for sub in range(g):
             lanes = slice(sub * d, (sub + 1) * d)
             q = q_ref[0][:, lanes]
@@ -765,8 +887,8 @@ def _fwd_kernel_bsh(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             lse_ref[0, sub:sub + 1, :] = jnp.transpose(lse)   # (1, bq)
 
 
-def _dqkv_kernel_bsh(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                     delta_ref, dq_ref, dk_ref, dv_ref,
+def _dqkv_kernel_bsh(len_ref, segq_ref, segk_ref, q_ref, k_ref, v_ref,
+                     do_ref, lse_ref, delta_ref, dq_ref, dk_ref, dv_ref,
                      dq_acc, dk_acc, dv_acc, *, scale, causal, bq, bk, sk,
                      d, g, n_grp):
     """Packed-layout fused backward — the ``_dqkv_kernel`` strategy (one
@@ -792,7 +914,10 @@ def _dqkv_kernel_bsh(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(compute)
     def _block():
-        valid = _valid_cols(blen, i, j, causal=causal, bq=bq, bk=bk, sk=sk)
+        segs = (None if segq_ref is None
+                else (segq_ref[:], segk_ref[:]))
+        valid = _valid_cols(blen, i, j, causal=causal, bq=bq, bk=bk, sk=sk,
+                            segs=segs)
         for sub in range(g):
             lanes = slice(sub * d, (sub + 1) * d)
             q = q_ref[0][:, lanes]
@@ -853,7 +978,7 @@ def _bsh_specs(bq, bk, n_grp):
     return qspec, kspec, lenspec
 
 
-def _run_fwd_bsh(q, k, v, lengths, scale, causal, d, g, n_grp,
+def _run_fwd_bsh(q, k, v, lengths, segments, scale, causal, d, g, n_grp,
                  block_q=None, block_k=None):
     b, sq, hidden = q.shape
     sk = k.shape[1]
@@ -867,12 +992,16 @@ def _run_fwd_bsh(q, k, v, lengths, scale, causal, d, g, n_grp,
                             memory_space=pltpu.VMEM)
     in_specs = [qspec, kspec, kspec]
     operands = [qp, kp, vp]
+    if segments is not None:
+        seg_q, seg_k = segments
+        sqs, sks = _seg_specs(bq, bk, n_grp, "bij")
+        in_specs = [sqs, sks] + in_specs
+        operands = [_pad_seg(seg_q, sqp), _pad_seg(seg_k, skp)] + operands
     if lengths is not None:
         in_specs = [lenspec] + in_specs
         operands = [lengths.reshape(b).astype(jnp.int32)] + operands
-        kernel = _fwd_kernel_bsh
-    else:
-        kernel = functools.partial(_drop_len, _fwd_kernel_bsh)
+    kernel = _bind_aux(_fwd_kernel_bsh, lengths is not None,
+                       segments is not None)
     out, lse = pl.pallas_call(
         functools.partial(kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, sk=sk, d=d, g=g, n_grp=n_grp),
@@ -893,7 +1022,7 @@ def _run_fwd_bsh(q, k, v, lengths, scale, causal, d, g, n_grp,
     return out[:, :sq], lse[:, :, :sq]
 
 
-def _run_bwd_bsh(q, k, v, do, lse, delta, lengths, scale, causal,
+def _run_bwd_bsh(q, k, v, do, lse, delta, lengths, segments, scale, causal,
                  d, g, n_grp, block_q=None, block_k=None):
     b, sq, hidden = q.shape
     sk = k.shape[1]
@@ -918,12 +1047,16 @@ def _run_bwd_bsh(q, k, v, do, lse, delta, lengths, scale, causal,
     lenspec2 = _len_spec()
     in_specs = [qspec2, kspec2, kspec2, qspec2, sspec2, sspec2]
     operands = [qp, kp, vp, dop, lsep, deltap]
+    if segments is not None:
+        seg_q, seg_k = segments
+        sqs, sks = _seg_specs(bq, bk, n_grp, "bji")
+        in_specs = [sqs, sks] + in_specs
+        operands = [_pad_seg(seg_q, sqp), _pad_seg(seg_k, skp)] + operands
     if lengths is not None:
         in_specs = [lenspec2] + in_specs
         operands = [lengths.reshape(b).astype(jnp.int32)] + operands
-        kernel = _dqkv_kernel_bsh
-    else:
-        kernel = functools.partial(_drop_len, _dqkv_kernel_bsh)
+    kernel = _bind_aux(_dqkv_kernel_bsh, lengths is not None,
+                       segments is not None)
     dq, dk, dv = pl.pallas_call(
         functools.partial(kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, sk=sk, d=d, g=g, n_grp=n_grp),
@@ -945,25 +1078,27 @@ def _run_bwd_bsh(q, k, v, do, lse, delta, lengths, scale, causal,
     return dq[:, :sq], dk[:, :sk], dv[:, :sk]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash_bsh(q, k, v, lengths, scale, causal, geom, block_q, block_k):
-    out, _ = _run_fwd_bsh(q, k, v, lengths, scale, causal, *geom,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_bsh(q, k, v, lengths, segs, scale, causal, geom, block_q,
+               block_k):
+    out, _ = _run_fwd_bsh(q, k, v, lengths, segs, scale, causal, *geom,
                           block_q=block_q, block_k=block_k)
     return out
 
 
-def _flash_bsh_fwd(q, k, v, lengths, scale, causal, geom, block_q, block_k):
-    out, lse = _run_fwd_bsh(q, k, v, lengths, scale, causal, *geom,
+def _flash_bsh_fwd(q, k, v, lengths, segs, scale, causal, geom, block_q,
+                   block_k):
+    out, lse = _run_fwd_bsh(q, k, v, lengths, segs, scale, causal, *geom,
                             block_q=block_q, block_k=block_k)
     # same residual names as the [b,h,s,d] path so remat policies
     # (save_only_these_names) pin them identically
     out = checkpoint_name(out, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
-    return out, (q, k, v, out, lse, lengths)
+    return out, (q, k, v, out, lse, lengths, segs)
 
 
 def _flash_bsh_bwd(scale, causal, geom, block_q, block_k, res, do):
-    q, k, v, out, lse, lengths = res
+    q, k, v, out, lse, lengths, segs = res
     d, g, n_grp = geom
     b, sq, hidden = q.shape
     # per-head delta = sum_d(out * do): [b, s, n_grp, g] → [b*n_grp, g, s]
@@ -971,14 +1106,10 @@ def _flash_bsh_bwd(scale, causal, geom, block_q, block_k, res, do):
         b, sq, n_grp * g, d).sum(axis=-1)
     delta = jnp.transpose(prod.reshape(b, sq, n_grp, g), (0, 2, 3, 1))
     delta = delta.reshape(b * n_grp, g, sq)
-    dq, dk, dv = _run_bwd_bsh(q, k, v, do, lse, delta, lengths, scale,
+    dq, dk, dv = _run_bwd_bsh(q, k, v, do, lse, delta, lengths, segs, scale,
                               causal, d, g, n_grp, block_q, block_k)
-    dlen = None
-    if lengths is not None:
-        import numpy as np
-
-        dlen = np.zeros(lengths.shape, dtype=jax.dtypes.float0)
-    return dq, dk, dv, dlen
+    dlen, dseg = _aux_zeros(lengths, segs)
+    return dq, dk, dv, dlen, dseg
 
 
 _flash_bsh.defvjp(_flash_bsh_fwd, _flash_bsh_bwd)
@@ -990,6 +1121,8 @@ def flash_attention_bsh(
     causal: bool = False,
     scale: Optional[float] = None,
     kv_lengths: Optional[jnp.ndarray] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+    kv_segment_ids: Optional[jnp.ndarray] = None,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
 ):
@@ -1026,7 +1159,9 @@ def flash_attention_bsh(
                 (0, 2, 1, 3))
         out = flash_attention(
             split(q), split(k), split(v), causal=causal, scale=s,
-            kv_lengths=kv_lengths, block_q=block_q, block_k=block_k)
+            kv_lengths=kv_lengths, segment_ids=segment_ids,
+            kv_segment_ids=kv_segment_ids, block_q=block_q,
+            block_k=block_k)
         return jnp.transpose(out, (0, 2, 1, 3)).reshape(b, sq, hidden)
     geom = _group_geometry(hidden, num_heads)  # non-None: eligible above
     q, was16 = widen_f16(q)
@@ -1035,5 +1170,6 @@ def flash_attention_bsh(
     lens = None
     if kv_lengths is not None:
         lens = jnp.asarray(kv_lengths, jnp.int32)
-    out = _flash_bsh(q, k, v, lens, s, causal, geom, block_q, block_k)
+    segs = _seg_pair(segment_ids, kv_segment_ids, b, sq, sk)
+    out = _flash_bsh(q, k, v, lens, segs, s, causal, geom, block_q, block_k)
     return out.astype(jnp.float16) if was16 else out

@@ -32,7 +32,12 @@ def _fwd_core(logits, target, label_smoothing: float, axis: str):
 
     # 1st allreduce: stabilising max over the full vocab.
     logits_max = lax.pmax(jnp.max(logits, axis=-1), axis)
-    shifted = (logits - lax.stop_gradient(logits_max)[..., None]).astype(jnp.float32)
+    # cast-then-subtract: for fp32 logits this is a no-op; for bf16
+    # logits (GPTConfig.ce_dtype="compute") the shift/exp/sum statistics
+    # stay fp32 without ever materialising fp32 logits — the elementwise
+    # convert fuses into the chain
+    shifted = (logits.astype(jnp.float32)
+               - lax.stop_gradient(logits_max)[..., None].astype(jnp.float32))
 
     # 2nd allreduce: the target's logit (out-of-shard ranks contribute 0).
     mask = (target >= start) & (target < end)
