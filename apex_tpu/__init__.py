@@ -30,6 +30,7 @@ documented in SURVEY.md (the reference mount was empty at survey time).
 
 __version__ = "0.1.0"
 
+from apex_tpu import _compat  # noqa: F401  (jax.shard_map shim)
 from apex_tpu import mesh  # noqa: F401
 
 __all__ = [

@@ -1,0 +1,56 @@
+"""JAX version compatibility shims.
+
+The repo targets the modern ``jax.shard_map`` entry point (keyword-only,
+``check_vma``); older runtimes ship it as
+``jax.experimental.shard_map.shard_map`` (``check_rep``). Installing the
+adapter at package import keeps every call site on the one modern
+spelling instead of scattering try/except through models, tests, and
+examples. No-op on runtimes that already expose ``jax.shard_map``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+#: True when the legacy ``jax.experimental.shard_map`` adapter is in
+#: place. Legacy ``check_rep`` inference is weaker than modern
+#: ``check_vma`` (e.g. it cannot see replication through a
+#: ``jax.grad``-of-psum), so callers that rely on the stronger
+#: inference gate on this flag.
+LEGACY_SHARD_MAP = False
+
+
+def _install_shard_map() -> None:
+    global LEGACY_SHARD_MAP
+    if getattr(jax, "shard_map", None) is not None:
+        return
+    try:
+        from jax.experimental.shard_map import shard_map as _legacy
+    except ImportError:  # pragma: no cover - no known runtime hits this
+        return
+    LEGACY_SHARD_MAP = True
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+                  **kwargs):
+        kwargs.pop("axis_names", None)  # legacy maps over all mesh axes
+        return _legacy(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=check_vma, **kwargs)
+
+    shard_map.__doc__ = _legacy.__doc__
+    jax.shard_map = shard_map
+
+
+def _install_axis_size() -> None:
+    if getattr(jax.lax, "axis_size", None) is not None:
+        return
+
+    def axis_size(axis_name):
+        # psum of a Python literal constant-folds to the (static) axis
+        # size — the documented pre-axis_size spelling of the same query
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = axis_size
+
+
+_install_shard_map()
+_install_axis_size()

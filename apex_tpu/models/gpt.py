@@ -42,6 +42,10 @@ import numpy as np
 from apex_tpu.kernels import flash_attention, flash_attention_bsh, layer_norm
 from apex_tpu.kernels.blockwise_attention import blockwise_attention
 from apex_tpu.mesh.topology import AXIS_CP, AXIS_DP, AXIS_EP, AXIS_PP, AXIS_TP
+# sampling lives in serving so generate and the continuous-batching
+# engine share one implementation (serving/__init__ loads its
+# gpt-importing submodules lazily, so this import is cycle-free)
+from apex_tpu.serving import sampling as _sampling
 from apex_tpu.transformer import moe as moe_mod
 from apex_tpu.transformer.context_parallel import ring_attention
 from apex_tpu.transformer.pipeline_parallel.schedules import pipelined_loss
@@ -940,7 +944,14 @@ def init_cache(cfg: GPTConfig, params, batch: int,
 
 
 def _decode_layer(cfg: GPTConfig, p, x, kv, pos):
-    """One layer for one token: x [b, hidden], kv [2, b, hl, S, d]."""
+    """One layer for one token: x [b, hidden], kv [2, b, hl, S, d].
+
+    ``pos`` is the write/attend position — a scalar (whole batch at one
+    position: generate/beam) or a ``[b]`` vector (per-slot positions:
+    the continuous-batching engine). The two forms are value-identical
+    per row; the vector form writes by one-hot select (a batched
+    ``dynamic_update_slice`` at per-row offsets is not expressible) and
+    masks per row."""
     xa = _layer_norm(cfg, x, p["ln1"]["scale"], p["ln1"]["bias"])
     d = cfg.head_dim
     b = xa.shape[0]
@@ -948,17 +959,24 @@ def _decode_layer(cfg: GPTConfig, p, x, kv, pos):
     q, k_new, v_new = (
         t.reshape(b, hl // d, d)
         for t in _qkv_project(cfg, p["attn"]["qkv"], xa))
-    k_cache = lax.dynamic_update_slice_in_dim(
-        kv[0], k_new[:, :, None], pos, axis=2)
-    v_cache = lax.dynamic_update_slice_in_dim(
-        kv[1], v_new[:, :, None], pos, axis=2)
+    s_max = kv.shape[3]
+    if pos.ndim == 0:
+        k_cache = lax.dynamic_update_slice_in_dim(
+            kv[0], k_new[:, :, None], pos, axis=2)
+        v_cache = lax.dynamic_update_slice_in_dim(
+            kv[1], v_new[:, :, None], pos, axis=2)
+        valid = (jnp.arange(s_max) <= pos)[None, None]      # [1, 1, S]
+    else:
+        hit = (jnp.arange(s_max)[None] == pos[:, None])[:, None, :, None]
+        k_cache = jnp.where(hit, k_new[:, :, None], kv[0])
+        v_cache = jnp.where(hit, v_new[:, :, None], kv[1])
+        valid = (jnp.arange(s_max)[None] <= pos[:, None])[:, None]
     # scale folded into q BEFORE the einsum: the unscaled dot product
     # overflows fp16's 65504 range (same guard as the training path's
     # compute-dtype branch)
     q = q * jnp.asarray(1.0 / np.sqrt(d), q.dtype)
     scores = jnp.einsum("bhd,bhsd->bhs", q, k_cache).astype(jnp.float32)
-    valid = jnp.arange(k_cache.shape[2]) <= pos
-    scores = jnp.where(valid[None, None], scores, -1e30)
+    scores = jnp.where(valid, scores, -1e30)
     p_attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bhs,bhsd->bhd", p_attn, v_cache).reshape(b, hl)
     attn = row_parallel_linear(
@@ -990,6 +1008,13 @@ def decode_step(cfg: GPTConfig, params, cache, token, pos):
     """One decoding step: ``token [b] int32`` at position ``pos`` →
     (full-vocab fp32 logits ``[b, vocab]``, updated cache).
 
+    ``pos`` is a scalar (the whole batch decodes in lockstep —
+    generate/beam) or a ``[b] int32`` vector of per-row positions (the
+    serving engine's slots, each mid-way through its own request); row
+    semantics are identical either way, and garbage cache entries past a
+    row's position are masked to exact softmax zeros, so a row's logits
+    match a solo run regardless of batch-mates or cache horizon.
+
     Sequence parallelism is stripped: decode has no sequence dim, and the
     SP gather/scatter would misread the batch dim as one.
     """
@@ -999,10 +1024,14 @@ def decode_step(cfg: GPTConfig, params, cache, token, pos):
             "encoder mode) has no incremental-decode semantics")
     if cfg.sequence_parallel:
         cfg = dataclasses.replace(cfg, sequence_parallel=False)
+    pos = jnp.asarray(pos, jnp.int32)
     table = params["embedding"]["word"]["table"].astype(cfg.compute_dtype)
     emb = vocab_parallel_embedding(token[:, None], table, axis=cfg.axis)
-    pos_e = lax.dynamic_index_in_dim(
-        params["embedding"]["position"], pos, 0, keepdims=False)
+    if pos.ndim == 0:
+        pos_e = lax.dynamic_index_in_dim(
+            params["embedding"]["position"], pos, 0, keepdims=False)
+    else:
+        pos_e = jnp.take(params["embedding"]["position"], pos, axis=0)
     x = (emb[:, 0] + pos_e.astype(cfg.compute_dtype)).astype(
         cfg.compute_dtype)
 
@@ -1046,20 +1075,12 @@ def _decode_entry_cfg(cfg: GPTConfig, p_len: int,
     return cfg
 
 
-def prefill(cfg: GPTConfig, params, prompt, *, max_len: Optional[int] = None):
-    """Bulk prompt ingestion: ONE forward over ``prompt [b, p_len]``
-    (the training-path attention — packed flash/XLA by ``attn_impl``)
-    fills the KV cache and returns ``(cache, logits)`` where ``logits``
-    ``[b, vocab]`` (fp32) predict position ``p_len``. Replaces p_len
-    sequential decode steps; decoding then starts at position ``p_len``.
-
-    Local semantics (call inside ``shard_map``). SP is stripped like
-    :func:`decode_step`; ``max_len`` sizes the cache (default
-    ``cfg.seq_len``).
-    """
+def _prefill_states(cfg: GPTConfig, params, prompt, max_len: int):
+    """Shared body of :func:`prefill` / :func:`prefill_at`: one
+    training-path forward over ``prompt [b, p_len]`` → (cache
+    ``[l, 2, b, hl, max_len, d]``, pre-final-LN hidden ``[b, p_len,
+    hid]``)."""
     b, p_len = prompt.shape
-    cfg = _decode_entry_cfg(cfg, p_len)
-    max_len = max_len or cfg.seq_len
     if p_len > max_len:
         raise ValueError(f"prompt {p_len} exceeds cache max_len {max_len}")
     h = _embed(cfg, params, prompt.astype(jnp.int32))
@@ -1073,45 +1094,63 @@ def prefill(cfg: GPTConfig, params, prompt, *, max_len: Optional[int] = None):
     # ks/vs [l_local, b, heads_local, p_len, d] → cache [l, 2, b, hl, S, d]
     pad = ((0, 0),) * 3 + ((0, max_len - p_len), (0, 0))
     cache = jnp.stack([jnp.pad(ks, pad), jnp.pad(vs, pad)], axis=1)
+    return cache, h
+
+
+def prefill(cfg: GPTConfig, params, prompt, *, max_len: Optional[int] = None):
+    """Bulk prompt ingestion: ONE forward over ``prompt [b, p_len]``
+    (the training-path attention — packed flash/XLA by ``attn_impl``)
+    fills the KV cache and returns ``(cache, logits)`` where ``logits``
+    ``[b, vocab]`` (fp32) predict position ``p_len``. Replaces p_len
+    sequential decode steps; decoding then starts at position ``p_len``.
+
+    Local semantics (call inside ``shard_map``). SP is stripped like
+    :func:`decode_step`; ``max_len`` sizes the cache (default
+    ``cfg.seq_len``).
+    """
+    b, p_len = prompt.shape
+    cfg = _decode_entry_cfg(cfg, p_len)
+    cache, h = _prefill_states(cfg, params, prompt, max_len or cfg.seq_len)
     return cache, _lm_head(cfg, params, h[:, -1])
 
 
-def _filter_logits(logits, top_k: int, top_p: float):
-    """Nucleus/top-k logit filtering: positions outside the top-k (by
-    value), or outside the smallest set whose softmax mass reaches
-    top_p, are masked to -inf. Filters compose in the mainstream
-    (HF/Megatron warper) order — top-k first, nucleus mass measured on
-    the renormalized top-k distribution — and the caller applies
-    temperature *before* this, so the nucleus is that of the actual
-    sampling distribution. One sort; static shapes throughout (the form
-    ``lax.scan`` and jit need — no dynamic vocabulary slicing)."""
-    vocab = logits.shape[-1]
-    kk = top_k if 0 < top_k < vocab else 0
-    pp = top_p if 0.0 < top_p < 1.0 else 0.0
-    if not kk and not pp:
-        return logits
-    neg = jnp.finfo(logits.dtype).min
-    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
-    if kk:
-        # masking the sorted tail IS the top-k filter (no second sort)
-        sorted_desc = jnp.where(
-            jnp.arange(vocab) < kk, sorted_desc, neg)
-        thresh = sorted_desc[..., kk - 1][..., None]
-    else:
-        thresh = None
-    if pp:
-        probs = jax.nn.softmax(sorted_desc, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # keep every position whose *preceding* cumulative mass is below
-        # top_p (the first token is always kept)
-        keep = jnp.concatenate(
-            [jnp.ones_like(cum[..., :1], bool), cum[..., :-1] < pp],
-            axis=-1)
-        # threshold value = smallest kept logit
-        pthresh = jnp.min(
-            jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True)
-        thresh = pthresh if thresh is None else jnp.maximum(thresh, pthresh)
-    return jnp.where(logits < thresh, neg, logits)
+def prefill_at(cfg: GPTConfig, params, prompt, last, *,
+               max_len: Optional[int] = None):
+    """:func:`prefill` for right-padded prompts: ``prompt [b, P]`` whose
+    real tokens end at (traced scalar) position ``last`` → ``(cache,
+    logits [b, vocab])`` predicting position ``last + 1``. Causal
+    attention makes every real position's hidden state and KV entry
+    identical to an unpadded run — pad positions' cache entries are
+    garbage, which decode masks to exact softmax zeros and overwrites as
+    it advances — so the serving engine can prefill every prompt at ONE
+    static length and admission never recompiles."""
+    b, p_len = prompt.shape
+    cfg = _decode_entry_cfg(cfg, p_len)
+    cache, h = _prefill_states(cfg, params, prompt, max_len or cfg.seq_len)
+    h_last = lax.dynamic_index_in_dim(h, jnp.asarray(last, jnp.int32), 1,
+                                      keepdims=False)
+    return cache, _lm_head(cfg, params, h_last)
+
+
+def cache_insert_slot(cache, block, slot):
+    """Insert one request's prefilled cache block ``[l, 2, 1, hl, P, d]``
+    into slot ``slot`` of a shared decode cache ``[l, 2, B, hl, S, d]``
+    (``P <= S``) — the slot-admission write, and the one place outside
+    :func:`init_cache` that knows the cache layout. ``slot`` may be a
+    traced scalar (admission is trace-stable); entries past ``P`` keep
+    whatever the slot last held, which decode masks until overwritten."""
+    if block.ndim != cache.ndim:
+        raise ValueError(
+            f"cache block rank {block.ndim} != cache rank {cache.ndim}")
+    zero = jnp.int32(0)
+    return lax.dynamic_update_slice(
+        cache, block.astype(cache.dtype),
+        (zero, zero, jnp.asarray(slot, jnp.int32), zero, zero, zero))
+
+
+# re-exported from the serving sampler (one implementation for generate
+# and the continuous-batching engine; the oracle tests pin them equal)
+_filter_logits = _sampling.filter_logits
 
 
 def generate(cfg: GPTConfig, params, prompt, n_new: int,
@@ -1155,14 +1194,8 @@ def generate(cfg: GPTConfig, params, prompt, n_new: int,
         return jnp.zeros((b, 0), jnp.int32)
 
     def draw(logits, t):
-        if temperature > 0.0:
-            # temperature first: top_p must see the distribution actually
-            # being sampled (standard warper order)
-            scaled = _filter_logits(logits / temperature, top_k, top_p)
-            return jax.random.categorical(
-                jax.random.fold_in(key, t), scaled, axis=-1
-            ).astype(jnp.int32)
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return _sampling.draw(logits, t, temperature=temperature,
+                              top_k=top_k, top_p=top_p, key=key)
 
     cache0, logits0 = prefill(cfg, params, prompt, max_len=total)
     first = draw(logits0, p_len - 1)

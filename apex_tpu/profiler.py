@@ -14,7 +14,9 @@ contrib benchmarks (U). The TPU build makes this a component:
   self-times WITHOUT TensorBoard (terminal-friendly xprof: aggregate,
   categorize, attribute to source lines),
 - :class:`MetricsLogger` — structured per-step metrics: in-memory ring,
-  optional JSONL file, optional TensorBoard writer when available.
+  optional JSONL file, optional TensorBoard writer when available,
+- :class:`LatencyStats` — streaming latency accumulator with percentile
+  summaries (TTFT / per-token latency for ``apex_tpu.serving``).
 """
 
 from __future__ import annotations
@@ -153,6 +155,40 @@ class MetricsLogger:
             self._jsonl.close()
         if self._tb is not None:
             self._tb.close()
+
+
+class LatencyStats:
+    """Streaming latency accumulator: keeps the most recent ``capacity``
+    samples (seconds) in a ring and summarises to mean + percentiles in
+    milliseconds — the serving scheduler's TTFT and per-token-latency
+    sink (training's :class:`StepTimer` has no percentile tail, which is
+    the number serving SLOs are written against)."""
+
+    def __init__(self, capacity: int = 8192):
+        self._cap = capacity
+        self._vals: List[float] = []
+        self._count = 0
+
+    def add(self, seconds: float) -> None:
+        self._vals.append(float(seconds))
+        self._count += 1
+        if len(self._vals) > self._cap:
+            self._vals.pop(0)
+
+    def summary(self) -> Dict[str, float]:
+        """``{count, mean_ms, p50_ms, p90_ms, p99_ms, max_ms}`` over the
+        retained window (empty dict before the first sample)."""
+        if not self._vals:
+            return {}
+        v = np.asarray(self._vals) * 1e3
+        return {
+            "count": float(self._count),
+            "mean_ms": float(v.mean()),
+            "p50_ms": float(np.percentile(v, 50)),
+            "p90_ms": float(np.percentile(v, 90)),
+            "p99_ms": float(np.percentile(v, 99)),
+            "max_ms": float(v.max()),
+        }
 
 
 def model_flops_per_token(n_params: int, *, include_backward: bool = True,

@@ -1,0 +1,66 @@
+"""apex_tpu.serving — static-shape continuous-batching inference engine.
+
+The training side of the repo compiles ONE program per step and never
+recompiles; this package re-derives vLLM-style continuous batching under
+the same discipline (the move ``schedules.py`` made for pipeline
+parallelism): a fixed batch of ``B`` decode *slots* drives one compiled
+per-token program, and when a slot finishes (eos / token budget /
+deadline) the next queued request is admitted into it by prefilling its
+prompt at a static padded length and inserting the resulting KV block
+into the shared cache — per-slot position, budget, eos, and sampling
+parameters are device arrays, so admission and decode are trace-stable
+(zero compiled-program cache misses after warmup).
+
+Layout:
+
+- :mod:`apex_tpu.serving.request`   — Request / SamplingParams /
+  Completion host-side dataclasses,
+- :mod:`apex_tpu.serving.sampling`  — the one temperature/top-k/top-p
+  sampler shared by ``gpt.generate`` (scalar params) and the engine
+  (per-slot vectors),
+- :mod:`apex_tpu.serving.engine`    — the device loop: slot state,
+  compiled step/admit/retire programs,
+- :mod:`apex_tpu.serving.scheduler` — the host loop: request queue with
+  backpressure, deadlines, response stream, serving metrics.
+
+``engine``/``scheduler`` import :mod:`apex_tpu.models.gpt`, which itself
+imports :mod:`.sampling`; they are loaded lazily (PEP 562) so either
+entry point — model first or serving first — resolves without a cycle.
+"""
+
+from __future__ import annotations
+
+from apex_tpu.serving import request, sampling  # noqa: F401
+from apex_tpu.serving.request import (  # noqa: F401
+    Completion,
+    Request,
+    SamplingParams,
+    StreamEvent,
+)
+
+__all__ = [
+    "request", "sampling", "engine", "scheduler",
+    "Request", "SamplingParams", "Completion", "StreamEvent",
+    "Engine", "EngineConfig", "Scheduler", "QueueFull",
+]
+
+_LAZY = {
+    "engine": "apex_tpu.serving.engine",
+    "scheduler": "apex_tpu.serving.scheduler",
+    "Engine": "apex_tpu.serving.engine",
+    "EngineConfig": "apex_tpu.serving.engine",
+    "Scheduler": "apex_tpu.serving.scheduler",
+    "QueueFull": "apex_tpu.serving.scheduler",
+}
+
+
+def __getattr__(name: str):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    mod = importlib.import_module(target)
+    value = mod if target.endswith("." + name) else getattr(mod, name)
+    globals()[name] = value
+    return value

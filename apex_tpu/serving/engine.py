@@ -1,0 +1,278 @@
+"""Slot-based continuous-batching decode engine — the device loop.
+
+XLA's static-shape world forbids vLLM's dynamic batch: instead a fixed
+batch of ``B`` decode *slots* drives ONE compiled per-token program, and
+requests flow through slots. All per-request state the device needs —
+position, remaining token budget, done flag, eos id, temperature /
+top-k / top-p / PRNG key — lives in ``[B]`` device vectors, so the three
+compiled programs are trace-stable across the whole serving lifetime:
+
+- ``step``:   one ``gpt.decode_step`` over all B slots at their own
+  positions + one per-slot :func:`apex_tpu.serving.sampling.draw_slots`,
+  emitting a token per live slot and finish flags,
+- ``admit``:  prefill ONE request's prompt at the static padded length
+  (``gpt.prefill_at`` — causal attention makes the padded forward exact
+  for the real tokens), draw its first token, insert the KV block into
+  the shared cache (``gpt.cache_insert_slot``), and scatter the slot's
+  state vectors at a traced slot index,
+- ``retire``: force a slot done (deadline expiry).
+
+A slot's token stream is bit-identical to a solo ``gpt.generate`` run of
+the same request (same key, params) — the continuous-batching oracle
+test pins this token-for-token, and ``compiled_cache_sizes`` pins that
+no program recompiles after warmup. Host-side policy (queueing,
+deadlines, metrics) lives in :mod:`apex_tpu.serving.scheduler`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.models import gpt
+from apex_tpu.serving import sampling
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Static engine geometry — everything that shapes the compiled
+    programs. ``max_prompt_len`` is the single padded prefill length
+    (one admission program for every prompt); ``max_seq_len`` is the
+    per-slot KV horizon (prompt + generated tokens, ``<= cfg.seq_len``
+    for the position table)."""
+
+    slots: int = 4
+    max_prompt_len: int = 64
+    max_seq_len: int = 128
+    pad_token_id: int = 0
+
+
+#: eos sentinel in the per-slot eos vector: no stop token for this slot
+_NO_EOS = -1
+
+
+class Engine:
+    """Compiled slot engine over ``mesh`` (tp sharding like the rest of
+    the decode path; dp/pp axes must be 1 — decode state is replicated).
+
+    The class owns the device buffers (cache + slot-state vectors) and
+    exposes host-facing ``admit`` / ``step`` / ``retire``; each call
+    fetches only the tiny per-slot outputs.
+    """
+
+    def __init__(self, cfg: "gpt.GPTConfig", params, mesh,
+                 engine_cfg: Optional[EngineConfig] = None, **overrides):
+        ecfg = engine_cfg or EngineConfig(**overrides)
+        if engine_cfg is not None and overrides:
+            raise ValueError("pass engine_cfg or field overrides, not both")
+        if ecfg.slots < 1:
+            raise ValueError("need at least one slot")
+        if not 1 <= ecfg.max_prompt_len <= ecfg.max_seq_len:
+            raise ValueError(
+                f"max_prompt_len {ecfg.max_prompt_len} must be in "
+                f"[1, max_seq_len={ecfg.max_seq_len}]")
+        if ecfg.max_seq_len > cfg.seq_len:
+            raise ValueError(
+                f"max_seq_len {ecfg.max_seq_len} exceeds the position "
+                f"table (cfg.seq_len={cfg.seq_len})")
+        gpt._check_stop_tokens(cfg, None, ecfg.pad_token_id)
+        for axis in ("dp", "pp", "cp", "ep"):
+            if axis in mesh.shape and mesh.shape[axis] != 1:
+                raise ValueError(
+                    f"serving engine shards over tp only; mesh has "
+                    f"{axis}={mesh.shape[axis]}")
+        self.cfg = cfg
+        self.engine_cfg = ecfg
+        self._mesh = mesh
+        self._params = params
+        self._build()
+        self.cache, self.state = self._init(params)
+
+    # -- compiled programs -------------------------------------------------
+
+    def _build(self):
+        cfg, ecfg, mesh = self.cfg, self.engine_cfg, self._mesh
+        pspecs = gpt.param_specs(cfg)
+        B = ecfg.slots
+        pad = jnp.int32(ecfg.pad_token_id)
+        # cache [l, 2, B, heads, S, d]: heads are the tp-sharded dim
+        cache_spec = P(None, None, None, cfg.axis, None, None)
+        state_spec = {k: P() for k in (
+            "tok", "pos", "remaining", "done", "temp", "top_k", "top_p",
+            "key", "eos")}
+
+        def init_local(params):
+            cache = gpt.init_cache(cfg, params, B, max_len=ecfg.max_seq_len)
+            state = {
+                "tok": jnp.full((B,), pad, jnp.int32),
+                "pos": jnp.zeros((B,), jnp.int32),
+                "remaining": jnp.zeros((B,), jnp.int32),
+                "done": jnp.ones((B,), bool),   # every slot starts free
+                "temp": jnp.zeros((B,), jnp.float32),
+                "top_k": jnp.zeros((B,), jnp.int32),
+                "top_p": jnp.ones((B,), jnp.float32),
+                "key": jnp.zeros((B, 2), jnp.uint32),
+                "eos": jnp.full((B,), _NO_EOS, jnp.int32),
+            }
+            return cache, state
+
+        def step_local(params, cache, state):
+            logits, cache = gpt.decode_step(
+                cfg, params, cache, state["tok"], state["pos"])
+            nxt = sampling.draw_slots(
+                logits, state["key"], state["pos"], state["temp"],
+                state["top_k"], state["top_p"])
+            live = ~state["done"]
+            emit = jnp.where(live, nxt, pad)
+            remaining = state["remaining"] - live.astype(jnp.int32)
+            hit_eos = live & (state["eos"] >= 0) & (emit == state["eos"])
+            finished = live & (hit_eos | (remaining <= 0))
+            state = {
+                **state,
+                # done slots keep tok/pos frozen so their (discarded)
+                # lanes never index past the cache horizon
+                "tok": jnp.where(live, emit, state["tok"]),
+                "pos": state["pos"] + live.astype(jnp.int32),
+                "remaining": remaining,
+                "done": state["done"] | finished,
+            }
+            return cache, state, emit, finished
+
+        def admit_local(params, cache, state, slot, prompt, p_len,
+                        max_tokens, temp, top_k, top_p, key, eos):
+            block, logits0 = gpt.prefill_at(
+                cfg, params, prompt[None], p_len - 1,
+                max_len=ecfg.max_prompt_len)
+            # the [1]-shaped draw_slots call IS the solo-generate first
+            # draw (same [1, vocab] gumbel shape, same fold index)
+            one = lambda v, dt: jnp.reshape(v, (1,)).astype(dt)
+            first = sampling.draw_slots(
+                logits0, key[None], one(p_len - 1, jnp.int32),
+                one(temp, jnp.float32), one(top_k, jnp.int32),
+                one(top_p, jnp.float32))[0]
+            cache = gpt.cache_insert_slot(cache, block, slot)
+            hit_eos = (eos >= 0) & (first == eos)
+            done0 = hit_eos | (max_tokens <= 1)
+            upd = lambda a, v: a.at[slot].set(jnp.asarray(v, a.dtype))
+            state = {
+                "tok": upd(state["tok"], first),
+                "pos": upd(state["pos"], p_len),
+                "remaining": upd(state["remaining"], max_tokens - 1),
+                "done": upd(state["done"], done0),
+                "temp": upd(state["temp"], temp),
+                "top_k": upd(state["top_k"], top_k),
+                "top_p": upd(state["top_p"], top_p),
+                "key": state["key"].at[slot].set(key),
+                "eos": upd(state["eos"], eos),
+            }
+            return cache, state, first, hit_eos, done0
+
+        def retire_local(state, slot):
+            return {**state, "done": state["done"].at[slot].set(True)}
+
+        # cache + state are donated: the engine rebinds self.cache /
+        # self.state from each call's outputs, and without donation
+        # every step/admit copies the whole [l, 2, B, hl, S, d] cache
+        # just to update one slot's column (CPU-mesh A/B in
+        # docs/DESIGN.md "Serving"; re-measure on chip)
+        sm = lambda f, in_specs, out_specs, donate=(): jax.jit(
+            jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False),
+            donate_argnums=donate)
+        scalar = P()
+        self._init = sm(init_local, (pspecs,), (cache_spec, state_spec))
+        self._step = sm(
+            step_local, (pspecs, cache_spec, state_spec),
+            (cache_spec, state_spec, scalar, scalar), donate=(1, 2))
+        self._admit = sm(
+            admit_local,
+            (pspecs, cache_spec, state_spec) + (scalar,) * 9,
+            (cache_spec, state_spec, scalar, scalar, scalar),
+            donate=(1, 2))
+        self._retire = sm(retire_local, (state_spec, scalar), state_spec,
+                          donate=(0,))
+
+    # -- host API ----------------------------------------------------------
+
+    @property
+    def slots(self) -> int:
+        return self.engine_cfg.slots
+
+    def pad_prompt(self, prompt) -> np.ndarray:
+        """Right-pad ``prompt`` (1-D ints) to ``max_prompt_len``
+        (validating its length) — the static admission shape."""
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1 or not 1 <= prompt.size <= \
+                self.engine_cfg.max_prompt_len:
+            raise ValueError(
+                f"prompt must be 1-D with 1..{self.engine_cfg.max_prompt_len}"
+                f" tokens, got shape {prompt.shape}")
+        out = np.full((self.engine_cfg.max_prompt_len,),
+                      self.engine_cfg.pad_token_id, np.int32)
+        out[:prompt.size] = prompt
+        return out
+
+    def admit(self, slot: int, prompt, max_tokens: int, *,
+              temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+              seed: Optional[int] = None,
+              eos_token_id: Optional[int] = None) -> Tuple[int, bool, bool]:
+        """Admit one request into ``slot``: prefill + first token. Returns
+        ``(first_token, hit_eos, finished)`` — ``finished`` True when the
+        request is already complete after its first token (eos, or a
+        budget of 1). ``max_tokens`` must fit the slot's cache horizon."""
+        if not 0 <= slot < self.slots:
+            raise ValueError(
+                f"slot {slot} outside [0, {self.slots}) — a traced "
+                f"out-of-range index would silently clamp into a "
+                f"neighbouring slot's cache")
+        # same stop-token contract as gpt.generate (rejects vocab-range
+        # violations AND an explicit -1, which would alias the
+        # no-eos sentinel)
+        gpt._check_stop_tokens(self.cfg, eos_token_id, None)
+        prompt = np.asarray(prompt, np.int32)
+        padded = self.pad_prompt(prompt)
+        room = self.engine_cfg.max_seq_len - prompt.size
+        if max_tokens < 1 or max_tokens > room:
+            raise ValueError(
+                f"max_tokens {max_tokens} outside [1, {room}] for a "
+                f"{prompt.size}-token prompt at max_seq_len "
+                f"{self.engine_cfg.max_seq_len}")
+        key = (jax.random.PRNGKey(seed) if seed is not None
+               else jnp.zeros((2,), jnp.uint32))
+        eos = _NO_EOS if eos_token_id is None else int(eos_token_id)
+        self.cache, self.state, first, hit_eos, done = self._admit(
+            self._params, self.cache, self.state, np.int32(slot), padded,
+            np.int32(prompt.size), np.int32(max_tokens),
+            np.float32(temperature), np.int32(top_k), np.float32(top_p),
+            jnp.asarray(key, jnp.uint32), np.int32(eos))
+        return int(first), bool(hit_eos), bool(done)
+
+    def step(self) -> Tuple[np.ndarray, np.ndarray]:
+        """One decode step over every slot. Returns ``(tokens [B],
+        finished [B])`` — tokens are ``pad_token_id`` for slots that
+        were already done entering the step."""
+        self.cache, self.state, emit, finished = self._step(
+            self._params, self.cache, self.state)
+        return np.asarray(emit), np.asarray(finished)
+
+    def retire(self, slot: int) -> None:
+        """Force ``slot`` done (scheduler deadline expiry). The slot's
+        lane keeps riding the compiled step unmodified; its output is
+        pad until the next admission overwrites the state."""
+        self.state = self._retire(self.state, np.int32(slot))
+
+    def compiled_cache_sizes(self) -> Dict[str, Any]:
+        """jit-cache entry count per program — the trace-stability
+        probe: after warmup each must stay at 1 no matter how many
+        requests were admitted (the oracle test asserts this)."""
+        out = {}
+        for name in ("init", "step", "admit", "retire"):
+            fn = getattr(self, f"_{name}")
+            size = getattr(fn, "_cache_size", None)
+            out[name] = size() if callable(size) else None
+        return out

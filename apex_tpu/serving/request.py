@@ -1,0 +1,80 @@
+"""Host-side request/response types for the serving engine.
+
+The device side of the engine only sees fixed-shape vectors; everything
+request-scoped and dynamically sized — prompt tokens, deadlines, the
+response token stream — lives in these plain dataclasses. Finish
+reasons mirror the three ways a slot is released: the request emitted
+its stop token (``eos``), exhausted its token budget (``length``), or
+blew its deadline and was retired by the scheduler (``timeout``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+FINISH_EOS = "eos"
+FINISH_LENGTH = "length"
+FINISH_TIMEOUT = "timeout"
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling controls — the scalar arguments of
+    ``gpt.generate``, carried as data so every request in the batch can
+    differ. ``temperature == 0`` is greedy argmax (``seed`` unused);
+    ``top_k``/``top_p`` use the same disabled sentinels (0 / 1.0) and
+    warper order as :func:`apex_tpu.serving.sampling.draw`."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: Optional[int] = None
+
+    def validate(self) -> None:
+        if self.temperature > 0.0 and self.seed is None:
+            raise ValueError("temperature > 0 needs a seed")
+        if (self.top_k > 0 or self.top_p < 1.0) and self.temperature <= 0.0:
+            raise ValueError("top_k/top_p filter sampled draws; set "
+                             "temperature > 0")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``deadline`` is an absolute scheduler-clock
+    time (``time.monotonic`` unless the scheduler was given another
+    clock); ``None`` never times out."""
+
+    request_id: str
+    prompt: Sequence[int]
+    max_tokens: int
+    sampling: SamplingParams = dataclasses.field(default_factory=SamplingParams)
+    eos_token_id: Optional[int] = None
+    deadline: Optional[float] = None
+    arrival_time: Optional[float] = None  # stamped by Scheduler.submit
+
+
+@dataclasses.dataclass
+class StreamEvent:
+    """One element of the response stream: a token (or, for a request
+    finishing with zero tokens, just the finish flag) for ``request_id``."""
+
+    request_id: str
+    token: Optional[int]
+    finished: bool
+    finish_reason: Optional[str] = None
+
+
+@dataclasses.dataclass
+class Completion:
+    """Terminal state of a request. ``ttft`` is arrival → first token on
+    the host; ``latency`` is arrival → completion (both in scheduler-clock
+    seconds, ``None`` for zero-token completions' ttft)."""
+
+    request_id: str
+    tokens: List[int]
+    finish_reason: str
+    ttft: Optional[float] = None
+    latency: Optional[float] = None

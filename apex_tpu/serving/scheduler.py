@@ -1,0 +1,279 @@
+"""Continuous-batching scheduler — the host loop around the engine.
+
+Policy lives here, device mechanics in :mod:`apex_tpu.serving.engine`:
+a FIFO request queue with backpressure (``max_queue``), per-request
+deadlines (queued requests expire in place; active slots are retired),
+admission of queued requests into free slots, a response stream
+(:class:`apex_tpu.serving.request.StreamEvent`), and serving metrics —
+TTFT, per-token latency, queue depth, slot occupancy, tokens/s —
+aggregated via :class:`apex_tpu.profiler.LatencyStats` and emitted
+through a :class:`apex_tpu.profiler.MetricsLogger` when one is given.
+
+The boundary fix the engine relies on: a request whose prompt already
+ends in its eos token completes at ``submit`` time with zero generated
+tokens — it never occupies a slot (admitting it would burn
+``max_tokens`` steps decoding past a finished sequence).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Callable, Deque, Dict, List, Optional
+
+from apex_tpu import profiler
+from apex_tpu.serving.engine import Engine
+from apex_tpu.serving.request import (
+    FINISH_EOS,
+    FINISH_LENGTH,
+    FINISH_TIMEOUT,
+    Completion,
+    Request,
+    StreamEvent,
+)
+
+
+class QueueFull(RuntimeError):
+    """Backpressure signal: the request queue is at ``max_queue``."""
+
+
+class _Active:
+    """Host view of one occupied slot."""
+
+    __slots__ = ("request", "tokens", "first_token_time")
+
+    def __init__(self, request: Request):
+        self.request = request
+        self.tokens: List[int] = []
+        self.first_token_time: Optional[float] = None
+
+
+class Scheduler:
+    """Drive an :class:`Engine` over a stream of requests.
+
+    >>> sched = Scheduler(engine)
+    >>> sched.submit(Request("r0", prompt, max_tokens=16))
+    >>> sched.run_until_idle()
+    >>> sched.completions["r0"].tokens
+
+    ``clock`` is injectable (tests drive deadlines with a fake clock);
+    it must be monotonic. ``metrics`` receives one record per step plus
+    one per completion.
+    """
+
+    def __init__(self, engine: Engine, *, max_queue: int = 256,
+                 metrics: Optional[profiler.MetricsLogger] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.engine = engine
+        self.max_queue = max_queue
+        self.metrics = metrics
+        self.clock = clock
+        self.queue: Deque[Request] = collections.deque()
+        self.active: Dict[int, _Active] = {}
+        self.completions: Dict[str, Completion] = {}
+        self.events: Deque[StreamEvent] = collections.deque()
+        self.ttft_stats = profiler.LatencyStats()
+        self.token_latency_stats = profiler.LatencyStats()
+        self._free: List[int] = list(range(engine.slots))[::-1]
+        self._steps = 0
+        self._tokens_emitted = 0
+        self._started: Optional[float] = None
+        self._last_step_time: Optional[float] = None
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, request: Request) -> None:
+        """Enqueue ``request``; raises :class:`QueueFull` at capacity.
+        Prompt-validity errors raise immediately; a prompt that already
+        ends in the request's eos token completes here with zero
+        generated tokens."""
+        if request.request_id in self.completions or any(
+                a.request.request_id == request.request_id
+                for a in self.active.values()) or any(
+                r.request_id == request.request_id for r in self.queue):
+            raise ValueError(f"duplicate request_id {request.request_id!r}")
+        request.sampling.validate()
+        prompt = list(request.prompt)
+        ecfg = self.engine.engine_cfg
+        # the slot must fit prompt + at least one generated token
+        limit = min(ecfg.max_prompt_len, ecfg.max_seq_len - 1)
+        if not 1 <= len(prompt) <= limit:
+            raise ValueError(
+                f"prompt length {len(prompt)} outside [1, {limit}]")
+        room = ecfg.max_seq_len - len(prompt)
+        if not 1 <= request.max_tokens <= room:
+            raise ValueError(
+                f"max_tokens {request.max_tokens} outside [1, {room}] "
+                f"for a {len(prompt)}-token prompt at max_seq_len "
+                f"{ecfg.max_seq_len} — a clamped budget would silently "
+                f"break solo-generate parity")
+        eos = request.eos_token_id
+        if eos is not None and not 0 <= eos < self.engine.cfg.vocab_size:
+            raise ValueError(
+                f"eos_token_id {eos} outside vocab "
+                f"[0, {self.engine.cfg.vocab_size})")
+        now = self.clock()
+        request.arrival_time = now
+        if (request.eos_token_id is not None
+                and prompt[-1] == request.eos_token_id):
+            self._complete(request, [], FINISH_EOS, ttft=None, now=now)
+            return
+        if len(self.queue) >= self.max_queue:
+            raise QueueFull(
+                f"queue at capacity ({self.max_queue}); retry later")
+        self.queue.append(request)
+
+    # -- the loop ----------------------------------------------------------
+
+    def step(self) -> None:
+        """One scheduler tick: expire deadlines, admit into free slots,
+        advance the engine one token if any slot is live."""
+        now = self.clock()
+        if self._started is None:
+            self._started = now
+        self._expire(now)
+        self._admit_queued(now)
+        if self.active:
+            before = self.clock()
+            tokens, finished = self.engine.step()
+            dt = self.clock() - before
+            for slot in list(self.active):
+                act = self.active[slot]
+                tok = int(tokens[slot])
+                act.tokens.append(tok)
+                self._tokens_emitted += 1
+                self.token_latency_stats.add(dt)
+                done = bool(finished[slot])
+                reason = None
+                if done:
+                    eos = act.request.eos_token_id
+                    reason = (FINISH_EOS if eos is not None and tok == eos
+                              else FINISH_LENGTH)
+                self.events.append(StreamEvent(
+                    act.request.request_id, tok, done, reason))
+                if done:
+                    self._release(slot, reason)
+        self._steps += 1
+        if self.metrics is not None:
+            elapsed = max(self.clock() - self._started, 1e-9)
+            self.metrics.log(self._steps, {
+                "queue_depth": len(self.queue),
+                "slot_occupancy": len(self.active) / self.engine.slots,
+                "tokens_emitted": self._tokens_emitted,
+                "tokens_per_sec": self._tokens_emitted / elapsed,
+            })
+
+    def run_until_idle(self, max_steps: int = 100_000) -> None:
+        """Step until queue and slots are empty (offline batch mode)."""
+        steps = 0
+        while self.queue or self.active:
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"not idle after {max_steps} steps — live slots "
+                    f"{sorted(self.active)}, queue {len(self.queue)}")
+
+    def pop_events(self) -> List[StreamEvent]:
+        """Drain the response stream."""
+        out = list(self.events)
+        self.events.clear()
+        return out
+
+    # -- internals ---------------------------------------------------------
+
+    def _expire(self, now: float) -> None:
+        self.queue = collections.deque(
+            r for r in self.queue
+            if not self._expire_queued(r, now))
+        for slot in list(self.active):
+            act = self.active[slot]
+            dl = act.request.deadline
+            if dl is not None and now >= dl:
+                self.engine.retire(slot)
+                self.events.append(StreamEvent(
+                    act.request.request_id, None, True, FINISH_TIMEOUT))
+                self._release(slot, FINISH_TIMEOUT)
+
+    def _expire_queued(self, request: Request, now: float) -> bool:
+        dl = request.deadline
+        if dl is None or now < dl:
+            return False
+        self._complete(request, [], FINISH_TIMEOUT, ttft=None, now=now)
+        self.events.append(StreamEvent(
+            request.request_id, None, True, FINISH_TIMEOUT))
+        return True
+
+    def _admit_queued(self, now: float) -> None:
+        while self._free and self.queue:
+            request = self.queue.popleft()
+            slot = self._free.pop()
+            sp = request.sampling
+            first, hit_eos, done = self.engine.admit(
+                slot, request.prompt, request.max_tokens,
+                temperature=sp.temperature, top_k=sp.top_k, top_p=sp.top_p,
+                seed=sp.seed,
+                eos_token_id=request.eos_token_id)
+            act = _Active(request)
+            t_first = self.clock()
+            act.first_token_time = t_first
+            act.tokens.append(first)
+            self._tokens_emitted += 1
+            self.ttft_stats.add(t_first - request.arrival_time)
+            reason = None
+            if done:
+                reason = FINISH_EOS if hit_eos else FINISH_LENGTH
+            self.events.append(StreamEvent(
+                request.request_id, first, done, reason))
+            self.active[slot] = act
+            if done:
+                self._release(slot, reason)
+
+    def _release(self, slot: int, reason: str) -> None:
+        act = self.active.pop(slot)
+        self._free.append(slot)
+        now = self.clock()
+        ttft = (None if act.first_token_time is None
+                else act.first_token_time - act.request.arrival_time)
+        self._complete(act.request, act.tokens, reason, ttft=ttft, now=now)
+
+    def _complete(self, request: Request, tokens: List[int], reason: str,
+                  *, ttft: Optional[float], now: float) -> None:
+        arrival = request.arrival_time if request.arrival_time is not None \
+            else now
+        comp = Completion(request.request_id, list(tokens), reason,
+                          ttft=ttft, latency=now - arrival)
+        self.completions[request.request_id] = comp
+        if reason == FINISH_EOS and not tokens:
+            # eos-terminal prompt: completes at submit, emits only the
+            # finished event (no token)
+            self.events.append(StreamEvent(
+                request.request_id, None, True, reason))
+        if self.metrics is not None:
+            self.metrics.log(self._steps, {
+                "completed": 1.0,
+                "n_tokens": float(len(tokens)),
+                "ttft_s": -1.0 if ttft is None else ttft,
+                "latency_s": comp.latency,
+            })
+
+    # -- reporting ---------------------------------------------------------
+
+    def summary(self) -> Dict[str, float]:
+        """Aggregate serving metrics: throughput + latency percentiles
+        (the bench's one JSON line)."""
+        elapsed = None
+        if self._started is not None:
+            elapsed = max(self.clock() - self._started, 1e-9)
+        out = {
+            "requests_completed": float(len(self.completions)),
+            "tokens_emitted": float(self._tokens_emitted),
+            "steps": float(self._steps),
+        }
+        if elapsed:
+            out["tokens_per_sec"] = self._tokens_emitted / elapsed
+        for name, stats in (("ttft", self.ttft_stats),
+                            ("token_latency", self.token_latency_stats)):
+            for k, v in stats.summary().items():
+                out[f"{name}_{k}"] = v
+        return out

@@ -1,4 +1,6 @@
-"""Benchmark: GPT-2 355M-class training throughput on one chip.
+"""Benchmark: GPT-2 355M-class training throughput on one chip, and
+(``--mode serve``) continuous-batching serving throughput/latency over
+the same model family.
 
 Flagship config (BASELINE.md tracked config #4's model at single-chip
 scale): full train step — bf16 forward/backward with remat, fused-Adam
@@ -12,6 +14,7 @@ Megatron-class GPT-2 355M at ~40% MFU on A100 bf16 (312 TFLOP/s peak):
 measured / 58600.
 """
 
+import argparse
 import json
 import time
 
@@ -30,6 +33,72 @@ from apex_tpu.models import gpt, training
 from apex_tpu.optimizers import fused_adam
 
 BASELINE_TOKENS_PER_SEC = 58600.0
+
+
+def serve():
+    """Serving throughput/latency at a fixed seeded request trace: one
+    JSON line with tokens/s, mean/p99 TTFT, mean per-token latency —
+    the serving-side companion of the training number (ISSUE 1)."""
+    from apex_tpu.serving import Request, SamplingParams
+    from apex_tpu.serving.engine import Engine, EngineConfig
+    from apex_tpu.serving.scheduler import Scheduler
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if on_tpu:
+        cfg = gpt.GPTConfig(  # the training bench's 355M, decode form
+            vocab_size=50304, hidden_size=1024, num_layers=24, num_heads=16,
+            seq_len=1024, remat=False, compute_dtype=jnp.bfloat16,
+            attn_impl="flash", ln_impl="xla",
+        )
+        ecfg = EngineConfig(slots=8, max_prompt_len=64, max_seq_len=192)
+        n_requests, max_tokens = 32, 64
+    else:  # CPU smoke fallback so the harness always gets a line
+        cfg = gpt.GPTConfig(
+            vocab_size=1024, hidden_size=256, num_layers=4, num_heads=8,
+            seq_len=256, remat=False, compute_dtype=jnp.float32,
+        )
+        ecfg = EngineConfig(slots=4, max_prompt_len=16, max_seq_len=32)
+        n_requests, max_tokens = 8, 8
+
+    mesh = mx.build_mesh(tp=1, devices=jax.devices()[:1])
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    engine = Engine(cfg, params, mesh, ecfg)
+
+    def trace(seed0, n):
+        reqs = []
+        for i in range(n):
+            p_len = 1 + (11 * i + 5) % ecfg.max_prompt_len
+            prompt = [int(t) for t in jax.random.randint(
+                jax.random.PRNGKey(seed0 + i), (p_len,), 0,
+                cfg.vocab_size)]
+            sp = (SamplingParams(temperature=0.9, top_k=40, seed=i)
+                  if i % 2 else SamplingParams())
+            reqs.append(Request(f"r{i}", prompt, max_tokens=max_tokens,
+                                sampling=sp))
+        return reqs
+
+    # warmup: compile admit + step (and fill the persistent cache)
+    warm = Scheduler(engine)
+    for r in trace(9000, 2):
+        warm.submit(r)
+    warm.run_until_idle()
+
+    sched = Scheduler(engine)
+    for r in trace(100, n_requests):
+        sched.submit(r)
+    sched.run_until_idle()
+    s = sched.summary()
+    print(json.dumps({
+        "metric": "gpt2_355m_serve_tokens_per_sec_per_chip" if on_tpu
+        else "gpt_serve_smoke_cpu_tokens_per_sec",
+        "value": round(s["tokens_per_sec"], 1),
+        "unit": "tokens/s",
+        "requests": n_requests,
+        "slots": engine.slots,
+        "ttft_mean_ms": round(s["ttft_mean_ms"], 2),
+        "ttft_p99_ms": round(s["ttft_p99_ms"], 2),
+        "token_latency_mean_ms": round(s["token_latency_mean_ms"], 3),
+    }))
 
 
 def main():
@@ -93,4 +162,10 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=("train", "serve"), default="train",
+                    help="train (default): whole-step training "
+                    "throughput; serve: continuous-batching decode "
+                    "throughput + TTFT/latency at a fixed request trace")
+    args = ap.parse_args()
+    serve() if args.mode == "serve" else main()

@@ -153,6 +153,41 @@ def test_gpt_train_moe_example_smoke(tmp_path):
     assert len(losses) == 2 and losses[1] < losses[0]
 
 
+def test_serve_gpt_example_smoke(tmp_path):
+    """Offline batch serving: a JSONL request file (greedy, sampled, and
+    an eos-terminal prompt) flows through the continuous-batching engine
+    over tp=2; one line per request plus a summary JSON line."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep + env.get("PYTHONPATH", ""))
+    reqfile = str(tmp_path / "requests.jsonl")
+    with open(reqfile, "w") as f:
+        for d in ({"id": "greedy", "prompt": [3, 1, 4, 1, 5],
+                   "max_tokens": 4},
+                  {"id": "sampled", "prompt": [2, 7, 1, 8],
+                   "max_tokens": 5, "temperature": 0.9, "top_k": 11,
+                   "seed": 9},
+                  {"id": "instant", "prompt": [6, 2, 9],
+                   "max_tokens": 6, "eos_token_id": 9}):
+            f.write(json.dumps(d) + "\n")
+    cmd = [sys.executable, os.path.join(repo, "examples", "serve_gpt.py"),
+           "--tp", "2", "--slots", "2", "--requests", reqfile]
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = {l.split()[1]: l for l in r.stdout.splitlines()
+             if l.startswith("request ")}
+    assert set(lines) == {"greedy", "sampled", "instant"}
+    assert "[length]" in lines["greedy"]
+    # the eos-terminal prompt completes at submit with zero tokens
+    assert "[eos]" in lines["instant"] and "-> []" in lines["instant"]
+    served = [l for l in r.stdout.splitlines() if l.startswith("served ")]
+    summary = json.loads(served[0][len("served "):])
+    assert summary["requests_completed"] == 3
+    assert summary["tokens_emitted"] == 9  # 4 + 5 + 0
+
+
 def test_generate_example_smoke(tmp_path):
     """Decode demo runs greedy over tp=2 and prints a continuation per
     batch row."""

@@ -1,0 +1,504 @@
+"""apex_tpu.serving — continuous-batching engine oracles.
+
+Headline oracle: a continuously-batched run over N requests with
+staggered arrivals and mixed per-request sampling params emits, per
+request, exactly the tokens a solo ``gpt.generate`` run with that
+request's params and key emits — and admission is trace-stable (no
+compiled-program cache miss after warmup). Sharded-vs-unsharded parity
+(tp=2 vs tp=1) follows the repo-wide oracle pattern."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import mesh as mx
+from apex_tpu import profiler
+from apex_tpu.models import gpt
+from apex_tpu.serving import Request, SamplingParams, sampling
+from apex_tpu.serving.engine import Engine, EngineConfig
+from apex_tpu.serving.request import (
+    FINISH_EOS,
+    FINISH_LENGTH,
+    FINISH_TIMEOUT,
+)
+from apex_tpu.serving.scheduler import QueueFull, Scheduler
+from apex_tpu.transformer.testing import standalone_gpt_config
+
+VOCAB = 96
+
+
+def _cfg(**overrides):
+    base = dict(vocab_size=VOCAB, seq_len=64)
+    base.update(overrides)
+    return standalone_gpt_config(**base)
+
+
+def _solo_generate(cfg, params, mesh, prompt, n_new, sp: SamplingParams,
+                   eos_token_id=None):
+    """The solo reference: one ``gpt.generate`` run with this request's
+    params and key, exactly as a user would issue it."""
+    pspecs = gpt.param_specs(cfg)
+    key = (jax.random.PRNGKey(sp.seed)
+           if sp.temperature > 0 and sp.seed is not None else None)
+    out = jax.jit(jax.shard_map(
+        lambda p, t: gpt.generate(
+            cfg, p, t, n_new, temperature=sp.temperature, top_k=sp.top_k,
+            top_p=sp.top_p, key=key, eos_token_id=eos_token_id,
+            pad_token_id=0),
+        mesh=mesh, in_specs=(pspecs, P(None, None)),
+        out_specs=P(None, None), check_vma=False))(
+            params, jnp.asarray([prompt], jnp.int32))
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def _expect_tokens(solo, eos):
+    """Truncate the solo reference at its eos (inclusive) — the engine
+    releases the slot there instead of emitting pad to the horizon."""
+    if eos is None or eos not in solo:
+        return solo
+    return solo[:solo.index(eos) + 1]
+
+
+def _mixed_requests(n, max_prompt_len, *, eos=None, seed0=100):
+    """Deterministic mixed-parameter request set: greedy and sampled
+    lanes, varied prompt lengths and budgets."""
+    reqs = []
+    for i in range(n):
+        k = jax.random.PRNGKey(seed0 + i)
+        p_len = 1 + (7 * i + 3) % max_prompt_len
+        prompt = [int(t) for t in
+                  jax.random.randint(k, (p_len,), 0, VOCAB)]
+        if i % 3 == 1:
+            sp = SamplingParams(temperature=0.8 + 0.1 * (i % 4),
+                                top_k=(0, 7, 3, 11)[i % 4],
+                                top_p=(1.0, 0.9, 0.8, 1.0)[i % 4],
+                                seed=17 + i)
+        else:
+            sp = SamplingParams()
+        reqs.append(Request(f"r{i}", prompt, max_tokens=4 + i % 5,
+                            sampling=sp, eos_token_id=eos))
+    return reqs
+
+
+def _assert_oracle(cfg, params, mesh, sched, reqs):
+    for r in reqs:
+        comp = sched.completions[r.request_id]
+        solo = _solo_generate(cfg, params, mesh, list(r.prompt),
+                              r.max_tokens, r.sampling, r.eos_token_id)
+        want = _expect_tokens(solo, r.eos_token_id)
+        assert comp.tokens == want, (
+            f"{r.request_id}: engine {comp.tokens} != solo {want}")
+        want_reason = (FINISH_EOS if r.eos_token_id is not None
+                       and want and want[-1] == r.eos_token_id
+                       else FINISH_LENGTH)
+        assert comp.finish_reason == want_reason
+
+
+def test_continuous_batching_oracle(devices8):
+    """Staggered arrivals + mixed sampling params: every request's output
+    is token-identical to its solo ``gpt.generate`` run, and no program
+    recompiles after warmup."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    eng = Engine(cfg, params, mesh,
+                 EngineConfig(slots=2, max_prompt_len=10, max_seq_len=24))
+    sched = Scheduler(eng)
+    reqs = _mixed_requests(5, 10)
+
+    sched.submit(reqs[0])
+    sched.submit(reqs[1])
+    sched.step()
+    sched.step()
+    sched.submit(reqs[2])
+    sched.step()
+    sched.submit(reqs[3])
+    sched.submit(reqs[4])
+    sched.run_until_idle()
+
+    assert set(sched.completions) == {r.request_id for r in reqs}
+    _assert_oracle(cfg, params, mesh, sched, reqs)
+    # trace stability: one compiled program each, however many admissions
+    sizes = eng.compiled_cache_sizes()
+    for name in ("init", "step", "admit"):
+        assert sizes[name] in (1, None), sizes
+
+
+def test_oracle_with_eos_early_stop(devices8):
+    """A request whose continuation hits eos releases its slot there and
+    matches the solo run up to and including the eos token; the freed
+    slot is reused by a queued request."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    base_prompt = [int(t) for t in
+                   jax.random.randint(jax.random.PRNGKey(4), (6,), 0, VOCAB)]
+    base = _solo_generate(cfg, params, mesh, base_prompt, 8,
+                          SamplingParams())
+    # the third greedy token becomes the stop token (the first two
+    # collide with the prompt's own last token, which would trip the
+    # eos-terminal-prompt completion at submit instead)
+    eos = base[2]
+    assert base_prompt[-1] != eos
+
+    eng = Engine(cfg, params, mesh,
+                 EngineConfig(slots=1, max_prompt_len=8, max_seq_len=20))
+    sched = Scheduler(eng)
+    reqs = [Request("stop", base_prompt, max_tokens=8,
+                    eos_token_id=eos),
+            Request("after", [int(x) for x in base_prompt[:4]],
+                    max_tokens=5)]
+    for r in reqs:
+        sched.submit(r)
+    sched.run_until_idle()
+    comp = sched.completions["stop"]
+    assert comp.finish_reason == FINISH_EOS
+    assert comp.tokens == base[:3]  # up to and including the eos
+    _assert_oracle(cfg, params, mesh, sched, reqs)
+
+
+def test_eos_terminal_prompt_completes_at_submit(devices8):
+    """The engine-boundary fix: a prompt already ending in eos completes
+    immediately with zero generated tokens — it never occupies a slot
+    (and the admit program is never even compiled for it)."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    eng = Engine(cfg, params, mesh,
+                 EngineConfig(slots=1, max_prompt_len=8, max_seq_len=16))
+    sched = Scheduler(eng)
+    sched.submit(Request("done", [5, 9, 7], max_tokens=6, eos_token_id=7))
+    comp = sched.completions["done"]
+    assert comp.tokens == [] and comp.finish_reason == FINISH_EOS
+    assert comp.ttft is None and comp.latency is not None
+    assert not sched.queue and not sched.active
+    assert eng.compiled_cache_sizes()["admit"] in (0, None)
+    evs = sched.pop_events()
+    assert len(evs) == 1 and evs[0].finished and evs[0].token is None
+    # a prompt merely CONTAINING eos mid-stream is not terminal
+    sched.submit(Request("mid", [7, 5, 9], max_tokens=2, eos_token_id=7))
+    sched.run_until_idle()
+    assert len(sched.completions["mid"].tokens) >= 1
+
+
+def test_deadline_timeout_and_slot_reuse(devices8):
+    """Deadlines under an injected clock: a queued request expires in
+    place; an active slot is retired mid-decode with its partial output;
+    the freed slot serves the next request normally."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    eng = Engine(cfg, params, mesh,
+                 EngineConfig(slots=1, max_prompt_len=8, max_seq_len=24))
+    now = [0.0]
+    sched = Scheduler(eng, clock=lambda: now[0])
+    prompt = [1, 2, 3, 4]
+    sched.submit(Request("active", prompt, max_tokens=10, deadline=50.0))
+    sched.submit(Request("queued", prompt, max_tokens=4, deadline=5.0))
+    sched.step()  # admits "active"; "queued" still waiting
+    now[0] = 6.0
+    sched.step()  # "queued" expires in the queue
+    qc = sched.completions["queued"]
+    assert qc.finish_reason == FINISH_TIMEOUT and qc.tokens == []
+    now[0] = 60.0
+    sched.step()  # "active" blows its deadline mid-decode
+    ac = sched.completions["active"]
+    assert ac.finish_reason == FINISH_TIMEOUT
+    assert 1 <= len(ac.tokens) < 10  # partial output is preserved
+    assert not sched.active
+    # the freed slot still serves
+    sched.submit(Request("fresh", prompt, max_tokens=3))
+    sched.run_until_idle()
+    assert sched.completions["fresh"].finish_reason == FINISH_LENGTH
+    assert len(sched.completions["fresh"].tokens) == 3
+
+
+def test_queue_backpressure_and_validation(devices8):
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    eng = Engine(cfg, params, mesh,
+                 EngineConfig(slots=1, max_prompt_len=6, max_seq_len=12))
+    sched = Scheduler(eng, max_queue=1)
+    sched.submit(Request("a", [1, 2], max_tokens=2))
+    with pytest.raises(QueueFull):
+        sched.submit(Request("b", [1, 2], max_tokens=2))
+    with pytest.raises(ValueError, match="duplicate"):
+        sched.submit(Request("a", [1, 2], max_tokens=2))
+    with pytest.raises(ValueError, match="prompt length"):
+        sched.submit(Request("long", [1] * 7, max_tokens=2))
+    with pytest.raises(ValueError, match="max_tokens"):
+        sched.submit(Request("zero", [1, 2], max_tokens=0))
+    # budget beyond the slot horizon raises instead of silently clamping
+    with pytest.raises(ValueError, match="max_tokens"):
+        sched.submit(Request("big", [1, 2], max_tokens=11))
+    with pytest.raises(ValueError, match="eos_token_id"):
+        sched.submit(Request("eos", [1, 2], max_tokens=2,
+                             eos_token_id=VOCAB))
+    with pytest.raises(ValueError, match="eos_token_id"):
+        eng.admit(0, [1, 2], max_tokens=2, eos_token_id=-1)
+    with pytest.raises(ValueError, match="temperature"):
+        sched.submit(Request("filt", [1, 2], max_tokens=2,
+                             sampling=SamplingParams(top_k=3)))
+    with pytest.raises(ValueError, match="seed"):
+        sched.submit(Request("seed", [1, 2], max_tokens=2,
+                             sampling=SamplingParams(temperature=1.0)))
+    with pytest.raises(ValueError, match="max_tokens"):
+        eng.admit(0, [1, 2], max_tokens=99)
+    # an out-of-range slot would CLAMP into a neighbour's cache if traced
+    with pytest.raises(ValueError, match="slot"):
+        eng.admit(1, [1, 2], max_tokens=2)
+    with pytest.raises(ValueError, match="slot"):
+        eng.admit(-1, [1, 2], max_tokens=2)
+
+
+def test_engine_config_validation(devices8):
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    with pytest.raises(ValueError, match="slot"):
+        Engine(cfg, params, mesh, EngineConfig(slots=0))
+    with pytest.raises(ValueError, match="max_prompt_len"):
+        Engine(cfg, params, mesh,
+               EngineConfig(max_prompt_len=32, max_seq_len=16))
+    with pytest.raises(ValueError, match="position"):
+        Engine(cfg, params, mesh,
+               EngineConfig(max_prompt_len=16, max_seq_len=128))
+    with pytest.raises(ValueError, match="engine_cfg or field"):
+        Engine(cfg, params, mesh, EngineConfig(), slots=2)
+    mesh_dp = mx.build_mesh(dp=2, tp=1, devices=devices8[:2])
+    with pytest.raises(ValueError, match="tp only"):
+        Engine(cfg, params, mesh_dp,
+               EngineConfig(max_prompt_len=8, max_seq_len=16))
+
+
+def _run_trace(eng, reqs):
+    sched = Scheduler(eng)
+    for r in reqs:
+        sched.submit(r)
+    sched.run_until_idle()
+    return {rid: c.tokens for rid, c in sched.completions.items()}
+
+
+def test_engine_tp2_matches_tp1(devices8):
+    """Sharded-vs-unsharded parity for the serving path (the repo-wide
+    oracle pattern): the same trace over tp=2 emits identical tokens."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    ecfg = EngineConfig(slots=2, max_prompt_len=8, max_seq_len=20)
+    reqs = _mixed_requests(4, 8, seed0=300)
+    got1 = _run_trace(
+        Engine(cfg, params, mx.build_mesh(tp=1, devices=devices8[:1]),
+               ecfg), reqs)
+    got2 = _run_trace(
+        Engine(cfg, params, mx.build_mesh(tp=2, devices=devices8[:2]),
+               ecfg), [Request(r.request_id, r.prompt, r.max_tokens,
+                               sampling=r.sampling) for r in reqs])
+    assert got1 == got2
+
+
+def test_scheduler_metrics_and_summary(devices8, tmp_path):
+    """Serving metrics flow through profiler.MetricsLogger, and
+    summary() carries throughput + TTFT/latency percentiles."""
+    import json
+
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    eng = Engine(cfg, params, mesh,
+                 EngineConfig(slots=2, max_prompt_len=6, max_seq_len=16))
+    jsonl = str(tmp_path / "serve.jsonl")
+    logger = profiler.MetricsLogger(jsonl_path=jsonl)
+    sched = Scheduler(eng, metrics=logger)
+    for r in _mixed_requests(3, 6, seed0=400):
+        sched.submit(r)
+    sched.run_until_idle()
+    logger.close()
+    s = sched.summary()
+    assert s["requests_completed"] == 3.0
+    assert s["tokens_per_sec"] > 0
+    for k in ("ttft_mean_ms", "ttft_p99_ms", "token_latency_mean_ms"):
+        assert s[k] >= 0.0
+    lines = [json.loads(l) for l in open(jsonl)]
+    step_recs = [l for l in lines if "slot_occupancy" in l]
+    comp_recs = [l for l in lines if "ttft_s" in l]
+    assert step_recs and len(comp_recs) == 3
+    assert max(l["slot_occupancy"] for l in step_recs) == 1.0
+
+
+# --- sampling extraction: old-vs-new parity --------------------------------
+
+
+def _legacy_filter_logits(logits, top_k, top_p):
+    """Verbatim copy of the pre-refactor ``gpt._filter_logits`` — the
+    reference the extracted ``serving.sampling.filter_logits`` is pinned
+    against."""
+    vocab = logits.shape[-1]
+    kk = top_k if 0 < top_k < vocab else 0
+    pp = top_p if 0.0 < top_p < 1.0 else 0.0
+    if not kk and not pp:
+        return logits
+    neg = jnp.finfo(logits.dtype).min
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    if kk:
+        sorted_desc = jnp.where(jnp.arange(vocab) < kk, sorted_desc, neg)
+        thresh = sorted_desc[..., kk - 1][..., None]
+    else:
+        thresh = None
+    if pp:
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = jnp.concatenate(
+            [jnp.ones_like(cum[..., :1], bool), cum[..., :-1] < pp],
+            axis=-1)
+        pthresh = jnp.min(
+            jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True)
+        thresh = pthresh if thresh is None else jnp.maximum(thresh, pthresh)
+    return jnp.where(logits < thresh, neg, logits)
+
+
+def _legacy_generate(cfg, params, prompt, n_new, *, temperature=0.0,
+                     top_k=0, top_p=1.0, key=None):
+    """``gpt.generate``'s pre-refactor body with its draw closure inlined
+    verbatim (prefill + decode_step + legacy filter) — local semantics."""
+    b, p_len = prompt.shape
+    total = p_len + n_new
+
+    def draw(logits, t):
+        if temperature > 0.0:
+            scaled = _legacy_filter_logits(
+                logits / temperature, top_k, top_p)
+            return jax.random.categorical(
+                jax.random.fold_in(key, t), scaled, axis=-1
+            ).astype(jnp.int32)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    cache0, logits0 = gpt.prefill(cfg, params, prompt, max_len=total)
+    first = draw(logits0, p_len - 1)
+
+    def step(carry, t):
+        tok, cache = carry
+        logits, cache = gpt.decode_step(cfg, params, cache, tok, t)
+        nxt = draw(logits, t)
+        return (nxt, cache), nxt
+
+    _, outs = jax.lax.scan(step, (first, cache0),
+                           jnp.arange(p_len, total - 1, dtype=jnp.int32))
+    return jnp.transpose(
+        jnp.concatenate([first[None], outs], axis=0), (1, 0))
+
+
+def test_generate_matches_pre_refactor_tokens(devices8):
+    """The extraction satellite's parity pin: post-refactor
+    ``gpt.generate`` (drawing through serving.sampling) emits exactly
+    the tokens the pre-refactor implementation emits — greedy and
+    sampled with temperature/top_k/top_p."""
+    cfg = _cfg(seq_len=32)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    pspecs = gpt.param_specs(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (3, 8), 0, VOCAB)
+    for kw in (dict(),
+               dict(temperature=0.9, top_k=7, top_p=0.8,
+                    key=jax.random.PRNGKey(3))):
+        new = jax.jit(jax.shard_map(
+            lambda p, t: gpt.generate(cfg, p, t, 6, **kw), mesh=mesh,
+            in_specs=(pspecs, P(None, None)), out_specs=P(None, None),
+            check_vma=False))(params, prompt)
+        old = jax.jit(jax.shard_map(
+            lambda p, t: _legacy_generate(cfg, p, t, 6, **kw), mesh=mesh,
+            in_specs=(pspecs, P(None, None)), out_specs=P(None, None),
+            check_vma=False))(params, prompt)
+        np.testing.assert_array_equal(np.asarray(new), np.asarray(old))
+
+
+def test_draw_slots_matches_scalar_draw():
+    """Each lane of the vectorised per-slot draw is bit-identical to the
+    scalar ``draw`` a solo generate run would issue — greedy and sampled
+    lanes side by side in one batch."""
+    logits = jax.random.normal(jax.random.PRNGKey(0), (4, 33)) * 3.0
+    temps = [0.0, 0.7, 1.3, 1.0]
+    top_ks = [0, 5, 0, 3]
+    top_ps = [1.0, 1.0, 0.6, 0.9]
+    ts = [3, 5, 0, 9]
+    keys = jnp.stack([jnp.asarray(jax.random.PRNGKey(40 + i), jnp.uint32)
+                      for i in range(4)])
+    got = sampling.draw_slots(
+        logits, keys, jnp.asarray(ts, jnp.int32),
+        jnp.asarray(temps, jnp.float32), jnp.asarray(top_ks, jnp.int32),
+        jnp.asarray(top_ps, jnp.float32))
+    for i in range(4):
+        want = sampling.draw(
+            logits[i:i + 1], ts[i], temperature=temps[i], top_k=top_ks[i],
+            top_p=top_ps[i], key=keys[i])[0]
+        assert int(got[i]) == int(want), f"lane {i}"
+
+
+def test_traced_filter_matches_static():
+    """The traced-parameter filter (per-slot values under vmap) is
+    value-equal to the static form across enabled, combined, and
+    disabled settings."""
+    logits = jax.random.normal(jax.random.PRNGKey(7), (2, 33)) * 2.0
+    for kk in (0, 2, 5, 33):
+        for pp in (1.0, 0.85, 0.3):
+            want = np.asarray(sampling.filter_logits(logits, kk, pp))
+            got = np.asarray(sampling._filter_logits_traced(
+                logits, jnp.int32(kk), jnp.float32(pp)))
+            np.testing.assert_array_equal(got, want, err_msg=f"k={kk} p={pp}")
+
+
+# --- soak (slow) + fast smoke ----------------------------------------------
+
+
+def _soak(cfg, params, mesh, n_requests, slots, *, eos=None):
+    eng = Engine(cfg, params, mesh,
+                 EngineConfig(slots=slots, max_prompt_len=10,
+                              max_seq_len=24))
+    sched = Scheduler(eng)
+    reqs = _mixed_requests(n_requests, 10, eos=eos, seed0=500)
+    # staggered arrivals: a deterministic drip of 2 submissions per tick
+    pending = list(reqs)
+    while pending or sched.queue or sched.active:
+        for r in pending[:2]:
+            sched.submit(r)
+        pending = pending[2:]
+        sched.step()
+    return eng, sched, reqs
+
+
+@pytest.mark.slow
+def test_serving_soak_full_parity(devices8):
+    """Soak/stress: 18 mixed requests (greedy + sampled + eos lanes)
+    dripped through 3 slots — EVERY request stays token-identical to its
+    solo generate run, and the programs never recompile."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    eng, sched, reqs = _soak(cfg, params, mesh, 18, 3, eos=11)
+    assert len(sched.completions) == 18
+    _assert_oracle(cfg, params, mesh, sched, reqs)
+    sizes = eng.compiled_cache_sizes()
+    for name in ("step", "admit"):
+        assert sizes[name] in (1, None), sizes
+
+
+def test_serving_soak_smoke(devices8):
+    """Tier-1 smoke variant of the soak: a short drip through 2 slots
+    completes every request with sane shapes and stable programs (full
+    per-request parity runs in the slow soak)."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    eng, sched, reqs = _soak(cfg, params, mesh, 5, 2)
+    assert len(sched.completions) == 5
+    for r in reqs:
+        comp = sched.completions[r.request_id]
+        assert 1 <= len(comp.tokens) <= r.max_tokens
+        assert all(0 <= t < VOCAB for t in comp.tokens)
+        assert comp.finish_reason == FINISH_LENGTH
+        assert comp.ttft is not None and comp.ttft >= 0
+    sizes = eng.compiled_cache_sizes()
+    for name in ("step", "admit"):
+        assert sizes[name] in (1, None), sizes
