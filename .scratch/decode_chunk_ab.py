@@ -1,0 +1,74 @@
+"""In-process steady-decode A/B: decode_chunk=8 vs per-token dispatch.
+
+Per the perf-claims convention: one process, value-fetch sync (engine.step
+fetches its [B, n] outputs), warm programs, CPU mesh (no chip attached) —
+relative numbers only. Two shapes: the dispatch-dominated probe (tiny
+model — the CPU proxy for the chip's multi-ms tunnel latency, which is
+what chunking amortizes) and the serve-smoke shape (compute-dominated on
+CPU: expected ~flat).
+"""
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import mesh as mx
+from apex_tpu.models import gpt
+from apex_tpu.serving.engine import Engine, EngineConfig
+
+
+def steady_tps(cfg, params, ecfg, chunk, n_tokens):
+    mesh = mx.build_mesh(tp=1, devices=jax.devices()[:1])
+    eng = Engine(cfg, params, mesh,
+                 dataclasses.replace(ecfg, decode_chunk=chunk))
+    for s in range(ecfg.slots):  # fill every slot; huge budgets
+        eng.admit(s, [1 + s, 2, 3], max_tokens=ecfg.max_seq_len - 4)
+    t_warm, _ = eng.step()  # warm the step program
+    toks = [t_warm]         # warmup tokens join the parity stream
+    n_chunks = max(1, n_tokens // (chunk * ecfg.slots))
+    timed = 0
+    t0 = time.perf_counter()
+    for _ in range(n_chunks):
+        t, _ = eng.step()  # np.asarray fetch = the sync
+        toks.append(t)
+        timed += t.size
+    dt = time.perf_counter() - t0
+    return timed / dt, np.concatenate(toks, axis=1)
+
+
+def run(name, cfg, ecfg, n_tokens):
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    out = {}
+    for chunk in (1, 8):
+        best, em = 0.0, None
+        for _ in range(5):
+            tps, em = steady_tps(cfg, params, ecfg, chunk, n_tokens)
+            best = max(best, tps)
+        out[chunk] = (best, em)
+    # bit-identical steady-state tokens, chunk=8 vs chunk=1
+    n = min(out[1][1].shape[1], out[8][1].shape[1])
+    np.testing.assert_array_equal(out[1][1][:, :n], out[8][1][:, :n])
+    print(f"{name}: chunk=1 {out[1][0]:.0f} tok/s, "
+          f"chunk=8 {out[8][0]:.0f} tok/s, "
+          f"ratio {out[8][0] / out[1][0]:.2f}x (tokens identical)")
+
+
+tiny = gpt.GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                     num_heads=2, seq_len=128, remat=False,
+                     compute_dtype=jnp.float32)
+run("tiny 1L/32h (dispatch-dominated)", tiny,
+    EngineConfig(slots=4, max_prompt_len=8, max_seq_len=96), 1920)
+
+probe = gpt.GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                      num_heads=4, seq_len=128, remat=False,
+                      compute_dtype=jnp.float32)
+run("probe 2L/64h (dispatch-dominated)", probe,
+    EngineConfig(slots=4, max_prompt_len=8, max_seq_len=96), 1920)
+
+smoke = gpt.GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4,
+                      num_heads=8, seq_len=256, remat=False,
+                      compute_dtype=jnp.float32)
+run("smoke 4L/256h (compute-dominated on CPU)", smoke,
+    EngineConfig(slots=4, max_prompt_len=16, max_seq_len=64), 480)

@@ -13,6 +13,7 @@ from apex_tpu.kernels.softmax import (
     scaled_upper_triang_masked_softmax,
 )
 from apex_tpu.kernels.xentropy import softmax_cross_entropy
+from apex_tpu.kernels.decode_attention import decode_attention
 from apex_tpu.kernels.flash_attention import (
     flash_attention,
     flash_attention_bsh,
@@ -37,6 +38,7 @@ __all__ = [
     "scaled_masked_softmax",
     "scaled_upper_triang_masked_softmax",
     "softmax_cross_entropy",
+    "decode_attention",
     "flash_attention",
     "flash_attention_bsh",
     "flash_attention_with_lse",

@@ -1,0 +1,242 @@
+"""Single-query (flash-decode) attention Pallas kernel for the KV-cache
+decode hot path.
+
+The XLA decode path under vector per-slot positions (the serving
+engine's form) cannot express "write one column at per-row offsets" —
+``dynamic_update_slice`` takes one start index per operand — so it
+rewrites the ENTIRE ``[b, h, S, d]`` K and V caches through a one-hot
+``jnp.where`` every layer every token: O(b·h·S·d) HBM read+write
+traffic that scales with the cache horizon just to land one
+``[b, h, d]`` column. This module replaces that with two kernels
+composed by :func:`decode_attention`:
+
+- **column write**: the new K/V column lands at each row's own ``pos``
+  via a scalar-prefetch output index map (the block index IS
+  ``pos[b]``) with the cache aliased input→output
+  (``input_output_aliases``), so exactly one ``[h, 1, d]`` block per
+  batch row is written and the rest of the cache is never touched;
+- **split-K read**: flash-decode attention — the cache horizon is swept
+  in ``block_k`` chunks with a running online-softmax ``(out, lse)``
+  merge (the same ``m/l/acc`` update as the training flash kernel),
+  per-row masking ``col <= pos[b]`` matching ``gpt.decode_step``'s
+  vector-``pos`` semantics exactly: garbage cache entries past a row's
+  position contribute exact softmax zeros.
+
+Numerics match the materialised-scores XLA path: scores are computed
+with fp32 accumulation (``preferred_element_type``) and the softmax
+statistics are fp32; the only divergence is where the ``1/sqrt(d)``
+scale is applied (fp32 scores here vs compute-dtype q there), which the
+oracle test covers with per-dtype tolerances
+(``tests/test_decode_attention.py``).
+
+Like every kernel in this package it runs interpreted off-TPU, so the
+CPU test backbone exercises identical semantics; the model-level
+dispatch (``GPTConfig.decode_attn_impl="auto"``) keeps the XLA path for
+interpret mode and short horizons per the repo's crossover convention.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.kernels._utils import round_up, use_interpret, widen_f16
+
+_NEG = -1e30
+_LANES = 128  # stat scratch lane width (matches flash_attention)
+#: default split-K chunk of the cache horizon; _fit cuts it down for
+#: short/misaligned horizons
+_DEFAULT_BLOCK_K = 256
+
+
+def _fit_block_k(want: int, sk: int) -> int:
+    """Largest chunk ≤ ``want`` that doesn't over-sweep a short horizon
+    by more than a quarter (same policy as flash's ``_fit_block``, with
+    a smaller floor — decode horizons can be tiny)."""
+    b = min(want, round_up(sk, 8))
+    while b > 8 and round_up(sk, b) - sk > sk // 4:
+        b //= 2
+    return b
+
+
+# ---------------------------------------------------------------------------
+# column write: cache[b, :, pos[b], :] = new[b]  (one block per row)
+# ---------------------------------------------------------------------------
+
+def _write_kernel(pos_ref, kn_ref, vn_ref, ki_ref, vi_ref, ko_ref, vo_ref):
+    del pos_ref, ki_ref, vi_ref  # pos drives the index map; caches are
+    #                              aliased to the outputs, never read here
+    ko_ref[...] = kn_ref[...][:, :, None]
+    vo_ref[...] = vn_ref[...][:, :, None]
+
+
+def _write_column(k_new, v_new, k_cache, v_cache, pos):
+    """Write ``k_new/v_new [b, h, d]`` into column ``pos[b]`` of the
+    caches ``[b, h, S, d]`` — each grid step touches exactly one
+    ``[h, 1, d]`` output block (the scalar-prefetched ``pos`` IS the
+    block index on the S dim), and ``input_output_aliases`` keeps every
+    other cache byte in place."""
+    b, h, sk, d = k_cache.shape
+    new_spec = pl.BlockSpec((1, h, d), lambda i, pos_ref: (i, 0, 0))
+    col_spec = pl.BlockSpec((1, h, 1, d),
+                            lambda i, pos_ref: (i, 0, pos_ref[i], 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b,),
+        in_specs=[new_spec, new_spec,
+                  pl.BlockSpec(memory_space=pltpu.ANY),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=[col_spec, col_spec],
+    )
+    return pl.pallas_call(
+        _write_kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+                   jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype)],
+        # operand order: (pos, k_new, v_new, k_cache, v_cache)
+        input_output_aliases={3: 0, 4: 1},
+        interpret=use_interpret(),
+    )(pos, k_new.astype(k_cache.dtype), v_new.astype(v_cache.dtype),
+      k_cache, v_cache)
+
+
+# ---------------------------------------------------------------------------
+# split-K read: one query row against its masked cache horizon
+# ---------------------------------------------------------------------------
+
+def _attn_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                 l_ref, *, scale, bk, sk, h):
+    r = pl.program_id(0)        # (batch, head) row
+    j = pl.program_id(1)        # split-K chunk of the horizon
+    nk = pl.num_programs(1)
+    pos = pos_ref[lax.div(r, h)]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # chunks entirely past the row's position contribute nothing (the
+    # decode analogue of the causal block skip)
+    @pl.when(j * bk <= pos)
+    def _block():
+        q = q_ref[0]                                      # (1, d)
+        k = k_ref[0]                                      # (bk, d)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (1, bk)
+        col = lax.broadcasted_iota(jnp.int32, (1, bk), 1) + j * bk
+        valid = (col <= pos) & (col < sk)
+        s = jnp.where(valid, s, _NEG)
+        # masked V rows can be horizon padding (NaN in interpret mode,
+        # arbitrary garbage on chip): zero them so 0·garbage can't
+        # poison the accumulator dot
+        v = jnp.where(jnp.transpose(valid), v, 0.0).astype(v.dtype)
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        l_ref[:] = jnp.broadcast_to(
+            corr * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True),
+            l_ref.shape)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
+def _run_attn(q, k_cache, v_cache, pos, scale, h, block_k):
+    bh, sk, d = k_cache.shape
+    bk = _fit_block_k(block_k or _DEFAULT_BLOCK_K, sk)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, -(-sk // bk)),
+        in_specs=[
+            pl.BlockSpec((1, 1, d), lambda r, j, pos_ref: (r, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda r, j, pos_ref: (r, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda r, j, pos_ref: (r, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d),
+                               lambda r, j, pos_ref: (r, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, d), jnp.float32),
+            pltpu.VMEM((1, _LANES), jnp.float32),
+            pltpu.VMEM((1, _LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_attn_kernel, scale=scale, bk=bk, sk=sk, h=h),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, 1, d), q.dtype),
+        interpret=use_interpret(),
+    )(pos, q[:, None], k_cache, v_cache)
+    return out[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def decode_attention(q, k_new, v_new, k_cache, v_cache, pos, *,
+                     scale: Optional[float] = None,
+                     block_k: Optional[int] = None):
+    """One decode step of attention for every (batch, head) row.
+
+    ``q``/``k_new``/``v_new`` are ``[b, h, d]`` (this token's projected
+    query and cache entries), ``k_cache``/``v_cache`` ``[b, h, S, d]``,
+    ``pos`` ``[b] int32`` — each row's write/attend position (``0 <=
+    pos[i] < S``; ``gpt.decode_step`` guarantees this by freezing done
+    slots). Returns ``(out [b, h, d], k_cache, v_cache)`` where the
+    caches hold the new column at ``pos`` (written in place when XLA
+    honours the alias — inside the donated decode scan it does) and
+    ``out`` attends over positions ``0..pos[i]`` inclusive, bit-exactly
+    masked like the XLA path: rows past ``pos`` are exact softmax
+    zeros, so stale cache garbage never leaks into the output.
+
+    ``scale`` defaults to ``1/sqrt(d)`` and is applied to the fp32
+    scores (no overflow at any IO dtype — the XLA path instead folds it
+    into q in compute dtype, the fp16-range guard a fp32-accumulating
+    kernel doesn't need).
+    """
+    if q.ndim != 3 or k_cache.ndim != 4:
+        raise ValueError(
+            f"expected q [b, h, d] and caches [b, h, S, d], got "
+            f"{q.shape} / {k_cache.shape}")
+    b, h, d = q.shape
+    sk = k_cache.shape[2]
+    if k_cache.shape != (b, h, sk, d):
+        raise ValueError(
+            f"cache shape {k_cache.shape} inconsistent with q {q.shape}")
+    if pos.shape != (b,):
+        raise ValueError(f"pos must be [{b}], got {pos.shape}")
+    s = float(scale) if scale is not None else 1.0 / d ** 0.5
+    q, was16 = widen_f16(q)
+    k_new, _ = widen_f16(k_new)
+    v_new, _ = widen_f16(v_new)
+    k_cache, cache16 = widen_f16(k_cache)
+    v_cache, _ = widen_f16(v_cache)
+    pos = jnp.asarray(pos, jnp.int32)
+    k_cache, v_cache = _write_column(k_new, v_new, k_cache, v_cache, pos)
+    out = _run_attn(
+        q.reshape(b * h, d), k_cache.reshape(b * h, sk, d),
+        v_cache.reshape(b * h, sk, d), pos, s, h, block_k,
+    ).reshape(b, h, d)
+    if was16:
+        out = out.astype(jnp.float16)
+    if cache16:
+        k_cache = k_cache.astype(jnp.float16)
+        v_cache = v_cache.astype(jnp.float16)
+    return out, k_cache, v_cache

@@ -39,7 +39,12 @@ from jax.sharding import PartitionSpec as P
 
 import numpy as np
 
-from apex_tpu.kernels import flash_attention, flash_attention_bsh, layer_norm
+from apex_tpu.kernels import (
+    decode_attention,
+    flash_attention,
+    flash_attention_bsh,
+    layer_norm,
+)
 from apex_tpu.kernels.blockwise_attention import blockwise_attention
 from apex_tpu.mesh.topology import AXIS_CP, AXIS_DP, AXIS_EP, AXIS_PP, AXIS_TP
 # sampling lives in serving so generate and the continuous-batching
@@ -141,6 +146,20 @@ class GPTConfig:
     #: scores in compute dtype with fp32 max/exp/sum softmax statistics —
     #: flash-kernel numerics at half the bandwidth.
     attn_score_dtype: str = "f32"
+    #: Decode-attention impl for the KV-cache path (:func:`decode_step` /
+    #: :func:`decode_steps` / the serving engine). "kernel" → the Pallas
+    #: flash-decode kernel (``kernels/decode_attention.py``): split-K
+    #: sweep with online (out, lse) merge and a true one-column cache
+    #: write, replacing the XLA path's one-hot rewrite of the ENTIRE
+    #: [b, h, S, d] K/V caches per layer per token (O(B·h·S·d) HBM
+    #: traffic that scales with horizon). "xla" → materialised-scores
+    #: einsum attention (the only fast path off-TPU, where Pallas runs
+    #: interpreted). "auto" picks kernel on TPU from horizon 128
+    #: (provisional crossover — no chip was attached when this shipped;
+    #: re-measure whole-step per the perf-claims convention), except
+    #: under f16 compute, whose widen-at-kernel-boundary cost would
+    #: copy both full caches per layer per token.
+    decode_attn_impl: str = "auto"
     #: Long-context mode (no reference analogue — SURVEY.md §5 "no ring
     #: attention"): activations stay sequence-sharded over the ``cp`` mesh
     #: axis through the whole stack; attention is exact ring attention
@@ -943,15 +962,41 @@ def init_cache(cfg: GPTConfig, params, batch: int,
         cfg.compute_dtype)
 
 
+def _decode_attn_impl(cfg: GPTConfig, s_max: int) -> str:
+    """Resolve ``cfg.decode_attn_impl`` for a cache horizon of
+    ``s_max`` — the decode-side instance of the repo's crossover
+    convention (kernel on TPU from horizon 128, XLA off-TPU where
+    Pallas runs interpreted and at short horizons)."""
+    impl = cfg.decode_attn_impl
+    if impl == "auto":
+        from apex_tpu.kernels._utils import use_interpret
+
+        # f16 stays on XLA: Mosaic has no f16, so the kernel boundary
+        # would widen BOTH full caches to f32 and cast back every layer
+        # every token — strictly more HBM traffic than the one-hot
+        # rewrite the kernel exists to remove
+        f16 = jnp.dtype(cfg.compute_dtype) == jnp.float16
+        impl = ("xla" if use_interpret() or f16 or s_max < 128
+                else "kernel")
+    if impl not in ("kernel", "xla"):
+        raise ValueError(
+            f"unknown decode_attn_impl {cfg.decode_attn_impl!r}")
+    return impl
+
+
 def _decode_layer(cfg: GPTConfig, p, x, kv, pos):
     """One layer for one token: x [b, hidden], kv [2, b, hl, S, d].
 
     ``pos`` is the write/attend position — a scalar (whole batch at one
     position: generate/beam) or a ``[b]`` vector (per-slot positions:
     the continuous-batching engine). The two forms are value-identical
-    per row; the vector form writes by one-hot select (a batched
-    ``dynamic_update_slice`` at per-row offsets is not expressible) and
-    masks per row."""
+    per row. Attention dispatches on :func:`_decode_attn_impl`: the
+    Pallas flash-decode kernel writes the new K/V column in place and
+    sweeps the horizon with an online (out, lse) merge, while the XLA
+    path writes by one-hot select under vector ``pos`` (a batched
+    ``dynamic_update_slice`` at per-row offsets is not expressible —
+    the full-cache rewrite the kernel exists to remove) and masks per
+    row."""
     xa = _layer_norm(cfg, x, p["ln1"]["scale"], p["ln1"]["bias"])
     d = cfg.head_dim
     b = xa.shape[0]
@@ -960,25 +1005,35 @@ def _decode_layer(cfg: GPTConfig, p, x, kv, pos):
         t.reshape(b, hl // d, d)
         for t in _qkv_project(cfg, p["attn"]["qkv"], xa))
     s_max = kv.shape[3]
-    if pos.ndim == 0:
-        k_cache = lax.dynamic_update_slice_in_dim(
-            kv[0], k_new[:, :, None], pos, axis=2)
-        v_cache = lax.dynamic_update_slice_in_dim(
-            kv[1], v_new[:, :, None], pos, axis=2)
-        valid = (jnp.arange(s_max) <= pos)[None, None]      # [1, 1, S]
+    if _decode_attn_impl(cfg, s_max) == "kernel":
+        posv = (jnp.full((b,), pos, jnp.int32) if pos.ndim == 0
+                else pos)
+        ctx, k_cache, v_cache = decode_attention(
+            q, k_new, v_new, kv[0], kv[1], posv,
+            scale=1.0 / np.sqrt(d))
+        out = ctx.reshape(b, hl)
     else:
-        hit = (jnp.arange(s_max)[None] == pos[:, None])[:, None, :, None]
-        k_cache = jnp.where(hit, k_new[:, :, None], kv[0])
-        v_cache = jnp.where(hit, v_new[:, :, None], kv[1])
-        valid = (jnp.arange(s_max)[None] <= pos[:, None])[:, None]
-    # scale folded into q BEFORE the einsum: the unscaled dot product
-    # overflows fp16's 65504 range (same guard as the training path's
-    # compute-dtype branch)
-    q = q * jnp.asarray(1.0 / np.sqrt(d), q.dtype)
-    scores = jnp.einsum("bhd,bhsd->bhs", q, k_cache).astype(jnp.float32)
-    scores = jnp.where(valid, scores, -1e30)
-    p_attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhs,bhsd->bhd", p_attn, v_cache).reshape(b, hl)
+        if pos.ndim == 0:
+            k_cache = lax.dynamic_update_slice_in_dim(
+                kv[0], k_new[:, :, None], pos, axis=2)
+            v_cache = lax.dynamic_update_slice_in_dim(
+                kv[1], v_new[:, :, None], pos, axis=2)
+            valid = (jnp.arange(s_max) <= pos)[None, None]    # [1, 1, S]
+        else:
+            hit = (jnp.arange(s_max)[None]
+                   == pos[:, None])[:, None, :, None]
+            k_cache = jnp.where(hit, k_new[:, :, None], kv[0])
+            v_cache = jnp.where(hit, v_new[:, :, None], kv[1])
+            valid = (jnp.arange(s_max)[None] <= pos[:, None])[:, None]
+        # scale folded into q BEFORE the einsum: the unscaled dot
+        # product overflows fp16's 65504 range (same guard as the
+        # training path's compute-dtype branch)
+        q = q * jnp.asarray(1.0 / np.sqrt(d), q.dtype)
+        scores = jnp.einsum(
+            "bhd,bhsd->bhs", q, k_cache).astype(jnp.float32)
+        scores = jnp.where(valid, scores, -1e30)
+        p_attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhs,bhsd->bhd", p_attn, v_cache).reshape(b, hl)
     attn = row_parallel_linear(
         out, p["attn"]["proj"]["kernel"], p["attn"]["proj"]["bias"],
         axis=cfg.axis)
@@ -1042,6 +1097,76 @@ def decode_step(cfg: GPTConfig, params, cache, token, pos):
 
     x, new_cache = lax.scan(body, x, (params["layers"], cache))
     return _lm_head(cfg, params, x), new_cache
+
+
+#: sentinel in per-slot ``eos`` vectors: no stop token for this row
+#: (the serving engine re-exports this as its ``_NO_EOS``)
+_NO_EOS_SENTINEL = -1
+
+
+def decode_steps(cfg: GPTConfig, params, cache, state, n: int, *,
+                 pad_token_id: int = 0, draw_fn=None):
+    """``n`` fused decode steps as ONE compiled ``lax.scan`` — the
+    chunked device-side decode loop. Each step is a
+    :func:`decode_step` + on-device sampling + per-slot eos/budget
+    masking, so a caller dispatches (and pays the multi-ms tunnel
+    latency) once per ``n`` tokens instead of once per token.
+
+    ``state`` is the per-slot device state the serving engine carries —
+    ``[B]`` vectors ``tok`` (last token), ``pos`` (its position),
+    ``remaining`` (token budget left), ``done``, ``eos`` (-1 = no stop
+    token), plus ``temp``/``top_k``/``top_p``/``key`` when sampling
+    through the default per-slot draw. Per step, live slots emit
+    ``draw(logits)`` and advance; done slots emit ``pad_token_id`` with
+    ``tok``/``pos`` frozen (their lanes keep riding the scan but never
+    index past the cache horizon). A slot finishes when it emits its
+    eos or exhausts ``remaining`` — semantics identical to the serving
+    engine's historical per-token step, which this function now IS (the
+    chunk-parity test pins ``decode_steps(n)`` token-for-token against
+    n single steps).
+
+    ``draw_fn(logits, pos) -> [B] int32`` overrides the per-slot
+    :func:`apex_tpu.serving.sampling.draw_slots` draw (``pos`` is the
+    per-row position vector of the token each row's logits were
+    computed from) — :func:`generate` threads its shared-key scalar
+    sampler through this hook, so the sampler state vectors may be
+    omitted from ``state`` then.
+
+    Returns ``(cache, state, tokens [B, n], finished [B, n])``.
+    """
+    pad = jnp.int32(pad_token_id)
+
+    def body(carry, _):
+        cache, st = carry
+        logits, cache = decode_step(
+            cfg, params, cache, st["tok"], st["pos"])
+        if draw_fn is None:
+            nxt = _sampling.draw_slots(
+                logits, st["key"], st["pos"], st["temp"], st["top_k"],
+                st["top_p"])
+        else:
+            nxt = draw_fn(logits, st["pos"])
+        live = ~st["done"]
+        emit = jnp.where(live, nxt, pad)
+        remaining = st["remaining"] - live.astype(jnp.int32)
+        hit_eos = live & (st["eos"] >= 0) & (emit == st["eos"])
+        finished = live & (hit_eos | (remaining <= 0))
+        st = {
+            **st,
+            # done slots keep tok/pos frozen so their (discarded) lanes
+            # never index past the cache horizon
+            "tok": jnp.where(live, emit, st["tok"]),
+            "pos": st["pos"] + live.astype(jnp.int32),
+            "remaining": remaining,
+            "done": st["done"] | finished,
+        }
+        return (cache, st), (emit, finished)
+
+    (cache, state), (toks, fins) = lax.scan(
+        body, (cache, state), None, length=n)
+    # scan stacks on the leading (step) dim → [B, n]
+    return (cache, state, jnp.transpose(toks, (1, 0)),
+            jnp.transpose(fins, (1, 0)))
 
 
 def _check_stop_tokens(cfg: GPTConfig, eos_token_id, pad_token_id):
@@ -1201,21 +1326,26 @@ def generate(cfg: GPTConfig, params, prompt, n_new: int,
     first = draw(logits0, p_len - 1)
     eos = eos_token_id
     done0 = (first == eos) if eos is not None else jnp.zeros((b,), bool)
-
-    def step(carry, t):
-        tok_in, cache, done = carry
-        logits, cache = decode_step(cfg, params, cache, tok_in, t)
-        nxt = draw(logits, t)
-        if eos is not None:
-            nxt = jnp.where(done, jnp.int32(pad_token_id), nxt)
-            done = done | (nxt == eos)
-        return (nxt, cache, done), nxt
-
-    (_, _, _), outs = lax.scan(
-        step, (first, cache0, done0),
-        jnp.arange(p_len, total - 1, dtype=jnp.int32))
-    outs = jnp.concatenate([first[None], outs], axis=0)
-    return jnp.transpose(outs, (1, 0))
+    # the remaining horizon rides the chunked decode loop: one
+    # decode_steps scan of n_new - 1 fused steps. The horizon is the
+    # scan length (not the budget), so remaining is effectively
+    # infinite; rows decode in lockstep, and the shared-key batched
+    # draw threads through draw_fn at the live rows' position (done
+    # rows freeze theirs; any live row holds the max).
+    state = {
+        "tok": first,
+        "pos": jnp.full((b,), p_len, jnp.int32),
+        "remaining": jnp.full((b,), jnp.iinfo(jnp.int32).max // 2,
+                              jnp.int32),
+        "done": done0,
+        "eos": jnp.full((b,), _NO_EOS_SENTINEL if eos is None else eos,
+                        jnp.int32),
+    }
+    _, _, outs, _ = decode_steps(
+        cfg, params, cache0, state, n_new - 1,
+        pad_token_id=pad_token_id,
+        draw_fn=lambda lg, posv: draw(lg, jnp.max(posv)))
+    return jnp.concatenate([first[:, None], outs], axis=1)
 
 
 def beam_search(cfg: GPTConfig, params, prompt, n_new: int,

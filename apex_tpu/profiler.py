@@ -166,21 +166,25 @@ class LatencyStats:
 
     def __init__(self, capacity: int = 8192):
         self._cap = capacity
-        self._vals: List[float] = []
+        # fixed-size ring + cursor: ``add`` is O(1) on the scheduler's
+        # per-token hot path (a list with pop(0) is O(capacity) per
+        # sample once the window fills). Order within the window is
+        # irrelevant to every summary statistic.
+        self._ring = np.empty(capacity, np.float64)
+        self._cursor = 0
         self._count = 0
 
     def add(self, seconds: float) -> None:
-        self._vals.append(float(seconds))
+        self._ring[self._cursor] = seconds
+        self._cursor = (self._cursor + 1) % self._cap
         self._count += 1
-        if len(self._vals) > self._cap:
-            self._vals.pop(0)
 
     def summary(self) -> Dict[str, float]:
         """``{count, mean_ms, p50_ms, p90_ms, p99_ms, max_ms}`` over the
         retained window (empty dict before the first sample)."""
-        if not self._vals:
+        if not self._count:
             return {}
-        v = np.asarray(self._vals) * 1e3
+        v = self._ring[:min(self._count, self._cap)] * 1e3
         return {
             "count": float(self._count),
             "mean_ms": float(v.mean()),

@@ -7,9 +7,13 @@ position, remaining token budget, done flag, eos id, temperature /
 top-k / top-p / PRNG key — lives in ``[B]`` device vectors, so the three
 compiled programs are trace-stable across the whole serving lifetime:
 
-- ``step``:   one ``gpt.decode_step`` over all B slots at their own
-  positions + one per-slot :func:`apex_tpu.serving.sampling.draw_slots`,
-  emitting a token per live slot and finish flags,
+- ``step``:   one ``gpt.decode_steps`` chunk — ``decode_chunk``
+  fused per-token steps (each one ``gpt.decode_step`` over all B slots
+  at their own positions + one per-slot
+  :func:`apex_tpu.serving.sampling.draw_slots`) in ONE compiled
+  ``lax.scan``, emitting ``[B, decode_chunk]`` tokens + finish flags
+  per dispatch so the multi-ms tunnel/dispatch cost is paid once per
+  chunk instead of once per token,
 - ``admit``:  prefill ONE request's prompt at the static padded length
   (``gpt.prefill_at`` — causal attention makes the padded forward exact
   for the real tokens), draw its first token, insert the KV block into
@@ -50,10 +54,19 @@ class EngineConfig:
     max_prompt_len: int = 64
     max_seq_len: int = 128
     pad_token_id: int = 0
+    #: tokens decoded per compiled ``step`` dispatch
+    #: (``gpt.decode_steps``): raising it amortises the per-dispatch
+    #: tunnel latency over n tokens at the cost of admission latency —
+    #: queued requests wait for the in-flight chunk, and a slot that
+    #: finishes mid-chunk rides out the rest emitting pad. Token
+    #: streams are bit-identical at every setting (the chunk-parity
+    #: test pins chunk=8 against chunk=1 against solo generate).
+    decode_chunk: int = 1
 
 
 #: eos sentinel in the per-slot eos vector: no stop token for this slot
-_NO_EOS = -1
+#: (single-sourced from the decode loop that interprets it)
+_NO_EOS = gpt._NO_EOS_SENTINEL
 
 
 class Engine:
@@ -80,6 +93,9 @@ class Engine:
             raise ValueError(
                 f"max_seq_len {ecfg.max_seq_len} exceeds the position "
                 f"table (cfg.seq_len={cfg.seq_len})")
+        if ecfg.decode_chunk < 1:
+            raise ValueError(
+                f"decode_chunk {ecfg.decode_chunk} must be >= 1")
         gpt._check_stop_tokens(cfg, None, ecfg.pad_token_id)
         for axis in ("dp", "pp", "cp", "ep"):
             if axis in mesh.shape and mesh.shape[axis] != 1:
@@ -122,26 +138,12 @@ class Engine:
             return cache, state
 
         def step_local(params, cache, state):
-            logits, cache = gpt.decode_step(
-                cfg, params, cache, state["tok"], state["pos"])
-            nxt = sampling.draw_slots(
-                logits, state["key"], state["pos"], state["temp"],
-                state["top_k"], state["top_p"])
-            live = ~state["done"]
-            emit = jnp.where(live, nxt, pad)
-            remaining = state["remaining"] - live.astype(jnp.int32)
-            hit_eos = live & (state["eos"] >= 0) & (emit == state["eos"])
-            finished = live & (hit_eos | (remaining <= 0))
-            state = {
-                **state,
-                # done slots keep tok/pos frozen so their (discarded)
-                # lanes never index past the cache horizon
-                "tok": jnp.where(live, emit, state["tok"]),
-                "pos": state["pos"] + live.astype(jnp.int32),
-                "remaining": remaining,
-                "done": state["done"] | finished,
-            }
-            return cache, state, emit, finished
+            # the whole per-token body (decode + per-slot draw +
+            # eos/budget masking) lives in gpt.decode_steps — ONE
+            # compiled scan of decode_chunk steps per dispatch
+            return gpt.decode_steps(
+                cfg, params, cache, state, ecfg.decode_chunk,
+                pad_token_id=ecfg.pad_token_id)
 
         def admit_local(params, cache, state, slot, prompt, p_len,
                         max_tokens, temp, top_k, top_p, key, eos):
@@ -253,9 +255,12 @@ class Engine:
         return int(first), bool(hit_eos), bool(done)
 
     def step(self) -> Tuple[np.ndarray, np.ndarray]:
-        """One decode step over every slot. Returns ``(tokens [B],
-        finished [B])`` — tokens are ``pad_token_id`` for slots that
-        were already done entering the step."""
+        """One decode chunk over every slot — ``decode_chunk`` fused
+        per-token steps in one dispatch. Returns ``(tokens [B, n],
+        finished [B, n])`` with ``n = decode_chunk``; column ``j`` holds
+        step ``j``'s emissions, ``pad_token_id`` for slots that were
+        done entering that step (a slot that finishes at column ``j``
+        emits pad from ``j + 1`` on)."""
         self.cache, self.state, emit, finished = self._step(
             self._params, self.cache, self.state)
         return np.asarray(emit), np.asarray(finished)

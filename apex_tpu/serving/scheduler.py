@@ -79,6 +79,11 @@ class Scheduler:
         self._tokens_emitted = 0
         self._started: Optional[float] = None
         self._last_step_time: Optional[float] = None
+        # steady-decode split: wall time inside engine.step() and the
+        # tokens it emitted — TTFT (admission/prefill) excluded, so
+        # summary() can report the two regimes separately
+        self._decode_time = 0.0
+        self._decode_tokens = 0
 
     # -- intake ------------------------------------------------------------
 
@@ -127,7 +132,11 @@ class Scheduler:
 
     def step(self) -> None:
         """One scheduler tick: expire deadlines, admit into free slots,
-        advance the engine one token if any slot is live."""
+        advance the engine one decode CHUNK (``decode_chunk`` tokens
+        per live slot, one dispatch) if any slot is live, and unpack
+        the chunk's per-token stream events in emission order.
+        Deadlines and admissions are checked between chunks — the
+        ``decode_chunk`` admission-latency/throughput tradeoff."""
         now = self.clock()
         if self._started is None:
             self._started = now
@@ -137,22 +146,30 @@ class Scheduler:
             before = self.clock()
             tokens, finished = self.engine.step()
             dt = self.clock() - before
-            for slot in list(self.active):
-                act = self.active[slot]
-                tok = int(tokens[slot])
-                act.tokens.append(tok)
-                self._tokens_emitted += 1
-                self.token_latency_stats.add(dt)
-                done = bool(finished[slot])
-                reason = None
-                if done:
-                    eos = act.request.eos_token_id
-                    reason = (FINISH_EOS if eos is not None and tok == eos
-                              else FINISH_LENGTH)
-                self.events.append(StreamEvent(
-                    act.request.request_id, tok, done, reason))
-                if done:
-                    self._release(slot, reason)
+            n_cols = tokens.shape[1]
+            per_tok = dt / n_cols
+            self._decode_time += dt
+            for j in range(n_cols):
+                # slots released at an earlier column drop out of
+                # active; their remaining columns are pad by contract
+                for slot in list(self.active):
+                    act = self.active[slot]
+                    tok = int(tokens[slot, j])
+                    act.tokens.append(tok)
+                    self._tokens_emitted += 1
+                    self._decode_tokens += 1
+                    self.token_latency_stats.add(per_tok)
+                    done = bool(finished[slot, j])
+                    reason = None
+                    if done:
+                        eos = act.request.eos_token_id
+                        reason = (FINISH_EOS
+                                  if eos is not None and tok == eos
+                                  else FINISH_LENGTH)
+                    self.events.append(StreamEvent(
+                        act.request.request_id, tok, done, reason))
+                    if done:
+                        self._release(slot, reason)
         self._steps += 1
         if self.metrics is not None:
             elapsed = max(self.clock() - self._started, 1e-9)
@@ -272,6 +289,14 @@ class Scheduler:
         }
         if elapsed:
             out["tokens_per_sec"] = self._tokens_emitted / elapsed
+        if self._decode_time > 0:
+            # the steady-state half of the TTFT-vs-decode split: tokens
+            # emitted by engine.step() per second of wall time spent in
+            # it (admission/prefill — the TTFT side — excluded)
+            out["decode_tokens_per_sec"] = (
+                self._decode_tokens / self._decode_time)
+            out["decode_tokens"] = float(self._decode_tokens)
+            out["decode_time_s"] = self._decode_time
         for name, stats in (("ttft", self.ttft_stats),
                             ("token_latency", self.token_latency_stats)):
             for k, v in stats.summary().items():

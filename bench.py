@@ -37,8 +37,12 @@ BASELINE_TOKENS_PER_SEC = 58600.0
 
 def serve():
     """Serving throughput/latency at a fixed seeded request trace: one
-    JSON line with tokens/s, mean/p99 TTFT, mean per-token latency —
-    the serving-side companion of the training number (ISSUE 1)."""
+    JSON line with tokens/s, the TTFT-vs-steady-decode split, and a
+    ``decode_chunk`` sweep (chunked device-side decode loop,
+    ``gpt.decode_steps``) — the serving-side companion of the training
+    number, trajectory-trackable per chunk setting."""
+    import dataclasses
+
     from apex_tpu.serving import Request, SamplingParams
     from apex_tpu.serving.engine import Engine, EngineConfig
     from apex_tpu.serving.scheduler import Scheduler
@@ -62,7 +66,6 @@ def serve():
 
     mesh = mx.build_mesh(tp=1, devices=jax.devices()[:1])
     params = gpt.init(cfg, jax.random.PRNGKey(0))
-    engine = Engine(cfg, params, mesh, ecfg)
 
     def trace(seed0, n):
         reqs = []
@@ -77,27 +80,51 @@ def serve():
                                 sampling=sp))
         return reqs
 
-    # warmup: compile admit + step (and fill the persistent cache)
-    warm = Scheduler(engine)
-    for r in trace(9000, 2):
-        warm.submit(r)
-    warm.run_until_idle()
-
-    sched = Scheduler(engine)
-    for r in trace(100, n_requests):
-        sched.submit(r)
-    sched.run_until_idle()
-    s = sched.summary()
+    sweep = {}
+    tokens_by_chunk = {}
+    for chunk in (1, 2, 4, 8):
+        engine = Engine(cfg, params, mesh,
+                        dataclasses.replace(ecfg, decode_chunk=chunk))
+        # warmup: compile admit + step (and fill the persistent cache)
+        warm = Scheduler(engine)
+        for r in trace(9000, 2):
+            warm.submit(r)
+        warm.run_until_idle()
+        sched = Scheduler(engine)
+        for r in trace(100, n_requests):
+            sched.submit(r)
+        sched.run_until_idle()
+        s = sched.summary()
+        tokens_by_chunk[chunk] = {
+            rid: c.tokens for rid, c in sched.completions.items()}
+        sweep[str(chunk)] = {
+            "tokens_per_sec": round(s["tokens_per_sec"], 1),
+            "decode_tokens_per_sec": round(
+                s.get("decode_tokens_per_sec", 0.0), 1),
+            "ttft_mean_ms": round(s["ttft_mean_ms"], 2),
+            "ttft_p99_ms": round(s["ttft_p99_ms"], 2),
+            "token_latency_mean_ms": round(
+                s["token_latency_mean_ms"], 3),
+        }
+    # the chunk knob must not change a single emitted token
+    assert all(tokens_by_chunk[c] == tokens_by_chunk[1]
+               for c in tokens_by_chunk), "chunk sweep token drift"
+    head = sweep["8"]
     print(json.dumps({
         "metric": "gpt2_355m_serve_tokens_per_sec_per_chip" if on_tpu
         else "gpt_serve_smoke_cpu_tokens_per_sec",
-        "value": round(s["tokens_per_sec"], 1),
+        "value": head["tokens_per_sec"],
         "unit": "tokens/s",
         "requests": n_requests,
-        "slots": engine.slots,
-        "ttft_mean_ms": round(s["ttft_mean_ms"], 2),
-        "ttft_p99_ms": round(s["ttft_p99_ms"], 2),
-        "token_latency_mean_ms": round(s["token_latency_mean_ms"], 3),
+        "slots": ecfg.slots,
+        "decode_chunk": 8,
+        # TTFT (admission/prefill) vs steady-decode split at the
+        # headline chunk, then the whole sweep for trajectory tracking
+        "ttft_mean_ms": head["ttft_mean_ms"],
+        "ttft_p99_ms": head["ttft_p99_ms"],
+        "decode_tokens_per_sec": head["decode_tokens_per_sec"],
+        "token_latency_mean_ms": head["token_latency_mean_ms"],
+        "chunk_sweep": sweep,
     }))
 
 

@@ -84,6 +84,10 @@ def main():
                     help="synthetic-trace size when --requests is omitted")
     ap.add_argument("--max-tokens", type=int, default=8,
                     help="synthetic-trace token budget per request")
+    ap.add_argument("--decode-chunk", type=int, default=1,
+                    help="tokens per compiled decode dispatch "
+                    "(gpt.decode_steps): amortises dispatch latency; "
+                    "token streams are identical at any setting")
     ap.add_argument("--ckpt", help=".atck from examples/gpt_train.py "
                     "(--preset tiny); random init if omitted")
     args = ap.parse_args()
@@ -108,7 +112,7 @@ def main():
 
     engine = Engine(cfg, params, mesh, EngineConfig(
         slots=args.slots, max_prompt_len=args.max_prompt_len,
-        max_seq_len=args.max_seq_len))
+        max_seq_len=args.max_seq_len, decode_chunk=args.decode_chunk))
     reqs = (load_requests(args.requests, cfg.vocab_size) if args.requests
             else synthetic_requests(args.num_requests, 8, args.max_tokens,
                                     cfg.vocab_size))

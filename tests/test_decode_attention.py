@@ -1,0 +1,162 @@
+"""Flash-decode kernel oracles (`kernels/decode_attention.py`).
+
+Oracle pattern (SURVEY.md §4): the Pallas kernel vs the materialised-
+scores XLA decode path with per-dtype tolerances — both standalone
+(kernel vs fp32 numpy reference) and integrated (a full ``decode_step``
+with ``decode_attn_impl="kernel"`` vs ``"xla"``), plus the one-column
+cache-write contract: every cache byte outside the written column is
+bit-identical to the input."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import mesh as mx
+from apex_tpu.kernels import decode_attention
+from apex_tpu.models import gpt
+from apex_tpu.transformer.testing import standalone_gpt_config
+
+_TOL = {
+    jnp.float32: dict(rtol=2e-5, atol=2e-5),
+    jnp.bfloat16: dict(rtol=3e-2, atol=3e-2),
+    jnp.float16: dict(rtol=2e-3, atol=2e-3),
+}
+
+
+def _reference(q, k_new, v_new, k_cache, v_cache, pos):
+    """fp32 numpy: write the column, mask ``<= pos``, plain softmax."""
+    q, k_new, v_new, k_cache, v_cache = (
+        np.asarray(t, np.float32)
+        for t in (q, k_new, v_new, k_cache, v_cache))
+    b, h, S, d = k_cache.shape
+    kc, vc = k_cache.copy(), v_cache.copy()
+    for i in range(b):
+        kc[i, :, int(pos[i])] = k_new[i]
+        vc[i, :, int(pos[i])] = v_new[i]
+    s = np.einsum("bhd,bhsd->bhs", q, kc) / np.sqrt(d)
+    valid = np.arange(S)[None, None] <= np.asarray(pos)[:, None, None]
+    s = np.where(valid, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhs,bhsd->bhd", p, vc), kc, vc
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, jnp.float16])
+def test_kernel_matches_fp32_reference(dtype):
+    """Standalone oracle across dtypes, at a horizon that is not a
+    multiple of the split-K chunk (exercises the padded tail) and with
+    per-row positions spanning first/mid/last slots."""
+    b, h, S, d = 3, 4, 19, 32
+    ks = jax.random.split(jax.random.PRNGKey(0), 5)
+    mk = lambda k, shp: (jax.random.normal(k, shp) * 0.5).astype(dtype)
+    q = mk(ks[0], (b, h, d))
+    k_new = mk(ks[1], (b, h, d))
+    v_new = mk(ks[2], (b, h, d))
+    k_cache = mk(ks[3], (b, h, S, d))
+    v_cache = mk(ks[4], (b, h, S, d))
+    pos = jnp.asarray([2, 0, 18], jnp.int32)
+    out, kc, vc = jax.jit(decode_attention)(
+        q, k_new, v_new, k_cache, v_cache, pos)
+    ref_out, ref_kc, ref_vc = _reference(
+        q, k_new, v_new, k_cache, v_cache, pos)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), ref_out, **_TOL[dtype])
+    # one-column write contract: outside the written column the cache
+    # is BIT-identical to the input; the column holds k_new/v_new
+    for got, want, orig in ((kc, ref_kc, k_cache), (vc, ref_vc, v_cache)):
+        got = np.asarray(got, np.float32)
+        orig = np.asarray(orig, np.float32)
+        col = np.zeros((b, h, S, d), bool)
+        for i in range(b):
+            col[i, :, int(pos[i])] = True
+        np.testing.assert_array_equal(got[~col], orig[~col])
+        np.testing.assert_allclose(got[col], want[col], **_TOL[dtype])
+
+
+def test_kernel_masks_stale_cache_garbage():
+    """Entries past a row's position must be exact softmax zeros: a
+    cache whose masked tail holds huge garbage yields the same output
+    as one holding zeros (the engine's padded-prefill contract)."""
+    b, h, S, d = 2, 2, 12, 32
+    ks = jax.random.split(jax.random.PRNGKey(1), 5)
+    q = jax.random.normal(ks[0], (b, h, d))
+    k_new = jax.random.normal(ks[1], (b, h, d))
+    v_new = jax.random.normal(ks[2], (b, h, d))
+    k_cache = jax.random.normal(ks[3], (b, h, S, d))
+    v_cache = jax.random.normal(ks[4], (b, h, S, d))
+    pos = jnp.asarray([3, 7], jnp.int32)
+    tail = jnp.arange(S)[None, None, :, None] > pos[:, None, None, None]
+    run = jax.jit(decode_attention)
+    out_clean, _, _ = run(
+        q, k_new, v_new,
+        jnp.where(tail, 0.0, k_cache), jnp.where(tail, 0.0, v_cache), pos)
+    out_junk, _, _ = run(
+        q, k_new, v_new,
+        jnp.where(tail, 1e30, k_cache), jnp.where(tail, -1e30, v_cache),
+        pos)
+    np.testing.assert_array_equal(
+        np.asarray(out_clean), np.asarray(out_junk))
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_decode_step_kernel_matches_xla(devices8, dtype):
+    """Integration oracle: a full ``decode_step`` (vector per-slot
+    positions, tp sharded) through ``decode_attn_impl="kernel"``
+    matches the materialised-scores XLA path at unchanged per-dtype
+    tolerances — logits AND updated cache."""
+    cfg0 = standalone_gpt_config(vocab_size=96, seq_len=32,
+                                 compute_dtype=dtype)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (4, 6), 0, 96)
+    tok = jax.random.randint(jax.random.PRNGKey(2), (4,), 0, 96)
+    pos = jnp.asarray([6, 3, 1, 5], jnp.int32)
+    outs = {}
+    for tp in (1, 2):
+        mesh = mx.build_mesh(tp=tp, devices=devices8[:tp])
+        for impl in ("xla", "kernel"):
+            cfg = dataclasses.replace(cfg0, decode_attn_impl=impl)
+            params = gpt.init(cfg, jax.random.PRNGKey(0))
+            pspecs = gpt.param_specs(cfg)
+
+            def run(p, t, tk):
+                cache, _ = gpt.prefill(cfg, p, t, max_len=cfg.seq_len)
+                return gpt.decode_step(cfg, p, cache, tk, pos)
+
+            lg, cache = jax.jit(jax.shard_map(
+                run, mesh=mesh,
+                in_specs=(pspecs, P(None, None), P(None)),
+                out_specs=(P(None, None),
+                           P(None, None, None, "tp", None, None)),
+                check_vma=False))(params, prompt, tok)
+            outs[(tp, impl)] = (np.asarray(lg, np.float32),
+                                np.asarray(cache, np.float32))
+    tol = _TOL[dtype]
+    for tp in (1, 2):
+        got_lg, got_c = outs[(tp, "kernel")]
+        want_lg, want_c = outs[(tp, "xla")]
+        np.testing.assert_allclose(got_lg, want_lg, err_msg=f"tp{tp}",
+                                   **tol)
+        np.testing.assert_allclose(got_c, want_c, err_msg=f"tp{tp}",
+                                   **tol)
+
+
+def test_decode_attention_validation():
+    b, h, S, d = 2, 2, 8, 32
+    z3 = jnp.zeros((b, h, d))
+    z4 = jnp.zeros((b, h, S, d))
+    with pytest.raises(ValueError, match="expected q"):
+        decode_attention(z4, z3, z3, z4, z4, jnp.zeros((b,), jnp.int32))
+    with pytest.raises(ValueError, match="pos must be"):
+        decode_attention(z3, z3, z3, z4, z4, jnp.zeros((3,), jnp.int32))
+    with pytest.raises(ValueError, match="unknown decode_attn_impl"):
+        gpt._decode_attn_impl(
+            standalone_gpt_config(decode_attn_impl="nope"), 8)
+    # off-TPU "auto" resolves to the XLA path (Pallas runs interpreted),
+    # and f16 does everywhere (the kernel boundary would widen the full
+    # caches per layer per token)
+    assert gpt._decode_attn_impl(standalone_gpt_config(), 4096) == "xla"
+    assert gpt._decode_attn_impl(
+        standalone_gpt_config(compute_dtype=jnp.float16), 4096) == "xla"

@@ -199,6 +199,29 @@ def test_metrics_tensorboard_sink(tmp_path):
     assert events and os.path.getsize(events[0]) > 0
 
 
+def test_latency_stats_ring_wraparound():
+    """The O(1) ring buffer keeps exactly the most recent ``capacity``
+    samples across wraparound — same summary() contract as the list
+    window it replaced (count = lifetime total, stats over the window),
+    and an empty accumulator summarises to {}."""
+    stats = profiler.LatencyStats(capacity=4)
+    assert stats.summary() == {}
+    stats.add(5.0)  # partially-filled window
+    s = stats.summary()
+    assert s["count"] == 1.0 and s["mean_ms"] == 5000.0
+    assert s["p50_ms"] == 5000.0 and s["max_ms"] == 5000.0
+    # wrap twice: samples 1..10 at capacity 4 retain {7, 8, 9, 10}
+    stats = profiler.LatencyStats(capacity=4)
+    for i in range(1, 11):
+        stats.add(float(i))
+    s = stats.summary()
+    assert s["count"] == 10.0
+    assert s["mean_ms"] == 8500.0          # mean(7..10) in ms
+    assert s["max_ms"] == 10000.0          # 5s and 6s evicted
+    assert s["p50_ms"] == 8500.0
+    assert s["p99_ms"] <= s["max_ms"]
+
+
 def test_annotate_and_sync():
     with profiler.annotate("test-range"):
         y = jnp.sum(jnp.arange(10.0))

@@ -449,6 +449,157 @@ def test_traced_filter_matches_static():
             np.testing.assert_array_equal(got, want, err_msg=f"k={kk} p={pp}")
 
 
+# --- chunked decode (gpt.decode_steps + EngineConfig.decode_chunk) ---------
+
+
+def _singles_reference(cfg, params, cache, state, n, pad):
+    """n SINGLE per-token steps — the pre-chunk engine step body
+    verbatim (decode_step + draw_slots + eos/budget masking), the
+    reference ``gpt.decode_steps(n)`` is pinned against."""
+    toks, fins = [], []
+    for _ in range(n):
+        logits, cache = gpt.decode_step(
+            cfg, params, cache, state["tok"], state["pos"])
+        nxt = sampling.draw_slots(
+            logits, state["key"], state["pos"], state["temp"],
+            state["top_k"], state["top_p"])
+        live = ~state["done"]
+        emit = jnp.where(live, nxt, jnp.int32(pad))
+        remaining = state["remaining"] - live.astype(jnp.int32)
+        hit_eos = live & (state["eos"] >= 0) & (emit == state["eos"])
+        finished = live & (hit_eos | (remaining <= 0))
+        state = {
+            **state,
+            "tok": jnp.where(live, emit, state["tok"]),
+            "pos": state["pos"] + live.astype(jnp.int32),
+            "remaining": remaining,
+            "done": state["done"] | finished,
+        }
+        toks.append(emit)
+        fins.append(finished)
+    return cache, state, jnp.stack(toks, 1), jnp.stack(fins, 1)
+
+
+def _chunk_state(b):
+    """Mixed per-slot state: greedy and sampled lanes, one eos lane,
+    one budget-starved lane, one already-done lane."""
+    keys = jnp.stack([jnp.asarray(jax.random.PRNGKey(60 + i), jnp.uint32)
+                      for i in range(b)])
+    return {
+        "tok": jnp.asarray([3, 9, 14, 2][:b], jnp.int32),
+        "pos": jnp.asarray([6, 4, 2, 5][:b], jnp.int32),
+        "remaining": jnp.asarray([20, 3, 20, 20][:b], jnp.int32),
+        "done": jnp.asarray([False, False, False, True][:b], bool),
+        "temp": jnp.asarray([0.0, 0.9, 1.2, 0.0][:b], jnp.float32),
+        "top_k": jnp.asarray([0, 5, 0, 0][:b], jnp.int32),
+        "top_p": jnp.asarray([1.0, 0.9, 1.0, 1.0][:b], jnp.float32),
+        "key": keys,
+        "eos": jnp.asarray([11, -1, 11, -1][:b], jnp.int32),
+    }
+
+
+def _run_decode_steps(cfg, params, mesh, n, chunked: bool):
+    """Prefill a 4-row batch, then n tokens — one decode_steps(n) scan
+    or n single per-token step dispatches."""
+    pspecs = gpt.param_specs(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (4, 8), 0, VOCAB)
+    cache_spec = P(None, None, None, "tp", None, None)
+    state = _chunk_state(4)
+    st_spec = {k: P() for k in state}
+
+    def pre(p, t):
+        cache, _ = gpt.prefill(cfg, p, t, max_len=24)
+        return cache
+
+    cache = jax.jit(jax.shard_map(
+        pre, mesh=mesh, in_specs=(pspecs, P(None, None)),
+        out_specs=cache_spec, check_vma=False))(params, prompt)
+    if chunked:
+        fn = jax.jit(jax.shard_map(
+            lambda p, c, st: gpt.decode_steps(cfg, p, c, st, n),
+            mesh=mesh, in_specs=(pspecs, cache_spec, st_spec),
+            out_specs=(cache_spec, st_spec, P(), P()), check_vma=False))
+        _, _, toks, fins = fn(params, cache, state)
+    else:
+        fn = jax.jit(jax.shard_map(
+            lambda p, c, st: _singles_reference(cfg, p, c, st, 1, 0),
+            mesh=mesh, in_specs=(pspecs, cache_spec, st_spec),
+            out_specs=(cache_spec, st_spec, P(), P()), check_vma=False))
+        cols_t, cols_f = [], []
+        for _ in range(n):
+            cache, state, t1, f1 = fn(params, cache, state)
+            cols_t.append(t1)
+            cols_f.append(f1)
+        toks = jnp.concatenate(cols_t, axis=1)
+        fins = jnp.concatenate(cols_f, axis=1)
+    return np.asarray(toks), np.asarray(fins)
+
+
+def test_decode_steps_matches_single_steps(devices8):
+    """Token parity: decode_steps(n) == n single decode_step dispatches
+    — greedy AND sampled lanes, eos and budget finishes mid-chunk, and
+    tp2-vs-tp1 (the repo-wide sharded-parity oracle)."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    got = {}
+    for tp in (1, 2):
+        mesh = mx.build_mesh(tp=tp, devices=devices8[:tp])
+        got[(tp, "chunk")] = _run_decode_steps(cfg, params, mesh, 6, True)
+        got[(tp, "single")] = _run_decode_steps(cfg, params, mesh, 6,
+                                                False)
+    for tp in (1, 2):
+        for a, b in zip(got[(tp, "chunk")], got[(tp, "single")]):
+            np.testing.assert_array_equal(a, b, err_msg=f"tp{tp}")
+    for a, b in zip(got[(1, "chunk")], got[(2, "chunk")]):
+        np.testing.assert_array_equal(a, b, err_msg="tp2 vs tp1")
+    toks, fins = got[(1, "chunk")]
+    assert fins.any(), "expected a mid-chunk finish in the fixture"
+    # the budget-starved lane (remaining=3) pads after its 3rd token
+    assert (toks[1, 3:] == 0).all()
+
+
+def test_engine_chunked_matches_per_token_and_solo(devices8):
+    """decode_chunk=8 vs =1 vs solo generate: bit-identical tokens per
+    request, and the chunked engine's programs stay at one compiled
+    entry across admissions (trace stability)."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    reqs = _mixed_requests(5, 8, eos=13, seed0=700)
+    mk = lambda chunk: Engine(
+        cfg, params, mesh,
+        EngineConfig(slots=2, max_prompt_len=8, max_seq_len=24,
+                     decode_chunk=chunk))
+    eng8 = mk(8)
+    got8 = _run_trace(eng8, reqs)
+    got1 = _run_trace(mk(1), [Request(r.request_id, r.prompt,
+                                      r.max_tokens, sampling=r.sampling,
+                                      eos_token_id=r.eos_token_id)
+                              for r in reqs])
+    assert got8 == got1
+    sizes = eng8.compiled_cache_sizes()
+    for name in ("init", "step", "admit"):
+        assert sizes[name] in (1, None), sizes
+    # solo-generate parity through the chunked path (the headline
+    # oracle, re-run at chunk=8)
+    sched = Scheduler(eng8)
+    for r in _mixed_requests(4, 8, eos=13, seed0=900):
+        sched.submit(r)
+    sched.run_until_idle()
+    _assert_oracle(cfg, params, mesh, sched,
+                   _mixed_requests(4, 8, eos=13, seed0=900))
+
+
+def test_engine_decode_chunk_validation(devices8):
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    with pytest.raises(ValueError, match="decode_chunk"):
+        Engine(cfg, params, mesh,
+               EngineConfig(max_prompt_len=8, max_seq_len=16,
+                            decode_chunk=0))
+
+
 # --- soak (slow) + fast smoke ----------------------------------------------
 
 
