@@ -23,6 +23,11 @@ A ground-up JAX/XLA/Pallas re-design of the capabilities of apex
   runtime, csrc/host_runtime.cpp).
 - ``apex_tpu.profiler``     — tracing/metrics subsystem (xprof hooks,
   per-step timing, structured metrics).
+- ``apex_tpu.serving``      — static-shape continuous-batching inference
+  engine (slot engine + scheduler).
+- ``apex_tpu.telemetry``    — system-wide observability: metrics
+  registry, per-request span timelines, recompile sentinel, live
+  ``/metrics`` endpoint.
 
 Citation convention: ``(U)`` paths refer to the upstream apex layout as
 documented in SURVEY.md (the reference mount was empty at survey time).
@@ -52,6 +57,8 @@ __all__ = [
     "rnn",
     "reparameterization",
     "models",
+    "serving",
+    "telemetry",
     "testing",
     "capabilities",
     "has_capability",

@@ -40,6 +40,62 @@ def _install_shard_map() -> None:
     jax.shard_map = shard_map
 
 
+def register_monitoring_listeners(on_event, on_duration):
+    """Subscribe to the runtime's compile-event stream
+    (``jax.monitoring``), returning an unregister callable — or ``None``
+    on legacy runtimes without the module, in which case the caller
+    falls back to polling its tracked functions' jit-cache sizes (the
+    lowering/cache-miss counter the recompile sentinel keeps anyway).
+
+    ``on_event(name, **kw)`` receives point events (persistent-cache
+    hits/misses); ``on_duration(name, seconds, **kw)`` receives duration
+    events — ``/jax/core/compile/backend_compile_duration`` is the one
+    that matters: it fires whenever a new executable materialises
+    (fresh XLA compile OR persistent-cache load) and never on an
+    in-memory jit-cache hit.
+    """
+    try:
+        from jax import monitoring
+    except ImportError:  # pragma: no cover - legacy runtime
+        return None
+    # require BOTH registration APIs before touching either — a partial
+    # register with no unregister handle would leak for process lifetime
+    if not (hasattr(monitoring, "register_event_listener") and
+            hasattr(monitoring, "register_event_duration_secs_listener")):
+        return None  # pragma: no cover - legacy runtime
+    # unregistration only exists as private helpers, living on the
+    # implementation module (jax._src.monitoring — the public re-export
+    # does NOT carry them on this runtime). Resolve them BEFORE
+    # registering: a runtime where they are gone (they are private, no
+    # stability guarantee) gets the clean cache-polling fallback instead
+    # of listeners that Engine.close() can never release.
+    impl = monitoring
+    if not hasattr(impl, "_unregister_event_listener_by_callback"):
+        try:
+            from jax._src import monitoring as impl  # type: ignore
+        except ImportError:  # pragma: no cover
+            return None
+    unreg_event = getattr(impl, "_unregister_event_listener_by_callback",
+                          None)
+    unreg_duration = getattr(
+        impl, "_unregister_event_duration_listener_by_callback", None)
+    if unreg_event is None or unreg_duration is None:
+        return None  # pragma: no cover - future runtime
+
+    monitoring.register_event_listener(on_event)
+    monitoring.register_event_duration_secs_listener(on_duration)
+
+    def unregister():
+        for fn, cb in ((unreg_event, on_event),
+                       (unreg_duration, on_duration)):
+            try:
+                fn(cb)
+            except ValueError:  # already removed
+                pass
+
+    return unregister
+
+
 def _install_axis_size() -> None:
     if getattr(jax.lax, "axis_size", None) is not None:
         return

@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu.telemetry.ring import Ring
+
 
 @contextlib.contextmanager
 def trace(logdir: str):
@@ -72,8 +74,10 @@ class StepTimer:
                  window: int = 50):
         self._tokens = tokens_per_step
         self._flops = model_flops_per_step
-        self._window = window
-        self._times: List[float] = []
+        # windowing via the shared O(1) ring (a list with pop(0) is
+        # O(window) per step once the window fills — the same hot-path
+        # bug LatencyStats fixed, hoisted to telemetry.ring for both)
+        self._times = Ring(window)
         self._last: Optional[float] = None
 
     def tick(self, sync_on: Any = None) -> float:
@@ -86,8 +90,6 @@ class StepTimer:
         self._last = now
         if dt > 0.0:
             self._times.append(dt)
-            if len(self._times) > self._window:
-                self._times.pop(0)
         return dt
 
     def reset(self):
@@ -95,9 +97,9 @@ class StepTimer:
         self._last = None
 
     def summary(self) -> Dict[str, float]:
-        if not self._times:
+        if not len(self._times):
             return {}
-        ts = np.asarray(self._times)
+        ts = self._times.array()
         out = {
             "steps": float(len(ts)),
             "mean_step_s": float(ts.mean()),
@@ -111,15 +113,38 @@ class StepTimer:
             out["model_flops_per_sec"] = self._flops / float(np.median(ts))
         return out
 
+    def publish(self, registry, prefix: str = "train_") -> Dict[str, float]:
+        """Mirror :meth:`summary` into gauges on a
+        :class:`apex_tpu.telemetry.registry.Registry` — the training
+        side of the shared-registry path (step percentiles, tokens/s,
+        FLOP/s next to the serving counters on one ``/metrics`` page).
+        Returns the summary it published."""
+        from apex_tpu.telemetry.registry import sanitize_metric_name
+
+        s = self.summary()
+        for k, v in s.items():
+            registry.gauge(sanitize_metric_name(prefix + k),
+                           "StepTimer window statistic").set(v)
+        return s
+
 
 class MetricsLogger:
     """Structured per-step metrics: ring buffer + optional JSONL sink +
-    optional TensorBoard (the "structured metrics dict" plan, SURVEY.md
-    §5 'Metrics / logging')."""
+    optional TensorBoard + optional shared
+    :class:`apex_tpu.telemetry.registry.Registry` (the "structured
+    metrics dict" plan, SURVEY.md §5 'Metrics / logging', grown into a
+    *view* over the system-wide registry: every logged scalar also sets
+    a gauge, so training and serving expose through one ``/metrics``).
+
+    Usable as a context manager (``with MetricsLogger(...) as log:``) —
+    ``close()`` runs on exit. The JSONL line format is byte-stable
+    across the registry addition.
+    """
 
     def __init__(self, jsonl_path: Optional[str] = None,
                  tensorboard_dir: Optional[str] = None,
-                 history: int = 1000):
+                 history: int = 1000, registry=None,
+                 registry_prefix: str = ""):
         self._jsonl = open(jsonl_path, "a") if jsonl_path else None
         self._tb = None
         if tensorboard_dir is not None:
@@ -128,16 +153,22 @@ class MetricsLogger:
                 self._tb = SummaryWriter(tensorboard_dir)
             except Exception:
                 self._tb = None
-        self._hist: List[Dict[str, float]] = []
-        self._cap = history
+        self._hist = Ring(history)
+        self._registry = registry
+        self._reg_prefix = registry_prefix
+        self._gauges: Dict[str, Any] = {}
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
 
     def log(self, step: int, metrics: Dict[str, Any]):
         flat = {k: float(jax.device_get(v)) if hasattr(v, "dtype") else
                 float(v) for k, v in metrics.items()}
         flat["step"] = step
         self._hist.append(flat)
-        if len(self._hist) > self._cap:
-            self._hist.pop(0)
         if self._jsonl is not None:
             self._jsonl.write(json.dumps(flat) + "\n")
             self._jsonl.flush()
@@ -145,10 +176,21 @@ class MetricsLogger:
             for k, v in flat.items():
                 if k != "step":
                     self._tb.add_scalar(k, v, step)
+        if self._registry is not None:
+            for k, v in flat.items():
+                gauge = self._gauges.get(k)
+                if gauge is None:
+                    from apex_tpu.telemetry.registry import \
+                        sanitize_metric_name
+
+                    gauge = self._gauges[k] = self._registry.gauge(
+                        sanitize_metric_name(self._reg_prefix + k),
+                        "MetricsLogger scalar")
+                gauge.set(v)
 
     @property
     def history(self) -> List[Dict[str, float]]:
-        return list(self._hist)
+        return self._hist.values()
 
     def close(self):
         if self._jsonl is not None:
@@ -165,28 +207,27 @@ class LatencyStats:
     the number serving SLOs are written against)."""
 
     def __init__(self, capacity: int = 8192):
-        self._cap = capacity
-        # fixed-size ring + cursor: ``add`` is O(1) on the scheduler's
-        # per-token hot path (a list with pop(0) is O(capacity) per
-        # sample once the window fills). Order within the window is
-        # irrelevant to every summary statistic.
-        self._ring = np.empty(capacity, np.float64)
-        self._cursor = 0
-        self._count = 0
+        # the shared O(1) ring (telemetry.ring.Ring): ``add`` is O(1) on
+        # the scheduler's per-token hot path (a list with pop(0) is
+        # O(capacity) per sample once the window fills). Order within
+        # the window is irrelevant to every summary statistic.
+        self._ring = Ring(capacity)
 
     def add(self, seconds: float) -> None:
-        self._ring[self._cursor] = seconds
-        self._cursor = (self._cursor + 1) % self._cap
-        self._count += 1
+        self._ring.append(seconds)
+
+    @property
+    def _count(self) -> int:
+        return self._ring.total
 
     def summary(self) -> Dict[str, float]:
         """``{count, mean_ms, p50_ms, p90_ms, p99_ms, max_ms}`` over the
         retained window (empty dict before the first sample)."""
-        if not self._count:
+        if not self._ring.total:
             return {}
-        v = self._ring[:min(self._count, self._cap)] * 1e3
+        v = self._ring.array() * 1e3
         return {
-            "count": float(self._count),
+            "count": float(self._ring.total),
             "mean_ms": float(v.mean()),
             "p50_ms": float(np.percentile(v, 50)),
             "p90_ms": float(np.percentile(v, 90)),

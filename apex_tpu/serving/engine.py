@@ -106,6 +106,7 @@ class Engine:
         self.engine_cfg = ecfg
         self._mesh = mesh
         self._params = params
+        self._sentinel = None  # lazily via recompile_sentinel()
         self._build()
         self.cache, self.state = self._init(params)
 
@@ -281,3 +282,60 @@ class Engine:
             size = getattr(fn, "_cache_size", None)
             out[name] = size() if callable(size) else None
         return out
+
+    # -- recompile sentinel (apex_tpu.telemetry.recompile) -----------------
+
+    def recompile_sentinel(self, registry=None):
+        """The engine's installed
+        :class:`apex_tpu.telemetry.recompile.RecompileSentinel`, created
+        on first call with all four compiled programs tracked (so
+        ``compiles_total()["tracked"]`` attributes growth to
+        init/step/admit/retire by name). Pass ``registry`` on the first
+        call to mirror compile/alarm counters into ``/metrics`` —
+        passing it once a registry-less sentinel exists raises rather
+        than silently dropping the wiring (the counters would simply
+        never appear in scrapes)."""
+        if self._sentinel is not None and registry is not None \
+                and registry is not self._sentinel.registry:
+            raise ValueError(
+                "this engine's recompile sentinel already exists (an "
+                "earlier recompile_sentinel()/recompile_guard() call) "
+                "and cannot adopt a different registry retroactively; "
+                "pass registry on the FIRST call, or engine.close() to "
+                "discard the old sentinel")
+        if self._sentinel is None:
+            from apex_tpu.telemetry.recompile import RecompileSentinel
+
+            sentinel = RecompileSentinel(registry=registry).install()
+            for name in ("init", "step", "admit", "retire"):
+                sentinel.track(name, getattr(self, f"_{name}"))
+            self._sentinel = sentinel
+        return self._sentinel
+
+    def recompile_guard(self, *, raise_on_recompile: bool = True,
+                        registry=None):
+        """Arm the never-recompile-after-warmup invariant: enter the
+        returned context once every program has compiled (one admit +
+        one step + one retire cover it) and any later compilation —
+        process-wide event or growth of this engine's program caches —
+        increments the alarm counter and (by default) raises
+        :class:`~apex_tpu.telemetry.recompile.RecompileError`::
+
+            engine/scheduler warmup ...
+            with engine.recompile_guard():
+                serve_forever()
+        """
+        return self.recompile_sentinel(registry=registry).guard(
+            raise_on_recompile=raise_on_recompile)
+
+    def close(self) -> None:
+        """Release process-wide telemetry hooks — the recompile
+        sentinel's ``jax.monitoring`` listener stays registered for
+        process lifetime otherwise, so engines created in a loop (the
+        bench's chunk sweep, a service rebuilding on config reload)
+        must close the old one. Idempotent; the engine itself remains
+        usable, and a later :meth:`recompile_sentinel` call reinstalls
+        a fresh sentinel."""
+        if self._sentinel is not None:
+            self._sentinel.uninstall()
+            self._sentinel = None

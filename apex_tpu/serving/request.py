@@ -17,6 +17,11 @@ FINISH_EOS = "eos"
 FINISH_LENGTH = "length"
 FINISH_TIMEOUT = "timeout"
 
+#: every finish reason, in release-path order — label values for the
+#: scheduler's ``serving_requests_finished_total`` counter (pre-created
+#: per reason so a scrape shows explicit zeros, not absent series)
+FINISH_REASONS = (FINISH_EOS, FINISH_LENGTH, FINISH_TIMEOUT)
+
 
 @dataclasses.dataclass(frozen=True)
 class SamplingParams:

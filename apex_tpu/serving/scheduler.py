@@ -9,6 +9,15 @@ TTFT, per-token latency, queue depth, slot occupancy, tokens/s —
 aggregated via :class:`apex_tpu.profiler.LatencyStats` and emitted
 through a :class:`apex_tpu.profiler.MetricsLogger` when one is given.
 
+Observability (``apex_tpu.telemetry``): pass ``registry`` to count
+admissions / finishes-by-reason / tokens and observe TTFT + per-token
+latency into SLO-bucketed histograms (scrapeable live via
+``telemetry.http.MetricsServer``), and ``spans`` to record each
+request's phase timeline (queued → prefill → first_token → decode
+chunks → retired) plus engine-dispatch sections, exportable as
+Chrome-trace JSON. Both are pre-bound at construction so the per-token
+hot path pays an attribute access and an add, nothing more.
+
 The boundary fix the engine relies on: a request whose prompt already
 ends in its eos token completes at ``submit`` time with zero generated
 tokens — it never occupies a slot (admitting it would burn
@@ -26,15 +35,57 @@ from apex_tpu.serving.engine import Engine
 from apex_tpu.serving.request import (
     FINISH_EOS,
     FINISH_LENGTH,
+    FINISH_REASONS,
     FINISH_TIMEOUT,
     Completion,
     Request,
     StreamEvent,
 )
+from apex_tpu.telemetry import spans as spans_mod
 
 
 class QueueFull(RuntimeError):
     """Backpressure signal: the request queue is at ``max_queue``."""
+
+
+class _RegistryMetrics:
+    """Pre-bound registry handles — children resolved once here so the
+    scheduler's per-token path never does a name/label lookup."""
+
+    def __init__(self, registry, slots: int):
+        self.queue_depth = registry.gauge(
+            "serving_queue_depth", "requests waiting for a slot")
+        self.active_slots = registry.gauge(
+            "serving_active_slots", "decode slots currently occupied")
+        registry.gauge(
+            "serving_slots_total", "decode slots in the engine"
+        ).set(slots)
+        self.submitted = registry.counter(
+            "serving_requests_submitted_total", "requests accepted into "
+            "the queue (or completed at submit)")
+        self.admitted = registry.counter(
+            "serving_requests_admitted_total",
+            "requests prefilled into a slot")
+        fin = registry.counter(
+            "serving_requests_finished_total",
+            "completed requests by finish reason", labels=("reason",))
+        # pre-create every reason so a scrape shows explicit zeros
+        self.finished = {r: fin.labels(reason=r) for r in FINISH_REASONS}
+        self.queue_expired = registry.counter(
+            "serving_queue_expired_total",
+            "requests that blew their deadline while still queued")
+        self.tokens = registry.counter(
+            "serving_tokens_emitted_total", "generated tokens streamed")
+        self.steps = registry.counter(
+            "serving_scheduler_steps_total", "scheduler ticks")
+        self.ttft = registry.histogram(
+            "serving_ttft_seconds", "arrival to first token")
+        self.token_latency = registry.histogram(
+            "serving_token_latency_seconds",
+            "per-token steady-decode latency (chunk wall time / chunk "
+            "tokens)")
+        self.request_latency = registry.histogram(
+            "serving_request_latency_seconds", "arrival to completion")
 
 
 class _Active:
@@ -63,11 +114,22 @@ class Scheduler:
 
     def __init__(self, engine: Engine, *, max_queue: int = 256,
                  metrics: Optional[profiler.MetricsLogger] = None,
+                 registry=None, spans=None,
                  clock: Callable[[], float] = time.monotonic):
         self.engine = engine
         self.max_queue = max_queue
         self.metrics = metrics
         self.clock = clock
+        #: telemetry sinks (both optional): a telemetry.Registry the
+        #: scheduler counts/observes into, and a telemetry.SpanRecorder
+        #: receiving per-request phase marks + dispatch sections. The
+        #: recorder's clock is slaved to the scheduler's so injected
+        #: test clocks produce deterministic timelines.
+        self.telemetry = (None if registry is None
+                          else _RegistryMetrics(registry, engine.slots))
+        self.spans = spans
+        if spans is not None:
+            spans.clock = self.clock
         self.queue: Deque[Request] = collections.deque()
         self.active: Dict[int, _Active] = {}
         self.completions: Dict[str, Completion] = {}
@@ -121,12 +183,19 @@ class Scheduler:
         request.arrival_time = now
         if (request.eos_token_id is not None
                 and prompt[-1] == request.eos_token_id):
+            if self.telemetry is not None:
+                self.telemetry.submitted.inc()
             self._complete(request, [], FINISH_EOS, ttft=None, now=now)
             return
         if len(self.queue) >= self.max_queue:
             raise QueueFull(
                 f"queue at capacity ({self.max_queue}); retry later")
         self.queue.append(request)
+        if self.telemetry is not None:
+            self.telemetry.submitted.inc()
+            self.telemetry.queue_depth.set(len(self.queue))
+        if self.spans is not None:
+            self.spans.mark(request.request_id, spans_mod.PHASE_QUEUED)
 
     # -- the loop ----------------------------------------------------------
 
@@ -146,9 +215,17 @@ class Scheduler:
             before = self.clock()
             tokens, finished = self.engine.step()
             dt = self.clock() - before
+            if self.spans is not None:
+                # one section per dispatch + a decode mark per slot
+                # that rode the chunk (each O(1) ring appends)
+                self.spans.section_at("engine.step", before, before + dt)
+                for act in self.active.values():
+                    self.spans.mark(act.request.request_id,
+                                    spans_mod.PHASE_DECODE)
             n_cols = tokens.shape[1]
             per_tok = dt / n_cols
             self._decode_time += dt
+            tele = self.telemetry
             for j in range(n_cols):
                 # slots released at an earlier column drop out of
                 # active; their remaining columns are pad by contract
@@ -159,6 +236,9 @@ class Scheduler:
                     self._tokens_emitted += 1
                     self._decode_tokens += 1
                     self.token_latency_stats.add(per_tok)
+                    if tele is not None:
+                        tele.tokens.inc()
+                        tele.token_latency.observe(per_tok)
                     done = bool(finished[slot, j])
                     reason = None
                     if done:
@@ -171,6 +251,10 @@ class Scheduler:
                     if done:
                         self._release(slot, reason)
         self._steps += 1
+        if self.telemetry is not None:
+            self.telemetry.steps.inc()
+            self.telemetry.queue_depth.set(len(self.queue))
+            self.telemetry.active_slots.set(len(self.active))
         if self.metrics is not None:
             elapsed = max(self.clock() - self._started, 1e-9)
             self.metrics.log(self._steps, {
@@ -216,6 +300,8 @@ class Scheduler:
         dl = request.deadline
         if dl is None or now < dl:
             return False
+        if self.telemetry is not None:
+            self.telemetry.queue_expired.inc()
         self._complete(request, [], FINISH_TIMEOUT, ttft=None, now=now)
         self.events.append(StreamEvent(
             request.request_id, None, True, FINISH_TIMEOUT))
@@ -226,6 +312,11 @@ class Scheduler:
             request = self.queue.popleft()
             slot = self._free.pop()
             sp = request.sampling
+            if self.spans is not None:
+                self.spans.mark(request.request_id,
+                                spans_mod.PHASE_PREFILL,
+                                note=f"slot {slot}")
+                t_admit = self.clock()
             first, hit_eos, done = self.engine.admit(
                 slot, request.prompt, request.max_tokens,
                 temperature=sp.temperature, top_k=sp.top_k, top_p=sp.top_p,
@@ -237,6 +328,15 @@ class Scheduler:
             act.tokens.append(first)
             self._tokens_emitted += 1
             self.ttft_stats.add(t_first - request.arrival_time)
+            if self.spans is not None:
+                self.spans.section_at("engine.admit", t_admit, t_first)
+                self.spans.mark(request.request_id,
+                                spans_mod.PHASE_FIRST_TOKEN)
+            if self.telemetry is not None:
+                self.telemetry.admitted.inc()
+                self.telemetry.tokens.inc()
+                self.telemetry.queue_depth.set(len(self.queue))
+                self.telemetry.ttft.observe(t_first - request.arrival_time)
             reason = None
             if done:
                 reason = FINISH_EOS if hit_eos else FINISH_LENGTH
@@ -266,13 +366,24 @@ class Scheduler:
             # finished event (no token)
             self.events.append(StreamEvent(
                 request.request_id, None, True, reason))
+        if self.telemetry is not None:
+            self.telemetry.finished[reason].inc()
+            self.telemetry.request_latency.observe(comp.latency)
+        if self.spans is not None:
+            self.spans.mark(request.request_id, spans_mod.PHASE_RETIRED,
+                            note=reason)
         if self.metrics is not None:
-            self.metrics.log(self._steps, {
+            # no value for "no first token" — a -1.0 ttft sentinel
+            # silently poisons any downstream mean/percentile, so the
+            # key is simply absent for zero-token completions
+            rec = {
                 "completed": 1.0,
                 "n_tokens": float(len(tokens)),
-                "ttft_s": -1.0 if ttft is None else ttft,
                 "latency_s": comp.latency,
-            })
+            }
+            if ttft is not None:
+                rec["ttft_s"] = ttft
+            self.metrics.log(self._steps, rec)
 
     # -- reporting ---------------------------------------------------------
 

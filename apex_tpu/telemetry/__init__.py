@@ -1,0 +1,68 @@
+"""apex_tpu.telemetry — system-wide observability.
+
+The reference stack leaned on external nsys/nvprof with scattered event
+timings (SURVEY.md §5); ``apex_tpu.profiler`` made capture first-class,
+and this package makes *reporting* first-class — one layer every other
+layer funnels through:
+
+- :mod:`apex_tpu.telemetry.ring`      — the O(1) fixed-window ring
+  buffer behind every bounded history in the repo,
+- :mod:`apex_tpu.telemetry.registry`  — Counter / Gauge / Histogram
+  with labels and fixed SLO buckets; Prometheus-text + JSON snapshots.
+  Training metrics (via ``profiler.MetricsLogger(registry=...)``) and
+  serving metrics (``Scheduler(registry=...)``) share it,
+- :mod:`apex_tpu.telemetry.spans`     — per-request span timelines
+  (queued → prefill → first_token → decode chunks → retired) exported
+  as Chrome-trace JSON, viewable in Perfetto next to device captures,
+- :mod:`apex_tpu.telemetry.recompile` — the recompile sentinel: count
+  executable materialisations via ``jax.monitoring`` and arm a
+  :class:`~apex_tpu.telemetry.recompile.RecompileGuard` after warmup so
+  the serving engine's never-recompile invariant is a runtime
+  guarantee, not a code-review note,
+- :mod:`apex_tpu.telemetry.http`      — ``/metrics`` (Prometheus),
+  ``/healthz``, ``/vars`` from a stdlib daemon-thread server.
+
+Dependency-free by contract: no torch, no tensorboard (a tier-1 test
+imports every module here with both purged); ``recompile`` is the only
+module that imports jax. Submodules load lazily (PEP 562) so
+``from apex_tpu.telemetry.ring import Ring`` costs exactly one module.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "ring", "registry", "spans", "recompile", "http",
+    "Ring", "Registry", "DEFAULT_BUCKETS", "parse_prometheus_text",
+    "SpanRecorder", "RecompileSentinel", "RecompileGuard",
+    "RecompileError", "MetricsServer",
+]
+
+_LAZY = {
+    "ring": "apex_tpu.telemetry.ring",
+    "registry": "apex_tpu.telemetry.registry",
+    "spans": "apex_tpu.telemetry.spans",
+    "recompile": "apex_tpu.telemetry.recompile",
+    "http": "apex_tpu.telemetry.http",
+    "Ring": "apex_tpu.telemetry.ring",
+    "Registry": "apex_tpu.telemetry.registry",
+    "DEFAULT_BUCKETS": "apex_tpu.telemetry.registry",
+    "parse_prometheus_text": "apex_tpu.telemetry.registry",
+    "SpanRecorder": "apex_tpu.telemetry.spans",
+    "RecompileSentinel": "apex_tpu.telemetry.recompile",
+    "RecompileGuard": "apex_tpu.telemetry.recompile",
+    "RecompileError": "apex_tpu.telemetry.recompile",
+    "MetricsServer": "apex_tpu.telemetry.http",
+}
+
+
+def __getattr__(name: str):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    mod = importlib.import_module(target)
+    value = mod if target.endswith("." + name) else getattr(mod, name)
+    globals()[name] = value
+    return value

@@ -1,0 +1,119 @@
+"""Live exposition — ``/metrics``, ``/healthz``, ``/vars`` from a
+background thread.
+
+The ROADMAP north star serves heavy traffic; an operator's first three
+questions about a live process are "is it up", "what are the numbers",
+and "what is it doing right now". This answers all three with zero
+dependencies (stdlib ``http.server`` on a daemon thread):
+
+- ``/metrics``  — Prometheus text 0.0.4 from the registry (scrape it),
+- ``/healthz``  — ``ok`` + 200 (wire it to a load-balancer check),
+- ``/vars``     — one JSON snapshot: registry dict + span-recorder
+  summary + recompile-sentinel counters + any caller extras (the
+  human-curl endpoint).
+
+``port=0`` binds an ephemeral port (tests; ``server.port`` tells you
+what you got). The handler only reads snapshot methods that take their
+own locks, so scrapes never block the serving hot path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsServer:
+    """Serve a registry (and optionally spans / recompile state) over
+    HTTP until ``stop()``.
+
+    >>> server = MetricsServer(registry, port=9090).start()
+    >>> # curl localhost:9090/metrics
+    >>> server.stop()
+    """
+
+    def __init__(self, registry, *, host: str = "127.0.0.1",
+                 port: int = 0, spans=None, sentinel=None,
+                 extra_vars: Optional[Callable[[], Dict[str, Any]]] = None):
+        self.registry = registry
+        self.spans = spans
+        self.sentinel = sentinel
+        self.extra_vars = extra_vars
+        self._host = host
+        self._requested_port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "MetricsServer":
+        if self._httpd is not None:
+            return self
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # silence per-request spam
+                pass
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = server.registry.to_prometheus_text() \
+                        .encode("utf-8")
+                    ctype = PROMETHEUS_CONTENT_TYPE
+                elif path == "/healthz":
+                    body, ctype = b"ok\n", "text/plain; charset=utf-8"
+                elif path == "/vars":
+                    body = json.dumps(server.vars(), indent=1,
+                                      sort_keys=True).encode("utf-8")
+                    ctype = "application/json"
+                else:
+                    self.send_error(404, "try /metrics /healthz /vars")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer(
+            (self._host, self._requested_port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="apex-tpu-metrics",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None
+
+    # -- views --------------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            raise RuntimeError("server not started")
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self.port}"
+
+    def vars(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"metrics": self.registry.to_dict()}
+        if self.spans is not None:
+            out["spans"] = self.spans.summary()
+        if self.sentinel is not None:
+            out["recompile"] = self.sentinel.compiles_total()
+        if self.extra_vars is not None:
+            out.update(self.extra_vars())
+        return out

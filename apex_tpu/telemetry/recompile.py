@@ -1,0 +1,242 @@
+"""Recompile sentinel — turn "never recompile after warmup" into a
+monitored runtime guarantee.
+
+The serving engine's whole design rests on one invariant: after warmup
+its compiled programs are trace-stable, so the steady state never eats
+a multi-second XLA compile (``apex_tpu/serving/engine.py``). Until now
+that invariant was a code-review property plus a jit-cache-size assert
+in tests; this module makes it observable and enforceable at runtime:
+
+- :class:`RecompileSentinel` subscribes to the runtime's compile-event
+  stream (``jax.monitoring`` via
+  :func:`apex_tpu._compat.register_monitoring_listeners`) and counts
+  executable materialisations process-wide —
+  ``/jax/core/compile/backend_compile_duration`` fires on fresh
+  compiles AND persistent-cache loads, never on in-memory jit-cache
+  hits, so it is exactly "a program the warmup didn't cover". Tracked
+  functions (``sentinel.track(name, jitted_fn)``) add per-function
+  attribution by polling ``_cache_size`` — also the complete fallback
+  on legacy runtimes without ``jax.monitoring``.
+- :class:`RecompileGuard` is the armed form: entered after warmup, any
+  compile event (or tracked-function cache growth) increments an alarm
+  counter and — configurably — raises :class:`RecompileError` naming
+  what grew. The engine hands one out via ``Engine.recompile_guard()``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from apex_tpu import _compat
+
+#: the duration event that marks a new executable materialising
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+#: lowering happens once per new traced variant — the cache-miss
+#: counter that backs the legacy fallback's cross-check
+LOWERING_EVENT = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+class RecompileError(RuntimeError):
+    """An armed :class:`RecompileGuard` observed a compilation."""
+
+
+def _cache_size(fn) -> Optional[int]:
+    size = getattr(fn, "_cache_size", None)
+    return size() if callable(size) else None
+
+
+class RecompileSentinel:
+    """Process-wide compile counters + per-function attribution.
+
+    >>> sentinel = RecompileSentinel().install()
+    >>> sentinel.track("step", engine._step)
+    >>> ... warmup ...
+    >>> with sentinel.guard():          # steady state: no compiles
+    ...     serve_forever()
+
+    When ``registry`` is given, counters mirror into it:
+    ``jax_compiles_total``, ``jax_lowerings_total``,
+    ``jax_compile_seconds_total``, ``recompile_alarms_total``.
+    """
+
+    def __init__(self, registry=None):
+        #: the registry the counters mirror into (None = unmirrored);
+        #: exposed so owners can tell "already wired to X" from "never
+        #: wired"
+        self.registry = registry
+        self._lock = threading.Lock()
+        self._counts = {"backend_compiles": 0, "lowerings": 0,
+                        "cache_hits": 0, "cache_misses": 0}
+        self._compile_seconds = 0.0
+        self._tracked: Dict[str, Any] = {}
+        self._unregister: Optional[Callable[[], None]] = None
+        self._installed = False
+        self.monitoring_available = False
+        self._guards: List["RecompileGuard"] = []
+        self._m_compiles = self._m_lowerings = None
+        self._m_compile_secs = self._m_alarms = None
+        if registry is not None:
+            self._m_compiles = registry.counter(
+                "jax_compiles_total",
+                "executables materialised (fresh compile or "
+                "persistent-cache load)")
+            self._m_lowerings = registry.counter(
+                "jax_lowerings_total", "jaxpr-to-MLIR lowerings (one per "
+                "new traced variant)")
+            self._m_compile_secs = registry.counter(
+                "jax_compile_seconds_total",
+                "wall seconds spent materialising executables")
+            self._m_alarms = registry.counter(
+                "recompile_alarms_total",
+                "compiles observed while a RecompileGuard was armed")
+
+    # -- listener plumbing --------------------------------------------------
+
+    def install(self) -> "RecompileSentinel":
+        """Subscribe to compile events (idempotent). Without
+        ``jax.monitoring`` this is a no-op and only tracked-function
+        cache polling is live (``monitoring_available`` says which)."""
+        if not self._installed:
+            self._unregister = _compat.register_monitoring_listeners(
+                self._on_event, self._on_duration)
+            self.monitoring_available = self._unregister is not None
+            self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if self._unregister is not None:
+            self._unregister()
+            self._unregister = None
+        self._installed = False
+        self.monitoring_available = False
+
+    def _on_event(self, name: str, **kw) -> None:
+        if name == CACHE_HIT_EVENT:
+            with self._lock:
+                self._counts["cache_hits"] += 1
+        elif name == CACHE_MISS_EVENT:
+            with self._lock:
+                self._counts["cache_misses"] += 1
+
+    def _on_duration(self, name: str, seconds: float, **kw) -> None:
+        if name == BACKEND_COMPILE_EVENT:
+            with self._lock:
+                self._counts["backend_compiles"] += 1
+                self._compile_seconds += seconds
+                guards = list(self._guards)
+            if self._m_compiles is not None:
+                self._m_compiles.inc()
+                self._m_compile_secs.inc(seconds)
+            for g in guards:
+                g._alarm(f"compile event {name} ({seconds:.3f}s)")
+            # one observed breach per event, however many guards are
+            # armed — per-guard increments would overstate it
+            if guards and self._m_alarms is not None:
+                self._m_alarms.inc()
+        elif name == LOWERING_EVENT:
+            with self._lock:
+                self._counts["lowerings"] += 1
+            if self._m_lowerings is not None:
+                self._m_lowerings.inc()
+
+    # -- attribution --------------------------------------------------------
+
+    def track(self, name: str, fn) -> None:
+        """Attribute compiles to ``name`` by polling ``fn._cache_size``
+        (any ``jax.jit`` result). Snapshot deltas are per-function
+        ``compiles_total`` — and the whole mechanism on legacy runtimes
+        without monitoring."""
+        self._tracked[name] = fn
+
+    def compiles_total(self) -> Dict[str, Any]:
+        """Counter snapshot: process-wide event counts plus per-tracked
+        -function jit-cache sizes."""
+        with self._lock:
+            out: Dict[str, Any] = dict(self._counts)
+            out["compile_seconds"] = self._compile_seconds
+        out["monitoring_available"] = self.monitoring_available
+        out["tracked"] = {name: _cache_size(fn)
+                          for name, fn in self._tracked.items()}
+        return out
+
+    def guard(self, *, raise_on_recompile: bool = True) -> "RecompileGuard":
+        return RecompileGuard(self, raise_on_recompile=raise_on_recompile)
+
+
+class RecompileGuard:
+    """Armed context: entering snapshots the sentinel, any compile while
+    inside increments ``alarms`` (and the registry alarm counter), and
+    ``check()`` / ``__exit__`` raise :class:`RecompileError` when
+    ``raise_on_recompile`` (the default) and anything grew."""
+
+    def __init__(self, sentinel: RecompileSentinel, *,
+                 raise_on_recompile: bool = True):
+        self._sentinel = sentinel
+        self._raise = raise_on_recompile
+        self._baseline: Optional[Dict[str, Any]] = None
+        self.alarms: List[str] = []
+
+    def __enter__(self) -> "RecompileGuard":
+        self._sentinel.install()
+        self._baseline = self._sentinel.compiles_total()
+        with self._sentinel._lock:
+            self._sentinel._guards.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        with self._sentinel._lock:
+            if self in self._sentinel._guards:
+                self._sentinel._guards.remove(self)
+        if exc_type is None:
+            # always check on exit: with raise_on_recompile=False this
+            # still records the breach in alarms / the alarm counter
+            # (the only detection path on runtimes where tracked-cache
+            # polling is the signal)
+            self.check()
+
+    def _alarm(self, detail: str) -> None:
+        self.alarms.append(detail)
+
+    @property
+    def tripped(self) -> bool:
+        return bool(self.alarms) or bool(self.delta())
+
+    def delta(self) -> Dict[str, Any]:
+        """What grew since ``__enter__``: event-count increases plus
+        tracked functions whose jit cache gained entries."""
+        if self._baseline is None:
+            raise RuntimeError("guard not entered")
+        now = self._sentinel.compiles_total()
+        out: Dict[str, Any] = {}
+        if now["backend_compiles"] > self._baseline["backend_compiles"]:
+            out["backend_compiles"] = (
+                now["backend_compiles"] - self._baseline["backend_compiles"])
+        grew = {}
+        for name, size in now["tracked"].items():
+            base = self._baseline["tracked"].get(name)
+            if size is not None and base is not None and size > base:
+                grew[name] = size - base
+        if grew:
+            out["tracked"] = grew
+        return out
+
+    def check(self) -> Dict[str, Any]:
+        """Raise (or return) the delta. Call mid-flight for prompt
+        failure; ``__exit__`` calls it for you."""
+        delta = self.delta()
+        if delta and not self.alarms:
+            # breach seen only through cache polling (legacy runtime,
+            # or growth the event stream missed): record it so the
+            # alarm list and counter reflect it even without raising
+            self._alarm(f"tracked-cache growth {delta}")
+            if self._sentinel._m_alarms is not None:
+                self._sentinel._m_alarms.inc()
+        if delta and self._raise:
+            raise RecompileError(
+                f"compilation inside a RecompileGuard — the "
+                f"trace-stability invariant is broken: {delta}; "
+                f"alarms: {self.alarms}")
+        return delta

@@ -35,17 +35,24 @@ from apex_tpu.optimizers import fused_adam
 BASELINE_TOKENS_PER_SEC = 58600.0
 
 
-def serve():
+def serve(telemetry_out=None):
     """Serving throughput/latency at a fixed seeded request trace: one
     JSON line with tokens/s, the TTFT-vs-steady-decode split, and a
     ``decode_chunk`` sweep (chunked device-side decode loop,
     ``gpt.decode_steps``) — the serving-side companion of the training
-    number, trajectory-trackable per chunk setting."""
+    number, trajectory-trackable per chunk setting.
+
+    ``telemetry_out``: dump a telemetry-registry snapshot of the
+    headline (chunk=8) trace, replayed instrumented AFTER the measured
+    sweep so the throughput numbers stay flag-independent — ``"-"``
+    embeds it in the JSON line under ``"telemetry"``, any other value
+    writes that path."""
     import dataclasses
 
     from apex_tpu.serving import Request, SamplingParams
     from apex_tpu.serving.engine import Engine, EngineConfig
     from apex_tpu.serving.scheduler import Scheduler
+    from apex_tpu.telemetry.registry import Registry
 
     on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
@@ -109,8 +116,18 @@ def serve():
     # the chunk knob must not change a single emitted token
     assert all(tokens_by_chunk[c] == tokens_by_chunk[1]
                for c in tokens_by_chunk), "chunk sweep token drift"
+    if telemetry_out:
+        # snapshot from a SEPARATE instrumented replay of the headline
+        # (chunk=8) trace on the already-warm engine — the measured
+        # sweep above stays uninstrumented, so the trajectory metric is
+        # comparable whether or not this flag is passed
+        registry = Registry()
+        sched = Scheduler(engine, registry=registry)
+        for r in trace(100, n_requests):
+            sched.submit(r)
+        sched.run_until_idle()
     head = sweep["8"]
-    print(json.dumps({
+    line = {
         "metric": "gpt2_355m_serve_tokens_per_sec_per_chip" if on_tpu
         else "gpt_serve_smoke_cpu_tokens_per_sec",
         "value": head["tokens_per_sec"],
@@ -125,7 +142,14 @@ def serve():
         "decode_tokens_per_sec": head["decode_tokens_per_sec"],
         "token_latency_mean_ms": head["token_latency_mean_ms"],
         "chunk_sweep": sweep,
-    }))
+    }
+    if telemetry_out == "-":
+        line["telemetry"] = registry.to_dict()
+    elif telemetry_out:
+        with open(telemetry_out, "w") as f:
+            json.dump(registry.to_dict(), f, indent=1, sort_keys=True)
+        line["telemetry_out"] = telemetry_out
+    print(json.dumps(line))
 
 
 def main():
@@ -194,5 +218,10 @@ if __name__ == "__main__":
                     help="train (default): whole-step training "
                     "throughput; serve: continuous-batching decode "
                     "throughput + TTFT/latency at a fixed request trace")
+    ap.add_argument("--telemetry-out", metavar="PATH", default=None,
+                    help="serve mode: dump the telemetry-registry "
+                    "snapshot of the headline run — '-' embeds it in "
+                    "the JSON line, anything else writes that file")
     args = ap.parse_args()
-    serve() if args.mode == "serve" else main()
+    serve(telemetry_out=args.telemetry_out) if args.mode == "serve" \
+        else main()

@@ -18,6 +18,14 @@ Run (CPU simulation; omit --requests for a synthetic trace):
   PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python examples/serve_gpt.py --tp 2 --slots 2
+
+Observability (``apex_tpu.telemetry``): ``--metrics-port N`` serves
+``/metrics`` (Prometheus text), ``/healthz``, and ``/vars`` (JSON incl.
+span + recompile state) from a background thread for the life of the
+process — scrape while it serves, or add ``--metrics-linger S`` to keep
+the endpoint up after the batch drains. ``--span-trace out.json``
+writes the per-request span timeline as Chrome-trace JSON (open in
+Perfetto next to a ``profiler.trace`` device capture).
 """
 
 import argparse
@@ -90,6 +98,15 @@ def main():
                     "token streams are identical at any setting")
     ap.add_argument("--ckpt", help=".atck from examples/gpt_train.py "
                     "(--preset tiny); random init if omitted")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve /metrics /healthz /vars on this port "
+                    "(0 = ephemeral, printed at startup)")
+    ap.add_argument("--metrics-linger", type=float, default=0.0,
+                    help="keep the metrics endpoint up this many "
+                    "seconds after the batch drains")
+    ap.add_argument("--span-trace", metavar="PATH", default=None,
+                    help="write the per-request span timeline as "
+                    "Chrome-trace JSON (view in Perfetto)")
     args = ap.parse_args()
 
     cfg = gpt.GPTConfig(vocab_size=1024, hidden_size=128, num_layers=4,
@@ -116,9 +133,30 @@ def main():
     reqs = (load_requests(args.requests, cfg.vocab_size) if args.requests
             else synthetic_requests(args.num_requests, 8, args.max_tokens,
                                     cfg.vocab_size))
+
+    # telemetry: spans whenever a trace is requested; the registry +
+    # process-wide recompile sentinel only when there is a /metrics
+    # endpoint to export them through (counters nobody can scrape are
+    # pure per-token overhead)
+    registry = spans = server = None
+    if args.span_trace or args.metrics_port is not None:
+        from apex_tpu.telemetry import SpanRecorder
+
+        spans = SpanRecorder()
+    if args.metrics_port is not None:
+        from apex_tpu.telemetry import MetricsServer, Registry
+
+        registry = Registry()
+        engine.recompile_sentinel(registry=registry)
+        server = MetricsServer(
+            registry, port=args.metrics_port, spans=spans,
+            sentinel=engine.recompile_sentinel()).start()
+        print(f"metrics: {server.url}/metrics  /healthz  /vars")
+
     # offline batch mode submits everything up front — size the queue to
     # the trace instead of dying on backpressure at the default 256
-    sched = Scheduler(engine, max_queue=max(256, len(reqs)))
+    sched = Scheduler(engine, max_queue=max(256, len(reqs)),
+                      registry=registry, spans=spans)
     for r in reqs:
         sched.submit(r)
     sched.run_until_idle()
@@ -128,6 +166,19 @@ def main():
               f"{list(r.prompt)} -> {c.tokens}")
     print("served " + json.dumps(
         {k: round(v, 3) for k, v in sched.summary().items()}))
+    if args.span_trace:
+        with open(args.span_trace, "w") as f:
+            json.dump(spans.to_chrome_trace(), f)
+        print(f"span trace: {args.span_trace} "
+              f"({spans.summary()['events']} events)")
+    if server is not None:
+        if args.metrics_linger > 0:
+            import time
+
+            print(f"metrics endpoint lingering {args.metrics_linger}s "
+                  f"at {server.url}")
+            time.sleep(args.metrics_linger)
+        server.stop()
 
 
 if __name__ == "__main__":

@@ -222,6 +222,59 @@ def test_latency_stats_ring_wraparound():
     assert s["p99_ms"] <= s["max_ms"]
 
 
+def test_step_timer_window_is_ring(tmp_path):
+    """StepTimer windows through the shared O(1) ring: the window caps
+    at ``window`` retaining the most recent ticks, reset clears, and
+    publish() mirrors the summary into registry gauges."""
+    from apex_tpu.telemetry import Registry
+    from apex_tpu.telemetry.ring import Ring
+
+    timer = profiler.StepTimer(tokens_per_step=10, window=3)
+    assert isinstance(timer._times, Ring)
+    for _ in range(6):
+        timer.tick()
+    s = timer.summary()
+    assert s["steps"] == 3.0  # window kept the most recent 3 of 5
+    assert timer._times.total == 5 and timer._times.dropped == 2
+    reg = Registry()
+    pub = timer.publish(reg)
+    assert pub == s
+    text = reg.to_prometheus_text()
+    assert "train_steps 3" in text
+    assert "train_tokens_per_sec" in text
+    timer.reset()
+    assert timer.summary() == {}
+
+
+def test_metrics_logger_ring_ctx_and_registry(tmp_path):
+    """MetricsLogger: O(1) ring history with the oldest dropped at
+    capacity, context-manager close, registry gauge mirroring with
+    sanitized names — and the JSONL line format byte-stable."""
+    import json
+
+    from apex_tpu.telemetry import Registry
+
+    reg = Registry()
+    jsonl = str(tmp_path / "m.jsonl")
+    with profiler.MetricsLogger(jsonl_path=jsonl, history=2,
+                                registry=reg) as log:
+        for i in range(4):
+            log.log(i, {"loss": 4.0 - i, "grad_norm/global": 0.5})
+    assert log._jsonl.closed
+    # ring: most recent 2 of 4, oldest first
+    assert [h["step"] for h in log.history] == [2, 3]
+    # registry view: last value wins, name sanitized to a legal metric
+    assert reg.gauge("loss").value == 1.0
+    assert reg.gauge("grad_norm_global").value == 0.5
+    assert reg.gauge("step").value == 3.0
+    # byte-stable JSONL: same keys, same order, plain floats
+    lines = open(jsonl).read().splitlines()
+    assert json.loads(lines[0]) == {"loss": 4.0,
+                                    "grad_norm/global": 0.5, "step": 0}
+    assert lines[0] == json.dumps({"loss": 4.0, "grad_norm/global": 0.5,
+                                   "step": 0})
+
+
 def test_annotate_and_sync():
     with profiler.annotate("test-range"):
         y = jnp.sum(jnp.arange(10.0))
@@ -284,6 +337,60 @@ def test_op_profile_self_times(tmp_path):
 def test_op_profile_missing_trace(tmp_path):
     with pytest.raises(FileNotFoundError, match="trace.json.gz"):
         profiler.op_profile(str(tmp_path))
+
+
+def test_op_profile_newest_capture_and_nested_streams(tmp_path):
+    """Two capture dirs under one logdir: op_profile parses the newest
+    (by mtime); its fixture nests ops on BOTH cores, so per-stream
+    self-time accounting and category rollup are exercised together."""
+    import gzip
+    import json
+    import os
+    import time
+
+    def write(dirname, events):
+        d = tmp_path / "plugins" / "profile" / dirname
+        os.makedirs(d)
+        path = d / "vm.trace.json.gz"
+        with gzip.open(path, "wt") as f:
+            json.dump({"traceEvents": events}, f)
+        return path
+
+    meta = []
+    for pid in (3, 4):
+        meta += [
+            {"ph": "M", "pid": pid, "name": "process_name",
+             "args": {"name": f"/device:TPU:{pid - 3}"}},
+            {"ph": "M", "pid": pid, "tid": 1, "name": "thread_name",
+             "args": {"name": "XLA Ops"}},
+        ]
+    write("2026_01_01_00_00_00", meta + [
+        {"ph": "X", "pid": 3, "tid": 1, "name": "stale.1", "ts": 0,
+         "dur": 50, "args": {"hlo_category": "loop fusion"}}])
+    time.sleep(0.05)  # distinct mtimes
+    # newest capture: a while on each core, each containing one fusion
+    newest = write("2026_01_01_00_00_59", meta + [
+        {"ph": "X", "pid": 3, "tid": 1, "name": "while.a", "ts": 0,
+         "dur": 100, "args": {"hlo_category": "while"}},
+        {"ph": "X", "pid": 3, "tid": 1, "name": "fusion.a", "ts": 20,
+         "dur": 30, "args": {"hlo_category": "loop fusion"}},
+        {"ph": "X", "pid": 4, "tid": 1, "name": "while.b", "ts": 10,
+         "dur": 60, "args": {"hlo_category": "while"}},
+        {"ph": "X", "pid": 4, "tid": 1, "name": "fusion.b", "ts": 30,
+         "dur": 20, "args": {"hlo_category": "convolution fusion"}},
+    ])
+    prof = profiler.op_profile(str(tmp_path))
+    assert prof["trace_path"] == str(newest)
+    by_name = {o["name"]: o for o in prof["top_ops"]}
+    assert "stale.1" not in by_name
+    # self-time = parent minus its own core's child only
+    assert by_name["while.a"]["seconds"] == pytest.approx(70e-6)
+    assert by_name["while.b"]["seconds"] == pytest.approx(40e-6)
+    assert prof["total_s"] == pytest.approx(160e-6)
+    assert prof["by_category"]["while"] == pytest.approx(110e-6)
+    assert prof["by_category"]["loop fusion"] == pytest.approx(30e-6)
+    assert prof["by_category"]["convolution fusion"] == \
+        pytest.approx(20e-6)
 
 
 def test_op_profile_multi_device_streams(tmp_path):

@@ -301,7 +301,10 @@ def test_engine_tp2_matches_tp1(devices8):
 
 def test_scheduler_metrics_and_summary(devices8, tmp_path):
     """Serving metrics flow through profiler.MetricsLogger, and
-    summary() carries throughput + TTFT/latency percentiles."""
+    summary() carries throughput + TTFT/latency percentiles. A
+    zero-token completion (eos-terminal prompt) OMITS ``ttft_s`` from
+    its record — there is no first token, and the old ``-1.0`` sentinel
+    silently poisoned any downstream aggregation."""
     import json
 
     cfg = _cfg()
@@ -310,22 +313,31 @@ def test_scheduler_metrics_and_summary(devices8, tmp_path):
     eng = Engine(cfg, params, mesh,
                  EngineConfig(slots=2, max_prompt_len=6, max_seq_len=16))
     jsonl = str(tmp_path / "serve.jsonl")
-    logger = profiler.MetricsLogger(jsonl_path=jsonl)
-    sched = Scheduler(eng, metrics=logger)
-    for r in _mixed_requests(3, 6, seed0=400):
-        sched.submit(r)
-    sched.run_until_idle()
-    logger.close()
+    with profiler.MetricsLogger(jsonl_path=jsonl) as logger:
+        sched = Scheduler(eng, metrics=logger)
+        for r in _mixed_requests(3, 6, seed0=400):
+            sched.submit(r)
+        # eos-terminal prompt: completes at submit with no first token
+        sched.submit(Request("term", [5, 9, 7], max_tokens=4,
+                             eos_token_id=7))
+        sched.run_until_idle()
+    assert logger._jsonl.closed  # context manager closed the sink
     s = sched.summary()
-    assert s["requests_completed"] == 3.0
+    assert s["requests_completed"] == 4.0
     assert s["tokens_per_sec"] > 0
     for k in ("ttft_mean_ms", "ttft_p99_ms", "token_latency_mean_ms"):
         assert s[k] >= 0.0
     lines = [json.loads(l) for l in open(jsonl)]
     step_recs = [l for l in lines if "slot_occupancy" in l]
-    comp_recs = [l for l in lines if "ttft_s" in l]
-    assert step_recs and len(comp_recs) == 3
+    comp_recs = [l for l in lines if "completed" in l]
+    assert step_recs and len(comp_recs) == 4
     assert max(l["slot_occupancy"] for l in step_recs) == 1.0
+    with_ttft = [l for l in comp_recs if "ttft_s" in l]
+    assert len(with_ttft) == 3  # the slotted requests
+    assert all(l["ttft_s"] >= 0.0 for l in with_ttft)
+    term = [l for l in comp_recs if l["n_tokens"] == 0.0]
+    assert len(term) == 1 and "ttft_s" not in term[0]
+    assert term[0]["latency_s"] >= 0.0
 
 
 # --- sampling extraction: old-vs-new parity --------------------------------

@@ -1,0 +1,499 @@
+"""apex_tpu.telemetry — registry / spans / recompile sentinel / http.
+
+Headline (the engine-invariant acceptance): drive the serving Engine
+through warmup, arm ``RecompileGuard``, run admit / decode-chunk /
+retire across varied slots and sampling params, and assert
+``compiles_total`` stays flat — then prove a deliberately shape-busting
+call trips the guard. Plus: exposition round trips through a minimal
+Prometheus parser scraped from a LIVE engine, the span timeline exports
+as valid Chrome-trace JSON, and the whole layer imports with
+torch/tensorboard purged (dependency-free by contract).
+"""
+
+import json
+import subprocess
+import sys
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import mesh as mx
+from apex_tpu.models import gpt
+from apex_tpu.serving import Request, SamplingParams
+from apex_tpu.serving.engine import Engine, EngineConfig
+from apex_tpu.serving.request import FINISH_REASONS
+from apex_tpu.serving.scheduler import Scheduler
+from apex_tpu.telemetry import (
+    MetricsServer,
+    RecompileError,
+    Registry,
+    Ring,
+    SpanRecorder,
+    parse_prometheus_text,
+)
+from apex_tpu.telemetry import recompile as rc
+from apex_tpu.telemetry import spans as spans_mod
+from apex_tpu.transformer.testing import standalone_gpt_config
+
+VOCAB = 96
+
+
+# --- ring ------------------------------------------------------------------
+
+
+def test_ring_wraparound_and_order():
+    r = Ring(3)
+    assert len(r) == 0 and r.values() == [] and r.total == 0
+    for i in range(5):
+        r.append(i)
+    assert len(r) == 3 and r.total == 5 and r.dropped == 2
+    assert r.values() == [2, 3, 4]  # oldest first across the wrap
+    # array() is for order-insensitive stats: same multiset, any order
+    assert sorted(r.array()) == [2.0, 3.0, 4.0]
+    r.clear()
+    assert len(r) == 0 and r.total == 0
+    with pytest.raises(ValueError):
+        Ring(0)
+
+
+# --- registry --------------------------------------------------------------
+
+
+def test_registry_counter_gauge_labels():
+    reg = Registry()
+    c = reg.counter("requests_total", "all requests")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError, match="only go up"):
+        c.inc(-1)
+    g = reg.gauge("depth")
+    g.set(4)
+    g.inc()
+    g.dec(2)
+    assert g.value == 3.0
+    lab = reg.counter("finished_total", labels=("reason",))
+    lab.labels(reason="eos").inc()
+    lab.labels(reason="eos").inc()
+    lab.labels(reason="length").inc()
+    assert lab.labels(reason="eos").value == 2.0
+    with pytest.raises(ValueError, match="expected labels"):
+        lab.labels(cause="eos")
+    with pytest.raises(ValueError, match="declares labels"):
+        lab.inc()
+    # create-or-get is idempotent; a conflicting re-registration raises
+    assert reg.counter("requests_total") is c
+    with pytest.raises(ValueError, match="re-registered"):
+        reg.gauge("requests_total")
+    with pytest.raises(ValueError, match="invalid metric name"):
+        reg.counter("bad name")
+
+
+def test_registry_histogram_and_prom_roundtrip():
+    reg = Registry()
+    h = reg.histogram("ttft_seconds", "ttft", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 5.0):
+        h.observe(v)
+    reg.gauge("depth", "queue depth").set(2)
+    reg.counter("finished_total", labels=("reason",)).labels(
+        reason='we"ird\\').inc()
+    # literal backslash followed by 'n' — the escape-adjacency trap
+    reg.counter("paths_total", labels=("path",)).labels(
+        path="C:\\new\nline").inc()
+    text = reg.to_prometheus_text()
+    parsed = parse_prometheus_text(text)
+    assert parsed["ttft_seconds_bucket"][(("le", "0.01"),)] == 1.0
+    assert parsed["ttft_seconds_bucket"][(("le", "0.1"),)] == 3.0
+    assert parsed["ttft_seconds_bucket"][(("le", "1"),)] == 3.0
+    assert parsed["ttft_seconds_bucket"][(("le", "+Inf"),)] == 4.0
+    assert parsed["ttft_seconds_count"][()] == 4.0
+    assert parsed["ttft_seconds_sum"][()] == pytest.approx(5.105)
+    assert parsed["depth"][()] == 2.0
+    # label-value escaping survives the round trip
+    assert parsed["finished_total"][(("reason", 'we"ird\\'),)] == 1.0
+    assert parsed["paths_total"][(("path", "C:\\new\nline"),)] == 1.0
+    # JSON snapshot agrees
+    d = reg.to_dict()
+    json.dumps(d)  # must be JSON-ready
+    assert d["ttft_seconds"]["samples"][0]["count"] == 4
+    assert d["ttft_seconds"]["samples"][0]["buckets"]["+Inf"] == 4
+    with pytest.raises(ValueError, match="sorted"):
+        reg.histogram("bad_seconds", buckets=(1.0, 0.1))
+
+
+# --- spans -----------------------------------------------------------------
+
+
+def test_span_recorder_chrome_trace():
+    t = [0.0]
+    rec = SpanRecorder(capacity=64, clock=lambda: t[0])
+    rec.mark("r0", spans_mod.PHASE_QUEUED)
+    t[0] = 0.010
+    rec.mark("r0", spans_mod.PHASE_PREFILL, note="slot 0")
+    t[0] = 0.025
+    rec.mark("r0", spans_mod.PHASE_FIRST_TOKEN)
+    with rec.section("engine.step"):
+        t[0] = 0.040
+    rec.mark("r0", spans_mod.PHASE_DECODE)
+    rec.mark("r1", spans_mod.PHASE_QUEUED)
+    t[0] = 0.050
+    rec.mark("r0", spans_mod.PHASE_RETIRED, note="eos")
+    ct = rec.to_chrome_trace()
+    json.dumps(ct)  # valid Chrome-trace JSON
+    evs = ct["traceEvents"]
+    xs = {(e["name"], e["ts"], e["dur"]) for e in evs if e["ph"] == "X"}
+    # consecutive marks become complete events named by the open phase
+    assert ("queued", 0.0, 10000.0) in xs
+    assert ("prefill", 10000.0, 15000.0) in xs
+    assert ("engine.step", 25000.0, 15000.0) in xs
+    # distinct requests get distinct lanes
+    lanes = {e["tid"] for e in evs
+             if e["ph"] == "X" and e["pid"] == 1}
+    r1_lane = [e["tid"] for e in evs if e["ph"] == "M"
+               and e.get("args", {}).get("name") == "req r1"]
+    assert r1_lane and r1_lane[0] not in lanes
+    # terminal marks are instants
+    instants = {e["name"] for e in evs if e["ph"] == "i"}
+    assert "retired" in instants and "queued" in instants
+    s = rec.summary()
+    assert s == {"events": 7, "events_total": 7, "events_dropped": 0,
+                 "requests": 2}
+
+
+def test_span_recorder_bounded():
+    rec = SpanRecorder(capacity=4, clock=lambda: 0.0)
+    for i in range(10):
+        rec.mark(f"r{i}", "queued")
+    s = rec.summary()
+    assert s["events"] == 4 and s["events_dropped"] == 6
+    json.dumps(rec.to_chrome_trace())
+
+
+# --- recompile sentinel ----------------------------------------------------
+
+
+def test_recompile_sentinel_counts_and_guard_trip():
+    reg = Registry()
+    sent = rc.RecompileSentinel(registry=reg).install()
+    try:
+        if not sent.monitoring_available:
+            pytest.skip("runtime has no jax.monitoring")
+        f = jax.jit(lambda x: x * 3 + 1)
+        before = sent.compiles_total()
+        f(jnp.ones((4,)))  # first call: an executable materialises
+        after = sent.compiles_total()
+        assert after["backend_compiles"] > before["backend_compiles"]
+        sent.track("f", f)
+        # steady state: repeat calls are in-memory cache hits — silent
+        with sent.guard() as g:
+            f(jnp.ones((4,)))
+            assert g.check() == {} and not g.tripped
+        # a new shape recompiles: alarm + raise, attributed to "f"
+        with pytest.raises(RecompileError, match="trace-stability"):
+            with sent.guard() as g:
+                f(jnp.ones((9,)))
+        assert g.alarms and g.tripped
+        assert g.delta().get("tracked", {}).get("f") == 1
+        assert reg.counter("recompile_alarms_total").value >= 1
+        assert reg.counter("jax_compiles_total").value >= 2
+        # raise_on_recompile=False: report, don't raise
+        with sent.guard(raise_on_recompile=False) as g:
+            f(jnp.ones((17,)))
+        assert g.tripped and g.check()["backend_compiles"] >= 1
+        # concurrent guards: one compile = ONE observed breach on the
+        # shared alarm counter (each guard still records it locally)
+        alarms_before = reg.counter("recompile_alarms_total").value
+        with sent.guard(raise_on_recompile=False) as g1:
+            with sent.guard(raise_on_recompile=False) as g2:
+                f(jnp.ones((23,)))
+        # every armed guard saw the same events; the shared counter
+        # advanced once per EVENT, not once per (event, guard) pair
+        # (note one host call can legitimately fire several compile
+        # events — e.g. jnp.ones of a fresh shape compiles its own
+        # fill program before f does)
+        assert g1.alarms and len(g1.alarms) == len(g2.alarms)
+        assert reg.counter("recompile_alarms_total").value == \
+            alarms_before + len(g1.alarms)
+    finally:
+        sent.uninstall()
+
+
+def test_sentinel_uninstall_releases_listener():
+    """install/uninstall is listener-neutral — engines created in a
+    loop must not grow jax.monitoring's listener list (uninstall used
+    to silently no-op: the private unregister helpers live on
+    jax._src.monitoring, not the public re-export)."""
+    try:
+        from jax._src import monitoring as impl
+    except ImportError:
+        pytest.skip("no jax._src.monitoring")
+    get = getattr(impl, "get_event_duration_listeners", None)
+    if get is None:
+        pytest.skip("runtime lacks listener introspection")
+    n0 = len(get())
+    sent = rc.RecompileSentinel().install()
+    if not sent.monitoring_available:
+        pytest.skip("runtime has no jax.monitoring")
+    assert len(get()) == n0 + 1
+    sent.install()  # idempotent: no second registration
+    assert len(get()) == n0 + 1
+    sent.uninstall()
+    assert len(get()) == n0
+    sent.uninstall()  # idempotent
+
+
+def test_recompile_guard_cache_poll_fallback(monkeypatch):
+    """Legacy runtimes without jax.monitoring: the sentinel degrades to
+    tracked-function jit-cache polling and the guard still trips."""
+    from apex_tpu import _compat
+
+    monkeypatch.setattr(_compat, "register_monitoring_listeners",
+                        lambda *a: None)
+    reg = Registry()
+    sent = rc.RecompileSentinel(registry=reg).install()
+    assert not sent.monitoring_available
+    f = jax.jit(lambda x: x - 2)
+    f(jnp.ones((3,)))
+    sent.track("f", f)
+    with sent.guard() as g:
+        f(jnp.ones((3,)))
+        assert g.check() == {}
+    with pytest.raises(RecompileError, match="tracked"):
+        with sent.guard():
+            f(jnp.ones((6,)))
+    # the breach is visible on the alarm counter even though no event
+    # listener exists — cache-poll detection feeds the same metric
+    assert reg.counter("recompile_alarms_total").value == 1.0
+    # ...and with raise_on_recompile=False the exit check still records
+    with sent.guard(raise_on_recompile=False) as g:
+        f(jnp.ones((9,)))
+    assert g.tripped and g.alarms
+    assert reg.counter("recompile_alarms_total").value == 2.0
+    assert sent.compiles_total()["backend_compiles"] == 0  # no listener
+    sent.uninstall()  # no-op, must not raise
+
+
+# --- the engine acceptance: warmup → guard → flat --------------------------
+
+
+def _cfg(**overrides):
+    base = dict(vocab_size=VOCAB, seq_len=64)
+    base.update(overrides)
+    return standalone_gpt_config(**base)
+
+
+def _varied_requests(n, *, seed0, eos=None):
+    """Greedy and sampled lanes, varied prompt lengths / budgets /
+    temperatures / top-k / top-p — the admission-diversity sweep."""
+    reqs = []
+    for i in range(n):
+        p_len = 1 + (5 * i + 2) % 8
+        prompt = [int(t) for t in jax.random.randint(
+            jax.random.PRNGKey(seed0 + i), (p_len,), 0, VOCAB)]
+        if i % 2:
+            sp = SamplingParams(temperature=0.7 + 0.2 * (i % 3),
+                                top_k=(0, 5, 9)[i % 3],
+                                top_p=(1.0, 0.9, 0.85)[i % 3],
+                                seed=seed0 + i)
+        else:
+            sp = SamplingParams()
+        reqs.append(Request(f"q{seed0}_{i}", prompt,
+                            max_tokens=3 + i % 5, sampling=sp,
+                            eos_token_id=eos))
+    return reqs
+
+
+@pytest.fixture(scope="module")
+def served_engine(devices8):
+    """One warmed engine (chunked decode) + its recompile sentinel,
+    shared by the guard and live-scrape tests. Shapes mirror
+    test_serving's chunked engine so the persistent compile cache is
+    warm across suites."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    eng = Engine(cfg, params, mesh,
+                 EngineConfig(slots=2, max_prompt_len=8, max_seq_len=24,
+                              decode_chunk=8))
+    registry = Registry()
+    eng.recompile_sentinel(registry=registry)
+    # warmup: compile all four programs (admit/step via a mixed batch,
+    # retire directly) plus the sampled host paths (PRNGKey etc.)
+    sched = Scheduler(eng)
+    for r in _varied_requests(4, seed0=2000, eos=13):
+        sched.submit(r)
+    sched.run_until_idle()
+    eng.retire(0)
+    yield cfg, params, mesh, eng, registry
+    eng.close()  # release the process-wide monitoring listener
+
+
+def test_engine_recompile_guard_stays_flat(served_engine):
+    """The acceptance pin: after warmup, a full serve cycle — admits
+    into both slots, chunked decode, deadline retire, varied sampling
+    params — runs inside an armed RecompileGuard without a single
+    compilation; a shape-busting call trips the same guard."""
+    cfg, params, mesh, eng, registry = served_engine
+    sent = eng.recompile_sentinel()
+    sizes0 = eng.compiled_cache_sizes()
+    now = [0.0]
+    # build the request set OUTSIDE the guard: its jax.random prompt
+    # synthesis compiles for fresh prompt lengths, which is exactly the
+    # kind of host-side compile the guard exists to catch
+    reqs = _varied_requests(6, seed0=3000, eos=13)
+    with eng.recompile_guard() as g:
+        sched = Scheduler(eng, clock=lambda: now[0])
+        for r in reqs:
+            sched.submit(r)
+        for _ in range(3):
+            sched.step()
+            now[0] += 1.0
+        # deadline-retire one live slot mid-flight, then drain
+        if sched.active:
+            slot = next(iter(sched.active))
+            sched.active[slot].request.deadline = now[0] - 0.5
+        sched.run_until_idle()
+        assert len(sched.completions) == 6
+        assert g.check() == {}  # flat mid-flight, by construction
+    assert not g.tripped
+    # compiles_total flat: per-program jit caches did not grow
+    totals = sent.compiles_total()
+    assert totals["tracked"] == {"init": 1, "step": 1, "admit": 1,
+                                 "retire": 1}
+    assert eng.compiled_cache_sizes() == sizes0
+    if not sent.monitoring_available:
+        pytest.skip("no jax.monitoring: event-trip half needs it")
+    # the same guard trips on a deliberately shape-busting call
+    with pytest.raises(RecompileError, match="RecompileGuard"):
+        with eng.recompile_guard():
+            jax.jit(lambda x: x * 2.0)(np.arange(7.0))
+    assert registry.counter("recompile_alarms_total").value >= 1
+    # re-passing the ALREADY-WIRED registry is fine (the natural
+    # re-arm pattern)...
+    assert eng.recompile_sentinel(registry=registry) is sent
+    # ...but wiring a DIFFERENT registry after the fact is a loud
+    # error, not silently-absent metrics
+    with pytest.raises(ValueError, match="FIRST"):
+        eng.recompile_sentinel(registry=Registry())
+
+
+# --- live /metrics endpoint over a serving engine --------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read().decode("utf-8")
+
+
+def test_metrics_endpoint_live_engine(served_engine):
+    """End-to-end smoke: scrape /metrics from a LIVE engine mid-batch,
+    round-trip the text through the minimal parser, check /healthz and
+    /vars, and validate the span export as Chrome-trace JSON."""
+    cfg, params, mesh, eng, _ = served_engine
+    registry = Registry()
+    spans = SpanRecorder()
+    sched = Scheduler(eng, registry=registry, spans=spans)
+    server = MetricsServer(registry, spans=spans,
+                           sentinel=eng.recompile_sentinel()).start()
+    try:
+        # budgets of 12 outlive a decode_chunk=8 dispatch, so slots are
+        # observably live at the mid-flight scrape
+        for r in _varied_requests(4, seed0=4000):
+            sched.submit(Request(r.request_id, r.prompt, max_tokens=12,
+                                 sampling=r.sampling))
+        sched.step()  # both slots admitted + one chunk; 2 still queued
+        status, mid = _get(server.url + "/metrics")
+        assert status == 200
+        p = parse_prometheus_text(mid)
+        assert p["serving_active_slots"][()] >= 1.0
+        assert p["serving_requests_admitted_total"][()] >= 2.0
+        assert p["serving_slots_total"][()] == 2.0
+        sched.run_until_idle()
+        _, done = _get(server.url + "/metrics")
+        p = parse_prometheus_text(done)
+        by_reason = {dict(k)["reason"]: v for k, v in
+                     p["serving_requests_finished_total"].items()}
+        assert set(by_reason) == set(FINISH_REASONS)  # zeros present
+        assert sum(by_reason.values()) == 4.0
+        assert p["serving_queue_depth"][()] == 0.0
+        assert p["serving_ttft_seconds_count"][()] == 4.0
+        assert p["serving_token_latency_seconds_count"][()] == \
+            p["serving_tokens_emitted_total"][()] - 4.0
+        status, health = _get(server.url + "/healthz")
+        assert status == 200 and health == "ok\n"
+        status, vars_body = _get(server.url + "/vars")
+        v = json.loads(vars_body)
+        assert v["spans"]["requests"] == 4
+        assert v["recompile"]["tracked"]["step"] == 1
+        assert v["metrics"]["serving_tokens_emitted_total"][
+            "samples"][0]["value"] >= 4.0
+        status, _ = _get(server.url + "/metrics?from=test")
+        assert status == 200
+        with pytest.raises(urllib.error.HTTPError):
+            _get(server.url + "/nope")
+    finally:
+        server.stop()
+    # span export: valid Chrome trace with the full phase vocabulary
+    ct = spans.to_chrome_trace()
+    json.loads(json.dumps(ct))
+    names = {e["name"] for e in ct["traceEvents"]
+             if e["ph"] in ("X", "i")}
+    assert {"queued", "prefill", "first_token", "decode", "retired",
+            "engine.step", "engine.admit"} <= names
+    for e in ct["traceEvents"]:
+        if e["ph"] == "X":
+            assert e["ts"] >= 0.0 and e["dur"] >= 0.0
+
+
+# --- dependency-free contract ----------------------------------------------
+
+
+def test_telemetry_imports_without_torch_tensorboard(tmp_path):
+    """The layer must import with torch/tensorboard purged AND blocked
+    — run in a subprocess with an import hook that fails either import,
+    proving no telemetry module (or its transitive imports) touches
+    them."""
+    code = """
+import sys
+
+BLOCKED = ("torch", "tensorboard")
+
+
+class _Blocker:
+    def find_spec(self, name, path=None, target=None):
+        if name.split(".")[0] in BLOCKED:
+            raise ImportError(f"blocked by test: {name}")
+        return None
+
+
+for mod in list(sys.modules):
+    if mod.split(".")[0] in BLOCKED:
+        del sys.modules[mod]
+sys.meta_path.insert(0, _Blocker())
+
+import apex_tpu.telemetry as t
+import apex_tpu.telemetry.ring
+import apex_tpu.telemetry.registry
+import apex_tpu.telemetry.spans
+import apex_tpu.telemetry.http
+import apex_tpu.telemetry.recompile
+
+r = t.Registry()
+r.counter("x_total").inc()
+assert "x_total 1" in r.to_prometheus_text()
+assert not any(m.split(".")[0] in BLOCKED for m in sys.modules)
+print("DEP_FREE_OK")
+"""
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "DEP_FREE_OK" in out.stdout
