@@ -1257,6 +1257,26 @@ def prefill_at(cfg: GPTConfig, params, prompt, last, *,
     return cache, _lm_head(cfg, params, h_last)
 
 
+def prefill_many(cfg: GPTConfig, params, prompts, last, *,
+                 max_len: Optional[int] = None):
+    """:func:`prefill_at` for a batch of right-padded prompts with
+    PER-ROW end positions: ``prompts [k, P]`` whose real tokens end at
+    ``last [k]`` (traced vector) → ``(cache [l, 2, k, hl, max_len, d],
+    logits [k, vocab])`` where row ``i``'s logits predict position
+    ``last[i] + 1``. ONE training-path forward admits the whole batch;
+    row ``i`` is value-identical to a solo ``prefill_at(prompts[i:i+1],
+    last[i])`` call (causal attention — no row sees another row or its
+    own padding), which is what lets the serving engine drain a burst
+    of k queued requests in a single admission dispatch."""
+    b, p_len = prompts.shape
+    cfg = _decode_entry_cfg(cfg, p_len)
+    cache, h = _prefill_states(cfg, params, prompts, max_len or cfg.seq_len)
+    last = jnp.asarray(last, jnp.int32)
+    # per-row gather of the hidden state at each prompt's true end
+    h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]
+    return cache, _lm_head(cfg, params, h_last)
+
+
 def cache_insert_slot(cache, block, slot):
     """Insert one request's prefilled cache block ``[l, 2, 1, hl, P, d]``
     into slot ``slot`` of a shared decode cache ``[l, 2, B, hl, S, d]``
@@ -1271,6 +1291,22 @@ def cache_insert_slot(cache, block, slot):
     return lax.dynamic_update_slice(
         cache, block.astype(cache.dtype),
         (zero, zero, jnp.asarray(slot, jnp.int32), zero, zero, zero))
+
+
+def cache_insert_slots(cache, blocks, slots):
+    """:func:`cache_insert_slot` for a batch: ``blocks [l, 2, k, hl, P,
+    d]`` (one prefilled block per row, ``P <= S``) written at slot
+    indices ``slots [k]`` (traced vector; must be distinct — duplicate
+    indices would race the writes). ``k`` is static from the block
+    shape, so this unrolls into k one-slot ``dynamic_update_slice``
+    writes — each touching only its own ``[.., 1, .., P, ..]`` column
+    of the shared cache."""
+    if blocks.ndim != cache.ndim:
+        raise ValueError(
+            f"cache blocks rank {blocks.ndim} != cache rank {cache.ndim}")
+    for i in range(blocks.shape[2]):
+        cache = cache_insert_slot(cache, blocks[:, :, i:i + 1], slots[i])
+    return cache
 
 
 # re-exported from the serving sampler (one implementation for generate

@@ -42,6 +42,7 @@ __all__ = [
     "request", "sampling", "engine", "scheduler",
     "Request", "SamplingParams", "Completion", "StreamEvent",
     "Engine", "EngineConfig", "Scheduler", "QueueFull",
+    "Admission", "AdmitResult", "StepHandle",
 ]
 
 _LAZY = {
@@ -49,6 +50,9 @@ _LAZY = {
     "scheduler": "apex_tpu.serving.scheduler",
     "Engine": "apex_tpu.serving.engine",
     "EngineConfig": "apex_tpu.serving.engine",
+    "Admission": "apex_tpu.serving.engine",
+    "AdmitResult": "apex_tpu.serving.engine",
+    "StepHandle": "apex_tpu.serving.engine",
     "Scheduler": "apex_tpu.serving.scheduler",
     "QueueFull": "apex_tpu.serving.scheduler",
 }

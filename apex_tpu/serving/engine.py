@@ -4,7 +4,7 @@ XLA's static-shape world forbids vLLM's dynamic batch: instead a fixed
 batch of ``B`` decode *slots* drives ONE compiled per-token program, and
 requests flow through slots. All per-request state the device needs —
 position, remaining token budget, done flag, eos id, temperature /
-top-k / top-p / PRNG key — lives in ``[B]`` device vectors, so the three
+top-k / top-p / PRNG key — lives in ``[B]`` device vectors, so the
 compiled programs are trace-stable across the whole serving lifetime:
 
 - ``step``:   one ``gpt.decode_steps`` chunk — ``decode_chunk``
@@ -13,25 +13,36 @@ compiled programs are trace-stable across the whole serving lifetime:
   :func:`apex_tpu.serving.sampling.draw_slots`) in ONE compiled
   ``lax.scan``, emitting ``[B, decode_chunk]`` tokens + finish flags
   per dispatch so the multi-ms tunnel/dispatch cost is paid once per
-  chunk instead of once per token,
-- ``admit``:  prefill ONE request's prompt at the static padded length
-  (``gpt.prefill_at`` — causal attention makes the padded forward exact
-  for the real tokens), draw its first token, insert the KV block into
-  the shared cache (``gpt.cache_insert_slot``), and scatter the slot's
-  state vectors at a traced slot index,
+  chunk instead of once per token. :meth:`Engine.step_async` exposes
+  the dispatch as an in-flight :class:`StepHandle` so a pipelined
+  scheduler can enqueue the NEXT chunk before fetching this one's
+  tokens — serial ``device + host`` becomes ``max(device, host)``.
+- ``admit``:  one program per static ``(bucket, k)`` pair — prefill a
+  ``[k, bucket]`` batch of right-padded prompts in ONE forward
+  (``gpt.prefill_many`` — causal attention makes the padded forward
+  exact for every row's real tokens), draw k first tokens, insert k
+  KV blocks into the shared cache (``gpt.cache_insert_slots``), and
+  scatter k state rows at traced slot indices. The admission ladder
+  (``admit_batch_sizes``, e.g. 1/2/4) lets a burst of queued requests
+  drain in ~1 dispatch instead of k; the prompt-length ladder
+  (``prompt_buckets``, powers of two up to ``max_prompt_len``) lets a
+  short prompt pay a short padded forward instead of the full one.
 - ``retire``: force a slot done (deadline expiry).
 
 A slot's token stream is bit-identical to a solo ``gpt.generate`` run of
 the same request (same key, params) — the continuous-batching oracle
-test pins this token-for-token, and ``compiled_cache_sizes`` pins that
-no program recompiles after warmup. Host-side policy (queueing,
-deadlines, metrics) lives in :mod:`apex_tpu.serving.scheduler`.
+test pins this token-for-token, batched admission is pinned equal to k
+single admits, bucketed prefill equal to max-length prefill — and
+``compiled_cache_sizes`` pins that no program recompiles after
+:meth:`Engine.warmup` (which compiles every (bucket, k) variant up
+front). Host-side policy (queueing, deadlines, metrics) lives in
+:mod:`apex_tpu.serving.scheduler`.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,13 +53,27 @@ from apex_tpu.models import gpt
 from apex_tpu.serving import sampling
 
 
+def default_prompt_buckets(max_prompt_len: int) -> Tuple[int, ...]:
+    """The static padded-prefill length ladder: powers of two from 8 up
+    to (and always including) ``max_prompt_len``. The floor of 8 keeps
+    the compiled-program count small — below it the padded forward is
+    already tiny and another bucket would buy nothing but a compile."""
+    out: List[int] = []
+    v = 8
+    while v < max_prompt_len:
+        out.append(v)
+        v *= 2
+    out.append(max_prompt_len)
+    return tuple(out)
+
+
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:
     """Static engine geometry — everything that shapes the compiled
-    programs. ``max_prompt_len`` is the single padded prefill length
-    (one admission program for every prompt); ``max_seq_len`` is the
-    per-slot KV horizon (prompt + generated tokens, ``<= cfg.seq_len``
-    for the position table)."""
+    programs. ``max_prompt_len`` caps prompt length (admission pads to
+    the smallest bucket that fits, see ``prompt_buckets``);
+    ``max_seq_len`` is the per-slot KV horizon (prompt + generated
+    tokens, ``<= cfg.seq_len`` for the position table)."""
 
     slots: int = 4
     max_prompt_len: int = 64
@@ -62,6 +87,21 @@ class EngineConfig:
     #: streams are bit-identical at every setting (the chunk-parity
     #: test pins chunk=8 against chunk=1 against solo generate).
     decode_chunk: int = 1
+    #: static ladder of padded prefill lengths; admission picks the
+    #: smallest bucket >= the (batch-max) prompt length, so a 4-token
+    #: prompt pays an 8-wide padded forward instead of the full
+    #: ``max_prompt_len`` one. None = :func:`default_prompt_buckets`
+    #: (powers of two up to ``max_prompt_len``). Must be strictly
+    #: increasing and end at ``max_prompt_len``. Each (bucket, k) pair
+    #: is one compiled admission program — ``Engine.warmup()`` compiles
+    #: them all so steady state never traces.
+    prompt_buckets: Optional[Tuple[int, ...]] = None
+    #: static ladder of admission batch sizes k: ``admit_many`` splits
+    #: a burst of queued requests into ladder-sized groups (largest
+    #: first), each group ONE prefill forward + ONE dispatch. None =
+    #: (1, 2, 4) capped at ``slots``. Must be strictly increasing and
+    #: start at 1 (any group count decomposes).
+    admit_batch_sizes: Optional[Tuple[int, ...]] = None
 
 
 #: eos sentinel in the per-slot eos vector: no stop token for this slot
@@ -69,13 +109,86 @@ class EngineConfig:
 _NO_EOS = gpt._NO_EOS_SENTINEL
 
 
+@dataclasses.dataclass(frozen=True)
+class Admission:
+    """One admission request — the argument row of
+    :meth:`Engine.admit_many` (``Engine.admit``'s keyword surface as
+    data, so a batch of them can ride one dispatch)."""
+
+    slot: int
+    prompt: Any
+    max_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: Optional[int] = None
+    eos_token_id: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmitResult:
+    """Per-request outcome of :meth:`Engine.admit_many`. ``finished``
+    is True when the request is already complete after its first token
+    (eos, or a budget of 1). ``bucket``/``batch_size``/``group`` record
+    which compiled admission variant served it and which dispatch group
+    of the call it rode — the scheduler's admission telemetry."""
+
+    first_token: int
+    hit_eos: bool
+    finished: bool
+    bucket: int
+    batch_size: int
+    group: int
+
+
+def _threefry_key_data(seed: int) -> np.ndarray:
+    """``jax.random.PRNGKey(seed)``'s raw data, computed host-side with
+    numpy for the common domain (non-negative int32 seeds — the
+    threefry key is just the packed seed, zero hi word, no hashing;
+    pinned bit-identical against the real PRNGKey in the tests).
+    Avoids dispatching + FETCHING one tiny device program per seeded
+    request on the admission hot path — through the chip tunnel each
+    fetch is a multi-ms round trip, which would cancel the k→1
+    dispatch amortization batched admission exists for. Seeds outside
+    that domain (negative, or > 31 bits — whose truncation depends on
+    the runtime's x64 mode) take the real PRNGKey, paying the round
+    trip to stay bit-stable."""
+    if 0 <= seed < 2**31:
+        return np.asarray([0, seed], np.uint32)
+    return np.asarray(jax.random.PRNGKey(seed), np.uint32)
+
+
+class StepHandle:
+    """One in-flight decode chunk: the ``[B, n]`` token/finished device
+    futures a :meth:`Engine.step_async` dispatch returned. ``fetch()``
+    is the value-fetch sync (per the perf-claims convention —
+    ``block_until_ready`` can return at dispatch time through the
+    tunnel, a value fetch cannot); it caches, so fetching twice costs
+    one transfer."""
+
+    __slots__ = ("_emit", "_finished", "_out")
+
+    def __init__(self, emit, finished):
+        self._emit = emit
+        self._finished = finished
+        self._out: Optional[Tuple[np.ndarray, np.ndarray]] = None
+
+    def fetch(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Block until the chunk lands; returns ``(tokens [B, n],
+        finished [B, n])`` as host arrays."""
+        if self._out is None:
+            self._out = (np.asarray(self._emit), np.asarray(self._finished))
+        return self._out
+
+
 class Engine:
     """Compiled slot engine over ``mesh`` (tp sharding like the rest of
     the decode path; dp/pp axes must be 1 — decode state is replicated).
 
     The class owns the device buffers (cache + slot-state vectors) and
-    exposes host-facing ``admit`` / ``step`` / ``retire``; each call
-    fetches only the tiny per-slot outputs.
+    exposes host-facing ``admit`` / ``admit_many`` / ``step`` /
+    ``step_async`` / ``retire``; each call fetches only the tiny
+    per-slot outputs (``step_async`` defers even that).
     """
 
     def __init__(self, cfg: "gpt.GPTConfig", params, mesh,
@@ -102,13 +215,56 @@ class Engine:
                 raise ValueError(
                     f"serving engine shards over tp only; mesh has "
                     f"{axis}={mesh.shape[axis]}")
+        self._buckets = self._resolve_buckets(ecfg)
+        self._batch_sizes = self._resolve_batch_sizes(ecfg)
         self.cfg = cfg
         self.engine_cfg = ecfg
         self._mesh = mesh
         self._params = params
         self._sentinel = None  # lazily via recompile_sentinel()
+        #: monotonic admission counter — folded into the default PRNG
+        #: key of unseeded requests so concurrent sampled requests never
+        #: share a stream (they all drew from the zero key before)
+        self._req_counter = 0
+        self._warmed = False
         self._build()
         self.cache, self.state = self._init(params)
+
+    @staticmethod
+    def _resolve_buckets(ecfg: EngineConfig) -> Tuple[int, ...]:
+        buckets = ecfg.prompt_buckets
+        if buckets is None:
+            return default_prompt_buckets(ecfg.max_prompt_len)
+        buckets = tuple(int(b) for b in buckets)
+        if not buckets or list(buckets) != sorted(set(buckets)):
+            raise ValueError(
+                f"prompt_buckets must be strictly increasing, got {buckets}")
+        if buckets[0] < 1 or buckets[-1] != ecfg.max_prompt_len:
+            raise ValueError(
+                f"prompt_buckets must lie in [1, max_prompt_len] and end "
+                f"at max_prompt_len={ecfg.max_prompt_len} (every prompt "
+                f"needs a bucket), got {buckets}")
+        return buckets
+
+    @staticmethod
+    def _resolve_batch_sizes(ecfg: EngineConfig) -> Tuple[int, ...]:
+        sizes = ecfg.admit_batch_sizes
+        if sizes is None:
+            return tuple(k for k in (1, 2, 4) if k <= ecfg.slots)
+        sizes = tuple(int(k) for k in sizes)
+        if not sizes or list(sizes) != sorted(set(sizes)):
+            raise ValueError(
+                f"admit_batch_sizes must be strictly increasing, got {sizes}")
+        if sizes[0] != 1:
+            raise ValueError(
+                f"admit_batch_sizes must start at 1 (the ladder must "
+                f"decompose any group count), got {sizes}")
+        if sizes[-1] > ecfg.slots:
+            raise ValueError(
+                f"admit_batch_sizes max {sizes[-1]} exceeds slots "
+                f"{ecfg.slots} — a batch cannot outnumber the slots it "
+                f"fills")
+        return sizes
 
     # -- compiled programs -------------------------------------------------
 
@@ -146,34 +302,45 @@ class Engine:
                 cfg, params, cache, state, ecfg.decode_chunk,
                 pad_token_id=ecfg.pad_token_id)
 
-        def admit_local(params, cache, state, slot, prompt, p_len,
-                        max_tokens, temp, top_k, top_p, key, eos):
-            block, logits0 = gpt.prefill_at(
-                cfg, params, prompt[None], p_len - 1,
-                max_len=ecfg.max_prompt_len)
-            # the [1]-shaped draw_slots call IS the solo-generate first
-            # draw (same [1, vocab] gumbel shape, same fold index)
-            one = lambda v, dt: jnp.reshape(v, (1,)).astype(dt)
-            first = sampling.draw_slots(
-                logits0, key[None], one(p_len - 1, jnp.int32),
-                one(temp, jnp.float32), one(top_k, jnp.int32),
-                one(top_p, jnp.float32))[0]
-            cache = gpt.cache_insert_slot(cache, block, slot)
-            hit_eos = (eos >= 0) & (first == eos)
-            done0 = hit_eos | (max_tokens <= 1)
-            upd = lambda a, v: a.at[slot].set(jnp.asarray(v, a.dtype))
-            state = {
-                "tok": upd(state["tok"], first),
-                "pos": upd(state["pos"], p_len),
-                "remaining": upd(state["remaining"], max_tokens - 1),
-                "done": upd(state["done"], done0),
-                "temp": upd(state["temp"], temp),
-                "top_k": upd(state["top_k"], top_k),
-                "top_p": upd(state["top_p"], top_p),
-                "key": state["key"].at[slot].set(key),
-                "eos": upd(state["eos"], eos),
-            }
-            return cache, state, first, hit_eos, done0
+        def make_admit(bucket: int):
+            def admit_local(params, cache, state, slots, prompts, p_lens,
+                            max_tokens, temp, top_k, top_p, keys, eos,
+                            req_idx, seeded):
+                # ONE padded forward admits the whole [k, bucket] batch;
+                # row i's logits/KV are exactly its solo prefill_at's
+                blocks, logits0 = gpt.prefill_many(
+                    cfg, params, prompts, p_lens - 1, max_len=bucket)
+                # unseeded rows fold the monotonic request counter into
+                # the zero base key ON DEVICE (no host-side compile to
+                # trip a recompile guard); seeded rows keep their host
+                # key bit-for-bit
+                base = jnp.zeros((2,), jnp.uint32)
+                folded = jax.vmap(
+                    lambda i: jax.random.fold_in(base, i))(req_idx)
+                keys = jnp.where(seeded[:, None], keys, folded)
+                # the k-row draw_slots call vmaps per row over a
+                # [1, vocab] lane — each row IS the solo-generate first
+                # draw (same gumbel shape, same fold index)
+                first = sampling.draw_slots(
+                    logits0, keys, p_lens - 1, temp, top_k, top_p)
+                cache = gpt.cache_insert_slots(cache, blocks, slots)
+                hit_eos = (eos >= 0) & (first == eos)
+                done0 = hit_eos | (max_tokens <= 1)
+                state = {
+                    "tok": state["tok"].at[slots].set(first),
+                    "pos": state["pos"].at[slots].set(p_lens),
+                    "remaining": state["remaining"].at[slots].set(
+                        max_tokens - 1),
+                    "done": state["done"].at[slots].set(done0),
+                    "temp": state["temp"].at[slots].set(temp),
+                    "top_k": state["top_k"].at[slots].set(top_k),
+                    "top_p": state["top_p"].at[slots].set(top_p),
+                    "key": state["key"].at[slots].set(keys),
+                    "eos": state["eos"].at[slots].set(eos),
+                }
+                return cache, state, first, hit_eos, done0
+
+            return admit_local
 
         def retire_local(state, slot):
             return {**state, "done": state["done"].at[slot].set(True)}
@@ -192,11 +359,16 @@ class Engine:
         self._step = sm(
             step_local, (pspecs, cache_spec, state_spec),
             (cache_spec, state_spec, scalar, scalar), donate=(1, 2))
-        self._admit = sm(
-            admit_local,
-            (pspecs, cache_spec, state_spec) + (scalar,) * 9,
-            (cache_spec, state_spec, scalar, scalar, scalar),
-            donate=(1, 2))
+        # one admission program per (bucket, k) — the k dim and padded
+        # width are static shapes, everything request-scoped is data
+        self._admits: Dict[Tuple[int, int], Any] = {}
+        for bucket in self._buckets:
+            fn = make_admit(bucket)
+            for k in self._batch_sizes:
+                self._admits[(bucket, k)] = sm(
+                    fn, (pspecs, cache_spec, state_spec) + (scalar,) * 11,
+                    (cache_spec, state_spec, scalar, scalar, scalar),
+                    donate=(1, 2))
         self._retire = sm(retire_local, (state_spec, scalar), state_spec,
                           donate=(0,))
 
@@ -206,81 +378,235 @@ class Engine:
     def slots(self) -> int:
         return self.engine_cfg.slots
 
-    def pad_prompt(self, prompt) -> np.ndarray:
-        """Right-pad ``prompt`` (1-D ints) to ``max_prompt_len``
-        (validating its length) — the static admission shape."""
+    @property
+    def prompt_buckets(self) -> Tuple[int, ...]:
+        """The resolved padded-prefill length ladder (ascending; ends
+        at ``max_prompt_len``)."""
+        return self._buckets
+
+    @property
+    def admit_batch_sizes(self) -> Tuple[int, ...]:
+        """The resolved admission batch-size ladder (ascending; starts
+        at 1)."""
+        return self._batch_sizes
+
+    def bucket_for(self, prompt_len: int) -> int:
+        """The smallest prefill bucket that fits ``prompt_len``."""
+        for b in self._buckets:
+            if b >= prompt_len:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds max_prompt_len "
+            f"{self.engine_cfg.max_prompt_len}")
+
+    def pad_prompt(self, prompt, length: Optional[int] = None) -> np.ndarray:
+        """Right-pad ``prompt`` (1-D ints) to ``length`` (default
+        ``max_prompt_len``), validating its length — the static
+        admission shape of one bucket."""
+        length = self.engine_cfg.max_prompt_len if length is None else length
         prompt = np.asarray(prompt, np.int32)
-        if prompt.ndim != 1 or not 1 <= prompt.size <= \
-                self.engine_cfg.max_prompt_len:
+        if prompt.ndim != 1 or not 1 <= prompt.size <= length:
             raise ValueError(
-                f"prompt must be 1-D with 1..{self.engine_cfg.max_prompt_len}"
+                f"prompt must be 1-D with 1..{length}"
                 f" tokens, got shape {prompt.shape}")
-        out = np.full((self.engine_cfg.max_prompt_len,),
-                      self.engine_cfg.pad_token_id, np.int32)
+        out = np.full((length,), self.engine_cfg.pad_token_id, np.int32)
         out[:prompt.size] = prompt
         return out
 
-    def admit(self, slot: int, prompt, max_tokens: int, *,
-              temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
-              seed: Optional[int] = None,
-              eos_token_id: Optional[int] = None) -> Tuple[int, bool, bool]:
-        """Admit one request into ``slot``: prefill + first token. Returns
-        ``(first_token, hit_eos, finished)`` — ``finished`` True when the
-        request is already complete after its first token (eos, or a
-        budget of 1). ``max_tokens`` must fit the slot's cache horizon."""
-        if not 0 <= slot < self.slots:
+    def _validate_admission(self, a: Admission) -> Tuple[np.ndarray, int]:
+        """Shared per-request admission validation; returns the raw
+        prompt array and its length (padding happens per group, once
+        the group's bucket is known)."""
+        if not 0 <= a.slot < self.slots:
             raise ValueError(
-                f"slot {slot} outside [0, {self.slots}) — a traced "
+                f"slot {a.slot} outside [0, {self.slots}) — a traced "
                 f"out-of-range index would silently clamp into a "
                 f"neighbouring slot's cache")
         # same stop-token contract as gpt.generate (rejects vocab-range
         # violations AND an explicit -1, which would alias the
         # no-eos sentinel)
-        gpt._check_stop_tokens(self.cfg, eos_token_id, None)
-        prompt = np.asarray(prompt, np.int32)
-        padded = self.pad_prompt(prompt)
-        room = self.engine_cfg.max_seq_len - prompt.size
-        if max_tokens < 1 or max_tokens > room:
+        gpt._check_stop_tokens(self.cfg, a.eos_token_id, None)
+        prompt = np.asarray(a.prompt, np.int32)
+        if prompt.ndim != 1 or not \
+                1 <= prompt.size <= self.engine_cfg.max_prompt_len:
             raise ValueError(
-                f"max_tokens {max_tokens} outside [1, {room}] for a "
+                f"prompt must be 1-D with "
+                f"1..{self.engine_cfg.max_prompt_len} tokens, got shape "
+                f"{prompt.shape}")
+        room = self.engine_cfg.max_seq_len - prompt.size
+        if a.max_tokens < 1 or a.max_tokens > room:
+            raise ValueError(
+                f"max_tokens {a.max_tokens} outside [1, {room}] for a "
                 f"{prompt.size}-token prompt at max_seq_len "
                 f"{self.engine_cfg.max_seq_len}")
-        key = (jax.random.PRNGKey(seed) if seed is not None
-               else jnp.zeros((2,), jnp.uint32))
-        eos = _NO_EOS if eos_token_id is None else int(eos_token_id)
-        self.cache, self.state, first, hit_eos, done = self._admit(
-            self._params, self.cache, self.state, np.int32(slot), padded,
-            np.int32(prompt.size), np.int32(max_tokens),
-            np.float32(temperature), np.int32(top_k), np.float32(top_p),
-            jnp.asarray(key, jnp.uint32), np.int32(eos))
-        return int(first), bool(hit_eos), bool(done)
+        return prompt, prompt.size
+
+    def admit(self, slot: int, prompt, max_tokens: int, *,
+              temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+              seed: Optional[int] = None,
+              eos_token_id: Optional[int] = None) -> Tuple[int, bool, bool]:
+        """Admit one request into ``slot``: prefill + first token (the
+        k=1 lane of :meth:`admit_many`). Returns ``(first_token,
+        hit_eos, finished)`` — ``finished`` True when the request is
+        already complete after its first token (eos, or a budget of 1).
+        ``max_tokens`` must fit the slot's cache horizon."""
+        res = self.admit_many([Admission(
+            slot=slot, prompt=prompt, max_tokens=max_tokens,
+            temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
+            eos_token_id=eos_token_id)])[0]
+        return res.first_token, res.hit_eos, res.finished
+
+    def admit_many(self, items: Sequence[Admission]) -> List[AdmitResult]:
+        """Admit a batch of requests in as few dispatches as the ladders
+        allow: ``items`` (FIFO order, distinct slots) is split into
+        ``admit_batch_sizes`` groups largest-first; each group prefills
+        at the smallest bucket that fits its longest prompt and runs
+        ONE compiled ``(bucket, k)`` program — one forward + one cache/
+        state scatter for the whole group. Per-row results are
+        bit-identical to k single :meth:`admit` calls in the same
+        order (the admission-parity oracle pins this)."""
+        items = list(items)
+        if not items:
+            return []
+        validated = [self._validate_admission(a) for a in items]
+        slots_used = [a.slot for a in items]
+        if len(set(slots_used)) != len(slots_used):
+            raise ValueError(
+                f"admit_many slots must be distinct, got {slots_used}")
+        pending = []  # (device futures, bucket, k, group) per dispatch
+        i, group = 0, 0
+        while i < len(items):
+            k = max(s for s in self._batch_sizes if s <= len(items) - i)
+            batch = items[i:i + k]
+            proms = validated[i:i + k]
+            bucket = self.bucket_for(max(n for _, n in proms))
+            prompts = np.stack([self.pad_prompt(p, bucket)
+                                for p, _ in proms])
+            keys = np.stack([
+                _threefry_key_data(a.seed) if a.seed is not None
+                else np.zeros((2,), np.uint32) for a in batch])
+            seeded = np.asarray([a.seed is not None for a in batch], bool)
+            req_idx = np.arange(self._req_counter,
+                                self._req_counter + k, dtype=np.int32)
+            self._req_counter += k
+            arr = lambda vals, dt: np.asarray(vals, dt)
+            fn = self._admits[(bucket, k)]
+            self.cache, self.state, first, hit_eos, done = fn(
+                self._params, self.cache, self.state,
+                arr([a.slot for a in batch], np.int32), prompts,
+                arr([n for _, n in proms], np.int32),
+                arr([a.max_tokens for a in batch], np.int32),
+                arr([a.temperature for a in batch], np.float32),
+                arr([a.top_k for a in batch], np.int32),
+                arr([a.top_p for a in batch], np.float32),
+                keys,
+                arr([_NO_EOS if a.eos_token_id is None
+                     else int(a.eos_token_id) for a in batch], np.int32),
+                req_idx, seeded)
+            pending.append(((first, hit_eos, done), bucket, k, group))
+            i += k
+            group += 1
+        # fetch AFTER every group is dispatched — later groups ride the
+        # async queue behind earlier ones instead of waiting for each
+        # fetch round trip
+        results: List[AdmitResult] = []
+        for (first, hit_eos, done), bucket, k, group in pending:
+            first = np.asarray(first)
+            hit_eos, done = np.asarray(hit_eos), np.asarray(done)
+            for j in range(k):
+                results.append(AdmitResult(
+                    int(first[j]), bool(hit_eos[j]), bool(done[j]),
+                    bucket=bucket, batch_size=k, group=group))
+        return results
+
+    def step_async(self) -> StepHandle:
+        """Dispatch one decode chunk WITHOUT fetching its outputs: the
+        engine rebinds its (donated) cache/state to the returned device
+        futures immediately, so the caller may enqueue further work —
+        the next chunk, an admission — behind it before syncing, and
+        the device never idles through the host's fetch + event
+        processing. Returns the chunk's :class:`StepHandle`."""
+        self.cache, self.state, emit, finished = self._step(
+            self._params, self.cache, self.state)
+        return StepHandle(emit, finished)
 
     def step(self) -> Tuple[np.ndarray, np.ndarray]:
         """One decode chunk over every slot — ``decode_chunk`` fused
-        per-token steps in one dispatch. Returns ``(tokens [B, n],
-        finished [B, n])`` with ``n = decode_chunk``; column ``j`` holds
-        step ``j``'s emissions, ``pad_token_id`` for slots that were
-        done entering that step (a slot that finishes at column ``j``
-        emits pad from ``j + 1`` on)."""
-        self.cache, self.state, emit, finished = self._step(
-            self._params, self.cache, self.state)
-        return np.asarray(emit), np.asarray(finished)
+        per-token steps in one dispatch, fetched synchronously
+        (:meth:`step_async` + :meth:`StepHandle.fetch`). Returns
+        ``(tokens [B, n], finished [B, n])`` with ``n = decode_chunk``;
+        column ``j`` holds step ``j``'s emissions, ``pad_token_id`` for
+        slots that were done entering that step (a slot that finishes
+        at column ``j`` emits pad from ``j + 1`` on)."""
+        return self.step_async().fetch()
 
     def retire(self, slot: int) -> None:
         """Force ``slot`` done (scheduler deadline expiry). The slot's
         lane keeps riding the compiled step unmodified; its output is
-        pad until the next admission overwrites the state."""
+        pad until the next admission overwrites the state. Takes effect
+        for chunks dispatched AFTER this call — chunks already in
+        flight still carry the slot's real tokens (a pipelined
+        scheduler drops them)."""
         self.state = self._retire(self.state, np.int32(slot))
+
+    def warmup(self) -> "Engine":
+        """Compile every engine program up front — ``init``, ``step``,
+        ``retire``, and ALL ``(bucket, k)`` admission variants — then
+        reset the slot state, so :meth:`recompile_guard` can be armed
+        immediately after and stay flat across any serve cycle (the
+        host admission path is jax-free — seeded keys are packed with
+        numpy — so nothing else can compile mid-serve). Call BEFORE
+        admitting real requests (the reset frees every slot);
+        idempotent. Replaces the hand-rolled one-admit-one-step
+        warmups tests and examples used to do."""
+        if self._warmed:
+            return self
+        ecfg = self.engine_cfg
+        for (bucket, k), fn in sorted(self._admits.items()):
+            # dummy args exercise shapes only: k pad-token prompts of
+            # length 1, budget 1 (done at admission), no sampling
+            self.cache, self.state, first, _, _ = fn(
+                self._params, self.cache, self.state,
+                np.arange(k, dtype=np.int32),
+                np.full((k, bucket), ecfg.pad_token_id, np.int32),
+                np.ones((k,), np.int32), np.ones((k,), np.int32),
+                np.zeros((k,), np.float32), np.zeros((k,), np.int32),
+                np.ones((k,), np.float32),
+                np.zeros((k, 2), np.uint32),
+                np.full((k,), _NO_EOS, np.int32),
+                np.zeros((k,), np.int32), np.zeros((k,), bool))
+            np.asarray(first)
+        handle = self.step_async()
+        handle.fetch()
+        self.state = self._retire(self.state, np.int32(0))
+        # drop the warmup junk: a fresh init (compiled at construction)
+        # frees every slot again
+        self.cache, self.state = self._init(self._params)
+        self._warmed = True
+        return self
+
+    def _admit_variant_name(self, bucket: int, k: int) -> str:
+        return f"admit_p{bucket}_k{k}"
 
     def compiled_cache_sizes(self) -> Dict[str, Any]:
         """jit-cache entry count per program — the trace-stability
         probe: after warmup each must stay at 1 no matter how many
-        requests were admitted (the oracle test asserts this)."""
-        out = {}
-        for name in ("init", "step", "admit", "retire"):
-            fn = getattr(self, f"_{name}")
-            size = getattr(fn, "_cache_size", None)
-            out[name] = size() if callable(size) else None
+        requests were admitted (the oracle test asserts this). The
+        aggregate ``"admit"`` key is the MAX over the per-(bucket, k)
+        variants (each also reported under ``admit_p{bucket}_k{k}``),
+        so it reads exactly like the single-program days: 1 = stable."""
+        size_of = lambda fn: (fn._cache_size()
+                              if callable(getattr(fn, "_cache_size", None))
+                              else None)
+        out = {name: size_of(getattr(self, f"_{name}"))
+               for name in ("init", "step", "retire")}
+        admit_sizes = []
+        for (bucket, k), fn in sorted(self._admits.items()):
+            s = size_of(fn)
+            out[self._admit_variant_name(bucket, k)] = s
+            if s is not None:
+                admit_sizes.append(s)
+        out["admit"] = max(admit_sizes) if admit_sizes else None
         return out
 
     # -- recompile sentinel (apex_tpu.telemetry.recompile) -----------------
@@ -288,9 +614,10 @@ class Engine:
     def recompile_sentinel(self, registry=None):
         """The engine's installed
         :class:`apex_tpu.telemetry.recompile.RecompileSentinel`, created
-        on first call with all four compiled programs tracked (so
-        ``compiles_total()["tracked"]`` attributes growth to
-        init/step/admit/retire by name). Pass ``registry`` on the first
+        on first call with every compiled program tracked —
+        init/step/retire plus one ``admit_p{bucket}_k{k}`` entry per
+        admission variant (so ``compiles_total()["tracked"]``
+        attributes growth by name). Pass ``registry`` on the first
         call to mirror compile/alarm counters into ``/metrics`` —
         passing it once a registry-less sentinel exists raises rather
         than silently dropping the wiring (the counters would simply
@@ -307,21 +634,23 @@ class Engine:
             from apex_tpu.telemetry.recompile import RecompileSentinel
 
             sentinel = RecompileSentinel(registry=registry).install()
-            for name in ("init", "step", "admit", "retire"):
+            for name in ("init", "step", "retire"):
                 sentinel.track(name, getattr(self, f"_{name}"))
+            for (bucket, k), fn in sorted(self._admits.items()):
+                sentinel.track(self._admit_variant_name(bucket, k), fn)
             self._sentinel = sentinel
         return self._sentinel
 
     def recompile_guard(self, *, raise_on_recompile: bool = True,
                         registry=None):
         """Arm the never-recompile-after-warmup invariant: enter the
-        returned context once every program has compiled (one admit +
-        one step + one retire cover it) and any later compilation —
+        returned context once every program has compiled
+        (:meth:`warmup` covers all of them) and any later compilation —
         process-wide event or growth of this engine's program caches —
         increments the alarm counter and (by default) raises
         :class:`~apex_tpu.telemetry.recompile.RecompileError`::
 
-            engine/scheduler warmup ...
+            engine.warmup()
             with engine.recompile_guard():
                 serve_forever()
         """

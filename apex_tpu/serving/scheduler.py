@@ -3,20 +3,40 @@
 Policy lives here, device mechanics in :mod:`apex_tpu.serving.engine`:
 a FIFO request queue with backpressure (``max_queue``), per-request
 deadlines (queued requests expire in place; active slots are retired),
-admission of queued requests into free slots, a response stream
+batched admission of queued requests into free slots
+(:meth:`Engine.admit_many` — a burst drains in ~1 dispatch per ladder
+group instead of one per request), a response stream
 (:class:`apex_tpu.serving.request.StreamEvent`), and serving metrics —
 TTFT, per-token latency, queue depth, slot occupancy, tokens/s —
 aggregated via :class:`apex_tpu.profiler.LatencyStats` and emitted
 through a :class:`apex_tpu.profiler.MetricsLogger` when one is given.
 
+The decode loop is PIPELINED (``pipeline_depth``): each tick dispatches
+the next chunk (``Engine.step_async``) before fetching the previous
+one's tokens, so the host's fetch + event processing + admission
+interval overlaps device compute — serial ``device + host`` becomes
+``max(device, host)``. Depth 1 is the serial loop (dispatch, then fetch
+immediately); depth d keeps up to d-1 chunks in flight between ticks.
+Each in-flight chunk carries a snapshot of the slots that were live at
+dispatch: a slot released while the chunk was in flight (finish seen in
+an earlier chunk, or a deadline retire) has its columns dropped — the
+device emits pad for done slots, and a retired slot's in-flight real
+tokens belong to a request that already completed. Per-request token
+streams are bit-identical at every depth (the pipelined-parity test);
+only deadline OBSERVATION granularity coarsens with depth, exactly as
+it already coarsens with ``decode_chunk``.
+
 Observability (``apex_tpu.telemetry``): pass ``registry`` to count
-admissions / finishes-by-reason / tokens and observe TTFT + per-token
-latency into SLO-bucketed histograms (scrapeable live via
+admissions (by prefill bucket and admission-batch size) / finishes-by-
+reason / tokens, gauge the in-flight pipeline depth, and observe TTFT +
+per-token latency into SLO-bucketed histograms (scrapeable live via
 ``telemetry.http.MetricsServer``), and ``spans`` to record each
 request's phase timeline (queued → prefill → first_token → decode
-chunks → retired) plus engine-dispatch sections, exportable as
-Chrome-trace JSON. Both are pre-bound at construction so the per-token
-hot path pays an attribute access and an add, nothing more.
+chunks → retired) plus ``engine.dispatch`` / ``engine.fetch`` /
+``engine.admit`` host sections — the dispatch-vs-fetch split shows
+exactly how much host time the pipeline hides. Both are pre-bound at
+construction so the per-token hot path pays an attribute access and an
+add, nothing more.
 
 The boundary fix the engine relies on: a request whose prompt already
 ends in its eos token completes at ``submit`` time with zero generated
@@ -28,10 +48,10 @@ from __future__ import annotations
 
 import collections
 import time
-from typing import Callable, Deque, Dict, List, Optional
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from apex_tpu import profiler
-from apex_tpu.serving.engine import Engine
+from apex_tpu.serving.engine import Admission, Engine, StepHandle
 from apex_tpu.serving.request import (
     FINISH_EOS,
     FINISH_LENGTH,
@@ -52,24 +72,44 @@ class _RegistryMetrics:
     """Pre-bound registry handles — children resolved once here so the
     scheduler's per-token path never does a name/label lookup."""
 
-    def __init__(self, registry, slots: int):
+    def __init__(self, registry, engine: Engine):
         self.queue_depth = registry.gauge(
             "serving_queue_depth", "requests waiting for a slot")
         self.active_slots = registry.gauge(
             "serving_active_slots", "decode slots currently occupied")
         registry.gauge(
             "serving_slots_total", "decode slots in the engine"
-        ).set(slots)
+        ).set(engine.slots)
+        self.inflight = registry.gauge(
+            "serving_inflight_chunks",
+            "decode chunks dispatched but not yet fetched (the pipeline "
+            "depth actually in use)")
         self.submitted = registry.counter(
             "serving_requests_submitted_total", "requests accepted into "
             "the queue (or completed at submit)")
         self.admitted = registry.counter(
             "serving_requests_admitted_total",
             "requests prefilled into a slot")
+        self.admit_dispatches = registry.counter(
+            "serving_admit_dispatches_total",
+            "batched admission dispatches (one compiled (bucket, k) "
+            "program call each)")
+        ab = registry.counter(
+            "serving_admit_batch_requests_total",
+            "requests admitted, by admission-batch size",
+            labels=("size",))
+        # pre-create every ladder rung so a scrape shows explicit zeros
+        self.admit_batch = {k: ab.labels(size=str(k))
+                            for k in engine.admit_batch_sizes}
+        bk = registry.counter(
+            "serving_prefill_bucket_requests_total",
+            "requests admitted, by padded prefill bucket",
+            labels=("bucket",))
+        self.bucket = {b: bk.labels(bucket=str(b))
+                       for b in engine.prompt_buckets}
         fin = registry.counter(
             "serving_requests_finished_total",
             "completed requests by finish reason", labels=("reason",))
-        # pre-create every reason so a scrape shows explicit zeros
         self.finished = {r: fin.labels(reason=r) for r in FINISH_REASONS}
         self.queue_expired = registry.counter(
             "serving_queue_expired_total",
@@ -82,8 +122,8 @@ class _RegistryMetrics:
             "serving_ttft_seconds", "arrival to first token")
         self.token_latency = registry.histogram(
             "serving_token_latency_seconds",
-            "per-token steady-decode latency (chunk wall time / chunk "
-            "tokens)")
+            "per-token steady-decode latency (chunk dispatch-to-fetch "
+            "wall time / chunk tokens)")
         self.request_latency = registry.histogram(
             "serving_request_latency_seconds", "arrival to completion")
 
@@ -102,31 +142,46 @@ class _Active:
 class Scheduler:
     """Drive an :class:`Engine` over a stream of requests.
 
-    >>> sched = Scheduler(engine)
+    >>> sched = Scheduler(engine, pipeline_depth=2)
     >>> sched.submit(Request("r0", prompt, max_tokens=16))
     >>> sched.run_until_idle()
     >>> sched.completions["r0"].tokens
 
     ``clock`` is injectable (tests drive deadlines with a fake clock);
     it must be monotonic. ``metrics`` receives one record per step plus
-    one per completion.
+    one per completion. ``pipeline_depth`` >= 2 overlaps host work with
+    device decode (see module docstring); ``max_admit_batch`` caps how
+    many queued requests one tick hands to ``Engine.admit_many`` (None
+    = all that fit the free slots; 1 = serial single admits, the A/B
+    baseline).
     """
 
     def __init__(self, engine: Engine, *, max_queue: int = 256,
                  metrics: Optional[profiler.MetricsLogger] = None,
                  registry=None, spans=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 pipeline_depth: int = 1,
+                 max_admit_batch: Optional[int] = None):
+        if pipeline_depth < 1:
+            raise ValueError(
+                f"pipeline_depth {pipeline_depth} must be >= 1 (1 = the "
+                f"serial loop)")
+        if max_admit_batch is not None and max_admit_batch < 1:
+            raise ValueError(
+                f"max_admit_batch {max_admit_batch} must be >= 1 or None")
         self.engine = engine
         self.max_queue = max_queue
         self.metrics = metrics
         self.clock = clock
+        self.pipeline_depth = pipeline_depth
+        self.max_admit_batch = max_admit_batch
         #: telemetry sinks (both optional): a telemetry.Registry the
         #: scheduler counts/observes into, and a telemetry.SpanRecorder
         #: receiving per-request phase marks + dispatch sections. The
         #: recorder's clock is slaved to the scheduler's so injected
         #: test clocks produce deterministic timelines.
         self.telemetry = (None if registry is None
-                          else _RegistryMetrics(registry, engine.slots))
+                          else _RegistryMetrics(registry, engine))
         self.spans = spans
         if spans is not None:
             spans.clock = self.clock
@@ -137,15 +192,25 @@ class Scheduler:
         self.ttft_stats = profiler.LatencyStats()
         self.token_latency_stats = profiler.LatencyStats()
         self._free: List[int] = list(range(engine.slots))[::-1]
+        #: chunks dispatched but not yet fetched, oldest first; each
+        #: entry is (handle, slot->_Active snapshot at dispatch,
+        #: dispatch time)
+        self._inflight: Deque[
+            Tuple[StepHandle, Dict[int, _Active], float]] = \
+            collections.deque()
         self._steps = 0
         self._tokens_emitted = 0
+        self._admitted_requests = 0
+        self._admit_dispatches = 0
         self._started: Optional[float] = None
-        self._last_step_time: Optional[float] = None
-        # steady-decode split: wall time inside engine.step() and the
-        # tokens it emitted — TTFT (admission/prefill) excluded, so
-        # summary() can report the two regimes separately
+        # steady-decode split: wall time attributable to decode chunks
+        # (dispatch-to-fetch, overlap-deduplicated so pipelined chunks
+        # never double-count an interval) and the tokens they emitted —
+        # TTFT (admission/prefill) excluded, so summary() can report
+        # the two regimes separately
         self._decode_time = 0.0
         self._decode_tokens = 0
+        self._decode_mark = float("-inf")
 
     # -- intake ------------------------------------------------------------
 
@@ -200,56 +265,26 @@ class Scheduler:
     # -- the loop ----------------------------------------------------------
 
     def step(self) -> None:
-        """One scheduler tick: expire deadlines, admit into free slots,
-        advance the engine one decode CHUNK (``decode_chunk`` tokens
-        per live slot, one dispatch) if any slot is live, and unpack
-        the chunk's per-token stream events in emission order.
-        Deadlines and admissions are checked between chunks — the
-        ``decode_chunk`` admission-latency/throughput tradeoff."""
+        """One scheduler tick: expire deadlines, batch-admit queued
+        requests into free slots, dispatch the next decode chunk if any
+        slot is live, then fetch + unpack chunks down to the pipeline
+        depth (ALL of them when nothing was dispatched — the drain
+        path, so a tick always makes progress). At depth 1 this is the
+        serial loop: dispatch, fetch, unpack. Deadlines and admissions
+        are checked between chunks — the ``decode_chunk`` admission-
+        latency/throughput tradeoff, now also the pipeline-depth one."""
         now = self.clock()
         if self._started is None:
             self._started = now
         self._expire(now)
         self._admit_queued(now)
-        if self.active:
-            before = self.clock()
-            tokens, finished = self.engine.step()
-            dt = self.clock() - before
-            if self.spans is not None:
-                # one section per dispatch + a decode mark per slot
-                # that rode the chunk (each O(1) ring appends)
-                self.spans.section_at("engine.step", before, before + dt)
-                for act in self.active.values():
-                    self.spans.mark(act.request.request_id,
-                                    spans_mod.PHASE_DECODE)
-            n_cols = tokens.shape[1]
-            per_tok = dt / n_cols
-            self._decode_time += dt
-            tele = self.telemetry
-            for j in range(n_cols):
-                # slots released at an earlier column drop out of
-                # active; their remaining columns are pad by contract
-                for slot in list(self.active):
-                    act = self.active[slot]
-                    tok = int(tokens[slot, j])
-                    act.tokens.append(tok)
-                    self._tokens_emitted += 1
-                    self._decode_tokens += 1
-                    self.token_latency_stats.add(per_tok)
-                    if tele is not None:
-                        tele.tokens.inc()
-                        tele.token_latency.observe(per_tok)
-                    done = bool(finished[slot, j])
-                    reason = None
-                    if done:
-                        eos = act.request.eos_token_id
-                        reason = (FINISH_EOS
-                                  if eos is not None and tok == eos
-                                  else FINISH_LENGTH)
-                    self.events.append(StreamEvent(
-                        act.request.request_id, tok, done, reason))
-                    if done:
-                        self._release(slot, reason)
+        dispatched = False
+        if self._dispatchable():
+            self._dispatch_chunk()
+            dispatched = True
+        keep = self.pipeline_depth - 1 if dispatched else 0
+        while len(self._inflight) > keep:
+            self._collect_oldest()
         self._steps += 1
         if self.telemetry is not None:
             self.telemetry.steps.inc()
@@ -264,16 +299,24 @@ class Scheduler:
                 "tokens_per_sec": self._tokens_emitted / elapsed,
             })
 
+    def drain(self) -> None:
+        """Fetch + unpack every in-flight chunk (pipeline drain): after
+        this, ``events``/``completions`` reflect all dispatched work."""
+        while self._inflight:
+            self._collect_oldest()
+
     def run_until_idle(self, max_steps: int = 100_000) -> None:
-        """Step until queue and slots are empty (offline batch mode)."""
+        """Step until queue, slots, and the pipeline are empty (offline
+        batch mode)."""
         steps = 0
-        while self.queue or self.active:
+        while self.queue or self.active or self._inflight:
             self.step()
             steps += 1
             if steps > max_steps:
                 raise RuntimeError(
                     f"not idle after {max_steps} steps — live slots "
-                    f"{sorted(self.active)}, queue {len(self.queue)}")
+                    f"{sorted(self.active)}, queue {len(self.queue)}, "
+                    f"{len(self._inflight)} chunks in flight")
 
     def pop_events(self) -> List[StreamEvent]:
         """Drain the response stream."""
@@ -282,6 +325,99 @@ class Scheduler:
         return out
 
     # -- internals ---------------------------------------------------------
+
+    def _dispatchable(self) -> bool:
+        """Whether dispatching another chunk can produce ANY real
+        token: some active slot must have token budget left beyond the
+        columns already in flight for it. Without this guard a deep
+        pipeline burns a guaranteed-all-pad chunk at every wave of
+        finishes (the host only learns a slot died when it fetches the
+        chunk that killed it). Early-eos finishes stay speculative —
+        the host cannot predict them, so a chunk may still carry some
+        pad lanes, exactly like a mid-chunk finish under
+        ``decode_chunk`` — but a chunk that CANNOT pay for itself is
+        never dispatched."""
+        if not self.active:
+            return False
+        if not self._inflight:
+            return True
+        cols: Dict[int, int] = {}
+        chunk = self.engine.engine_cfg.decode_chunk
+        for _, snapshot, _ in self._inflight:
+            for slot, act in snapshot.items():
+                if self.active.get(slot) is act:
+                    cols[slot] = cols.get(slot, 0) + chunk
+        return any(
+            len(act.tokens) + cols.get(slot, 0) < act.request.max_tokens
+            for slot, act in self.active.items())
+
+    def _dispatch_chunk(self) -> None:
+        t0 = self.clock()
+        handle = self.engine.step_async()
+        t1 = self.clock()
+        if self.spans is not None:
+            # the host-side cost of getting the chunk onto the device —
+            # the half of the old engine.step section the pipeline
+            # cannot hide
+            self.spans.section_at("engine.dispatch", t0, t1)
+        # snapshot the live slots: by the time this chunk is fetched,
+        # some may have been released (finish seen in an earlier chunk,
+        # deadline retire) and their columns must be dropped
+        self._inflight.append((handle, dict(self.active), t0))
+        if self.telemetry is not None:
+            self.telemetry.inflight.set(len(self._inflight))
+
+    def _collect_oldest(self) -> None:
+        handle, snapshot, t_dispatch = self._inflight.popleft()
+        t0 = self.clock()
+        tokens, finished = handle.fetch()
+        now = self.clock()
+        tele = self.telemetry
+        if tele is not None:
+            tele.inflight.set(len(self._inflight))
+        if self.spans is not None:
+            # the blocking wait for the chunk's value — under pipelining
+            # this shrinks toward zero while engine.dispatch stays put
+            self.spans.section_at("engine.fetch", t0, now)
+            for slot, act in snapshot.items():
+                if self.active.get(slot) is act:
+                    self.spans.mark(act.request.request_id,
+                                    spans_mod.PHASE_DECODE)
+        n_cols = tokens.shape[1]
+        # in-flight latency of this chunk (dispatch -> value); the
+        # decode-time split dedups the overlap so pipelined chunks
+        # don't double-count wall time
+        per_tok = max(now - t_dispatch, 0.0) / n_cols
+        self._decode_time += now - max(self._decode_mark, t_dispatch)
+        self._decode_mark = now
+        for j in range(n_cols):
+            for slot, act in snapshot.items():
+                # a slot released since dispatch (earlier chunk/column
+                # finish, or a deadline retire landing mid-flight) is
+                # skipped: the device emits pad for done lanes, and a
+                # retired request's in-flight tokens belong to a
+                # completion that already closed
+                if self.active.get(slot) is not act:
+                    continue
+                tok = int(tokens[slot, j])
+                act.tokens.append(tok)
+                self._tokens_emitted += 1
+                self._decode_tokens += 1
+                self.token_latency_stats.add(per_tok)
+                if tele is not None:
+                    tele.tokens.inc()
+                    tele.token_latency.observe(per_tok)
+                done = bool(finished[slot, j])
+                reason = None
+                if done:
+                    eos = act.request.eos_token_id
+                    reason = (FINISH_EOS
+                              if eos is not None and tok == eos
+                              else FINISH_LENGTH)
+                self.events.append(StreamEvent(
+                    act.request.request_id, tok, done, reason))
+                if done:
+                    self._release(slot, reason)
 
     def _expire(self, now: float) -> None:
         self.queue = collections.deque(
@@ -309,42 +445,57 @@ class Scheduler:
 
     def _admit_queued(self, now: float) -> None:
         while self._free and self.queue:
-            request = self.queue.popleft()
-            slot = self._free.pop()
-            sp = request.sampling
+            n = min(len(self._free), len(self.queue))
+            if self.max_admit_batch is not None:
+                n = min(n, self.max_admit_batch)
+            reqs = [self.queue.popleft() for _ in range(n)]
+            slots = [self._free.pop() for _ in range(n)]
             if self.spans is not None:
-                self.spans.mark(request.request_id,
-                                spans_mod.PHASE_PREFILL,
-                                note=f"slot {slot}")
+                for r, slot in zip(reqs, slots):
+                    self.spans.mark(r.request_id, spans_mod.PHASE_PREFILL,
+                                    note=f"slot {slot}")
                 t_admit = self.clock()
-            first, hit_eos, done = self.engine.admit(
-                slot, request.prompt, request.max_tokens,
-                temperature=sp.temperature, top_k=sp.top_k, top_p=sp.top_p,
-                seed=sp.seed,
-                eos_token_id=request.eos_token_id)
-            act = _Active(request)
+            results = self.engine.admit_many([
+                Admission(slot=slot, prompt=r.prompt,
+                          max_tokens=r.max_tokens,
+                          temperature=r.sampling.temperature,
+                          top_k=r.sampling.top_k, top_p=r.sampling.top_p,
+                          seed=r.sampling.seed,
+                          eos_token_id=r.eos_token_id)
+                for r, slot in zip(reqs, slots)])
             t_first = self.clock()
-            act.first_token_time = t_first
-            act.tokens.append(first)
-            self._tokens_emitted += 1
-            self.ttft_stats.add(t_first - request.arrival_time)
+            n_groups = results[-1].group + 1
+            self._admitted_requests += n
+            self._admit_dispatches += n_groups
             if self.spans is not None:
                 self.spans.section_at("engine.admit", t_admit, t_first)
-                self.spans.mark(request.request_id,
-                                spans_mod.PHASE_FIRST_TOKEN)
-            if self.telemetry is not None:
-                self.telemetry.admitted.inc()
-                self.telemetry.tokens.inc()
-                self.telemetry.queue_depth.set(len(self.queue))
-                self.telemetry.ttft.observe(t_first - request.arrival_time)
-            reason = None
-            if done:
-                reason = FINISH_EOS if hit_eos else FINISH_LENGTH
-            self.events.append(StreamEvent(
-                request.request_id, first, done, reason))
-            self.active[slot] = act
-            if done:
-                self._release(slot, reason)
+            tele = self.telemetry
+            if tele is not None:
+                tele.admit_dispatches.inc(n_groups)
+                tele.queue_depth.set(len(self.queue))
+            for r, slot, res in zip(reqs, slots, results):
+                act = _Active(r)
+                act.first_token_time = t_first
+                act.tokens.append(res.first_token)
+                self._tokens_emitted += 1
+                self.ttft_stats.add(t_first - r.arrival_time)
+                if self.spans is not None:
+                    self.spans.mark(r.request_id,
+                                    spans_mod.PHASE_FIRST_TOKEN)
+                if tele is not None:
+                    tele.admitted.inc()
+                    tele.tokens.inc()
+                    tele.ttft.observe(t_first - r.arrival_time)
+                    tele.admit_batch[res.batch_size].inc()
+                    tele.bucket[res.bucket].inc()
+                reason = None
+                if res.finished:
+                    reason = FINISH_EOS if res.hit_eos else FINISH_LENGTH
+                self.events.append(StreamEvent(
+                    r.request_id, res.first_token, res.finished, reason))
+                self.active[slot] = act
+                if res.finished:
+                    self._release(slot, reason)
 
     def _release(self, slot: int, reason: str) -> None:
         act = self.active.pop(slot)
@@ -397,13 +548,19 @@ class Scheduler:
             "requests_completed": float(len(self.completions)),
             "tokens_emitted": float(self._tokens_emitted),
             "steps": float(self._steps),
+            "admitted_requests": float(self._admitted_requests),
+            # batched admission's amortisation, directly: requests
+            # prefilled per compiled admission dispatch
+            "admit_dispatches": float(self._admit_dispatches),
+            "pipeline_depth": float(self.pipeline_depth),
         }
         if elapsed:
             out["tokens_per_sec"] = self._tokens_emitted / elapsed
         if self._decode_time > 0:
             # the steady-state half of the TTFT-vs-decode split: tokens
-            # emitted by engine.step() per second of wall time spent in
-            # it (admission/prefill — the TTFT side — excluded)
+            # emitted by decode chunks per second of (overlap-dedup'd)
+            # wall time spent on them (admission/prefill — the TTFT
+            # side — excluded)
             out["decode_tokens_per_sec"] = (
                 self._decode_tokens / self._decode_time)
             out["decode_tokens"] = float(self._decode_tokens)

@@ -96,6 +96,11 @@ def main():
                     help="tokens per compiled decode dispatch "
                     "(gpt.decode_steps): amortises dispatch latency; "
                     "token streams are identical at any setting")
+    ap.add_argument("--pipeline-depth", type=int, default=2,
+                    help="decode chunks kept in flight by the "
+                    "scheduler (Engine.step_async): 1 = serial loop, "
+                    "2+ overlaps host event processing with device "
+                    "decode; token streams are identical at any depth")
     ap.add_argument("--ckpt", help=".atck from examples/gpt_train.py "
                     "(--preset tiny); random init if omitted")
     ap.add_argument("--metrics-port", type=int, default=None,
@@ -130,6 +135,10 @@ def main():
     engine = Engine(cfg, params, mesh, EngineConfig(
         slots=args.slots, max_prompt_len=args.max_prompt_len,
         max_seq_len=args.max_seq_len, decode_chunk=args.decode_chunk))
+    # compile every program (init/step/retire + each (bucket, k)
+    # admission variant) before the first request — admission never
+    # traces mid-serve, and recompile_guard could be armed right here
+    engine.warmup()
     reqs = (load_requests(args.requests, cfg.vocab_size) if args.requests
             else synthetic_requests(args.num_requests, 8, args.max_tokens,
                                     cfg.vocab_size))
@@ -156,7 +165,8 @@ def main():
     # offline batch mode submits everything up front — size the queue to
     # the trace instead of dying on backpressure at the default 256
     sched = Scheduler(engine, max_queue=max(256, len(reqs)),
-                      registry=registry, spans=spans)
+                      registry=registry, spans=spans,
+                      pipeline_depth=args.pipeline_depth)
     for r in reqs:
         sched.submit(r)
     sched.run_until_idle()

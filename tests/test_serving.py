@@ -665,3 +665,266 @@ def test_serving_soak_smoke(devices8):
     sizes = eng.compiled_cache_sizes()
     for name in ("step", "admit"):
         assert sizes[name] in (1, None), sizes
+
+
+# --- batched, bucketed admission + pipelined loop (PR 4) --------------------
+
+
+def test_admit_many_matches_single_admits(devices8):
+    """The admission-parity oracle: ``admit_many(k)`` — one padded
+    [k, bucket] prefill forward + one state/cache scatter — produces
+    the SAME first tokens and the same subsequent decode streams as k
+    single ``admit`` calls in the same order (greedy and sampled lanes,
+    mixed prompt lengths spanning buckets)."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    from apex_tpu.serving.engine import Admission
+
+    ecfg = EngineConfig(slots=4, max_prompt_len=10, max_seq_len=24)
+    items = []
+    for i in range(4):
+        p_len = (3, 9, 5, 10)[i]
+        prompt = [int(t) for t in jax.random.randint(
+            jax.random.PRNGKey(810 + i), (p_len,), 0, VOCAB)]
+        kw = (dict(temperature=0.9, top_k=5, seed=60 + i) if i % 2
+              else {})
+        items.append(Admission(slot=i, prompt=prompt, max_tokens=8,
+                               eos_token_id=13, **kw))
+
+    eng_b = Engine(cfg, params, mesh, ecfg)
+    batched = eng_b.admit_many(items)
+    assert [r.batch_size for r in batched] == [4] * 4
+    assert batched[0].bucket == 10  # smallest bucket >= the batch max
+    eng_s = Engine(cfg, params, mesh, ecfg)
+    singles = [eng_s.admit(a.slot, a.prompt, a.max_tokens,
+                           temperature=a.temperature, top_k=a.top_k,
+                           top_p=a.top_p, seed=a.seed,
+                           eos_token_id=a.eos_token_id) for a in items]
+    assert [(r.first_token, r.hit_eos, r.finished) for r in batched] == \
+        singles
+    for _ in range(4):  # the inserted caches/state rows decode the same
+        tb, fb = eng_b.step()
+        ts, fs = eng_s.step()
+        np.testing.assert_array_equal(tb, ts)
+        np.testing.assert_array_equal(fb, fs)
+    # a 3-item call decomposes over the ladder largest-first: 2 + 1
+    eng_b2 = Engine(cfg, params, mesh, ecfg)
+    three = eng_b2.admit_many(items[:3])
+    assert [(r.batch_size, r.group) for r in three] == \
+        [(2, 0), (2, 0), (1, 1)]
+    assert [r.first_token for r in three] == \
+        [s[0] for s in singles[:3]]
+    with pytest.raises(ValueError, match="distinct"):
+        eng_b2.admit_many([items[0], items[0]])
+
+
+def test_bucketed_prefill_matches_max_length(devices8):
+    """Bucketed admission is bit-identical to the flat max-length
+    prefill (causal padding exactness — same argument as prefill_at),
+    across a whole scheduler trace AND for the same request admitted
+    at two different bucket ladders."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    reqs = _mixed_requests(6, 10, eos=13, seed0=820)
+    clone = lambda: [Request(r.request_id, r.prompt, r.max_tokens,
+                             sampling=r.sampling,
+                             eos_token_id=r.eos_token_id) for r in reqs]
+    got_bucketed = _run_trace(
+        Engine(cfg, params, mesh,
+               EngineConfig(slots=2, max_prompt_len=10, max_seq_len=24)),
+        clone())
+    got_flat = _run_trace(
+        Engine(cfg, params, mesh,
+               EngineConfig(slots=2, max_prompt_len=10, max_seq_len=24,
+                            prompt_buckets=(10,),
+                            admit_batch_sizes=(1,))),
+        clone())
+    assert got_bucketed == got_flat
+
+
+def test_engine_ladder_validation(devices8):
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    mk = lambda **kw: Engine(cfg, params, mesh, EngineConfig(
+        slots=2, max_prompt_len=8, max_seq_len=16, **kw))
+    with pytest.raises(ValueError, match="end"):
+        mk(prompt_buckets=(4, 6))       # must end at max_prompt_len
+    with pytest.raises(ValueError, match="increasing"):
+        mk(prompt_buckets=(8, 4))
+    with pytest.raises(ValueError, match="start at 1"):
+        mk(admit_batch_sizes=(2,))
+    with pytest.raises(ValueError, match="exceeds slots"):
+        mk(admit_batch_sizes=(1, 4))
+    from apex_tpu.serving.engine import default_prompt_buckets
+
+    assert default_prompt_buckets(64) == (8, 16, 32, 64)
+    assert default_prompt_buckets(10) == (8, 10)
+    assert default_prompt_buckets(6) == (6,)
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        Scheduler(mk(), pipeline_depth=0)
+    with pytest.raises(ValueError, match="max_admit_batch"):
+        Scheduler(mk(), max_admit_batch=0)
+
+
+def test_pipelined_matches_serial_and_solo(devices8):
+    """The pipelining oracle: per-request token streams are
+    bit-identical at pipeline depths 1 (serial), 2, and 3, with and
+    without batched admission, and match solo ``gpt.generate`` — the
+    in-flight snapshot bookkeeping never corrupts a stream."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    reqs = _mixed_requests(7, 10, eos=13, seed0=830)
+    mk_eng = lambda: Engine(
+        cfg, params, mesh,
+        EngineConfig(slots=2, max_prompt_len=10, max_seq_len=24,
+                     decode_chunk=4))
+    got = {}
+    scheds = {}
+    for depth, mab in ((1, 1), (2, None), (3, None)):
+        sched = Scheduler(mk_eng(), pipeline_depth=depth,
+                          max_admit_batch=mab)
+        for r in reqs:
+            sched.submit(Request(r.request_id, r.prompt, r.max_tokens,
+                                 sampling=r.sampling,
+                                 eos_token_id=r.eos_token_id))
+        sched.run_until_idle()
+        assert not sched._inflight  # idle means the pipeline drained
+        got[(depth, mab)] = {rid: c.tokens
+                             for rid, c in sched.completions.items()}
+        scheds[(depth, mab)] = sched
+    assert got[(1, 1)] == got[(2, None)] == got[(3, None)]
+    # batched admission actually amortised: fewer dispatches than
+    # requests on the pipelined runs
+    assert scheds[(2, None)].summary()["admit_dispatches"] < len(reqs)
+    _assert_oracle(cfg, params, mesh, scheds[(2, None)], reqs)
+
+
+def test_retire_lands_while_chunk_in_flight(devices8):
+    """Deadline expiry with a decode chunk IN FLIGHT (pipeline depth
+    2): the retired request keeps only the tokens collected before the
+    retire (the in-flight chunk's lanes are dropped — the device emits
+    its tokens, the scheduler discards them), its span timeline still
+    closes with a ``retired`` mark, the batch-mate's stream is
+    untouched, and the freed slot serves a fresh request with full
+    solo parity — no state corruption."""
+    from apex_tpu.telemetry import SpanRecorder
+    from apex_tpu.telemetry import spans as spans_mod
+
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    eng = Engine(cfg, params, mesh,
+                 EngineConfig(slots=2, max_prompt_len=8, max_seq_len=24,
+                              decode_chunk=4))
+    now = [0.0]
+    spans = SpanRecorder()
+    sched = Scheduler(eng, clock=lambda: now[0], pipeline_depth=2,
+                      spans=spans)
+    doomed = Request("doomed", [1, 2, 3], max_tokens=12, deadline=5.0)
+    mate = Request("mate", [4, 5, 6, 7], max_tokens=10)
+    sched.submit(doomed)
+    sched.submit(mate)
+    sched.step()   # admits both, dispatches chunk 1 (stays in flight)
+    assert sched._inflight and len(sched.completions) == 0
+    now[0] = 6.0   # chunk 1 still in flight when the deadline lands
+    sched.step()   # expire retires "doomed"; its in-flight lanes drop
+    dc = sched.completions["doomed"]
+    assert dc.finish_reason == FINISH_TIMEOUT
+    assert len(dc.tokens) == 1  # the admission token only — chunk 1's
+    # four real tokens for the retired slot were dropped, not leaked
+    sched.run_until_idle()
+    mc = sched.completions["mate"]
+    assert mc.tokens == _solo_generate(cfg, params, mesh, [4, 5, 6, 7],
+                                       10, mate.sampling)
+    # the span timeline still closed for the retired request
+    retired = [e for e in spans.events()
+               if e[0] == 0 and e[2] == "doomed"
+               and e[3] == spans_mod.PHASE_RETIRED]
+    assert retired and retired[0][4] == FINISH_TIMEOUT
+    # the freed slot (and its stale cache columns) serve a fresh
+    # request with full parity
+    fresh = Request("fresh", [8, 9], max_tokens=6)
+    sched.submit(fresh)
+    sched.run_until_idle()
+    assert sched.completions["fresh"].tokens == _solo_generate(
+        cfg, params, mesh, [8, 9], 6, fresh.sampling)
+
+
+def test_unseeded_requests_get_distinct_default_keys(devices8):
+    """The shared-default-PRNG fix: two unseeded sampled requests with
+    the SAME prompt and params draw DIFFERENT streams (every request
+    used to inherit the zero key), the derivation is deterministic
+    across engine rebuilds (a monotonic counter folded on device), and
+    seeded paths are bit-stable against an explicit PRNGKey."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    mk = lambda: Engine(cfg, params, mesh,
+                        EngineConfig(slots=2, max_prompt_len=8,
+                                     max_seq_len=24))
+
+    def run_pair(eng):
+        streams = [[], []]
+        for s in (0, 1):
+            first, _, _ = eng.admit(s, [5, 6, 7], 8, temperature=1.0)
+            streams[s].append(first)
+        for _ in range(7):
+            toks, _ = eng.step()
+            for s in (0, 1):
+                streams[s].append(int(toks[s, 0]))
+        return streams
+
+    a = run_pair(mk())
+    assert a[0] != a[1], "unseeded requests shared a PRNG stream"
+    assert run_pair(mk()) == a  # deterministic across rebuilds
+    # a seeded admit is untouched by the counter machinery: same
+    # stream whether it is the 1st or the 10th admission
+    eng1, eng2 = mk(), mk()
+    for i in range(5):  # burn counters on engine 2 only
+        eng2.admit(0, [1 + i], 1)
+    s1 = eng1.admit(0, [5, 6, 7], 4, temperature=0.9, seed=42)
+    s2 = eng2.admit(0, [5, 6, 7], 4, temperature=0.9, seed=42)
+    assert s1 == s2
+
+
+def test_threefry_key_data_matches_prngkey():
+    """The host-side numpy key packing admit_many uses for seeded
+    requests is bit-identical to ``jax.random.PRNGKey`` — the
+    non-negative int32 domain takes the numpy fast path (no device
+    round trip); exotic seeds fall back to the real PRNGKey, so
+    equality holds everywhere."""
+    from apex_tpu.serving.engine import _threefry_key_data
+
+    for seed in (0, 1, 42, 2**31 - 1, -1):
+        np.testing.assert_array_equal(
+            _threefry_key_data(seed),
+            np.asarray(jax.random.PRNGKey(seed), np.uint32),
+            err_msg=f"seed {seed}")
+
+
+def test_warmup_compiles_everything_and_stays_flat(devices8):
+    """``Engine.warmup()`` compiles every program — init/step/retire
+    and ALL (bucket, k) admission variants — resets the slots, and a
+    full varied serve cycle afterwards never adds a cache entry."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    eng = Engine(cfg, params, mesh,
+                 EngineConfig(slots=2, max_prompt_len=10, max_seq_len=24,
+                              decode_chunk=4))
+    assert eng.prompt_buckets == (8, 10)
+    assert eng.admit_batch_sizes == (1, 2)
+    eng.warmup()
+    sizes = eng.compiled_cache_sizes()
+    assert set(sizes.values()) == {1}, sizes
+    assert eng.warmup() is eng  # idempotent
+    sched = Scheduler(eng, pipeline_depth=2)
+    for r in _mixed_requests(6, 10, eos=13, seed0=840):
+        sched.submit(r)
+    sched.run_until_idle()
+    assert len(sched.completions) == 6
+    assert eng.compiled_cache_sizes() == sizes

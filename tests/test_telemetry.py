@@ -287,10 +287,12 @@ def _cfg(**overrides):
 
 def _varied_requests(n, *, seed0, eos=None):
     """Greedy and sampled lanes, varied prompt lengths / budgets /
-    temperatures / top-k / top-p — the admission-diversity sweep."""
+    temperatures / top-k / top-p — the admission-diversity sweep.
+    Prompt lengths span 1..10, so admissions land in BOTH prefill
+    buckets of the mpl=10 fixture engine (8 and 10)."""
     reqs = []
     for i in range(n):
-        p_len = 1 + (5 * i + 2) % 8
+        p_len = 1 + (7 * i + 2) % 10
         prompt = [int(t) for t in jax.random.randint(
             jax.random.PRNGKey(seed0 + i), (p_len,), 0, VOCAB)]
         if i % 2:
@@ -308,61 +310,79 @@ def _varied_requests(n, *, seed0, eos=None):
 
 @pytest.fixture(scope="module")
 def served_engine(devices8):
-    """One warmed engine (chunked decode) + its recompile sentinel,
-    shared by the guard and live-scrape tests. Shapes mirror
-    test_serving's chunked engine so the persistent compile cache is
-    warm across suites."""
+    """One warmed engine (chunked decode, two prefill buckets, two
+    admission batch sizes) + its recompile sentinel, shared by the
+    guard and live-scrape tests. ``Engine.warmup()`` replaces the old
+    hand-rolled scheduler warm run — it compiles every program
+    (init/step/retire + all four (bucket, k) admission variants) plus
+    the seeded-admission host path."""
     cfg = _cfg()
     params = gpt.init(cfg, jax.random.PRNGKey(0))
     mesh = mx.build_mesh(tp=1, devices=devices8[:1])
     eng = Engine(cfg, params, mesh,
-                 EngineConfig(slots=2, max_prompt_len=8, max_seq_len=24,
+                 EngineConfig(slots=2, max_prompt_len=10, max_seq_len=24,
                               decode_chunk=8))
     registry = Registry()
     eng.recompile_sentinel(registry=registry)
-    # warmup: compile all four programs (admit/step via a mixed batch,
-    # retire directly) plus the sampled host paths (PRNGKey etc.)
-    sched = Scheduler(eng)
-    for r in _varied_requests(4, seed0=2000, eos=13):
-        sched.submit(r)
-    sched.run_until_idle()
-    eng.retire(0)
+    eng.warmup()
     yield cfg, params, mesh, eng, registry
     eng.close()  # release the process-wide monitoring listener
 
 
 def test_engine_recompile_guard_stays_flat(served_engine):
-    """The acceptance pin: after warmup, a full serve cycle — admits
-    into both slots, chunked decode, deadline retire, varied sampling
+    """The acceptance pin: after ``Engine.warmup()``, a full serve
+    cycle — admissions through EVERY prefill bucket and admission batch
+    size, pipelined chunked decode, deadline retire, varied sampling
     params — runs inside an armed RecompileGuard without a single
     compilation; a shape-busting call trips the same guard."""
     cfg, params, mesh, eng, registry = served_engine
     sent = eng.recompile_sentinel()
     sizes0 = eng.compiled_cache_sizes()
+    assert set(sizes0.values()) == {1}, sizes0  # warmup compiled ALL
     now = [0.0]
-    # build the request set OUTSIDE the guard: its jax.random prompt
+    # build the request sets OUTSIDE the guard: their jax.random prompt
     # synthesis compiles for fresh prompt lengths, which is exactly the
-    # kind of host-side compile the guard exists to catch
-    reqs = _varied_requests(6, seed0=3000, eos=13)
+    # kind of host-side compile the guard exists to catch. Four phases
+    # steer admissions through every (bucket, k) variant: a short pair
+    # (k=2, bucket 8), a pair with one long prompt (k=2, bucket 10),
+    # then staggered singles long and short (k=1 at both buckets).
+    def _mk(rid, p_len, i):
+        prompt = [int(t) for t in jax.random.randint(
+            jax.random.PRNGKey(3000 + i), (p_len,), 0, VOCAB)]
+        sp = (SamplingParams(temperature=0.8 + 0.1 * (i % 3),
+                             top_k=(0, 5, 9)[i % 3], seed=3000 + i)
+              if i % 2 else SamplingParams())
+        return Request(rid, prompt, max_tokens=3 + i % 4, sampling=sp,
+                       eos_token_id=13)
+
+    phases = [[_mk("ga", 3, 0), _mk("gb", 8, 1)],     # k=2, bucket 8
+              [_mk("gc", 10, 2), _mk("gd", 5, 3)],    # k=2, bucket 10
+              [_mk("ge", 9, 4)],                      # k=1, bucket 10
+              [_mk("gf", 2, 5)]]                      # k=1, bucket 8
     with eng.recompile_guard() as g:
-        sched = Scheduler(eng, clock=lambda: now[0])
-        for r in reqs:
-            sched.submit(r)
-        for _ in range(3):
+        sched = Scheduler(eng, clock=lambda: now[0], pipeline_depth=2)
+        seen = set()
+        for phase in phases:
+            for r in phase:
+                sched.submit(r)
             sched.step()
             now[0] += 1.0
-        # deadline-retire one live slot mid-flight, then drain
-        if sched.active:
-            slot = next(iter(sched.active))
-            sched.active[slot].request.deadline = now[0] - 0.5
-        sched.run_until_idle()
+            # deadline-retire one live slot mid-flight (a chunk is in
+            # flight at depth 2), then drain the phase
+            if len(seen) == 0 and sched.active:
+                slot = next(iter(sched.active))
+                sched.active[slot].request.deadline = now[0] - 0.5
+            sched.run_until_idle()
+            seen |= set(sched.completions)
         assert len(sched.completions) == 6
         assert g.check() == {}  # flat mid-flight, by construction
     assert not g.tripped
     # compiles_total flat: per-program jit caches did not grow
     totals = sent.compiles_total()
-    assert totals["tracked"] == {"init": 1, "step": 1, "admit": 1,
-                                 "retire": 1}
+    assert totals["tracked"] == {
+        "init": 1, "step": 1, "retire": 1,
+        "admit_p8_k1": 1, "admit_p8_k2": 1,
+        "admit_p10_k1": 1, "admit_p10_k2": 1}
     assert eng.compiled_cache_sizes() == sizes0
     if not sent.monitoring_available:
         pytest.skip("no jax.monitoring: event-trip half needs it")
@@ -389,13 +409,17 @@ def _get(url):
 
 
 def test_metrics_endpoint_live_engine(served_engine):
-    """End-to-end smoke: scrape /metrics from a LIVE engine mid-batch,
-    round-trip the text through the minimal parser, check /healthz and
-    /vars, and validate the span export as Chrome-trace JSON."""
+    """End-to-end smoke over the PIPELINED loop: scrape /metrics from a
+    LIVE engine mid-batch (with a decode chunk in flight), round-trip
+    the text through the minimal parser, assert the admission-batch /
+    bucket / in-flight instrumentation is present and consistent with
+    the scheduler's own summary, check /healthz and /vars, and validate
+    the span export as Chrome-trace JSON."""
     cfg, params, mesh, eng, _ = served_engine
     registry = Registry()
     spans = SpanRecorder()
-    sched = Scheduler(eng, registry=registry, spans=spans)
+    sched = Scheduler(eng, registry=registry, spans=spans,
+                      pipeline_depth=2)
     server = MetricsServer(registry, spans=spans,
                            sentinel=eng.recompile_sentinel()).start()
     try:
@@ -411,6 +435,9 @@ def test_metrics_endpoint_live_engine(served_engine):
         assert p["serving_active_slots"][()] >= 1.0
         assert p["serving_requests_admitted_total"][()] >= 2.0
         assert p["serving_slots_total"][()] == 2.0
+        # at depth 2 the first tick's chunk is still in flight when the
+        # tick returns — the pipeline gauge shows it
+        assert p["serving_inflight_chunks"][()] == 1.0
         sched.run_until_idle()
         _, done = _get(server.url + "/metrics")
         p = parse_prometheus_text(done)
@@ -419,9 +446,29 @@ def test_metrics_endpoint_live_engine(served_engine):
         assert set(by_reason) == set(FINISH_REASONS)  # zeros present
         assert sum(by_reason.values()) == 4.0
         assert p["serving_queue_depth"][()] == 0.0
+        assert p["serving_inflight_chunks"][()] == 0.0  # drained
         assert p["serving_ttft_seconds_count"][()] == 4.0
         assert p["serving_token_latency_seconds_count"][()] == \
             p["serving_tokens_emitted_total"][()] - 4.0
+        # admission instrumentation is consistent with the scheduler's
+        # own summary: every admitted request is counted exactly once
+        # by batch size and once by bucket, and the dispatch counter
+        # matches the summary's amortisation number
+        s = sched.summary()
+        admitted = p["serving_requests_admitted_total"][()]
+        assert admitted == s["admitted_requests"] == 4.0
+        by_size = {dict(k)["size"]: v for k, v in
+                   p["serving_admit_batch_requests_total"].items()}
+        assert set(by_size) == {str(k) for k in eng.admit_batch_sizes}
+        assert sum(by_size.values()) == admitted
+        by_bucket = {dict(k)["bucket"]: v for k, v in
+                     p["serving_prefill_bucket_requests_total"].items()}
+        assert set(by_bucket) == {str(b) for b in eng.prompt_buckets}
+        assert sum(by_bucket.values()) == admitted
+        assert p["serving_admit_dispatches_total"][()] == \
+            s["admit_dispatches"] > 0
+        assert p["serving_tokens_emitted_total"][()] == \
+            s["tokens_emitted"]
         status, health = _get(server.url + "/healthz")
         assert status == 200 and health == "ok\n"
         status, vars_body = _get(server.url + "/vars")
@@ -436,13 +483,14 @@ def test_metrics_endpoint_live_engine(served_engine):
             _get(server.url + "/nope")
     finally:
         server.stop()
-    # span export: valid Chrome trace with the full phase vocabulary
+    # span export: valid Chrome trace with the full phase vocabulary,
+    # including the pipelined loop's dispatch-vs-fetch section split
     ct = spans.to_chrome_trace()
     json.loads(json.dumps(ct))
     names = {e["name"] for e in ct["traceEvents"]
              if e["ph"] in ("X", "i")}
     assert {"queued", "prefill", "first_token", "decode", "retired",
-            "engine.step", "engine.admit"} <= names
+            "engine.dispatch", "engine.fetch", "engine.admit"} <= names
     for e in ct["traceEvents"]:
         if e["ph"] == "X":
             assert e["ts"] >= 0.0 and e["dur"] >= 0.0
