@@ -1,0 +1,96 @@
+"""Happy-path cost of the resilience layer (PR 5): in-process
+interleaved A/B of the NEW scheduler (fault detection, watchdog, EWMA,
+health polling — faults disabled) vs the PRE-PR scheduler loaded
+verbatim from git HEAD, over ONE shared warm engine per shape, same
+burst trace, best-of-N with sides interleaved so host drift hits both
+alike. Token parity asserted between sides.
+
+Run (CPU mesh):
+  git show <pre-PR-rev>:apex_tpu/serving/scheduler.py > /tmp/pre_scheduler_pr5.py
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  PYTHONPATH=/root/repo python .scratch/resilience_ab.py
+
+The engine-side seam cost (a `fault_plan is None` check per
+admit/dispatch and a no-op plan field on StepHandle) rides BOTH sides
+here — it is two attribute checks per dispatch, far below measurement
+noise; this A/B isolates the scheduler-side detection machinery, which
+is where all the per-chunk work lives.
+"""
+
+import importlib.util
+import json
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import mesh as mx
+from apex_tpu.models import gpt
+from apex_tpu.serving import Request, SamplingParams
+from apex_tpu.serving.engine import Engine, EngineConfig
+from apex_tpu.serving.scheduler import Scheduler as NewScheduler
+
+spec = importlib.util.spec_from_file_location(
+    "pre_scheduler_pr5", "/tmp/pre_scheduler_pr5.py")
+pre_mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(pre_mod)
+PreScheduler = pre_mod.Scheduler
+
+mesh = mx.build_mesh(tp=1, devices=jax.devices()[:1])
+
+SHAPES = {
+    # the dispatch-dominated probe (worst case for per-chunk host
+    # overhead: chunks are fast, so fixed host work per chunk is the
+    # largest relative slice)
+    "probe_1l32h": (
+        gpt.GPTConfig(vocab_size=256, hidden_size=32, num_layers=1,
+                      num_heads=2, seq_len=128, remat=False,
+                      compute_dtype=jnp.float32),
+        EngineConfig(slots=4, max_prompt_len=32, max_seq_len=96,
+                     decode_chunk=8), 24, 16),
+    # the compute-bound smoke shape
+    "smoke_4l256h": (
+        gpt.GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4,
+                      num_heads=8, seq_len=256, remat=False,
+                      compute_dtype=jnp.float32),
+        EngineConfig(slots=4, max_prompt_len=16, max_seq_len=48,
+                     decode_chunk=8), 12, 24),
+}
+
+
+def trace(cfg, ecfg, n, mt):
+    reqs = []
+    for i in range(n):
+        p_len = 1 + (11 * i + 5) % ecfg.max_prompt_len
+        prompt = [int(t) for t in jax.random.randint(
+            jax.random.PRNGKey(900 + i), (p_len,), 0, cfg.vocab_size)]
+        sp = (SamplingParams(temperature=0.9, top_k=20, seed=i)
+              if i % 2 else SamplingParams())
+        reqs.append(Request(f"r{i}", prompt, max_tokens=mt, sampling=sp))
+    return reqs
+
+
+out = {}
+for name, (cfg, ecfg, n_reqs, mt) in SHAPES.items():
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    engine = Engine(cfg, params, mesh, ecfg).warmup()
+    best = {"pre": 0.0, "new": 0.0}
+    toks = {}
+    for _ in range(7):
+        for side, cls in (("pre", PreScheduler), ("new", NewScheduler)):
+            sched = cls(engine, pipeline_depth=2)
+            for r in trace(cfg, ecfg, n_reqs, mt):
+                sched.submit(r)
+            sched.run_until_idle()
+            t = {rid: c.tokens for rid, c in sched.completions.items()}
+            toks.setdefault(side, t)
+            assert toks[side] == t, f"{name}/{side} rerun drift"
+            s = sched.summary()
+            best[side] = max(best[side], s["tokens_per_sec"])
+    assert toks["pre"] == toks["new"], f"{name} pre/new token drift"
+    out[name] = {
+        "pre_tokens_per_sec": round(best["pre"], 1),
+        "new_tokens_per_sec": round(best["new"], 1),
+        "new_over_pre": round(best["new"] / best["pre"], 4),
+    }
+print(json.dumps(out, indent=1))

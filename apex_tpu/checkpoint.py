@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import os
 import struct
+import tempfile
 from typing import Any
 
 import jax
@@ -48,11 +49,44 @@ def _path_key(path) -> str:
 #: .atck layout: magic, header-length u64, JSON header, blob, crc32 u32.
 _MAGIC = b"ATCK0001"
 
+#: process umask, probed once at import (os.umask can only be read by
+#: setting it — doing that per save would race other threads' file
+#: creation through a umask-0 window)
+_UMASK = os.umask(0)
+os.umask(_UMASK)
+
+
+def _atomic_write(path: str, write_fn) -> None:
+    """Run ``write_fn(file)`` against a same-directory temp file, then
+    ``os.replace`` it onto ``path``: a crash mid-write leaves the old
+    checkpoint (or nothing) at the destination, never a truncated file
+    that parses as garbage. Same-dir matters — ``os.replace`` is only
+    atomic within a filesystem. The fd is owned (and closed exactly
+    once) by the ``with`` block, so a failing replace still reports its
+    own error and the temp file is removed."""
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(os.path.abspath(path)) or ".",
+        prefix=os.path.basename(path) + ".tmp.")
+    try:
+        # mkstemp creates 0600; restore the umask-derived mode a plain
+        # open() would have given, so checkpoints stay readable by the
+        # same processes that could read them before the atomic switch
+        os.fchmod(fd, 0o666 & ~_UMASK)
+        with os.fdopen(fd, "wb") as f:
+            write_fn(f)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
 
 def save_checkpoint_bin(path: str, state: Any) -> str:
     """Write the ``.atck`` fast binary format: a JSON leaf manifest + one
     contiguous blob gathered by the native multithreaded pack engine, with
-    a trailing CRC32 of the blob."""
+    a trailing CRC32 of the blob. The write is atomic (same-dir temp
+    file + ``os.replace``), so a crash mid-write can never leave a
+    corrupt file at the destination."""
     flat = jax.tree_util.tree_flatten_with_path(state)[0]
     arrays, manifest, offsets = [], [], []
     off = 0
@@ -72,12 +106,15 @@ def save_checkpoint_bin(path: str, state: Any) -> str:
     header = json.dumps({"leaves": manifest}).encode()
     if not path.endswith(".atck"):
         path = path + ".atck"
-    with open(path, "wb") as f:
+
+    def _write(f):
         f.write(_MAGIC)
         f.write(struct.pack("<Q", len(header)))
         f.write(header)
         blob.tofile(f)  # zero-copy write of the packed blob
         f.write(struct.pack("<I", crc))
+
+    _atomic_write(path, _write)
     return path
 
 
@@ -87,10 +124,22 @@ def load_checkpoint_bin(path: str, like: Any) -> Any:
         path = path + ".atck"
     with open(path, "rb") as f:
         if f.read(len(_MAGIC)) != _MAGIC:
-            raise ValueError(f"{path}: not an .atck checkpoint")
-        (hlen,) = struct.unpack("<Q", f.read(8))
-        manifest = json.loads(f.read(hlen))["leaves"]
+            raise ValueError(f"{path}: not an .atck checkpoint "
+                             f"(bad or truncated magic)")
+        raw = f.read(8)
+        if len(raw) < 8:
+            raise ValueError(f"{path}: truncated .atck checkpoint "
+                             f"(header length cut short)")
+        (hlen,) = struct.unpack("<Q", raw)
+        raw = f.read(hlen)
+        if len(raw) < hlen:
+            raise ValueError(f"{path}: truncated .atck checkpoint "
+                             f"(manifest cut short)")
+        manifest = json.loads(raw)["leaves"]
         rest = f.read()
+    if len(rest) < 4:
+        raise ValueError(f"{path}: truncated .atck checkpoint "
+                         f"(missing CRC trailer)")
     blob, (crc,) = np.frombuffer(rest[:-4], np.uint8), struct.unpack(
         "<I", rest[-4:])
     if _native.crc32(blob) != crc:
@@ -151,7 +200,7 @@ def save_checkpoint(path: str, state: Any, *, force_npz: bool = False) -> str:
     arrays = {_path_key(p): _np(x) for p, x in flat}
     if not path.endswith(".npz"):
         path = path + ".npz"
-    np.savez(path, **arrays)
+    _atomic_write(path, lambda f: np.savez(f, **arrays))
     return path
 
 

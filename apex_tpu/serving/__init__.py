@@ -39,15 +39,18 @@ from apex_tpu.serving.request import (  # noqa: F401
 )
 
 __all__ = [
-    "request", "sampling", "engine", "scheduler",
+    "request", "sampling", "engine", "scheduler", "resilience",
     "Request", "SamplingParams", "Completion", "StreamEvent",
     "Engine", "EngineConfig", "Scheduler", "QueueFull",
     "Admission", "AdmitResult", "StepHandle",
+    "FaultPlan", "FaultSpec", "ResilienceConfig", "HealthMonitor",
+    "EngineFault", "InjectedFault", "EngineFailed",
 ]
 
 _LAZY = {
     "engine": "apex_tpu.serving.engine",
     "scheduler": "apex_tpu.serving.scheduler",
+    "resilience": "apex_tpu.serving.resilience",
     "Engine": "apex_tpu.serving.engine",
     "EngineConfig": "apex_tpu.serving.engine",
     "Admission": "apex_tpu.serving.engine",
@@ -55,6 +58,13 @@ _LAZY = {
     "StepHandle": "apex_tpu.serving.engine",
     "Scheduler": "apex_tpu.serving.scheduler",
     "QueueFull": "apex_tpu.serving.scheduler",
+    "FaultPlan": "apex_tpu.serving.resilience",
+    "FaultSpec": "apex_tpu.serving.resilience",
+    "ResilienceConfig": "apex_tpu.serving.resilience",
+    "HealthMonitor": "apex_tpu.serving.resilience",
+    "EngineFault": "apex_tpu.serving.resilience",
+    "InjectedFault": "apex_tpu.serving.resilience",
+    "EngineFailed": "apex_tpu.serving.resilience",
 }
 
 

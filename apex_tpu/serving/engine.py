@@ -51,6 +51,15 @@ from jax.sharding import PartitionSpec as P
 
 from apex_tpu.models import gpt
 from apex_tpu.serving import sampling
+from apex_tpu.serving.resilience import (
+    KIND_ERROR,
+    KIND_HANG,
+    KIND_NAN,
+    EngineFault,
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+)
 
 
 def default_prompt_buckets(max_prompt_len: int) -> Tuple[int, ...]:
@@ -164,20 +173,50 @@ class StepHandle:
     is the value-fetch sync (per the perf-claims convention —
     ``block_until_ready`` can return at dispatch time through the
     tunnel, a value fetch cannot); it caches, so fetching twice costs
-    one transfer."""
+    one transfer.
 
-    __slots__ = ("_emit", "_finished", "_out")
+    Fault injection (:mod:`apex_tpu.serving.resilience`): a plan's
+    ``fetch`` seam is consumed on the FIRST fetch only, and a
+    ``dispatch``-seam hang spec rides the handle to be applied where a
+    hung dispatch is observed — at the fetch."""
 
-    def __init__(self, emit, finished):
+    __slots__ = ("_emit", "_finished", "_out", "_plan", "_hang",
+                 "_on_poison")
+
+    def __init__(self, emit, finished, *, plan: Optional[FaultPlan] = None,
+                 hang: Optional[FaultSpec] = None,
+                 on_poison: Optional[Any] = None):
         self._emit = emit
         self._finished = finished
         self._out: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self._plan = plan
+        self._hang = hang
+        self._on_poison = on_poison
 
     def fetch(self) -> Tuple[np.ndarray, np.ndarray]:
         """Block until the chunk lands; returns ``(tokens [B, n],
         finished [B, n])`` as host arrays."""
-        if self._out is None:
-            self._out = (np.asarray(self._emit), np.asarray(self._finished))
+        if self._out is not None:
+            return self._out
+        spec = self._plan.take("fetch") if self._plan is not None else None
+        for s in (self._hang, spec):
+            if s is not None and s.kind == KIND_HANG:
+                self._plan.hang_fn(s.hang_s)
+        if spec is not None and spec.kind == KIND_ERROR:
+            if self._on_poison is not None:
+                self._on_poison()
+            raise InjectedFault(
+                f"injected device error at fetch: {spec.describe()}",
+                point="fetch", spec=spec)
+        tokens = np.asarray(self._emit)
+        finished = np.asarray(self._finished)
+        if spec is not None and spec.kind == KIND_NAN:
+            # what a NaN logit batch looks like by the time the host
+            # sees it: garbage token ids in the poisoned lanes
+            tokens = tokens.copy()
+            rows = [s for s in spec.slots if 0 <= s < tokens.shape[0]]
+            tokens[rows, :] = spec.token
+        self._out = (tokens, finished)
         return self._out
 
 
@@ -192,7 +231,8 @@ class Engine:
     """
 
     def __init__(self, cfg: "gpt.GPTConfig", params, mesh,
-                 engine_cfg: Optional[EngineConfig] = None, **overrides):
+                 engine_cfg: Optional[EngineConfig] = None,
+                 fault_plan: Optional[FaultPlan] = None, **overrides):
         ecfg = engine_cfg or EngineConfig(**overrides)
         if engine_cfg is not None and overrides:
             raise ValueError("pass engine_cfg or field overrides, not both")
@@ -227,6 +267,13 @@ class Engine:
         #: share a stream (they all drew from the zero key before)
         self._req_counter = 0
         self._warmed = False
+        #: chaos harness (resilience.FaultPlan): consulted at the
+        #: admit/dispatch/fetch seams; None in production
+        self.fault_plan = fault_plan
+        self._warming = False   # warmup must never consume plan faults
+        #: True after a fault invalidated the donated cache/state —
+        #: every device call refuses until rebuild_slots()
+        self._poisoned = False
         self._build()
         self.cache, self.state = self._init(params)
 
@@ -468,6 +515,15 @@ class Engine:
         items = list(items)
         if not items:
             return []
+        self._check_poisoned()
+        spec = self._take_fault("admit")
+        if spec is not None and spec.kind == KIND_ERROR:
+            # a device error escaping the admission call: the donated
+            # cache/state must be assumed consumed — poison until rebuilt
+            self._poisoned = True
+            raise InjectedFault(
+                f"injected device error at admit: {spec.describe()}",
+                point="admit", spec=spec)
         validated = [self._validate_admission(a) for a in items]
         slots_used = [a.slot for a in items]
         if len(set(slots_used)) != len(slots_used):
@@ -514,8 +570,12 @@ class Engine:
             first = np.asarray(first)
             hit_eos, done = np.asarray(hit_eos), np.asarray(done)
             for j in range(k):
+                tok = int(first[j])
+                if spec is not None and spec.kind == KIND_NAN \
+                        and len(results) in spec.slots:
+                    tok = spec.token  # NaN prefill: garbage first token
                 results.append(AdmitResult(
-                    int(first[j]), bool(hit_eos[j]), bool(done[j]),
+                    tok, bool(hit_eos[j]), bool(done[j]),
                     bucket=bucket, batch_size=k, group=group))
         return results
 
@@ -526,9 +586,20 @@ class Engine:
         the next chunk, an admission — behind it before syncing, and
         the device never idles through the host's fetch + event
         processing. Returns the chunk's :class:`StepHandle`."""
+        self._check_poisoned()
+        spec = self._take_fault("dispatch")
+        if spec is not None and spec.kind == KIND_ERROR:
+            self._poisoned = True
+            raise InjectedFault(
+                f"injected device error at dispatch: {spec.describe()}",
+                point="dispatch", spec=spec)
         self.cache, self.state, emit, finished = self._step(
             self._params, self.cache, self.state)
-        return StepHandle(emit, finished)
+        plan = None if self._warming else self.fault_plan
+        return StepHandle(emit, finished, plan=plan,
+                          hang=spec if spec is not None
+                          and spec.kind == KIND_HANG else None,
+                          on_poison=self._mark_poisoned)
 
     def step(self) -> Tuple[np.ndarray, np.ndarray]:
         """One decode chunk over every slot — ``decode_chunk`` fused
@@ -547,7 +618,50 @@ class Engine:
         for chunks dispatched AFTER this call — chunks already in
         flight still carry the slot's real tokens (a pipelined
         scheduler drops them)."""
+        self._check_poisoned()
+        spec = self._take_fault("retire")
+        if spec is not None and spec.kind == KIND_ERROR:
+            self._poisoned = True
+            raise InjectedFault(
+                f"injected device error at retire: {spec.describe()}",
+                point="retire", spec=spec)
         self.state = self._retire(self.state, np.int32(slot))
+
+    # -- failure isolation (apex_tpu.serving.resilience) -------------------
+
+    def _take_fault(self, point: str):
+        plan = self.fault_plan
+        if plan is None or self._warming:
+            return None
+        return plan.take(point)
+
+    def _mark_poisoned(self) -> None:
+        self._poisoned = True
+
+    def _check_poisoned(self) -> None:
+        if self._poisoned:
+            raise EngineFault(
+                "engine state is poisoned (a prior fault invalidated "
+                "the donated cache/state buffers); call rebuild_slots() "
+                "before the next device call")
+
+    @property
+    def poisoned(self) -> bool:
+        """True after a fault invalidated the donated cache/state
+        buffers (every device call raises until
+        :meth:`rebuild_slots`)."""
+        return self._poisoned
+
+    def rebuild_slots(self) -> None:
+        """Recovery: rebuild the donated cache/state buffers from the
+        compiled ``init`` program (every slot comes back FREE — the
+        scheduler deterministically replays interrupted requests from
+        its host-side slot snapshot, see
+        :mod:`apex_tpu.serving.resilience`). No recompilation: ``init``
+        was compiled at construction, so a recompile guard stays armed
+        through recovery."""
+        self.cache, self.state = self._init(self._params)
+        self._poisoned = False
 
     def warmup(self) -> "Engine":
         """Compile every engine program up front — ``init``, ``step``,
@@ -561,6 +675,15 @@ class Engine:
         warmups tests and examples used to do."""
         if self._warmed:
             return self
+        self._warming = True  # warmup must not consume fault-plan seams
+        try:
+            self._warmup_body()
+        finally:
+            self._warming = False
+        self._warmed = True
+        return self
+
+    def _warmup_body(self) -> None:
         ecfg = self.engine_cfg
         for (bucket, k), fn in sorted(self._admits.items()):
             # dummy args exercise shapes only: k pad-token prompts of
@@ -582,8 +705,6 @@ class Engine:
         # drop the warmup junk: a fresh init (compiled at construction)
         # frees every slot again
         self.cache, self.state = self._init(self._params)
-        self._warmed = True
-        return self
 
     def _admit_variant_name(self, bucket: int, k: int) -> str:
         return f"admit_p{bucket}_k{k}"

@@ -16,11 +16,15 @@ from typing import List, Optional, Sequence
 FINISH_EOS = "eos"
 FINISH_LENGTH = "length"
 FINISH_TIMEOUT = "timeout"
+#: the request was interrupted by a fault and its bounded retries were
+#: exhausted (or the engine failed terminally) — the resilience layer's
+#: outcome, see :mod:`apex_tpu.serving.resilience`
+FINISH_ERROR = "error"
 
 #: every finish reason, in release-path order — label values for the
 #: scheduler's ``serving_requests_finished_total`` counter (pre-created
 #: per reason so a scrape shows explicit zeros, not absent series)
-FINISH_REASONS = (FINISH_EOS, FINISH_LENGTH, FINISH_TIMEOUT)
+FINISH_REASONS = (FINISH_EOS, FINISH_LENGTH, FINISH_TIMEOUT, FINISH_ERROR)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,12 +68,18 @@ class Request:
 @dataclasses.dataclass
 class StreamEvent:
     """One element of the response stream: a token (or, for a request
-    finishing with zero tokens, just the finish flag) for ``request_id``."""
+    finishing with zero tokens, just the finish flag) for ``request_id``.
+    ``error`` carries fault context when the resilience layer
+    interrupts the request — with ``finished=False`` it announces a
+    retry in progress (the stream will resume), with
+    ``finished=True`` and ``finish_reason="error"`` the request is
+    over."""
 
     request_id: str
     token: Optional[int]
     finished: bool
     finish_reason: Optional[str] = None
+    error: Optional[str] = None
 
 
 @dataclasses.dataclass

@@ -1,0 +1,393 @@
+"""Fault injection, failure isolation, and live health for serving.
+
+The serving stack (engine + scheduler) is fast and observable but a
+single escaped exception, NaN-poisoned batch, or hung dispatch used to
+take down the whole engine and every in-flight request. Upstream apex's
+core robustness idea — the amp dynamic loss scaler that *detects* bad
+numerics and *recovers* instead of crashing (``apex/amp/scaler.py``
+(U)) — transplants to serving as four pieces, all host-side (zero
+change to the compiled programs, so the happy path pays nothing):
+
+- :class:`FaultPlan` — a deterministic, replayable chaos harness: each
+  engine seam (``admit`` / ``dispatch`` / ``fetch``, plus the
+  scheduler's ``submit``) counts its calls, and a plan maps call
+  indices to injected faults (raised device errors, NaN/invalid-token
+  batches, artificial hangs, queue floods). Seeded plans
+  (:meth:`FaultPlan.random`) make randomized chaos soaks exact reruns.
+- Failure-domain isolation — a fault poisons the engine's donated
+  cache/state buffers (:class:`EngineFault`); recovery rebuilds them
+  from the compiled ``init`` program and deterministically *replays*
+  interrupted requests from their prompts (the last known-good slot
+  snapshot is the scheduler's host record: prompt + emitted tokens —
+  generation is per-request deterministic, so the replayed stream is
+  bit-identical and already-streamed tokens are simply re-derived and
+  suppressed). Affected requests get bounded retries with exponential
+  backoff and per-request ``error`` stream events.
+- Overload protection — deadline-aware admission shedding (a queued
+  request whose estimated wait already blows its deadline is shed NOW,
+  not left to rot), structured :class:`~apex_tpu.serving.scheduler.
+  QueueFull` backpressure with a retry-after hint, and a fetch
+  watchdog that flags hung dispatches.
+- :class:`HealthMonitor` — the ``ok → degraded → draining → failed``
+  state machine driven by detected faults, watchdog trips, retry
+  exhaustion, and queue saturation; exported as the
+  ``serving_health_state`` gauge and as a ``/healthz`` callback for
+  :class:`apex_tpu.telemetry.http.MetricsServer` (load-balancer
+  semantics: ``ok``/``degraded`` answer 200, ``draining``/``failed``
+  answer 503).
+
+Dependency-free (stdlib only) so the chaos harness imports anywhere
+the telemetry layer does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random as _random
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# -- fault vocabulary --------------------------------------------------------
+
+#: a raised error at the seam (simulates an exception escaping the
+#: device call; poisons the engine's donated buffers)
+KIND_ERROR = "error"
+#: an invalid-token batch (what a NaN logit batch produces downstream:
+#: out-of-vocab token ids in the fetched host array)
+KIND_NAN = "nan"
+#: an artificial dispatch hang, observed at fetch (the watchdog's prey)
+KIND_HANG = "hang"
+#: a queue flood: the submit seam reports the queue saturated
+KIND_FLOOD = "flood"
+
+FAULT_KINDS = (KIND_ERROR, KIND_NAN, KIND_HANG, KIND_FLOOD)
+
+#: engine seams (``admit``/``dispatch``/``fetch``/``retire``) + the
+#: scheduler's intake seam (``submit``, the only place a flood makes
+#: sense)
+FAULT_POINTS = ("admit", "dispatch", "fetch", "retire", "submit")
+
+#: which kinds are meaningful at which seam
+_VALID = {
+    "admit": (KIND_ERROR, KIND_NAN),
+    "dispatch": (KIND_ERROR, KIND_HANG),
+    "fetch": (KIND_ERROR, KIND_NAN, KIND_HANG),
+    "retire": (KIND_ERROR,),
+    "submit": (KIND_FLOOD,),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One planned fault: the ``index``-th call at ``point`` (0-based,
+    counted per seam) misbehaves as ``kind``. ``slots`` are the lanes an
+    invalid-token batch corrupts (admit: batch rows; fetch: engine
+    slots); ``hang_s`` is the artificial stall for ``hang`` faults;
+    ``token`` is the injected out-of-vocab id (< 0 or >= vocab both
+    detect)."""
+
+    point: str
+    index: int
+    kind: str
+    slots: Tuple[int, ...] = (0,)
+    hang_s: float = 0.0
+    token: int = -1
+
+    def describe(self) -> str:
+        extra = f" hang={self.hang_s}s" if self.kind == KIND_HANG else (
+            f" slots={list(self.slots)}" if self.kind == KIND_NAN else "")
+        return f"{self.kind}@{self.point}[{self.index}]{extra}"
+
+
+class FaultPlan:
+    """A deterministic schedule of injected faults over the engine's
+    seams. Each seam keeps a monotonic call counter; :meth:`take`
+    advances it and returns the planned :class:`FaultSpec` for that
+    call, if any — so a plan replays EXACTLY given the same request
+    trace (chaos tests are reruns, not dice rolls). ``hang_fn``
+    implements the stall (default ``time.sleep``); tests inject a
+    fake-clock advance instead, so hangs are deterministic too.
+
+    >>> plan = FaultPlan([FaultSpec("fetch", 2, "nan", slots=(1,))])
+    >>> eng = Engine(cfg, params, mesh, ecfg, fault_plan=plan)
+    """
+
+    def __init__(self, specs: Sequence[FaultSpec] = (), *,
+                 hang_fn: Callable[[float], None] = time.sleep):
+        by_point: Dict[str, Dict[int, FaultSpec]] = {}
+        for s in specs:
+            if s.point not in FAULT_POINTS:
+                raise ValueError(
+                    f"unknown fault point {s.point!r}; one of "
+                    f"{FAULT_POINTS}")
+            if s.kind not in _VALID[s.point]:
+                raise ValueError(
+                    f"fault kind {s.kind!r} not injectable at "
+                    f"{s.point!r} (valid: {_VALID[s.point]})")
+            if s.index < 0:
+                raise ValueError(f"fault index {s.index} must be >= 0")
+            slot = by_point.setdefault(s.point, {})
+            if s.index in slot:
+                raise ValueError(
+                    f"duplicate fault at {s.point}[{s.index}] — one "
+                    f"fault per (point, call) keeps plans replayable")
+            slot[s.index] = s
+        self._by_point = by_point
+        self.hang_fn = hang_fn
+        self._counts = {p: 0 for p in FAULT_POINTS}
+        #: specs that actually fired, in firing order — the replay
+        #: record chaos tests reconcile counters against
+        self.injected: List[FaultSpec] = []
+
+    @classmethod
+    def random(cls, seed: int, n_faults: int = 3, *,
+               points: Sequence[str] = ("admit", "dispatch", "fetch"),
+               max_index: int = 24, slots: int = 4, hang_s: float = 0.0,
+               hang_fn: Callable[[float], None] = time.sleep
+               ) -> "FaultPlan":
+        """A seeded random plan: ``n_faults`` faults scattered over
+        ``points`` within the first ``max_index`` calls of each —
+        bit-reproducible from ``seed`` (``random.Random``, no global
+        state), so a failing soak reruns exactly."""
+        rng = _random.Random(seed)
+        specs: List[FaultSpec] = []
+        used = set()
+        while len(specs) < n_faults and len(used) < len(points) * max_index:
+            point = rng.choice(list(points))
+            index = rng.randrange(max_index)
+            if (point, index) in used:
+                continue
+            used.add((point, index))
+            kind = rng.choice(_VALID[point])
+            specs.append(FaultSpec(
+                point, index, kind,
+                slots=(rng.randrange(max(slots, 1)),),
+                hang_s=hang_s if kind == KIND_HANG else 0.0))
+        return cls(specs, hang_fn=hang_fn)
+
+    @property
+    def specs(self) -> Tuple[FaultSpec, ...]:
+        return tuple(s for by in self._by_point.values()
+                     for s in by.values())
+
+    def take(self, point: str) -> Optional[FaultSpec]:
+        """Advance ``point``'s call counter; return the fault planned
+        for this call (recording it in :attr:`injected`), or None."""
+        i = self._counts[point]
+        self._counts[point] = i + 1
+        spec = self._by_point.get(point, {}).get(i)
+        if spec is not None:
+            self.injected.append(spec)
+        return spec
+
+    def counts(self) -> Dict[str, int]:
+        """Calls seen per seam so far (diagnostics / plan sizing)."""
+        return dict(self._counts)
+
+    def reset(self) -> "FaultPlan":
+        """Rewind the counters and the firing record — the same plan
+        replays over a fresh trace."""
+        self._counts = {p: 0 for p in FAULT_POINTS}
+        self.injected = []
+        return self
+
+
+def parse_fault_plan(text: str, *,
+                     hang_fn: Callable[[float], None] = time.sleep
+                     ) -> FaultPlan:
+    """CLI surface for fault plans: either ``random:SEED[:N]`` or a
+    comma list of ``point:index:kind[:arg]`` where ``arg`` is
+    ``hang_s`` for hangs and a slot index for nan faults —
+    e.g. ``"fetch:2:nan:1,dispatch:5:error"``."""
+    text = text.strip()
+    if text.startswith("random:"):
+        parts = text.split(":")
+        seed = int(parts[1])
+        n = int(parts[2]) if len(parts) > 2 else 3
+        return FaultPlan.random(seed, n, hang_fn=hang_fn)
+    specs = []
+    for item in text.split(","):
+        parts = item.strip().split(":")
+        if len(parts) < 3:
+            raise ValueError(
+                f"fault spec {item!r}: want point:index:kind[:arg]")
+        point, index, kind = parts[0], int(parts[1]), parts[2]
+        kw: Dict[str, object] = {}
+        if len(parts) > 3:
+            if kind == KIND_HANG:
+                kw["hang_s"] = float(parts[3])
+            else:
+                kw["slots"] = (int(parts[3]),)
+        specs.append(FaultSpec(point, index, kind, **kw))
+    return FaultPlan(specs, hang_fn=hang_fn)
+
+
+# -- exceptions --------------------------------------------------------------
+
+
+class EngineFault(RuntimeError):
+    """A failure at an engine seam that invalidates the donated
+    cache/state buffers. The engine refuses further device calls until
+    :meth:`~apex_tpu.serving.engine.Engine.rebuild_slots` reconstructs
+    them (failure isolation: a poisoned buffer must never serve)."""
+
+    def __init__(self, message: str, *, point: str = "",
+                 spec: Optional[FaultSpec] = None):
+        super().__init__(message)
+        self.point = point
+        self.spec = spec
+
+
+class InjectedFault(EngineFault):
+    """An :class:`EngineFault` raised by a :class:`FaultPlan` (chaos
+    testing) rather than a real device failure."""
+
+
+class EngineFailed(RuntimeError):
+    """The health machine reached ``failed`` (terminal): recovery was
+    exhausted and the scheduler aborted all work with ``error``
+    outcomes. New submissions are refused."""
+
+
+# -- health state machine ----------------------------------------------------
+
+HEALTH_OK = "ok"
+HEALTH_DEGRADED = "degraded"
+HEALTH_DRAINING = "draining"
+HEALTH_FAILED = "failed"
+
+#: all states, in gauge-code order: ``serving_health_state`` exports
+#: the tuple index (0 = ok .. 3 = failed)
+HEALTH_STATES = (HEALTH_OK, HEALTH_DEGRADED, HEALTH_DRAINING,
+                 HEALTH_FAILED)
+
+
+class HealthMonitor:
+    """The serving health state machine.
+
+    Transitions: any detected fault / watchdog trip / queue saturation
+    degrades (``ok → degraded``); ``recovery_chunks`` consecutive
+    healthy decode-chunk fetches recover (``degraded → ok``);
+    ``begin_drain``/``end_drain`` bracket a pipeline drain
+    (``→ draining →`` back to whatever the state was, faults observed
+    mid-drain land in the resume state); ``fail()`` is terminal. The
+    ``serving_health_state`` gauge mirrors every transition when a
+    registry is given, and :meth:`healthz` is the callback shape
+    ``telemetry.http.MetricsServer(health=...)`` serves — 200 while
+    traffic should keep flowing (ok/degraded), 503 when it should stop
+    (draining/failed), body = the state name."""
+
+    def __init__(self, *, registry=None, recovery_chunks: int = 2):
+        if recovery_chunks < 1:
+            raise ValueError(
+                f"recovery_chunks {recovery_chunks} must be >= 1")
+        self.state = HEALTH_OK
+        self.recovery_chunks = recovery_chunks
+        self.last_cause: Optional[str] = None
+        self._resume = HEALTH_OK  # state a drain returns to
+        self._streak = 0          # consecutive healthy chunks
+        self._gauge = self._transitions = None
+        if registry is not None:
+            self._gauge = registry.gauge(
+                "serving_health_state",
+                "serving health: 0=ok 1=degraded 2=draining 3=failed")
+            self._gauge.set(0)
+            tr = registry.counter(
+                "serving_health_transitions_total",
+                "health state entries, by state", labels=("to",))
+            # pre-create every state so scrapes show explicit zeros
+            self._transitions = {s: tr.labels(to=s) for s in HEALTH_STATES}
+
+    def _set(self, state: str) -> None:
+        if state == self.state:
+            return
+        self.state = state
+        if self._gauge is not None:
+            self._gauge.set(HEALTH_STATES.index(state))
+            self._transitions[state].inc()
+
+    # -- inputs -------------------------------------------------------------
+
+    def record_fault(self, cause: str) -> None:
+        """A detected fault / watchdog trip / overload signal: degrade
+        (mid-drain: the drain continues, but resumes degraded)."""
+        if self.state == HEALTH_FAILED:
+            return
+        self.last_cause = cause
+        self._streak = 0
+        if self.state == HEALTH_DRAINING:
+            self._resume = HEALTH_DEGRADED
+        else:
+            self._set(HEALTH_DEGRADED)
+
+    def record_progress(self) -> None:
+        """One healthy decode chunk fetched end-to-end; enough of them
+        in a row recover a degraded engine."""
+        if self.state != HEALTH_DEGRADED:
+            return
+        self._streak += 1
+        if self._streak >= self.recovery_chunks:
+            self._set(HEALTH_OK)
+
+    def begin_drain(self) -> None:
+        if self.state in (HEALTH_FAILED, HEALTH_DRAINING):
+            return
+        self._resume = self.state
+        self._set(HEALTH_DRAINING)
+
+    def end_drain(self) -> None:
+        if self.state == HEALTH_DRAINING:
+            self._set(self._resume)
+
+    def fail(self, cause: str) -> None:
+        """Terminal: recovery exhausted."""
+        self.last_cause = cause
+        self._set(HEALTH_FAILED)
+
+    # -- outputs ------------------------------------------------------------
+
+    @property
+    def code(self) -> int:
+        return HEALTH_STATES.index(self.state)
+
+    def healthz(self) -> Tuple[int, str]:
+        """The ``MetricsServer(health=...)`` callback: (status code,
+        body). 200 for ok/degraded (keep routing traffic), 503 for
+        draining/failed (stop)."""
+        status = 200 if self.state in (HEALTH_OK, HEALTH_DEGRADED) \
+            else 503
+        body = self.state + "\n"
+        if self.state != HEALTH_OK and self.last_cause:
+            body = f"{self.state} ({self.last_cause})\n"
+        return status, body
+
+
+# -- scheduler policy knobs --------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ResilienceConfig:
+    """Recovery/overload policy for the scheduler, all host-side.
+    ``max_retries`` bounds re-admissions per FAULT-AFFECTED request
+    (requests merely interrupted by a batch-mate's fault replay for
+    free — they were not at fault); backoff before retry ``n`` is
+    ``backoff_base_s * backoff_factor**(n-1)`` on the scheduler clock.
+    ``watchdog_timeout_s`` flags a decode chunk whose dispatch→fetch
+    wall time exceeds it (a hung dispatch). ``shed_deadlines`` enables
+    deadline-aware admission shedding (queue depth × measured chunk
+    latency vs the request's deadline). ``max_consecutive_rebuilds``
+    caps back-to-back recoveries with no healthy chunk between them
+    before the engine is declared failed."""
+
+    max_retries: int = 2
+    backoff_base_s: float = 0.05
+    backoff_factor: float = 2.0
+    watchdog_timeout_s: float = 30.0
+    shed_deadlines: bool = True
+    recovery_chunks: int = 2
+    max_consecutive_rebuilds: int = 3
+
+    def backoff_s(self, attempt: int) -> float:
+        """Backoff before retry ``attempt`` (1-based)."""
+        return self.backoff_base_s * (
+            self.backoff_factor ** max(attempt - 1, 0))

@@ -26,17 +26,35 @@ streams are bit-identical at every depth (the pipelined-parity test);
 only deadline OBSERVATION granularity coarsens with depth, exactly as
 it already coarsens with ``decode_chunk``.
 
+Fault tolerance (:mod:`apex_tpu.serving.resilience`): an exception
+escaping an engine seam, an invalid-token (NaN-poisoned) batch, or a
+hung dispatch no longer takes the engine down. The failing chunk/call
+is quarantined, the engine's donated buffers are rebuilt from the
+compiled ``init`` program, and every interrupted request is
+deterministically REPLAYED from its prompt (generation is per-request
+deterministic, so the replayed stream is bit-identical and
+already-streamed tokens are re-derived silently). Requests in the
+fault's blast radius get bounded retries with exponential backoff and
+``error`` stream events; retry exhaustion completes them with the
+``error`` finish reason. Overload protection: deadline-aware admission
+shedding (queue depth × measured chunk latency vs the deadline — shed
+NOW instead of rotting then expiring), structured :class:`QueueFull`
+with a retry-after hint, and a fetch watchdog flagging hung dispatches.
+``self.health`` is the ``ok → degraded → draining → failed`` state
+machine, scrapeable live via
+``telemetry.http.MetricsServer(health=sched.health.healthz)``.
+
 Observability (``apex_tpu.telemetry``): pass ``registry`` to count
 admissions (by prefill bucket and admission-batch size) / finishes-by-
-reason / tokens, gauge the in-flight pipeline depth, and observe TTFT +
-per-token latency into SLO-bucketed histograms (scrapeable live via
+reason / tokens / faults / retries / rebuilds / sheds, gauge the
+in-flight pipeline depth and health state, and observe TTFT + per-token
+latency into SLO-bucketed histograms (scrapeable live via
 ``telemetry.http.MetricsServer``), and ``spans`` to record each
 request's phase timeline (queued → prefill → first_token → decode
-chunks → retired) plus ``engine.dispatch`` / ``engine.fetch`` /
-``engine.admit`` host sections — the dispatch-vs-fetch split shows
-exactly how much host time the pipeline hides. Both are pre-bound at
-construction so the per-token hot path pays an attribute access and an
-add, nothing more.
+chunks → retired, plus ``error`` marks) and ``engine.dispatch`` /
+``engine.fetch`` / ``engine.admit`` / ``engine.rebuild`` host sections.
+Both are pre-bound at construction so the per-token hot path pays an
+attribute access and an add, nothing more.
 
 The boundary fix the engine relies on: a request whose prompt already
 ends in its eos token completes at ``submit`` time with zero generated
@@ -48,12 +66,13 @@ from __future__ import annotations
 
 import collections
 import time
-from typing import Callable, Deque, Dict, List, Optional, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from apex_tpu import profiler
 from apex_tpu.serving.engine import Admission, Engine, StepHandle
 from apex_tpu.serving.request import (
     FINISH_EOS,
+    FINISH_ERROR,
     FINISH_LENGTH,
     FINISH_REASONS,
     FINISH_TIMEOUT,
@@ -61,11 +80,38 @@ from apex_tpu.serving.request import (
     Request,
     StreamEvent,
 )
+from apex_tpu.serving.resilience import (
+    HEALTH_FAILED,
+    KIND_FLOOD,
+    EngineFailed,
+    HealthMonitor,
+    ResilienceConfig,
+)
 from apex_tpu.telemetry import spans as spans_mod
+
+#: fault causes the scheduler can detect (label values of
+#: ``serving_faults_detected_total``, pre-created so scrapes show
+#: explicit zeros)
+FAULT_CAUSES = ("admit", "dispatch", "fetch", "retire", "invalid_token")
+
+#: shed reasons (label values of ``serving_requests_shed_total``)
+SHED_REASONS = ("queue_full", "deadline")
 
 
 class QueueFull(RuntimeError):
-    """Backpressure signal: the request queue is at ``max_queue``."""
+    """Backpressure signal: the request queue is at ``max_queue``.
+    Carries structured overload context so a client (or gateway) can
+    back off intelligently instead of parsing the message:
+    ``queue_depth`` is the depth at rejection time and
+    ``retry_after_s`` estimates when the queue will have drained
+    (depth × measured chunk latency; 0.0 before any chunk has been
+    measured)."""
+
+    def __init__(self, message: str, *, queue_depth: int = 0,
+                 retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.queue_depth = queue_depth
+        self.retry_after_s = retry_after_s
 
 
 class _RegistryMetrics:
@@ -126,17 +172,58 @@ class _RegistryMetrics:
             "wall time / chunk tokens)")
         self.request_latency = registry.histogram(
             "serving_request_latency_seconds", "arrival to completion")
+        # -- resilience (apex_tpu.serving.resilience) -------------------
+        flt = registry.counter(
+            "serving_faults_detected_total",
+            "faults detected at engine seams, by cause",
+            labels=("cause",))
+        self.faults = {c: flt.labels(cause=c) for c in FAULT_CAUSES}
+        shed = registry.counter(
+            "serving_requests_shed_total",
+            "requests rejected/shed by overload protection, by reason",
+            labels=("reason",))
+        self.shed = {r: shed.labels(reason=r) for r in SHED_REASONS}
+        self.retries = registry.counter(
+            "serving_retries_total",
+            "fault-affected requests scheduled for re-admission")
+        self.rebuilds = registry.counter(
+            "serving_rebuilds_total",
+            "cache/state buffer rebuilds after a fault")
+        self.watchdog = registry.counter(
+            "serving_watchdog_trips_total",
+            "decode chunks whose dispatch-to-fetch wall time exceeded "
+            "the watchdog timeout (hung dispatches)")
+        self.replayed = registry.counter(
+            "serving_replayed_tokens_total",
+            "tokens re-derived (and suppressed) during deterministic "
+            "replay after a rebuild")
 
 
 class _Active:
-    """Host view of one occupied slot."""
+    """Host view of one occupied slot. ``suppress`` is the replay
+    offset: tokens up to that count were already streamed before a
+    fault and are re-derived silently."""
 
-    __slots__ = ("request", "tokens", "first_token_time")
+    __slots__ = ("request", "tokens", "first_token_time", "suppress")
 
     def __init__(self, request: Request):
         self.request = request
         self.tokens: List[int] = []
         self.first_token_time: Optional[float] = None
+        self.suppress = 0
+
+
+class _ReplayState:
+    """Recovery bookkeeping for one request across rebuilds: the
+    tokens already streamed (the 'last known-good snapshot' replay
+    re-derives), retry attempts consumed, and the backoff gate."""
+
+    __slots__ = ("tokens", "attempts", "not_before")
+
+    def __init__(self):
+        self.tokens: List[int] = []
+        self.attempts = 0
+        self.not_before = float("-inf")
 
 
 class Scheduler:
@@ -148,20 +235,25 @@ class Scheduler:
     >>> sched.completions["r0"].tokens
 
     ``clock`` is injectable (tests drive deadlines with a fake clock);
-    it must be monotonic. ``metrics`` receives one record per step plus
-    one per completion. ``pipeline_depth`` >= 2 overlaps host work with
-    device decode (see module docstring); ``max_admit_batch`` caps how
-    many queued requests one tick hands to ``Engine.admit_many`` (None
-    = all that fit the free slots; 1 = serial single admits, the A/B
-    baseline).
+    it must be monotonic — inject ``sleep`` alongside it (backoff
+    waits go through ``sleep``, and real sleeping cannot advance a
+    fake clock). ``metrics`` receives one record per step plus one per
+    completion. ``pipeline_depth`` >= 2 overlaps host work with device
+    decode (see module docstring); ``max_admit_batch`` caps how many
+    queued requests one tick hands to ``Engine.admit_many`` (None =
+    all that fit the free slots; 1 = serial single admits, the A/B
+    baseline). ``resilience`` tunes recovery/overload policy
+    (defaults: :class:`~apex_tpu.serving.resilience.ResilienceConfig`).
     """
 
     def __init__(self, engine: Engine, *, max_queue: int = 256,
                  metrics: Optional[profiler.MetricsLogger] = None,
                  registry=None, spans=None,
                  clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
                  pipeline_depth: int = 1,
-                 max_admit_batch: Optional[int] = None):
+                 max_admit_batch: Optional[int] = None,
+                 resilience: Optional[ResilienceConfig] = None):
         if pipeline_depth < 1:
             raise ValueError(
                 f"pipeline_depth {pipeline_depth} must be >= 1 (1 = the "
@@ -173,8 +265,10 @@ class Scheduler:
         self.max_queue = max_queue
         self.metrics = metrics
         self.clock = clock
+        self.sleep = sleep
         self.pipeline_depth = pipeline_depth
         self.max_admit_batch = max_admit_batch
+        self.resilience = resilience or ResilienceConfig()
         #: telemetry sinks (both optional): a telemetry.Registry the
         #: scheduler counts/observes into, and a telemetry.SpanRecorder
         #: receiving per-request phase marks + dispatch sections. The
@@ -185,23 +279,41 @@ class Scheduler:
         self.spans = spans
         if spans is not None:
             spans.clock = self.clock
+        #: the ok → degraded → draining → failed state machine; wire
+        #: ``MetricsServer(health=sched.health.healthz)`` to serve it
+        self.health = HealthMonitor(
+            registry=registry,
+            recovery_chunks=self.resilience.recovery_chunks)
         self.queue: Deque[Request] = collections.deque()
         self.active: Dict[int, _Active] = {}
         self.completions: Dict[str, Completion] = {}
         self.events: Deque[StreamEvent] = collections.deque()
         self.ttft_stats = profiler.LatencyStats()
         self.token_latency_stats = profiler.LatencyStats()
-        self._free: List[int] = list(range(engine.slots))[::-1]
+        self._free: List[int] = self._reset_free()
         #: chunks dispatched but not yet fetched, oldest first; each
         #: entry is (handle, slot->_Active snapshot at dispatch,
-        #: dispatch time)
+        #: dispatch time, pipeline depth at dispatch incl. this chunk)
         self._inflight: Deque[
-            Tuple[StepHandle, Dict[int, _Active], float]] = \
+            Tuple[StepHandle, Dict[int, _Active], float, int]] = \
             collections.deque()
+        #: recovery bookkeeping per interrupted request (cleared at
+        #: completion)
+        self._replay: Dict[str, _ReplayState] = {}
         self._steps = 0
         self._tokens_emitted = 0
         self._admitted_requests = 0
         self._admit_dispatches = 0
+        self._retries = 0
+        self._rebuilds = 0
+        self._shed = 0
+        self._watchdog_trips = 0
+        self._consecutive_rebuilds = 0
+        #: EWMA of chunk dispatch→fetch wall time — the overload
+        #: estimator behind deadline shedding and the QueueFull
+        #: retry-after hint
+        self._chunk_ewma = 0.0
+        self._alarms_seen = self._guard_alarm_count()
         self._started: Optional[float] = None
         # steady-decode split: wall time attributable to decode chunks
         # (dispatch-to-fetch, overlap-deduplicated so pipelined chunks
@@ -215,10 +327,16 @@ class Scheduler:
     # -- intake ------------------------------------------------------------
 
     def submit(self, request: Request) -> None:
-        """Enqueue ``request``; raises :class:`QueueFull` at capacity.
-        Prompt-validity errors raise immediately; a prompt that already
-        ends in the request's eos token completes here with zero
-        generated tokens."""
+        """Enqueue ``request``; raises :class:`QueueFull` at capacity
+        (with queue depth + a retry-after hint attached) and
+        :class:`~apex_tpu.serving.resilience.EngineFailed` once the
+        health machine is terminal. Prompt-validity errors raise
+        immediately; a prompt that already ends in the request's eos
+        token completes here with zero generated tokens."""
+        if self.health.state == HEALTH_FAILED:
+            raise EngineFailed(
+                f"engine health is failed ({self.health.last_cause}); "
+                f"not accepting requests")
         if request.request_id in self.completions or any(
                 a.request.request_id == request.request_id
                 for a in self.active.values()) or any(
@@ -252,9 +370,20 @@ class Scheduler:
                 self.telemetry.submitted.inc()
             self._complete(request, [], FINISH_EOS, ttft=None, now=now)
             return
-        if len(self.queue) >= self.max_queue:
+        plan = self.engine.fault_plan
+        spec = plan.take("submit") if plan is not None else None
+        flooded = spec is not None and spec.kind == KIND_FLOOD
+        if flooded or len(self.queue) >= self.max_queue:
+            depth = self.max_queue if flooded else len(self.queue)
+            hint = depth * self._chunk_ewma
+            self._shed += 1
+            self.health.record_fault("queue_full")
+            if self.telemetry is not None:
+                self.telemetry.shed["queue_full"].inc()
             raise QueueFull(
-                f"queue at capacity ({self.max_queue}); retry later")
+                f"queue at capacity ({depth}"
+                f"{', injected flood' if flooded else ''}); retry in "
+                f"~{hint:.3f}s", queue_depth=depth, retry_after_s=hint)
         self.queue.append(request)
         if self.telemetry is not None:
             self.telemetry.submitted.inc()
@@ -265,23 +394,27 @@ class Scheduler:
     # -- the loop ----------------------------------------------------------
 
     def step(self) -> None:
-        """One scheduler tick: expire deadlines, batch-admit queued
-        requests into free slots, dispatch the next decode chunk if any
-        slot is live, then fetch + unpack chunks down to the pipeline
-        depth (ALL of them when nothing was dispatched — the drain
-        path, so a tick always makes progress). At depth 1 this is the
-        serial loop: dispatch, fetch, unpack. Deadlines and admissions
-        are checked between chunks — the ``decode_chunk`` admission-
-        latency/throughput tradeoff, now also the pipeline-depth one."""
+        """One scheduler tick: expire/shed deadlines, batch-admit
+        queued requests into free slots, dispatch the next decode chunk
+        if any slot is live, then fetch + unpack chunks down to the
+        pipeline depth (ALL of them when nothing was dispatched — the
+        drain path, so a tick always makes progress). At depth 1 this
+        is the serial loop: dispatch, fetch, unpack. Deadlines and
+        admissions are checked between chunks — the ``decode_chunk``
+        admission-latency/throughput tradeoff, now also the
+        pipeline-depth one. A fault detected anywhere in the tick
+        triggers quarantine + rebuild + replay instead of escaping
+        (see module docstring); once the health machine is terminal
+        the tick is a no-op."""
+        if self.health.state == HEALTH_FAILED:
+            return
         now = self.clock()
         if self._started is None:
             self._started = now
+        self._poll_guard_alarms()
         self._expire(now)
         self._admit_queued(now)
-        dispatched = False
-        if self._dispatchable():
-            self._dispatch_chunk()
-            dispatched = True
+        dispatched = bool(self.active) and self._dispatch_chunk()
         keep = self.pipeline_depth - 1 if dispatched else 0
         while len(self._inflight) > keep:
             self._collect_oldest()
@@ -301,13 +434,22 @@ class Scheduler:
 
     def drain(self) -> None:
         """Fetch + unpack every in-flight chunk (pipeline drain): after
-        this, ``events``/``completions`` reflect all dispatched work."""
-        while self._inflight:
-            self._collect_oldest()
+        this, ``events``/``completions`` reflect all dispatched work.
+        The health machine reads ``draining`` for the duration (a live
+        ``/healthz`` probe answers 503 — stop routing traffic here),
+        then returns to its prior state."""
+        self.health.begin_drain()
+        try:
+            while self._inflight:
+                self._collect_oldest()
+        finally:
+            self.health.end_drain()
 
     def run_until_idle(self, max_steps: int = 100_000) -> None:
         """Step until queue, slots, and the pipeline are empty (offline
-        batch mode)."""
+        batch mode). When every queued request is gated on retry
+        backoff and nothing is in flight, waits out the earliest gate
+        via ``sleep`` instead of spinning."""
         steps = 0
         while self.queue or self.active or self._inflight:
             self.step()
@@ -317,6 +459,9 @@ class Scheduler:
                     f"not idle after {max_steps} steps — live slots "
                     f"{sorted(self.active)}, queue {len(self.queue)}, "
                     f"{len(self._inflight)} chunks in flight")
+            wait = self._backoff_wait_s()
+            if wait is not None:
+                self.sleep(wait)
 
     def pop_events(self) -> List[StreamEvent]:
         """Drain the response stream."""
@@ -325,6 +470,33 @@ class Scheduler:
         return out
 
     # -- internals ---------------------------------------------------------
+
+    def _guard_alarm_count(self) -> float:
+        """Current value of the engine sentinel's recompile-alarm
+        counter (0.0 when no registry-wired sentinel exists) — polled
+        each tick so guard alarms degrade health automatically."""
+        sent = getattr(self.engine, "_sentinel", None)
+        return sent.alarms_total() if sent is not None else 0.0
+
+    def _poll_guard_alarms(self) -> None:
+        v = self._guard_alarm_count()
+        if v > self._alarms_seen:
+            self._alarms_seen = v
+            self.health.record_fault("recompile_alarm")
+
+    def _backoff_wait_s(self) -> Optional[float]:
+        """Seconds until the earliest retry-backoff gate opens, when
+        that is the ONLY remaining work (else None)."""
+        if self.active or self._inflight or not self.queue:
+            return None
+        now = self.clock()
+        waits = []
+        for r in self.queue:
+            st = self._replay.get(r.request_id)
+            if st is None or st.not_before <= now:
+                return None  # something is admissible right now
+            waits.append(st.not_before - now)
+        return min(waits) + 1e-4
 
     def _dispatchable(self) -> bool:
         """Whether dispatching another chunk can produce ANY real
@@ -343,7 +515,7 @@ class Scheduler:
             return True
         cols: Dict[int, int] = {}
         chunk = self.engine.engine_cfg.decode_chunk
-        for _, snapshot, _ in self._inflight:
+        for _, snapshot, _, _ in self._inflight:
             for slot, act in snapshot.items():
                 if self.active.get(slot) is act:
                     cols[slot] = cols.get(slot, 0) + chunk
@@ -351,9 +523,21 @@ class Scheduler:
             len(act.tokens) + cols.get(slot, 0) < act.request.max_tokens
             for slot, act in self.active.items())
 
-    def _dispatch_chunk(self) -> None:
+    def _dispatch_chunk(self) -> bool:
+        """Dispatch the next decode chunk if it can pay for itself;
+        True when one went out. A dispatch-seam fault triggers
+        recovery (every live slot was in the failing chunk's blast
+        radius)."""
+        if not self._dispatchable():
+            return False
         t0 = self.clock()
-        handle = self.engine.step_async()
+        try:
+            handle = self.engine.step_async()
+        except Exception as e:  # device error escaping the dispatch
+            self._recover(self.clock(), cause="dispatch", detail=str(e),
+                          affected=[a.request for _, a in
+                                    sorted(self.active.items())])
+            return False
         t1 = self.clock()
         if self.spans is not None:
             # the host-side cost of getting the chunk onto the device —
@@ -363,14 +547,24 @@ class Scheduler:
         # snapshot the live slots: by the time this chunk is fetched,
         # some may have been released (finish seen in an earlier chunk,
         # deadline retire) and their columns must be dropped
-        self._inflight.append((handle, dict(self.active), t0))
+        self._inflight.append((handle, dict(self.active), t0,
+                               len(self._inflight) + 1))
         if self.telemetry is not None:
             self.telemetry.inflight.set(len(self._inflight))
+        return True
 
     def _collect_oldest(self) -> None:
-        handle, snapshot, t_dispatch = self._inflight.popleft()
+        handle, snapshot, t_dispatch, depth_at_dispatch = \
+            self._inflight.popleft()
         t0 = self.clock()
-        tokens, finished = handle.fetch()
+        try:
+            tokens, finished = handle.fetch()
+        except Exception as e:  # device error escaping the fetch
+            self._recover(self.clock(), cause="fetch", detail=str(e),
+                          affected=[a.request
+                                    for s, a in sorted(snapshot.items())
+                                    if self.active.get(s) is a])
+            return
         now = self.clock()
         tele = self.telemetry
         if tele is not None:
@@ -383,6 +577,49 @@ class Scheduler:
                 if self.active.get(slot) is act:
                     self.spans.mark(act.request.request_id,
                                     spans_mod.PHASE_DECODE)
+        # chunk-latency EWMA + watchdog: a dispatch that took longer
+        # than the timeout to yield its value is flagged as hung (the
+        # tokens may still be good — the chunk proceeds). A tripped
+        # chunk is EXCLUDED from the EWMA: it is already accounted as
+        # a fault, and folding a 30 s hang into the overload estimator
+        # would shed every deadlined request in the queue against a
+        # latency the healthy engine does not have. The EWMA sample is
+        # normalized by the pipeline depth at dispatch: at depth d the
+        # dispatch-to-fetch wall includes waiting behind d-1 earlier
+        # in-flight chunks, and pricing the queue with the un-divided
+        # wall would overstate slot turnover ~d× and shed requests
+        # that would have met their deadlines
+        chunk_wall = max(now - t_dispatch, 0.0)
+        if chunk_wall > self.resilience.watchdog_timeout_s:
+            self._watchdog_trips += 1
+            self.health.record_fault("watchdog")
+            if tele is not None:
+                tele.watchdog.inc()
+        else:
+            sample = chunk_wall / max(depth_at_dispatch, 1)
+            self._chunk_ewma = sample if self._chunk_ewma == 0.0 \
+                else 0.7 * self._chunk_ewma + 0.3 * sample
+        # NaN/garbage quarantine: an out-of-vocab token id ANYWHERE in
+        # the batch means the step (and the cache it wrote) cannot be
+        # trusted — drop the whole chunk before unpacking a single
+        # token and rebuild, even when every corrupt lane belongs to a
+        # slot already released (the cache those lanes share is still
+        # poisoned). Only still-live corrupt lanes are charged a
+        # retry; everyone else replays for free. One whole-array
+        # min/max pass exits the healthy case before any per-slot
+        # work (this runs on every chunk)
+        vocab = self.engine.cfg.vocab_size
+        if tokens.size and (int(tokens.min()) < 0
+                            or int(tokens.max()) >= vocab):
+            bad = [act.request for slot, act in sorted(snapshot.items())
+                   if self.active.get(slot) is act
+                   and bool(((tokens[slot] < 0)
+                             | (tokens[slot] >= vocab)).any())]
+            self._recover(
+                now, cause="invalid_token",
+                detail="invalid token id in decode batch "
+                "(NaN-poisoned step)", affected=bad)
+            return
         n_cols = tokens.shape[1]
         # in-flight latency of this chunk (dispatch -> value); the
         # decode-time split dedups the overlap so pipelined chunks
@@ -401,12 +638,7 @@ class Scheduler:
                     continue
                 tok = int(tokens[slot, j])
                 act.tokens.append(tok)
-                self._tokens_emitted += 1
-                self._decode_tokens += 1
-                self.token_latency_stats.add(per_tok)
-                if tele is not None:
-                    tele.tokens.inc()
-                    tele.token_latency.observe(per_tok)
+                replayed = len(act.tokens) <= act.suppress
                 done = bool(finished[slot, j])
                 reason = None
                 if done:
@@ -414,20 +646,205 @@ class Scheduler:
                     reason = (FINISH_EOS
                               if eos is not None and tok == eos
                               else FINISH_LENGTH)
-                self.events.append(StreamEvent(
-                    act.request.request_id, tok, done, reason))
+                if replayed:
+                    # re-derived token, already streamed before the
+                    # fault — suppress the duplicate event
+                    if tele is not None:
+                        tele.replayed.inc()
+                else:
+                    self._tokens_emitted += 1
+                    self._decode_tokens += 1
+                    self.token_latency_stats.add(per_tok)
+                    if tele is not None:
+                        tele.tokens.inc()
+                        tele.token_latency.observe(per_tok)
+                    self.events.append(StreamEvent(
+                        act.request.request_id, tok, done, reason))
                 if done:
                     self._release(slot, reason)
+        # a chunk landed end-to-end: recovery streak for the health
+        # machine, and the rebuild-storm counter resets
+        self._consecutive_rebuilds = 0
+        self.health.record_progress()
+
+    def _reset_free(self) -> List[int]:
+        """Every slot free, pop order = slot order."""
+        self._free = list(range(self.engine.slots))[::-1]
+        return self._free
+
+    def _abort(self, request: Request, reason: str, now: float, *,
+               act: Optional[_Active] = None,
+               error: Optional[str] = None) -> None:
+        """Terminal non-success outcome (timeout shed/expiry, fault
+        error): one finished StreamEvent + a completion carrying the
+        longest stream the client saw — the live slot's tokens, or the
+        replay snapshot when a fault interrupted mid-replay and the
+        re-derivation had not caught up."""
+        st = self._replay.pop(request.request_id, None)
+        tokens = list(act.tokens) if act is not None else []
+        if st is not None and len(st.tokens) > len(tokens):
+            tokens = st.tokens
+        ttft = None
+        if act is not None and act.first_token_time is not None:
+            ttft = act.first_token_time - request.arrival_time
+        self.events.append(StreamEvent(
+            request.request_id, None, True, reason, error=error))
+        self._complete(request, tokens, reason, ttft=ttft, now=now)
+
+    # -- failure isolation + recovery --------------------------------------
+
+    def _recover(self, now: float, *, cause: str, detail: str,
+                 affected: Sequence[Request],
+                 batch_reqs: Sequence[Request] = ()) -> None:
+        """Quarantine + rebuild + deterministic replay. ``affected``
+        requests were in the fault's blast radius: they are charged a
+        retry (bounded, exponential backoff) and get an ``error``
+        stream event; exhaustion completes them with the ``error``
+        reason. Every other interrupted request — live slots, plus
+        ``batch_reqs`` from a failed admission call that never reached
+        a slot — replays for free. Replay = re-admit from the prompt:
+        generation is per-request deterministic, so the regenerated
+        stream is bit-identical and the already-streamed prefix
+        (tracked per request in ``_replay``) is re-derived silently."""
+        tele = self.telemetry
+        rcfg = self.resilience
+        self.health.record_fault(cause)
+        if tele is not None and cause in tele.faults:
+            tele.faults[cause].inc()
+        # in-flight chunks were dispatched against the poisoned
+        # buffers: discard them UNFETCHED (their futures may hold the
+        # error; the replay re-derives anything they carried)
+        self._inflight.clear()
+        if tele is not None:
+            tele.inflight.set(0)
+        self._consecutive_rebuilds += 1
+        if self._consecutive_rebuilds > rcfg.max_consecutive_rebuilds:
+            self.queue.extendleft(reversed(list(batch_reqs)))
+            self._fail_all(f"recovery storm ({cause}: {detail})", now)
+            return
+        # interrupted work, slot order first (they were admitted
+        # earliest), then the failed admission batch (they were at the
+        # queue's front moments ago)
+        interrupted: List[Tuple[Request, Optional[_Active]]] = [
+            (act.request, act)
+            for _, act in sorted(self.active.items())]
+        interrupted += [(r, None) for r in batch_reqs]
+        self.active.clear()
+        self._reset_free()
+        # always rebuild: even when the fault was detected host-side
+        # (invalid tokens) or the exception left the engine formally
+        # unpoisoned, the donated buffers were rebound across the
+        # failing call and cannot be trusted
+        self.engine.rebuild_slots()
+        self._rebuilds += 1
+        if tele is not None:
+            tele.rebuilds.inc()
+            tele.active_slots.set(0)
+        if self.spans is not None:
+            self.spans.section_at("engine.rebuild", now, self.clock())
+        affected_ids = {r.request_id for r in affected}
+        front: List[Request] = []
+        for r, act in interrupted:
+            st = self._replay.setdefault(r.request_id, _ReplayState())
+            if act is not None and len(act.tokens) > len(st.tokens):
+                # the last known-good snapshot: everything this request
+                # streamed before the fault, re-derived on replay. Only
+                # ever GROW it — a second fault landing mid-replay sees
+                # act.tokens shorter than what was already streamed
+                # (the replay had not caught up yet), and shrinking the
+                # snapshot would re-emit the tail as duplicates
+                st.tokens = list(act.tokens)
+            if r.request_id in affected_ids:
+                st.attempts += 1
+                if st.attempts > rcfg.max_retries:
+                    self.health.record_fault("retry_exhausted")
+                    self._abort(r, FINISH_ERROR, now, act=act,
+                                error=f"{cause}: {detail}; "
+                                f"{rcfg.max_retries} retries exhausted")
+                    continue
+                st.not_before = now + rcfg.backoff_s(st.attempts)
+                self._retries += 1
+                if tele is not None:
+                    tele.retries.inc()
+                self.events.append(StreamEvent(
+                    r.request_id, None, False, None,
+                    error=f"{cause}: {detail}; retry "
+                    f"{st.attempts}/{rcfg.max_retries}"))
+                if self.spans is not None:
+                    self.spans.mark(r.request_id, spans_mod.PHASE_ERROR,
+                                    note=cause)
+            front.append(r)
+        self.queue.extendleft(reversed(front))
+        if tele is not None:
+            tele.queue_depth.set(len(self.queue))
+
+    def _fail_all(self, cause: str, now: float) -> None:
+        """Terminal: abort every queued/active request with an
+        ``error`` outcome (partial streams preserved) and mark the
+        health machine failed. The process survives — callers see
+        completions, not a crash."""
+        self.health.fail(cause)
+        for slot, act in sorted(self.active.items()):
+            self._abort(act.request, FINISH_ERROR, now, act=act,
+                        error=cause)
+        self.active.clear()
+        self._reset_free()
+        for r in self.queue:
+            self._abort(r, FINISH_ERROR, now, error=cause)
+        self.queue.clear()
+        self._replay.clear()
+        self._inflight.clear()
+        if self.telemetry is not None:
+            self.telemetry.queue_depth.set(0)
+            self.telemetry.active_slots.set(0)
+            self.telemetry.inflight.set(0)
+
+    # -- deadlines + overload protection ------------------------------------
 
     def _expire(self, now: float) -> None:
-        self.queue = collections.deque(
-            r for r in self.queue
-            if not self._expire_queued(r, now))
+        kept: Deque[Request] = collections.deque()
+        n_free, n_slots = len(self._free), self.engine.slots
+        pos = 0
+        for r in self.queue:
+            if self._expire_queued(r, now):
+                continue
+            # deadline-aware shedding: when the queue ahead already
+            # implies missing this deadline, shed NOW — the client
+            # learns immediately instead of after the deadline the
+            # scheduler knew it would blow. The estimate accounts for
+            # slot concurrency: a request that fits the free slots
+            # admits THIS tick (never shed), the rest wait roughly one
+            # measured chunk latency per wave of `slots` ahead of them
+            wave = (pos - n_free) // n_slots + 1
+            if (self.resilience.shed_deadlines and r.deadline is not None
+                    and self._chunk_ewma > 0.0 and pos >= n_free
+                    and now + wave * self._chunk_ewma > r.deadline):
+                self._shed += 1
+                if self.telemetry is not None:
+                    self.telemetry.shed["deadline"].inc()
+                self._abort(r, FINISH_TIMEOUT, now)
+                continue
+            kept.append(r)
+            pos += 1
+        self.queue = kept
         for slot in list(self.active):
-            act = self.active[slot]
+            act = self.active.get(slot)
+            if act is None:
+                continue  # a retire-seam recovery below cleared it
             dl = act.request.deadline
             if dl is not None and now >= dl:
-                self.engine.retire(slot)
+                try:
+                    self.engine.retire(slot)
+                except Exception as e:  # device error escaping retire
+                    # the expiring request still times out (its tokens
+                    # so far are on the host); everyone else replays
+                    self.events.append(StreamEvent(
+                        act.request.request_id, None, True,
+                        FINISH_TIMEOUT))
+                    self._release(slot, FINISH_TIMEOUT)
+                    self._recover(now, cause="retire", detail=str(e),
+                                  affected=[])
+                    continue
                 self.events.append(StreamEvent(
                     act.request.request_id, None, True, FINISH_TIMEOUT))
                 self._release(slot, FINISH_TIMEOUT)
@@ -438,34 +855,70 @@ class Scheduler:
             return False
         if self.telemetry is not None:
             self.telemetry.queue_expired.inc()
-        self._complete(request, [], FINISH_TIMEOUT, ttft=None, now=now)
-        self.events.append(StreamEvent(
-            request.request_id, None, True, FINISH_TIMEOUT))
+        self._abort(request, FINISH_TIMEOUT, now)
         return True
+
+    # -- admission ----------------------------------------------------------
+
+    def _pop_eligible(self, now: float, n: int) -> List[Request]:
+        """Pop up to ``n`` queued requests whose retry-backoff gate
+        (if any) has opened, preserving queue order for the rest —
+        a backing-off request must not block the head of the line."""
+        picked: List[Request] = []
+        skipped: List[Request] = []
+        while self.queue and len(picked) < n:
+            r = self.queue.popleft()
+            st = self._replay.get(r.request_id)
+            if st is not None and now < st.not_before:
+                skipped.append(r)
+            else:
+                picked.append(r)
+        self.queue.extendleft(reversed(skipped))
+        return picked
 
     def _admit_queued(self, now: float) -> None:
         while self._free and self.queue:
             n = min(len(self._free), len(self.queue))
             if self.max_admit_batch is not None:
                 n = min(n, self.max_admit_batch)
-            reqs = [self.queue.popleft() for _ in range(n)]
-            slots = [self._free.pop() for _ in range(n)]
+            reqs = self._pop_eligible(now, n)
+            if not reqs:
+                return  # whole queue gated on retry backoff
+            slots = [self._free.pop() for _ in range(len(reqs))]
             if self.spans is not None:
                 for r, slot in zip(reqs, slots):
                     self.spans.mark(r.request_id, spans_mod.PHASE_PREFILL,
                                     note=f"slot {slot}")
-                t_admit = self.clock()
-            results = self.engine.admit_many([
-                Admission(slot=slot, prompt=r.prompt,
-                          max_tokens=r.max_tokens,
-                          temperature=r.sampling.temperature,
-                          top_k=r.sampling.top_k, top_p=r.sampling.top_p,
-                          seed=r.sampling.seed,
-                          eos_token_id=r.eos_token_id)
-                for r, slot in zip(reqs, slots)])
+            t_admit = self.clock()
+            try:
+                results = self.engine.admit_many([
+                    Admission(slot=slot, prompt=r.prompt,
+                              max_tokens=r.max_tokens,
+                              temperature=r.sampling.temperature,
+                              top_k=r.sampling.top_k,
+                              top_p=r.sampling.top_p,
+                              seed=r.sampling.seed,
+                              eos_token_id=r.eos_token_id)
+                    for r, slot in zip(reqs, slots)])
+            except Exception as e:  # device error escaping the admit
+                self._recover(self.clock(), cause="admit", detail=str(e),
+                              affected=list(reqs), batch_reqs=list(reqs))
+                return
             t_first = self.clock()
+            # NaN-poisoned prefill: a garbage first token means the
+            # admission's cache insert cannot be trusted — quarantine
+            # before any event leaks, charging only the bad rows
+            vocab = self.engine.cfg.vocab_size
+            bad = [r for r, res in zip(reqs, results)
+                   if not 0 <= res.first_token < vocab]
+            if bad:
+                self._recover(t_first, cause="invalid_token",
+                              detail="invalid first token from admission "
+                              "(NaN-poisoned prefill)",
+                              affected=bad, batch_reqs=list(reqs))
+                return
             n_groups = results[-1].group + 1
-            self._admitted_requests += n
+            self._admitted_requests += len(reqs)
             self._admit_dispatches += n_groups
             if self.spans is not None:
                 self.spans.section_at("engine.admit", t_admit, t_first)
@@ -474,25 +927,37 @@ class Scheduler:
                 tele.admit_dispatches.inc(n_groups)
                 tele.queue_depth.set(len(self.queue))
             for r, slot, res in zip(reqs, slots, results):
+                st = self._replay.get(r.request_id)
                 act = _Active(r)
+                act.suppress = 0 if st is None else len(st.tokens)
                 act.first_token_time = t_first
                 act.tokens.append(res.first_token)
-                self._tokens_emitted += 1
-                self.ttft_stats.add(t_first - r.arrival_time)
-                if self.spans is not None:
-                    self.spans.mark(r.request_id,
-                                    spans_mod.PHASE_FIRST_TOKEN)
+                replayed = len(act.tokens) <= act.suppress
                 if tele is not None:
                     tele.admitted.inc()
-                    tele.tokens.inc()
-                    tele.ttft.observe(t_first - r.arrival_time)
                     tele.admit_batch[res.batch_size].inc()
                     tele.bucket[res.bucket].inc()
+                if replayed:
+                    # the first token was streamed before the fault;
+                    # its re-derivation is silent
+                    if tele is not None:
+                        tele.replayed.inc()
+                else:
+                    self._tokens_emitted += 1
+                    self.ttft_stats.add(t_first - r.arrival_time)
+                    if self.spans is not None:
+                        self.spans.mark(r.request_id,
+                                        spans_mod.PHASE_FIRST_TOKEN)
+                    if tele is not None:
+                        tele.tokens.inc()
+                        tele.ttft.observe(t_first - r.arrival_time)
                 reason = None
                 if res.finished:
                     reason = FINISH_EOS if res.hit_eos else FINISH_LENGTH
-                self.events.append(StreamEvent(
-                    r.request_id, res.first_token, res.finished, reason))
+                if not replayed:
+                    self.events.append(StreamEvent(
+                        r.request_id, res.first_token, res.finished,
+                        reason))
                 self.active[slot] = act
                 if res.finished:
                     self._release(slot, reason)
@@ -503,7 +968,14 @@ class Scheduler:
         now = self.clock()
         ttft = (None if act.first_token_time is None
                 else act.first_token_time - act.request.arrival_time)
-        self._complete(act.request, act.tokens, reason, ttft=ttft, now=now)
+        st = self._replay.pop(act.request.request_id, None)
+        tokens = act.tokens
+        if st is not None and len(st.tokens) > len(tokens):
+            # retired mid-replay: the pre-fault stream is longer than
+            # what the replay re-derived — the completion must carry
+            # everything the client was streamed
+            tokens = st.tokens
+        self._complete(act.request, tokens, reason, ttft=ttft, now=now)
 
     def _complete(self, request: Request, tokens: List[int], reason: str,
                   *, ttft: Optional[float], now: float) -> None:
@@ -553,6 +1025,12 @@ class Scheduler:
             # prefilled per compiled admission dispatch
             "admit_dispatches": float(self._admit_dispatches),
             "pipeline_depth": float(self.pipeline_depth),
+            # resilience: recoveries + overload actions this run
+            "retries": float(self._retries),
+            "rebuilds": float(self._rebuilds),
+            "shed": float(self._shed),
+            "watchdog_trips": float(self._watchdog_trips),
+            "health_state": float(self.health.code),
         }
         if elapsed:
             out["tokens_per_sec"] = self._tokens_emitted / elapsed

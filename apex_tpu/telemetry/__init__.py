@@ -34,7 +34,7 @@ __all__ = [
     "ring", "registry", "spans", "recompile", "http",
     "Ring", "Registry", "DEFAULT_BUCKETS", "parse_prometheus_text",
     "SpanRecorder", "RecompileSentinel", "RecompileGuard",
-    "RecompileError", "MetricsServer",
+    "RecompileError", "MetricsServer", "start_metrics_server",
 ]
 
 _LAZY = {
@@ -52,6 +52,7 @@ _LAZY = {
     "RecompileGuard": "apex_tpu.telemetry.recompile",
     "RecompileError": "apex_tpu.telemetry.recompile",
     "MetricsServer": "apex_tpu.telemetry.http",
+    "start_metrics_server": "apex_tpu.telemetry.http",
 }
 
 

@@ -7,7 +7,11 @@ and "what is it doing right now". This answers all three with zero
 dependencies (stdlib ``http.server`` on a daemon thread):
 
 - ``/metrics``  — Prometheus text 0.0.4 from the registry (scrape it),
-- ``/healthz``  — ``ok`` + 200 (wire it to a load-balancer check),
+- ``/healthz``  — ``ok`` + 200 by default; pass ``health=`` (a callback
+  returning ``(status_code, body)`` — e.g.
+  ``serving.resilience.HealthMonitor.healthz``) so the serving health
+  state machine (or any user probe) drives the answer a load balancer
+  sees,
 - ``/vars``     — one JSON snapshot: registry dict + span-recorder
   summary + recompile-sentinel counters + any caller extras (the
   human-curl endpoint).
@@ -22,7 +26,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -38,11 +42,15 @@ class MetricsServer:
 
     def __init__(self, registry, *, host: str = "127.0.0.1",
                  port: int = 0, spans=None, sentinel=None,
-                 extra_vars: Optional[Callable[[], Dict[str, Any]]] = None):
+                 extra_vars: Optional[Callable[[], Dict[str, Any]]] = None,
+                 health: Optional[Callable[[], Tuple[int, str]]] = None):
         self.registry = registry
         self.spans = spans
         self.sentinel = sentinel
         self.extra_vars = extra_vars
+        #: optional ``/healthz`` callback returning (status code,
+        #: body); None keeps the historical unconditional ``ok`` + 200
+        self.health = health
         self._host = host
         self._requested_port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -61,12 +69,18 @@ class MetricsServer:
 
             def do_GET(self):
                 path = self.path.split("?", 1)[0]
+                status = 200
                 if path == "/metrics":
                     body = server.registry.to_prometheus_text() \
                         .encode("utf-8")
                     ctype = PROMETHEUS_CONTENT_TYPE
                 elif path == "/healthz":
-                    body, ctype = b"ok\n", "text/plain; charset=utf-8"
+                    ctype = "text/plain; charset=utf-8"
+                    if server.health is None:
+                        body = b"ok\n"
+                    else:
+                        status, text = server.health()
+                        body = text.encode("utf-8")
                 elif path == "/vars":
                     body = json.dumps(server.vars(), indent=1,
                                       sort_keys=True).encode("utf-8")
@@ -74,7 +88,7 @@ class MetricsServer:
                 else:
                     self.send_error(404, "try /metrics /healthz /vars")
                     return
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -114,6 +128,23 @@ class MetricsServer:
             out["spans"] = self.spans.summary()
         if self.sentinel is not None:
             out["recompile"] = self.sentinel.compiles_total()
+        if self.health is not None:
+            status, body = self.health()
+            out["health"] = {"status": status, "body": body.strip()}
         if self.extra_vars is not None:
             out.update(self.extra_vars())
         return out
+
+
+def start_metrics_server(registry, *, host: str = "127.0.0.1",
+                         port: int = 0, spans=None, sentinel=None,
+                         extra_vars=None, health=None) -> MetricsServer:
+    """Construct AND start a :class:`MetricsServer` in one call — the
+    one-liner for scripts::
+
+        server = start_metrics_server(registry, port=9090,
+                                      health=sched.health.healthz)
+    """
+    return MetricsServer(registry, host=host, port=port, spans=spans,
+                         sentinel=sentinel, extra_vars=extra_vars,
+                         health=health).start()

@@ -151,6 +151,13 @@ class RecompileSentinel:
         without monitoring."""
         self._tracked[name] = fn
 
+    def alarms_total(self) -> float:
+        """Total recompile-guard alarms observed so far — the registry
+        ``recompile_alarms_total`` counter's value (0.0 when the
+        sentinel was created without a registry). The public read the
+        serving health machine polls each tick."""
+        return self._m_alarms.value if self._m_alarms is not None else 0.0
+
     def compiles_total(self) -> Dict[str, Any]:
         """Counter snapshot: process-wide event counts plus per-tracked
         -function jit-cache sizes."""

@@ -35,6 +35,9 @@ PHASE_PREFILL = "prefill"
 PHASE_FIRST_TOKEN = "first_token"
 PHASE_DECODE = "decode"
 PHASE_RETIRED = "retired"
+#: out-of-band: the request was interrupted by a fault and is being
+#: retried (apex_tpu.serving.resilience); note = the detected cause
+PHASE_ERROR = "error"
 
 _MARK = 0
 _SECTION = 1

@@ -20,12 +20,21 @@ Run (CPU simulation; omit --requests for a synthetic trace):
   python examples/serve_gpt.py --tp 2 --slots 2
 
 Observability (``apex_tpu.telemetry``): ``--metrics-port N`` serves
-``/metrics`` (Prometheus text), ``/healthz``, and ``/vars`` (JSON incl.
-span + recompile state) from a background thread for the life of the
-process — scrape while it serves, or add ``--metrics-linger S`` to keep
-the endpoint up after the batch drains. ``--span-trace out.json``
-writes the per-request span timeline as Chrome-trace JSON (open in
-Perfetto next to a ``profiler.trace`` device capture).
+``/metrics`` (Prometheus text), ``/healthz`` (live-wired to the
+scheduler's health state machine: 200 ok/degraded, 503
+draining/failed), and ``/vars`` (JSON incl. span + recompile state)
+from a background thread for the life of the process — scrape while it
+serves, or add ``--metrics-linger S`` to keep the endpoint up after the
+batch drains. ``--span-trace out.json`` writes the per-request span
+timeline as Chrome-trace JSON (open in Perfetto next to a
+``profiler.trace`` device capture).
+
+Chaos (``apex_tpu.serving.resilience``): ``--fault-plan SPEC`` injects
+deterministic faults at the engine seams for manual recovery drills —
+``SPEC`` is ``random:SEED[:N]`` or a comma list of
+``point:index:kind[:arg]``, e.g. ``"fetch:2:nan:1,dispatch:5:error"``.
+Interrupted requests are replayed/retried; the run prints what fired
+and the final health state.
 """
 
 import argparse
@@ -112,6 +121,11 @@ def main():
     ap.add_argument("--span-trace", metavar="PATH", default=None,
                     help="write the per-request span timeline as "
                     "Chrome-trace JSON (view in Perfetto)")
+    ap.add_argument("--fault-plan", metavar="SPEC", default=None,
+                    help="inject deterministic faults at the engine "
+                    "seams: 'random:SEED[:N]' or a comma list of "
+                    "point:index:kind[:arg] (see "
+                    "apex_tpu.serving.resilience.parse_fault_plan)")
     args = ap.parse_args()
 
     cfg = gpt.GPTConfig(vocab_size=1024, hidden_size=128, num_layers=4,
@@ -132,9 +146,16 @@ def main():
     else:
         params = gpt.init(cfg, jax.random.PRNGKey(0))
 
+    fault_plan = None
+    if args.fault_plan:
+        from apex_tpu.serving.resilience import parse_fault_plan
+
+        fault_plan = parse_fault_plan(args.fault_plan)
+        print(f"fault plan: {[s.describe() for s in fault_plan.specs]}")
     engine = Engine(cfg, params, mesh, EngineConfig(
         slots=args.slots, max_prompt_len=args.max_prompt_len,
-        max_seq_len=args.max_seq_len, decode_chunk=args.decode_chunk))
+        max_seq_len=args.max_seq_len, decode_chunk=args.decode_chunk),
+        fault_plan=fault_plan)
     # compile every program (init/step/retire + each (bucket, k)
     # admission variant) before the first request — admission never
     # traces mid-serve, and recompile_guard could be armed right here
@@ -153,20 +174,26 @@ def main():
 
         spans = SpanRecorder()
     if args.metrics_port is not None:
-        from apex_tpu.telemetry import MetricsServer, Registry
+        from apex_tpu.telemetry import Registry
 
         registry = Registry()
         engine.recompile_sentinel(registry=registry)
-        server = MetricsServer(
-            registry, port=args.metrics_port, spans=spans,
-            sentinel=engine.recompile_sentinel()).start()
-        print(f"metrics: {server.url}/metrics  /healthz  /vars")
 
     # offline batch mode submits everything up front — size the queue to
     # the trace instead of dying on backpressure at the default 256
     sched = Scheduler(engine, max_queue=max(256, len(reqs)),
                       registry=registry, spans=spans,
                       pipeline_depth=args.pipeline_depth)
+    if args.metrics_port is not None:
+        from apex_tpu.telemetry import start_metrics_server
+
+        # /healthz answers from the scheduler's live health machine
+        # (200 ok/degraded, 503 draining/failed)
+        server = start_metrics_server(
+            registry, port=args.metrics_port, spans=spans,
+            sentinel=engine.recompile_sentinel(),
+            health=sched.health.healthz)
+        print(f"metrics: {server.url}/metrics  /healthz  /vars")
     for r in reqs:
         sched.submit(r)
     sched.run_until_idle()
@@ -176,6 +203,10 @@ def main():
               f"{list(r.prompt)} -> {c.tokens}")
     print("served " + json.dumps(
         {k: round(v, 3) for k, v in sched.summary().items()}))
+    if fault_plan is not None:
+        print(f"chaos: {len(fault_plan.injected)} fault(s) fired "
+              f"({[s.describe() for s in fault_plan.injected]}), "
+              f"health={sched.health.state}")
     if args.span_trace:
         with open(args.span_trace, "w") as f:
             json.dump(spans.to_chrome_trace(), f)

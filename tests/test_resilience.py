@@ -1,0 +1,743 @@
+"""apex_tpu.serving.resilience — chaos suite.
+
+Headline oracle: a fault injected at ANY engine seam (admit /
+dispatch / fetch, plus NaN batches, hangs, and queue floods) never
+kills the engine — the failing chunk is quarantined, buffers rebuild,
+interrupted requests replay deterministically, and every request
+untouched by the fault (plus every successfully retried one) completes
+with tokens bit-identical to its solo ``gpt.generate`` run. Health
+transitions are observed end-to-end through a LIVE ``/healthz`` scrape,
+and the registry counters reconcile against the injected plan."""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import checkpoint as ckpt
+from apex_tpu import mesh as mx
+from apex_tpu.models import gpt
+from apex_tpu.serving import Request, SamplingParams
+from apex_tpu.serving.engine import Engine, EngineConfig
+from apex_tpu.serving.request import (
+    FINISH_ERROR,
+    FINISH_LENGTH,
+    FINISH_TIMEOUT,
+)
+from apex_tpu.serving.resilience import (
+    EngineFailed,
+    EngineFault,
+    FaultPlan,
+    FaultSpec,
+    HealthMonitor,
+    ResilienceConfig,
+    parse_fault_plan,
+)
+from apex_tpu.serving.scheduler import QueueFull, Scheduler
+from apex_tpu.telemetry import MetricsServer, Registry, parse_prometheus_text
+from apex_tpu.transformer.testing import standalone_gpt_config
+
+VOCAB = 96
+
+
+def _cfg(**overrides):
+    base = dict(vocab_size=VOCAB, seq_len=64)
+    base.update(overrides)
+    return standalone_gpt_config(**base)
+
+
+@pytest.fixture(scope="module")
+def model(devices8):
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    return cfg, params, mesh
+
+
+def _solo_generate(cfg, params, mesh, prompt, n_new, sp: SamplingParams,
+                   eos_token_id=None):
+    """The parity reference: one ``gpt.generate`` run with this
+    request's params and key."""
+    import jax.numpy as jnp
+
+    pspecs = gpt.param_specs(cfg)
+    key = (jax.random.PRNGKey(sp.seed)
+           if sp.temperature > 0 and sp.seed is not None else None)
+    out = jax.jit(jax.shard_map(
+        lambda p, t: gpt.generate(
+            cfg, p, t, n_new, temperature=sp.temperature, top_k=sp.top_k,
+            top_p=sp.top_p, key=key, eos_token_id=eos_token_id,
+            pad_token_id=0),
+        mesh=mesh, in_specs=(pspecs, P(None, None)),
+        out_specs=P(None, None), check_vma=False))(
+            params, jnp.asarray([prompt], jnp.int32))
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def _reqs(n, *, seed0=7000, max_tokens=6):
+    """Deterministic mixed trace: greedy + seeded-sampled lanes (every
+    scheduler-visible request is deterministic, which is exactly what
+    makes replay-after-rebuild bit-identical)."""
+    out = []
+    for i in range(n):
+        p_len = 2 + (3 * i) % 6
+        prompt = [int(t) for t in jax.random.randint(
+            jax.random.PRNGKey(seed0 + i), (p_len,), 0, VOCAB)]
+        sp = (SamplingParams(temperature=0.9, top_k=7, seed=seed0 + i)
+              if i % 2 else SamplingParams())
+        out.append(Request(f"c{seed0}_{i}", prompt, max_tokens=max_tokens,
+                           sampling=sp))
+    return out
+
+
+def _assert_parity(cfg, params, mesh, sched, reqs, *, skip=()):
+    for r in reqs:
+        if r.request_id in skip:
+            continue
+        comp = sched.completions[r.request_id]
+        want = _solo_generate(cfg, params, mesh, list(r.prompt),
+                              r.max_tokens, r.sampling, r.eos_token_id)
+        assert comp.tokens == want, (
+            f"{r.request_id}: engine {comp.tokens} != solo {want}")
+
+
+def _mk_engine(cfg, params, mesh, plan=None, *, slots=2, chunk=2,
+               mpl=8, msl=24):
+    return Engine(cfg, params, mesh,
+                  EngineConfig(slots=slots, max_prompt_len=mpl,
+                               max_seq_len=msl, decode_chunk=chunk),
+                  fault_plan=plan)
+
+
+# --- plan + health unit coverage (host-only, fast) --------------------------
+
+
+def test_fault_plan_deterministic_and_validated():
+    plan = FaultPlan([FaultSpec("fetch", 1, "nan", slots=(1,)),
+                      FaultSpec("admit", 0, "error")])
+    assert plan.take("fetch") is None          # call 0: clean
+    spec = plan.take("fetch")                  # call 1: the fault
+    assert spec is not None and spec.kind == "nan"
+    assert plan.take("fetch") is None
+    assert plan.injected == [spec]
+    assert plan.counts()["fetch"] == 3
+    plan.reset()
+    assert plan.injected == [] and plan.counts()["fetch"] == 0
+    # seeded plans are exact reruns
+    assert FaultPlan.random(11, 5).specs == FaultPlan.random(11, 5).specs
+    assert FaultPlan.random(11, 5).specs != FaultPlan.random(12, 5).specs
+    # CLI parsing round trip
+    p = parse_fault_plan("fetch:2:nan:1,dispatch:5:error,fetch:7:hang:0.5")
+    kinds = {(s.point, s.index): s for s in p.specs}
+    assert kinds[("fetch", 2)].slots == (1,)
+    assert kinds[("fetch", 7)].hang_s == 0.5
+    assert parse_fault_plan("random:3:4").specs == \
+        FaultPlan.random(3, 4).specs
+    with pytest.raises(ValueError, match="unknown fault point"):
+        FaultPlan([FaultSpec("teleport", 0, "error")])
+    with pytest.raises(ValueError, match="not injectable"):
+        FaultPlan([FaultSpec("dispatch", 0, "nan")])
+    with pytest.raises(ValueError, match="duplicate"):
+        FaultPlan([FaultSpec("fetch", 1, "error"),
+                   FaultSpec("fetch", 1, "hang")])
+
+
+def test_health_monitor_machine_and_gauge():
+    reg = Registry()
+    h = HealthMonitor(registry=reg, recovery_chunks=2)
+    gauge = reg.gauge("serving_health_state")
+    assert h.state == "ok" and h.healthz() == (200, "ok\n")
+    h.record_fault("watchdog")
+    assert h.state == "degraded" and gauge.value == 1.0
+    code, body = h.healthz()
+    assert code == 200 and body.startswith("degraded")
+    h.record_progress()
+    assert h.state == "degraded"  # one healthy chunk is not enough
+    h.record_progress()
+    assert h.state == "ok" and gauge.value == 0.0
+    # drain brackets restore the prior state; mid-drain faults land in
+    # the resume state
+    h.begin_drain()
+    assert h.healthz()[0] == 503 and gauge.value == 2.0
+    h.record_fault("fetch")
+    assert h.state == "draining"
+    h.end_drain()
+    assert h.state == "degraded"
+    h.fail("storm")
+    assert h.state == "failed" and h.healthz()[0] == 503
+    h.record_fault("x")
+    h.record_progress()
+    assert h.state == "failed"  # terminal
+    trans = {dict(k)["to"]: v for k, v in parse_prometheus_text(
+        reg.to_prometheus_text())
+        ["serving_health_transitions_total"].items()}
+    assert trans["failed"] == 1.0 and trans["degraded"] == 2.0
+
+
+# --- the chaos oracle, seam by seam -----------------------------------------
+
+
+def test_admit_error_recovers_with_parity(model):
+    """A device error escaping the FIRST admission call: both requests
+    in the batch are retried after backoff, the engine rebuilds without
+    recompiling, and every completion is bit-identical to solo."""
+    cfg, params, mesh = model
+    plan = FaultPlan([FaultSpec("admit", 0, "error")])
+    eng = _mk_engine(cfg, params, mesh, plan)
+    eng.warmup()
+    sizes0 = eng.compiled_cache_sizes()
+    rcfg = ResilienceConfig(backoff_base_s=0.005)
+    sched = Scheduler(eng, resilience=rcfg)
+    reqs = _reqs(2, seed0=7100)
+    for r in reqs:
+        sched.submit(r)
+    sched.run_until_idle()
+    assert len(plan.injected) == 1
+    assert all(c.finish_reason == FINISH_LENGTH
+               for c in sched.completions.values())
+    _assert_parity(cfg, params, mesh, sched, reqs)
+    s = sched.summary()
+    assert s["rebuilds"] == 1.0 and s["retries"] == 2.0
+    # recovery reuses the compiled init program — no recompilation
+    assert eng.compiled_cache_sizes() == sizes0
+    errs = [e for e in sched.pop_events() if e.error is not None]
+    assert len(errs) == 2 and all(not e.finished for e in errs)
+
+
+def test_dispatch_and_fetch_errors_recover(model):
+    """Raised errors at the dispatch and fetch seams (separate runs):
+    live requests are retried and finish with solo parity; the poisoned
+    engine refuses device calls until the scheduler rebuilds it."""
+    cfg, params, mesh = model
+    for point in ("dispatch", "fetch"):
+        plan = FaultPlan([FaultSpec(point, 1, "error")])
+        eng = _mk_engine(cfg, params, mesh, plan)
+        sched = Scheduler(eng, pipeline_depth=2,
+                          resilience=ResilienceConfig(backoff_base_s=0.005))
+        reqs = _reqs(3, seed0=7200, max_tokens=7)
+        for r in reqs:
+            sched.submit(r)
+        sched.run_until_idle()
+        assert len(plan.injected) == 1, point
+        assert all(c.finish_reason == FINISH_LENGTH
+                   for c in sched.completions.values()), point
+        _assert_parity(cfg, params, mesh, sched, reqs)
+        assert sched.summary()["rebuilds"] == 1.0, point
+        assert sched.health.state in ("ok", "degraded")
+
+
+def test_poisoned_engine_refuses_until_rebuild(model):
+    """Failure isolation at the engine level: after a poisoning fault,
+    every device call raises EngineFault until rebuild_slots()."""
+    cfg, params, mesh = model
+    plan = FaultPlan([FaultSpec("dispatch", 0, "error")])
+    eng = _mk_engine(cfg, params, mesh, plan)
+    eng.admit(0, [1, 2, 3], 5)
+    with pytest.raises(EngineFault, match="injected"):
+        eng.step_async()
+    assert eng.poisoned
+    for call in (eng.step_async, lambda: eng.admit(1, [4], 2),
+                 lambda: eng.retire(0)):
+        with pytest.raises(EngineFault, match="poisoned"):
+            call()
+    eng.rebuild_slots()
+    assert not eng.poisoned
+    eng.admit(0, [1, 2, 3], 5)
+    eng.step()  # serves again
+
+
+def test_retire_error_recovers(model):
+    """A device error escaping the deadline-retire call: the expiring
+    request still completes with timeout (its tokens are host-side),
+    the batch-mate is replayed with full parity, and the engine
+    rebuilds — retire was the one seam recovery used to not cover."""
+    cfg, params, mesh = model
+    plan = FaultPlan([FaultSpec("retire", 0, "error")])
+    eng = _mk_engine(cfg, params, mesh, plan)
+    now = [0.0]
+    sched = Scheduler(eng, clock=lambda: now[0],
+                      sleep=lambda s: now.__setitem__(0, now[0] + s),
+                      resilience=ResilienceConfig(backoff_base_s=0.0))
+    doomed = Request("doomed", [1, 2, 3], max_tokens=10, deadline=5.0)
+    (mate,) = _reqs(1, seed0=7950, max_tokens=8)
+    sched.submit(doomed)
+    sched.submit(mate)
+    sched.step()   # both admitted, first chunk decoded
+    now[0] = 6.0   # the deadline lands; retire raises
+    sched.run_until_idle()
+    assert len(plan.injected) == 1
+    dc = sched.completions["doomed"]
+    assert dc.finish_reason == FINISH_TIMEOUT and len(dc.tokens) >= 1
+    _assert_parity(cfg, params, mesh, sched, [mate])
+    assert sched.summary()["rebuilds"] == 1.0
+    assert not eng.poisoned
+
+
+def test_nan_chunk_quarantines_only_affected_slot(model):
+    """An invalid-token (NaN-poisoned) decode batch in slot 1's lane:
+    the chunk is quarantined before any token leaks, slot 0's request
+    replays for free (no error event, no retry charged), slot 1's is
+    retried — and BOTH end bit-identical to solo."""
+    cfg, params, mesh = model
+    plan = FaultPlan([FaultSpec("fetch", 1, "nan", slots=(1,))])
+    eng = _mk_engine(cfg, params, mesh, plan)
+    sched = Scheduler(eng,
+                      resilience=ResilienceConfig(backoff_base_s=0.005))
+    reqs = _reqs(2, seed0=7300, max_tokens=8)
+    for r in reqs:
+        sched.submit(r)
+    sched.run_until_idle()
+    assert len(plan.injected) == 1
+    _assert_parity(cfg, params, mesh, sched, reqs)
+    s = sched.summary()
+    assert s["rebuilds"] == 1.0
+    assert s["retries"] == 1.0  # only the poisoned lane is charged
+    errs = [e for e in sched.pop_events() if e.error is not None]
+    assert [e.request_id for e in errs] == [reqs[1].request_id]
+
+
+@pytest.mark.parametrize("specs", [
+    [FaultSpec("fetch", 2, "nan", slots=(0,))],
+    # a SECOND fault landing mid-replay: the snapshot must only grow
+    # (a shrinking snapshot re-emitted the already-streamed tail as
+    # duplicate events — the regression this pins)
+    [FaultSpec("fetch", 2, "nan", slots=(0,)),
+     FaultSpec("dispatch", 5, "error")],
+], ids=["single", "fault_mid_replay"])
+def test_stream_events_survive_replay_without_duplicates(model, specs):
+    """The event stream under mid-decode faults carries each token
+    exactly once per request, in order, despite the replay(s)."""
+    cfg, params, mesh = model
+    plan = FaultPlan(specs)
+    eng = _mk_engine(cfg, params, mesh, plan)
+    sched = Scheduler(eng,
+                      resilience=ResilienceConfig(backoff_base_s=0.005))
+    reqs = _reqs(2, seed0=7350, max_tokens=9)
+    for r in reqs:
+        sched.submit(r)
+    streams = {r.request_id: [] for r in reqs}
+    while sched.queue or sched.active or sched._inflight:
+        sched.step()
+        for e in sched.pop_events():
+            if e.token is not None:
+                streams[e.request_id].append(e.token)
+        wait = sched._backoff_wait_s()
+        if wait is not None:
+            sched.sleep(wait)
+    assert len(plan.injected) == len(specs)
+    for r in reqs:
+        assert streams[r.request_id] == sched.completions[
+            r.request_id].tokens, r.request_id
+    assert sched.summary()["tokens_emitted"] == sum(
+        len(c.tokens) for c in sched.completions.values())
+    _assert_parity(cfg, params, mesh, sched, reqs)
+
+
+def test_nan_at_admission_quarantines(model):
+    """A garbage first token out of the admission forward (NaN-poisoned
+    prefill) is caught before any event leaks; the bad row is retried,
+    its batch-mate replays free, parity holds for both."""
+    cfg, params, mesh = model
+    plan = FaultPlan([FaultSpec("admit", 0, "nan", slots=(0,))])
+    eng = _mk_engine(cfg, params, mesh, plan)
+    sched = Scheduler(eng,
+                      resilience=ResilienceConfig(backoff_base_s=0.005))
+    reqs = _reqs(2, seed0=7400)
+    for r in reqs:
+        sched.submit(r)
+    sched.run_until_idle()
+    assert len(plan.injected) == 1
+    _assert_parity(cfg, params, mesh, sched, reqs)
+    s = sched.summary()
+    assert s["rebuilds"] == 1.0 and s["retries"] == 1.0
+
+
+def test_retry_exhaustion_errors_out_cleanly(model):
+    """A request whose admissions keep faulting exhausts its bounded
+    retries and completes with the ``error`` finish reason (terminal
+    error event, health degraded) — while an untouched request on the
+    other slot still completes with full parity."""
+    cfg, params, mesh = model
+    # one slot + zero backoff: the victim heads the queue, so admit
+    # calls 0/1/2 are all ITS (re)admissions — each NaN-poisoned at
+    # row 0 — and it exhausts max_retries=2 on the third; the survivor
+    # admits at call 3, which the plan leaves clean
+    plan = FaultPlan([FaultSpec("admit", 0, "nan", slots=(0,)),
+                      FaultSpec("admit", 1, "nan", slots=(0,)),
+                      FaultSpec("admit", 2, "nan", slots=(0,))])
+    eng = _mk_engine(cfg, params, mesh, plan, slots=1)
+    rcfg = ResilienceConfig(max_retries=2, backoff_base_s=0.0)
+    sched = Scheduler(eng, resilience=rcfg)
+    victim, survivor = _reqs(2, seed0=7500)
+    sched.submit(victim)
+    sched.submit(survivor)
+    sched.run_until_idle()
+    assert len(plan.injected) == 3
+    vc = sched.completions[victim.request_id]
+    assert vc.finish_reason == FINISH_ERROR and vc.tokens == []
+    _assert_parity(cfg, params, mesh, sched, [survivor])
+    finals = [e for e in sched.pop_events()
+              if e.error is not None and e.finished]
+    assert [e.request_id for e in finals] == [victim.request_id]
+    assert finals[0].finish_reason == FINISH_ERROR
+    assert sched.summary()["retries"] == 2.0  # bounded, then done
+    assert sched.health.state in ("ok", "degraded")  # recovered or not,
+    # never dead — the survivor's healthy chunks may have restored ok
+
+
+def test_rebuild_storm_fails_terminally_without_crashing(model):
+    """Recovery that cannot make progress (every admission faults,
+    back to back) trips max_consecutive_rebuilds: the health machine
+    goes terminal, every request gets an ``error`` outcome, the
+    process survives, and new submissions are refused with
+    EngineFailed."""
+    cfg, params, mesh = model
+    plan = FaultPlan([FaultSpec("admit", i, "error") for i in range(6)])
+    eng = _mk_engine(cfg, params, mesh, plan)
+    rcfg = ResilienceConfig(max_retries=10, backoff_base_s=0.001,
+                            max_consecutive_rebuilds=2)
+    sched = Scheduler(eng, resilience=rcfg)
+    reqs = _reqs(2, seed0=7600)
+    for r in reqs:
+        sched.submit(r)
+    sched.run_until_idle()  # exits cleanly: everything aborted
+    assert sched.health.state == "failed"
+    assert all(c.finish_reason == FINISH_ERROR
+               for c in sched.completions.values())
+    assert set(sched.completions) == {r.request_id for r in reqs}
+    with pytest.raises(EngineFailed):
+        sched.submit(Request("late", [1, 2], max_tokens=2))
+    sched.step()  # terminal tick is a no-op, not a crash
+
+
+# --- overload protection ----------------------------------------------------
+
+
+def test_queue_full_structured_hint_and_flood(model):
+    cfg, params, mesh = model
+    plan = FaultPlan([FaultSpec("submit", 2, "flood")])
+    eng = _mk_engine(cfg, params, mesh, plan)
+    reg = Registry()
+    sched = Scheduler(eng, max_queue=1, registry=reg)
+    # a measured chunk latency drives the retry-after estimate
+    sched._chunk_ewma = 0.25
+    sched.submit(Request("a", [1, 2], max_tokens=2))
+    with pytest.raises(QueueFull) as ei:
+        sched.submit(Request("b", [1, 2], max_tokens=2))
+    assert ei.value.queue_depth == 1
+    assert ei.value.retry_after_s == pytest.approx(0.25)
+    # the injected flood rejects despite nominal room
+    sched.queue.clear()
+    with pytest.raises(QueueFull, match="flood") as ei:
+        sched.submit(Request("c", [1, 2], max_tokens=2))
+    assert ei.value.queue_depth == 1  # reported at capacity
+    assert sched.health.state == "degraded"  # queue saturation degrades
+    shed = {dict(k)["reason"]: v for k, v in parse_prometheus_text(
+        reg.to_prometheus_text())["serving_requests_shed_total"].items()}
+    assert shed["queue_full"] == 2.0 and shed["deadline"] == 0.0
+
+
+def test_deadline_aware_shedding(model):
+    """A queued request whose deadline is already unreachable (queue
+    position × measured chunk latency) is shed IMMEDIATELY instead of
+    rotting in the queue until expiry; a reachable deadline is not."""
+    cfg, params, mesh = model
+    eng = _mk_engine(cfg, params, mesh, slots=1)
+    now = [100.0]
+    reg = Registry()
+    sched = Scheduler(eng, clock=lambda: now[0], registry=reg,
+                      sleep=lambda s: now.__setitem__(0, now[0] + s))
+    sched._chunk_ewma = 1.0  # the measured estimator, pinned
+    sched.submit(Request("hog", [1, 2, 3], max_tokens=4))
+    sched.submit(Request("doomed", [1, 2], max_tokens=2,
+                         deadline=now[0] + 0.5))
+    sched.submit(Request("fine", [1, 2], max_tokens=2,
+                         deadline=now[0] + 300.0))
+    sched.step()
+    dc = sched.completions["doomed"]
+    assert dc.finish_reason == FINISH_TIMEOUT and dc.tokens == []
+    assert "fine" not in sched.completions  # reachable: kept
+    shed = {dict(k)["reason"]: v for k, v in parse_prometheus_text(
+        reg.to_prometheus_text())["serving_requests_shed_total"].items()}
+    assert shed["deadline"] == 1.0
+    # it was shed, not expired-in-place
+    assert parse_prometheus_text(reg.to_prometheus_text())[
+        "serving_queue_expired_total"][()] == 0.0
+    sched.run_until_idle()
+    assert sched.completions["fine"].finish_reason == FINISH_LENGTH
+    # a request that fits the FREE slots admits this very tick and is
+    # never shed, however tight its deadline looks against the EWMA
+    sched.submit(Request("tight", [4, 5], max_tokens=2,
+                         deadline=now[0] + 0.5))
+    sched.run_until_idle()
+    assert sched.completions["tight"].finish_reason == FINISH_LENGTH
+
+
+def test_nan_in_released_lane_still_quarantines(model):
+    """An out-of-vocab token in a lane with NO live request (slot
+    released or never occupied) still quarantines the chunk: the
+    poisoned step wrote the shared cache, so the buffers rebuild — but
+    nobody is charged a retry, and the live request replays free with
+    full parity."""
+    cfg, params, mesh = model
+    # slot 1 is never occupied; the fault corrupts its (dead) lane
+    plan = FaultPlan([FaultSpec("fetch", 1, "nan", slots=(1,))])
+    eng = _mk_engine(cfg, params, mesh, plan)
+    sched = Scheduler(eng,
+                      resilience=ResilienceConfig(backoff_base_s=0.005))
+    (req,) = _reqs(1, seed0=7900, max_tokens=8)
+    sched.submit(req)
+    sched.run_until_idle()
+    assert len(plan.injected) == 1
+    s = sched.summary()
+    assert s["rebuilds"] == 1.0 and s["retries"] == 0.0
+    assert not [e for e in sched.pop_events() if e.error is not None]
+    _assert_parity(cfg, params, mesh, sched, [req])
+
+
+# --- watchdog + live /healthz e2e -------------------------------------------
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def test_watchdog_and_live_healthz_ok_degraded_ok(model):
+    """The e2e health acceptance: a hung dispatch (injected hang on a
+    fake clock) trips the fetch watchdog; a LIVE /healthz scrape
+    observes ok → degraded → ok as decode recovers, consistent with
+    the serving_health_state gauge and the watchdog counter."""
+    cfg, params, mesh = model
+    now = [0.0]
+    plan = FaultPlan(
+        [FaultSpec("fetch", 1, "hang", hang_s=35.0)],
+        hang_fn=lambda s: now.__setitem__(0, now[0] + s))
+    eng = _mk_engine(cfg, params, mesh, plan)
+    reg = Registry()
+    sched = Scheduler(eng, registry=reg, clock=lambda: now[0],
+                      sleep=lambda s: now.__setitem__(0, now[0] + s),
+                      resilience=ResilienceConfig(watchdog_timeout_s=30.0,
+                                                  recovery_chunks=2))
+    server = MetricsServer(reg, health=sched.health.healthz).start()
+    try:
+        code, body = _get(server.url + "/healthz")
+        assert (code, body) == (200, "ok\n")
+        for r in _reqs(2, seed0=7700, max_tokens=12):
+            sched.submit(r)
+        sched.step()  # chunk 0: clean
+        assert sched.health.state == "ok"
+        sched.step()  # chunk 1: hangs 35s > 30s watchdog
+        assert sched.health.state == "degraded"
+        code, body = _get(server.url + "/healthz")
+        assert code == 200 and body.startswith("degraded")
+        assert "watchdog" in body
+        gauge = parse_prometheus_text(reg.to_prometheus_text())
+        assert gauge["serving_health_state"][()] == 1.0
+        assert gauge["serving_watchdog_trips_total"][()] == 1.0
+        # the hung chunk is excluded from the overload estimator — a
+        # 35 s outlier folded into the EWMA would shed every deadlined
+        # request against a latency the healthy engine does not have
+        assert sched._chunk_ewma < 1.0
+        sched.run_until_idle()  # healthy chunks recover the state
+        code, body = _get(server.url + "/healthz")
+        assert (code, body) == (200, "ok\n")
+        assert parse_prometheus_text(reg.to_prometheus_text())[
+            "serving_health_state"][()] == 0.0
+        # no tokens were harmed: the hung chunk's values were valid
+        assert sched.summary()["rebuilds"] == 0.0
+    finally:
+        server.stop()
+
+
+def test_live_healthz_observes_draining(model):
+    """Scheduler.drain() reads ``draining`` on a LIVE scrape taken
+    mid-drain (a zero-second hang fault doubles as the observation
+    hook), answers 503 to the balancer, and restores the prior state
+    when the pipeline is empty."""
+    cfg, params, mesh = model
+    observed = []
+    reg = Registry()
+    server_box = []
+
+    def hang_fn(_s):
+        server = server_box[0]
+        observed.append(_get(server.url + "/healthz"))
+
+    plan = FaultPlan([FaultSpec("fetch", 1, "hang", hang_s=0.0)],
+                     hang_fn=hang_fn)
+    eng = _mk_engine(cfg, params, mesh, plan)
+    sched = Scheduler(eng, registry=reg, pipeline_depth=2)
+    server_box.append(MetricsServer(reg,
+                                    health=sched.health.healthz).start())
+    try:
+        sched.submit(Request("d0", [3, 4, 5], max_tokens=10))
+        sched.step()   # admit + dispatch chunk 0 (in flight at depth 2)
+        sched.step()   # dispatch chunk 1, fetch chunk 0 (fetch idx 0)
+        assert sched._inflight
+        sched.drain()  # fetch idx 1 fires the scrape hook mid-drain
+        assert observed == [(503, "draining\n")]
+        assert not sched._inflight
+        assert sched.health.state == "ok"  # restored after the drain
+        code, body = _get(server_box[0].url + "/healthz")
+        assert (code, body) == (200, "ok\n")
+    finally:
+        server_box[0].stop()
+
+
+def test_registry_counters_reconcile_with_plan(model):
+    """Counter consistency against a multi-fault plan: detected faults,
+    rebuilds, retries, replays, and health transitions all reconcile
+    with what the plan actually fired."""
+    cfg, params, mesh = model
+    plan = FaultPlan([FaultSpec("admit", 1, "error"),
+                      FaultSpec("fetch", 3, "nan", slots=(0,))])
+    eng = _mk_engine(cfg, params, mesh, plan)
+    reg = Registry()
+    sched = Scheduler(eng, registry=reg,
+                      resilience=ResilienceConfig(backoff_base_s=0.005))
+    reqs = _reqs(4, seed0=7800, max_tokens=7)
+    for r in reqs:
+        sched.submit(r)
+    sched.run_until_idle()
+    assert len(plan.injected) == 2
+    _assert_parity(cfg, params, mesh, sched, reqs)
+    p = parse_prometheus_text(reg.to_prometheus_text())
+    faults = {dict(k)["cause"]: v
+              for k, v in p["serving_faults_detected_total"].items()}
+    assert faults["admit"] == 1.0
+    assert faults["invalid_token"] == 1.0
+    assert faults["dispatch"] == 0.0 and faults["fetch"] == 0.0
+    s = sched.summary()
+    assert p["serving_rebuilds_total"][()] == s["rebuilds"] == 2.0
+    assert p["serving_retries_total"][()] == s["retries"]
+    assert p["serving_replayed_tokens_total"][()] > 0.0
+    # streamed tokens == sum over completions (replays suppressed)
+    assert p["serving_tokens_emitted_total"][()] == sum(
+        len(c.tokens) for c in sched.completions.values())
+    # the engine stayed trace-stable through both recoveries
+    sizes = eng.compiled_cache_sizes()
+    for name in ("init", "step", "admit"):
+        assert sizes[name] in (1, None), sizes
+
+
+# --- randomized chaos soak (slow) + fast smoke ------------------------------
+
+
+def _chaos_run(cfg, params, mesh, seed, n_reqs, n_faults):
+    plan = FaultPlan.random(seed, n_faults, max_index=20,
+                            slots=3, hang_s=0.0)
+    eng = Engine(cfg, params, mesh,
+                 EngineConfig(slots=3, max_prompt_len=8, max_seq_len=24,
+                              decode_chunk=2), fault_plan=plan)
+    sched = Scheduler(eng, pipeline_depth=2,
+                      resilience=ResilienceConfig(backoff_base_s=0.002,
+                                                  max_retries=4))
+    reqs = _reqs(n_reqs, seed0=8000 + seed, max_tokens=6)
+    pending = list(reqs)
+    while pending or sched.queue or sched.active or sched._inflight:
+        for r in pending[:2]:
+            sched.submit(r)
+        pending = pending[2:]
+        sched.step()
+        wait = sched._backoff_wait_s()
+        if wait is not None:
+            sched.sleep(wait)
+    return plan, eng, sched, reqs
+
+
+@pytest.mark.slow
+def test_chaos_soak_randomized():
+    """Randomized (seeded, exactly replayable) chaos soak: several
+    seeds × many requests through a fault-riddled engine — every
+    completion is either an explicit error outcome or bit-identical
+    to solo generate, and recovery accounting stays consistent."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=jax.devices()[:1])
+    for seed in (1, 2, 3):
+        plan, eng, sched, reqs = _chaos_run(cfg, params, mesh, seed,
+                                            n_reqs=10, n_faults=4)
+        assert len(sched.completions) == len(reqs)
+        errored = {rid for rid, c in sched.completions.items()
+                   if c.finish_reason == FINISH_ERROR}
+        _assert_parity(cfg, params, mesh, sched, reqs, skip=errored)
+        s = sched.summary()
+        hard = [x for x in plan.injected if x.kind in ("error", "nan")]
+        assert s["rebuilds"] <= len(hard)
+        assert s["rebuilds"] >= len(
+            [x for x in plan.injected if x.kind == "error"])
+
+
+def test_chaos_smoke(devices8):
+    """Tier-1 smoke slice of the randomized soak: one seed, small
+    trace, same invariants."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    plan, eng, sched, reqs = _chaos_run(cfg, params, mesh, seed=1,
+                                        n_reqs=5, n_faults=3)
+    assert len(sched.completions) == len(reqs)
+    errored = {rid for rid, c in sched.completions.items()
+               if c.finish_reason == FINISH_ERROR}
+    _assert_parity(cfg, params, mesh, sched, reqs, skip=errored)
+
+
+# --- atomic checkpoint writes (satellite) -----------------------------------
+
+
+def _tiny_state():
+    return {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "step": np.int32(7)}
+
+
+def test_checkpoint_atck_atomic_and_truncation_errors(tmp_path):
+    """save_checkpoint_bin writes via same-dir temp + os.replace (no
+    partial file can land at the destination), and any truncated
+    ``.atck`` fails with the clear magic/truncation/CRC error — never
+    struct/json garbage."""
+    state = _tiny_state()
+    path = str(tmp_path / "ck.atck")
+    out = ckpt.save_checkpoint_bin(path, state)
+    assert out == path
+    # no temp droppings after a clean save
+    assert sorted(p.name for p in tmp_path.iterdir()) == ["ck.atck"]
+    back = ckpt.load_checkpoint_bin(path, state)
+    np.testing.assert_array_equal(np.asarray(back["w"]), state["w"])
+    raw = open(path, "rb").read()
+    # cut points spanning every section: magic, header len, manifest,
+    # blob, CRC trailer
+    for cut in (0, 4, 10, 20, len(raw) - 30, len(raw) - 2):
+        trunc = str(tmp_path / "trunc.atck")
+        with open(trunc, "wb") as f:
+            f.write(raw[:cut])
+        with pytest.raises(ValueError,
+                           match="atck|CRC|truncated") as ei:
+            ckpt.load_checkpoint_bin(trunc, state)
+        assert "ck.atck" not in str(ei.value)  # names the bad file
+    # flipped blob byte: the CRC catches it
+    bad = bytearray(raw)
+    bad[len(raw) - 8] ^= 0xFF
+    with open(str(tmp_path / "flip.atck"), "wb") as f:
+        f.write(bytes(bad))
+    with pytest.raises(ValueError, match="CRC"):
+        ckpt.load_checkpoint_bin(str(tmp_path / "flip.atck"), state)
+
+
+def test_checkpoint_npz_atomic(tmp_path):
+    """The .npz fallback path is atomic too (temp + replace, no temp
+    droppings), and still round-trips."""
+    state = _tiny_state()
+    path = str(tmp_path / "ck.npz")
+    out = ckpt.save_checkpoint(path, state, force_npz=True)
+    assert out == path
+    assert sorted(p.name for p in tmp_path.iterdir()) == ["ck.npz"]
+    back = ckpt.load_checkpoint(path, state, force_npz=True)
+    np.testing.assert_array_equal(np.asarray(back["w"]), state["w"])
