@@ -25,13 +25,13 @@ def steady_tps(cfg, params, ecfg, chunk, n_tokens):
                  dataclasses.replace(ecfg, decode_chunk=chunk))
     for s in range(ecfg.slots):  # fill every slot; huge budgets
         eng.admit(s, [1 + s, 2, 3], max_tokens=ecfg.max_seq_len - 4)
-    t_warm, _ = eng.step()  # warm the step program
+    t_warm, _, _ = eng.step()  # warm the step program
     toks = [t_warm]         # warmup tokens join the parity stream
     n_chunks = max(1, n_tokens // (chunk * ecfg.slots))
     timed = 0
     t0 = time.perf_counter()
     for _ in range(n_chunks):
-        t, _ = eng.step()  # np.asarray fetch = the sync
+        t, _, _ = eng.step()  # np.asarray fetch = the sync
         toks.append(t)
         timed += t.size
     dt = time.perf_counter() - t0

@@ -1105,7 +1105,7 @@ _NO_EOS_SENTINEL = -1
 
 
 def decode_steps(cfg: GPTConfig, params, cache, state, n: int, *,
-                 pad_token_id: int = 0, draw_fn=None):
+                 pad_token_id: int = 0, draw_fn=None, masks=None):
     """``n`` fused decode steps as ONE compiled ``lax.scan`` — the
     chunked device-side decode loop. Each step is a
     :func:`decode_step` + on-device sampling + per-slot eos/budget
@@ -1132,7 +1132,17 @@ def decode_steps(cfg: GPTConfig, params, cache, state, n: int, *,
     sampler through this hook, so the sampler state vectors may be
     omitted from ``state`` then.
 
-    Returns ``(cache, state, tokens [B, n], finished [B, n])``.
+    ``masks`` (optional bool ``[B, vocab]``) is the per-slot
+    constrained-decoding vocab mask forwarded to the default
+    ``draw_slots`` draw; it is CONSTANT across the chunk (the host DFA
+    advances between dispatches), so schema-constrained slots are only
+    exact at ``n == 1`` — the scheduler enforces that.
+
+    Returns ``(cache, state, tokens [B, n], logprobs [B, n],
+    finished [B, n])`` — ``logprobs`` is the model's log-probability
+    (log-softmax of the RAW fp32 logits, before temperature/filters/
+    mask) of each emitted token, 0.0 in pad lanes; a static float32
+    output, so serving logprobs never retrace.
     """
     pad = jnp.int32(pad_token_id)
 
@@ -1143,11 +1153,15 @@ def decode_steps(cfg: GPTConfig, params, cache, state, n: int, *,
         if draw_fn is None:
             nxt = _sampling.draw_slots(
                 logits, st["key"], st["pos"], st["temp"], st["top_k"],
-                st["top_p"])
+                st["top_p"], masks=masks)
         else:
             nxt = draw_fn(logits, st["pos"])
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1), nxt[:, None], axis=1
+        )[:, 0]
         live = ~st["done"]
         emit = jnp.where(live, nxt, pad)
+        lp = jnp.where(live, lp, jnp.float32(0.0))
         remaining = st["remaining"] - live.astype(jnp.int32)
         hit_eos = live & (st["eos"] >= 0) & (emit == st["eos"])
         finished = live & (hit_eos | (remaining <= 0))
@@ -1160,13 +1174,13 @@ def decode_steps(cfg: GPTConfig, params, cache, state, n: int, *,
             "remaining": remaining,
             "done": st["done"] | finished,
         }
-        return (cache, st), (emit, finished)
+        return (cache, st), (emit, lp, finished)
 
-    (cache, state), (toks, fins) = lax.scan(
+    (cache, state), (toks, lps, fins) = lax.scan(
         body, (cache, state), None, length=n)
     # scan stacks on the leading (step) dim → [B, n]
     return (cache, state, jnp.transpose(toks, (1, 0)),
-            jnp.transpose(fins, (1, 0)))
+            jnp.transpose(lps, (1, 0)), jnp.transpose(fins, (1, 0)))
 
 
 def _check_stop_tokens(cfg: GPTConfig, eos_token_id, pad_token_id):
@@ -1377,7 +1391,7 @@ def generate(cfg: GPTConfig, params, prompt, n_new: int,
         "eos": jnp.full((b,), _NO_EOS_SENTINEL if eos is None else eos,
                         jnp.int32),
     }
-    _, _, outs, _ = decode_steps(
+    _, _, outs, _, _ = decode_steps(
         cfg, params, cache0, state, n_new - 1,
         pad_token_id=pad_token_id,
         draw_fn=lambda lg, posv: draw(lg, jnp.max(posv)))

@@ -21,7 +21,10 @@ Layout:
 - :mod:`apex_tpu.serving.engine`    — the device loop: slot state,
   compiled step/admit/retire programs,
 - :mod:`apex_tpu.serving.scheduler` — the host loop: request queue with
-  backpressure, deadlines, response stream, serving metrics.
+  backpressure, deadlines, response stream, serving metrics,
+- :mod:`apex_tpu.serving.api`       — OpenAI-compatible HTTP front end
+  (stdlib-only): SSE streaming, stop sequences, logprobs, n>1,
+  JSON-schema-constrained decoding.
 
 ``engine``/``scheduler`` import :mod:`apex_tpu.models.gpt`, which itself
 imports :mod:`.sampling`; they are loaded lazily (PEP 562) so either
@@ -30,24 +33,32 @@ entry point — model first or serving first — resolves without a cycle.
 
 from __future__ import annotations
 
-from apex_tpu.serving import request, sampling  # noqa: F401
+from apex_tpu.serving import request  # noqa: F401
 from apex_tpu.serving.request import (  # noqa: F401
     Completion,
     Request,
     SamplingParams,
+    StopMatcher,
     StreamEvent,
 )
 
 __all__ = [
-    "request", "sampling", "engine", "scheduler", "resilience",
+    "request", "sampling", "engine", "scheduler", "resilience", "api",
     "Request", "SamplingParams", "Completion", "StreamEvent",
+    "StopMatcher",
     "Engine", "EngineConfig", "Scheduler", "QueueFull",
     "Admission", "AdmitResult", "StepHandle",
     "FaultPlan", "FaultSpec", "ResilienceConfig", "HealthMonitor",
     "EngineFault", "InjectedFault", "EngineFailed",
 ]
 
+# ``sampling`` (jax) and ``api`` load lazily alongside engine/scheduler
+# so ``import apex_tpu.serving`` — and through it the stdlib-only
+# ``apex_tpu.serving.api`` front end — never drags jax in eagerly (the
+# api dependency-free test pins this).
 _LAZY = {
+    "sampling": "apex_tpu.serving.sampling",
+    "api": "apex_tpu.serving.api",
     "engine": "apex_tpu.serving.engine",
     "scheduler": "apex_tpu.serving.scheduler",
     "resilience": "apex_tpu.serving.resilience",

@@ -1,0 +1,44 @@
+"""apex_tpu.serving.api — OpenAI-compatible HTTP front end.
+
+The wire layer over the continuous-batching stack: stdlib-only (the
+``telemetry/http.py`` discipline — ``http.server`` + ``json`` +
+``threading``, nothing else at import), so the ingress tier deploys
+anywhere Python runs and the dependency-free test can import it with
+jax/numpy purged.
+
+Layout:
+
+- :mod:`~apex_tpu.serving.api.tokenizer` — minimal byte-level text
+  codec (token id == UTF-8 byte; streaming-safe incremental decode),
+- :mod:`~apex_tpu.serving.api.protocol`  — request parsing/validation
+  + response & SSE framing for ``/v1/chat/completions`` and
+  ``/v1/completions``,
+- :mod:`~apex_tpu.serving.api.constrain` — JSON-schema-constrained
+  decoding: a byte-level pushdown automaton whose allowed-byte set
+  becomes the sampling step's vocab mask,
+- :mod:`~apex_tpu.serving.api.server`    — the threaded HTTP server +
+  the single driver thread that owns the scheduler.
+"""
+
+from __future__ import annotations
+
+from apex_tpu.serving.api import constrain, protocol, tokenizer  # noqa: F401
+from apex_tpu.serving.api.constrain import JsonSchemaConstraint  # noqa: F401
+from apex_tpu.serving.api.protocol import (  # noqa: F401
+    ApiError,
+    render_chat_prompt,
+)
+from apex_tpu.serving.api.server import (  # noqa: F401
+    ApiServer,
+    start_api_server,
+)
+from apex_tpu.serving.api.tokenizer import (  # noqa: F401
+    ByteTokenizer,
+    StreamDecoder,
+)
+
+__all__ = [
+    "constrain", "protocol", "server", "tokenizer",
+    "ApiServer", "start_api_server", "ApiError", "ByteTokenizer",
+    "StreamDecoder", "JsonSchemaConstraint", "render_chat_prompt",
+]

@@ -11,9 +11,11 @@ compiled programs are trace-stable across the whole serving lifetime:
   fused per-token steps (each one ``gpt.decode_step`` over all B slots
   at their own positions + one per-slot
   :func:`apex_tpu.serving.sampling.draw_slots`) in ONE compiled
-  ``lax.scan``, emitting ``[B, decode_chunk]`` tokens + finish flags
-  per dispatch so the multi-ms tunnel/dispatch cost is paid once per
-  chunk instead of once per token. :meth:`Engine.step_async` exposes
+  ``lax.scan``, emitting ``[B, decode_chunk]`` tokens + logprobs +
+  finish flags per dispatch so the multi-ms tunnel/dispatch cost is
+  paid once per chunk instead of once per token. Per-slot vocab masks
+  (constrained decoding) ride every dispatch as one static bool
+  argument — all-True rows are bit-identical to no mask. :meth:`Engine.step_async` exposes
   the dispatch as an in-flight :class:`StepHandle` so a pipelined
   scheduler can enqueue the NEXT chunk before fetching this one's
   tokens — serial ``device + host`` becomes ``max(device, host)``.
@@ -122,7 +124,13 @@ _NO_EOS = gpt._NO_EOS_SENTINEL
 class Admission:
     """One admission request — the argument row of
     :meth:`Engine.admit_many` (``Engine.admit``'s keyword surface as
-    data, so a batch of them can ride one dispatch)."""
+    data, so a batch of them can ride one dispatch).
+
+    ``allowed_tokens`` (optional) is the constrained-decoding vocab
+    whitelist for the FIRST token — the schema DFA's initial allowed
+    set; it also seeds the slot's per-step mask
+    (:meth:`Engine.set_slot_mask` advances it between chunks). ``None``
+    = unconstrained (and resets any stale mask the slot carried)."""
 
     slot: int
     prompt: Any
@@ -132,15 +140,18 @@ class Admission:
     top_p: float = 1.0
     seed: Optional[int] = None
     eos_token_id: Optional[int] = None
+    allowed_tokens: Optional[Sequence[int]] = None
 
 
 @dataclasses.dataclass(frozen=True)
 class AdmitResult:
     """Per-request outcome of :meth:`Engine.admit_many`. ``finished``
     is True when the request is already complete after its first token
-    (eos, or a budget of 1). ``bucket``/``batch_size``/``group`` record
-    which compiled admission variant served it and which dispatch group
-    of the call it rode — the scheduler's admission telemetry."""
+    (eos, or a budget of 1). ``logprob`` is the model's log-probability
+    of the first token (log-softmax of the raw prefill logits).
+    ``bucket``/``batch_size``/``group`` record which compiled admission
+    variant served it and which dispatch group of the call it rode —
+    the scheduler's admission telemetry."""
 
     first_token: int
     hit_eos: bool
@@ -148,6 +159,7 @@ class AdmitResult:
     bucket: int
     batch_size: int
     group: int
+    logprob: float = 0.0
 
 
 def _threefry_key_data(seed: int) -> np.ndarray:
@@ -168,34 +180,37 @@ def _threefry_key_data(seed: int) -> np.ndarray:
 
 
 class StepHandle:
-    """One in-flight decode chunk: the ``[B, n]`` token/finished device
-    futures a :meth:`Engine.step_async` dispatch returned. ``fetch()``
-    is the value-fetch sync (per the perf-claims convention —
-    ``block_until_ready`` can return at dispatch time through the
-    tunnel, a value fetch cannot); it caches, so fetching twice costs
-    one transfer.
+    """One in-flight decode chunk: the ``[B, n]`` token/logprob/
+    finished device futures a :meth:`Engine.step_async` dispatch
+    returned. ``fetch()`` is the value-fetch sync (per the perf-claims
+    convention — ``block_until_ready`` can return at dispatch time
+    through the tunnel, a value fetch cannot); it caches, so fetching
+    twice costs one transfer.
 
     Fault injection (:mod:`apex_tpu.serving.resilience`): a plan's
     ``fetch`` seam is consumed on the FIRST fetch only, and a
     ``dispatch``-seam hang spec rides the handle to be applied where a
     hung dispatch is observed — at the fetch."""
 
-    __slots__ = ("_emit", "_finished", "_out", "_plan", "_hang",
-                 "_on_poison")
+    __slots__ = ("_emit", "_logprobs", "_finished", "_out", "_plan",
+                 "_hang", "_on_poison")
 
-    def __init__(self, emit, finished, *, plan: Optional[FaultPlan] = None,
+    def __init__(self, emit, logprobs, finished, *,
+                 plan: Optional[FaultPlan] = None,
                  hang: Optional[FaultSpec] = None,
                  on_poison: Optional[Any] = None):
         self._emit = emit
+        self._logprobs = logprobs
         self._finished = finished
-        self._out: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self._out: Optional[
+            Tuple[np.ndarray, np.ndarray, np.ndarray]] = None
         self._plan = plan
         self._hang = hang
         self._on_poison = on_poison
 
-    def fetch(self) -> Tuple[np.ndarray, np.ndarray]:
+    def fetch(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Block until the chunk lands; returns ``(tokens [B, n],
-        finished [B, n])`` as host arrays."""
+        logprobs [B, n], finished [B, n])`` as host arrays."""
         if self._out is not None:
             return self._out
         spec = self._plan.take("fetch") if self._plan is not None else None
@@ -209,6 +224,7 @@ class StepHandle:
                 f"injected device error at fetch: {spec.describe()}",
                 point="fetch", spec=spec)
         tokens = np.asarray(self._emit)
+        logprobs = np.asarray(self._logprobs)
         finished = np.asarray(self._finished)
         if spec is not None and spec.kind == KIND_NAN:
             # what a NaN logit batch looks like by the time the host
@@ -216,7 +232,7 @@ class StepHandle:
             tokens = tokens.copy()
             rows = [s for s in spec.slots if 0 <= s < tokens.shape[0]]
             tokens[rows, :] = spec.token
-        self._out = (tokens, finished)
+        self._out = (tokens, logprobs, finished)
         return self._out
 
 
@@ -274,6 +290,14 @@ class Engine:
         #: True after a fault invalidated the donated cache/state —
         #: every device call refuses until rebuild_slots()
         self._poisoned = False
+        #: per-slot constrained-decoding vocab masks, host mirror —
+        #: all-True rows (the unconstrained default) are bit-identical
+        #: to no mask in the draw. The device copy is cached and only
+        #: re-uploaded when a row changes (set_slot_mask / admission),
+        #: so the steady unconstrained path pays one stale-pointer
+        #: check per dispatch, not a [B, vocab] transfer.
+        self._masks = np.ones((ecfg.slots, cfg.vocab_size), bool)
+        self._masks_dev: Optional[Any] = None
         self._build()
         self.cache, self.state = self._init(params)
 
@@ -341,18 +365,20 @@ class Engine:
             }
             return cache, state
 
-        def step_local(params, cache, state):
+        def step_local(params, cache, state, masks):
             # the whole per-token body (decode + per-slot draw +
             # eos/budget masking) lives in gpt.decode_steps — ONE
-            # compiled scan of decode_chunk steps per dispatch
+            # compiled scan of decode_chunk steps per dispatch; masks
+            # is the per-slot constrained-decoding vocab whitelist
+            # (all-True rows are bit-identical to no mask)
             return gpt.decode_steps(
                 cfg, params, cache, state, ecfg.decode_chunk,
-                pad_token_id=ecfg.pad_token_id)
+                pad_token_id=ecfg.pad_token_id, masks=masks)
 
         def make_admit(bucket: int):
             def admit_local(params, cache, state, slots, prompts, p_lens,
                             max_tokens, temp, top_k, top_p, keys, eos,
-                            req_idx, seeded):
+                            req_idx, seeded, masks):
                 # ONE padded forward admits the whole [k, bucket] batch;
                 # row i's logits/KV are exactly its solo prefill_at's
                 blocks, logits0 = gpt.prefill_many(
@@ -369,7 +395,11 @@ class Engine:
                 # [1, vocab] lane — each row IS the solo-generate first
                 # draw (same gumbel shape, same fold index)
                 first = sampling.draw_slots(
-                    logits0, keys, p_lens - 1, temp, top_k, top_p)
+                    logits0, keys, p_lens - 1, temp, top_k, top_p,
+                    masks=masks)
+                first_lp = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits0, axis=-1),
+                    first[:, None], axis=1)[:, 0]
                 cache = gpt.cache_insert_slots(cache, blocks, slots)
                 hit_eos = (eos >= 0) & (first == eos)
                 done0 = hit_eos | (max_tokens <= 1)
@@ -385,7 +415,7 @@ class Engine:
                     "key": state["key"].at[slots].set(keys),
                     "eos": state["eos"].at[slots].set(eos),
                 }
-                return cache, state, first, hit_eos, done0
+                return cache, state, first, first_lp, hit_eos, done0
 
             return admit_local
 
@@ -404,8 +434,9 @@ class Engine:
         scalar = P()
         self._init = sm(init_local, (pspecs,), (cache_spec, state_spec))
         self._step = sm(
-            step_local, (pspecs, cache_spec, state_spec),
-            (cache_spec, state_spec, scalar, scalar), donate=(1, 2))
+            step_local, (pspecs, cache_spec, state_spec, scalar),
+            (cache_spec, state_spec, scalar, scalar, scalar),
+            donate=(1, 2))
         # one admission program per (bucket, k) — the k dim and padded
         # width are static shapes, everything request-scoped is data
         self._admits: Dict[Tuple[int, int], Any] = {}
@@ -413,8 +444,9 @@ class Engine:
             fn = make_admit(bucket)
             for k in self._batch_sizes:
                 self._admits[(bucket, k)] = sm(
-                    fn, (pspecs, cache_spec, state_spec) + (scalar,) * 11,
-                    (cache_spec, state_spec, scalar, scalar, scalar),
+                    fn, (pspecs, cache_spec, state_spec) + (scalar,) * 12,
+                    (cache_spec, state_spec, scalar, scalar, scalar,
+                     scalar),
                     donate=(1, 2))
         self._retire = sm(retire_local, (state_spec, scalar), state_spec,
                           donate=(0,))
@@ -486,7 +518,23 @@ class Engine:
                 f"max_tokens {a.max_tokens} outside [1, {room}] for a "
                 f"{prompt.size}-token prompt at max_seq_len "
                 f"{self.engine_cfg.max_seq_len}")
+        if a.allowed_tokens is not None:
+            # pre-flight (admit_many is all-or-nothing: nothing may
+            # dispatch if any row is invalid); the expansion itself is
+            # owned by set_slot_mask
+            self._check_allowed_tokens(a.allowed_tokens)
         return prompt, prompt.size
+
+    def _check_allowed_tokens(self, allowed: Sequence[int]) -> List[int]:
+        """THE constrained-decoding whitelist validation (shared by
+        admission pre-flight and :meth:`set_slot_mask`)."""
+        allowed = [int(t) for t in allowed]
+        if not allowed or any(not 0 <= t < self.cfg.vocab_size
+                              for t in allowed):
+            raise ValueError(
+                f"allowed token whitelist must be a non-empty subset "
+                f"of vocab [0, {self.cfg.vocab_size})")
+        return allowed
 
     def admit(self, slot: int, prompt, max_tokens: int, *,
               temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
@@ -545,9 +593,16 @@ class Engine:
             req_idx = np.arange(self._req_counter,
                                 self._req_counter + k, dtype=np.int32)
             self._req_counter += k
+            # first-token masks, and the per-slot mask rows the decode
+            # steps will use (set BEFORE the dispatch that reads them;
+            # unconstrained rows reset any stale mask the slot carried)
+            # — one expansion, owned by set_slot_mask
+            for a in batch:
+                self.set_slot_mask(a.slot, a.allowed_tokens)
+            masks = np.stack([self._masks[a.slot] for a in batch])
             arr = lambda vals, dt: np.asarray(vals, dt)
             fn = self._admits[(bucket, k)]
-            self.cache, self.state, first, hit_eos, done = fn(
+            self.cache, self.state, first, first_lp, hit_eos, done = fn(
                 self._params, self.cache, self.state,
                 arr([a.slot for a in batch], np.int32), prompts,
                 arr([n for _, n in proms], np.int32),
@@ -558,16 +613,18 @@ class Engine:
                 keys,
                 arr([_NO_EOS if a.eos_token_id is None
                      else int(a.eos_token_id) for a in batch], np.int32),
-                req_idx, seeded)
-            pending.append(((first, hit_eos, done), bucket, k, group))
+                req_idx, seeded, masks)
+            pending.append(((first, first_lp, hit_eos, done), bucket, k,
+                            group))
             i += k
             group += 1
         # fetch AFTER every group is dispatched — later groups ride the
         # async queue behind earlier ones instead of waiting for each
         # fetch round trip
         results: List[AdmitResult] = []
-        for (first, hit_eos, done), bucket, k, group in pending:
+        for (first, first_lp, hit_eos, done), bucket, k, group in pending:
             first = np.asarray(first)
+            first_lp = np.asarray(first_lp)
             hit_eos, done = np.asarray(hit_eos), np.asarray(done)
             for j in range(k):
                 tok = int(first[j])
@@ -576,7 +633,8 @@ class Engine:
                     tok = spec.token  # NaN prefill: garbage first token
                 results.append(AdmitResult(
                     tok, bool(hit_eos[j]), bool(done[j]),
-                    bucket=bucket, batch_size=k, group=group))
+                    bucket=bucket, batch_size=k, group=group,
+                    logprob=float(first_lp[j])))
         return results
 
     def step_async(self) -> StepHandle:
@@ -593,23 +651,53 @@ class Engine:
             raise InjectedFault(
                 f"injected device error at dispatch: {spec.describe()}",
                 point="dispatch", spec=spec)
-        self.cache, self.state, emit, finished = self._step(
-            self._params, self.cache, self.state)
+        if self._masks_dev is None:
+            self._masks_dev = jnp.asarray(self._masks)
+        self.cache, self.state, emit, logprobs, finished = self._step(
+            self._params, self.cache, self.state, self._masks_dev)
         plan = None if self._warming else self.fault_plan
-        return StepHandle(emit, finished, plan=plan,
+        return StepHandle(emit, logprobs, finished, plan=plan,
                           hang=spec if spec is not None
                           and spec.kind == KIND_HANG else None,
                           on_poison=self._mark_poisoned)
 
-    def step(self) -> Tuple[np.ndarray, np.ndarray]:
+    def step(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """One decode chunk over every slot — ``decode_chunk`` fused
         per-token steps in one dispatch, fetched synchronously
         (:meth:`step_async` + :meth:`StepHandle.fetch`). Returns
-        ``(tokens [B, n], finished [B, n])`` with ``n = decode_chunk``;
-        column ``j`` holds step ``j``'s emissions, ``pad_token_id`` for
-        slots that were done entering that step (a slot that finishes
-        at column ``j`` emits pad from ``j + 1`` on)."""
+        ``(tokens [B, n], logprobs [B, n], finished [B, n])`` with
+        ``n = decode_chunk``; column ``j`` holds step ``j``'s emissions,
+        ``pad_token_id`` for slots that were done entering that step (a
+        slot that finishes at column ``j`` emits pad from ``j + 1``
+        on)."""
         return self.step_async().fetch()
+
+    def set_slot_mask(self, slot: int,
+                      allowed: Optional[Sequence[int]] = None) -> None:
+        """Replace ``slot``'s constrained-decoding vocab mask with the
+        whitelist ``allowed`` (``None`` = unconstrained, all-True). The
+        schema DFA advances host-side per emitted token; the scheduler
+        calls this between chunk dispatches, so the next compiled step
+        reads the advanced mask — no recompile (the mask is data, one
+        static ``[B, vocab]`` bool argument of the step program)."""
+        if not 0 <= slot < self.slots:
+            raise ValueError(f"slot {slot} outside [0, {self.slots})")
+        if allowed is None:
+            # the hot unconstrained case (every admission resets its
+            # slot's row): an already-all-True row must NOT invalidate
+            # the cached device copy — that would re-upload the whole
+            # [B, vocab] array after every admission wave
+            if self._masks[slot].all():
+                return
+            self._masks[slot, :] = True
+        else:
+            allowed = self._check_allowed_tokens(allowed)
+            row = np.zeros((self.cfg.vocab_size,), bool)
+            row[allowed] = True
+            if (self._masks[slot] == row).all():
+                return  # unchanged (e.g. a DFA state with the same set)
+            self._masks[slot] = row
+        self._masks_dev = None
 
     def retire(self, slot: int) -> None:
         """Force ``slot`` done (scheduler deadline expiry). The slot's
@@ -661,6 +749,8 @@ class Engine:
         was compiled at construction, so a recompile guard stays armed
         through recovery."""
         self.cache, self.state = self._init(self._params)
+        self._masks[:, :] = True
+        self._masks_dev = None
         self._poisoned = False
 
     def warmup(self) -> "Engine":
@@ -688,7 +778,7 @@ class Engine:
         for (bucket, k), fn in sorted(self._admits.items()):
             # dummy args exercise shapes only: k pad-token prompts of
             # length 1, budget 1 (done at admission), no sampling
-            self.cache, self.state, first, _, _ = fn(
+            self.cache, self.state, first, _, _, _ = fn(
                 self._params, self.cache, self.state,
                 np.arange(k, dtype=np.int32),
                 np.full((k, bucket), ecfg.pad_token_id, np.int32),
@@ -697,7 +787,8 @@ class Engine:
                 np.ones((k,), np.float32),
                 np.zeros((k, 2), np.uint32),
                 np.full((k,), _NO_EOS, np.int32),
-                np.zeros((k,), np.int32), np.zeros((k,), bool))
+                np.zeros((k,), np.int32), np.zeros((k,), bool),
+                np.ones((k, self.cfg.vocab_size), bool))
             np.asarray(first)
         handle = self.step_async()
         handle.fetch()

@@ -11,9 +11,14 @@ blew its deadline and was retired by the scheduler (``timeout``).
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence
+from typing import Any, List, Optional, Sequence, Tuple
 
 FINISH_EOS = "eos"
+#: a host-side finish: a stop sequence matched on the streamed tail
+#: (the matched tokens are trimmed from the stream), or the request's
+#: schema constraint reached its final state (the emitted text is a
+#: complete schema-valid value)
+FINISH_STOP = "stop"
 FINISH_LENGTH = "length"
 FINISH_TIMEOUT = "timeout"
 #: the request was interrupted by a fault and its bounded retries were
@@ -24,7 +29,8 @@ FINISH_ERROR = "error"
 #: every finish reason, in release-path order — label values for the
 #: scheduler's ``serving_requests_finished_total`` counter (pre-created
 #: per reason so a scrape shows explicit zeros, not absent series)
-FINISH_REASONS = (FINISH_EOS, FINISH_LENGTH, FINISH_TIMEOUT, FINISH_ERROR)
+FINISH_REASONS = (FINISH_EOS, FINISH_STOP, FINISH_LENGTH, FINISH_TIMEOUT,
+                  FINISH_ERROR)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -54,7 +60,25 @@ class SamplingParams:
 class Request:
     """One generation request. ``deadline`` is an absolute scheduler-clock
     time (``time.monotonic`` unless the scheduler was given another
-    clock); ``None`` never times out."""
+    clock); ``None`` never times out.
+
+    ``stop`` is a list of stop TOKEN sequences, matched host-side on
+    the streamed tail: when one matches, the request finishes with
+    reason :data:`FINISH_STOP` and the matched tokens are trimmed from
+    the stream — tokens that could still be a stop prefix are held
+    back, so a client never sees part of a stop sequence (the
+    byte-level API front end compiles stop STRINGS down to these).
+
+    ``constraint`` is an optional schema-constrained-decoding DFA (see
+    :mod:`apex_tpu.serving.api.constrain` for the JSON implementation)
+    the scheduler drives opaquely; it must expose ``reset()`` (called
+    at every (re-)admission, so fault replay restarts it),
+    ``allowed_tokens() -> Sequence[int]`` (the current vocab
+    whitelist, uploaded as the slot's mask), ``advance(token)`` (fold
+    one emitted token), and ``done`` (True = the value is complete; the
+    scheduler finishes the request with :data:`FINISH_STOP`).
+    Constrained requests require ``decode_chunk == 1`` — the mask
+    advances between dispatches."""
 
     request_id: str
     prompt: Sequence[int]
@@ -63,6 +87,8 @@ class Request:
     eos_token_id: Optional[int] = None
     deadline: Optional[float] = None
     arrival_time: Optional[float] = None  # stamped by Scheduler.submit
+    stop: Optional[Sequence[Sequence[int]]] = None
+    constraint: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -80,16 +106,76 @@ class StreamEvent:
     finished: bool
     finish_reason: Optional[str] = None
     error: Optional[str] = None
+    #: the model's log-probability of ``token`` (log-softmax of the raw
+    #: logits) — None on token-less events
+    logprob: Optional[float] = None
 
 
 @dataclasses.dataclass
 class Completion:
     """Terminal state of a request. ``ttft`` is arrival → first token on
     the host; ``latency`` is arrival → completion (both in scheduler-clock
-    seconds, ``None`` for zero-token completions' ttft)."""
+    seconds, ``None`` for zero-token completions' ttft). ``logprobs``
+    aligns 1:1 with ``tokens`` (the model's log-probability of each)."""
 
     request_id: str
     tokens: List[int]
     finish_reason: str
     ttft: Optional[float] = None
     latency: Optional[float] = None
+    logprobs: Optional[List[float]] = None
+
+
+class StopMatcher:
+    """Streaming stop-sequence matcher with trimmed emission.
+
+    Feed each generated ``(token, logprob)`` through :meth:`push`; it
+    returns the pairs now safe to stream and whether a stop sequence
+    just completed. The matcher holds back exactly the longest tail of
+    the stream that is a proper prefix of some stop sequence, so a
+    client never sees tokens that turn out to belong to a stop — and
+    on a match the stop's tokens are dropped (trimmed), never flushed.
+    Deterministic in the token stream, so fault replay re-derives the
+    identical flush pattern (the scheduler's suppression counts stay
+    aligned)."""
+
+    __slots__ = ("stops", "pending", "matched")
+
+    def __init__(self, stops: Sequence[Sequence[int]]):
+        self.stops: List[Tuple[int, ...]] = [
+            tuple(int(t) for t in s) for s in stops if len(s)]
+        self.pending: List[Tuple[int, float]] = []
+        self.matched = False
+
+    def push(self, token: int, logprob: float = 0.0
+             ) -> Tuple[List[Tuple[int, float]], bool]:
+        """Fold one generated token; returns ``(flushed_pairs,
+        matched)``. After a match the matcher is terminal (``matched``
+        stays True; the scheduler releases the request)."""
+        if not self.stops:
+            return [(token, logprob)], False
+        self.pending.append((token, logprob))
+        toks = tuple(t for t, _ in self.pending)
+        for s in self.stops:
+            if len(toks) >= len(s) and toks[-len(s):] == s:
+                flushed = self.pending[:len(self.pending) - len(s)]
+                self.pending = []
+                self.matched = True
+                return flushed, True
+        # hold back the longest suffix that is a proper prefix of some
+        # stop — by induction that suffix always lies inside pending
+        keep = 0
+        for j in range(1, len(toks) + 1):
+            suf = toks[-j:]
+            if any(len(s) > j and s[:j] == suf for s in self.stops):
+                keep = j
+        cut = len(self.pending) - keep
+        flushed, self.pending = self.pending[:cut], self.pending[cut:]
+        return flushed, False
+
+    def flush(self) -> List[Tuple[int, float]]:
+        """Release every held pair (a non-stop finish — eos, length,
+        deadline, error — streams the held tail instead of trimming
+        it)."""
+        out, self.pending = self.pending, []
+        return out

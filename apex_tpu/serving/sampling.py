@@ -24,12 +24,23 @@ import jax
 import jax.numpy as jnp
 
 
-def filter_logits(logits, top_k: int, top_p: float):
+def filter_logits(logits, top_k: int, top_p: float, mask=None):
     """Nucleus/top-k logit filtering: positions outside the top-k (by
     value), or outside the smallest set whose softmax mass reaches
     top_p, are masked to -inf. ``top_k``/``top_p`` are static Python
-    values; 0 / outside (0, 1) disable. One sort; static shapes."""
+    values; 0 / outside (0, 1) disable. One sort; static shapes.
+
+    ``mask`` (optional, bool ``[..., vocab]``) is the constrained-
+    decoding vocab mask: False positions are removed from the candidate
+    set BEFORE the top-k/top-p filters, so the filters act on the
+    allowed distribution (an all-True mask is value-identical to no
+    mask). The serving engine threads a per-slot mask through the
+    traced variant; the host-side schema DFA
+    (:mod:`apex_tpu.serving.api.constrain`) advances it per emitted
+    token."""
     vocab = logits.shape[-1]
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
     kk = top_k if 0 < top_k < vocab else 0
     pp = top_p if 0.0 < top_p < 1.0 else 0.0
     if not kk and not pp:
@@ -84,27 +95,37 @@ def _filter_logits_traced(logits, top_k, top_p):
 
 
 def draw(logits, t, *, temperature: float = 0.0, top_k: int = 0,
-         top_p: float = 1.0, key=None):
+         top_p: float = 1.0, key=None, mask=None):
     """One token per row of ``logits [..., vocab]`` — ``gpt.generate``'s
     draw, verbatim: greedy argmax at ``temperature <= 0``, else a
     categorical sample from the temperature-scaled, top-k/top-p-filtered
     distribution under ``fold_in(key, t)`` (``t`` is the position of the
     token the logits were computed from, so every decode step draws from
-    a distinct, reproducible stream)."""
+    a distinct, reproducible stream). ``mask`` (bool ``[..., vocab]``)
+    restricts the draw to True positions — constrained decoding; both
+    the greedy argmax and the sampled branch honour it."""
     if temperature > 0.0:
         # temperature first: top_p must see the distribution actually
         # being sampled (standard warper order)
-        scaled = filter_logits(logits / temperature, top_k, top_p)
+        scaled = filter_logits(logits / temperature, top_k, top_p,
+                               mask=mask)
         return jax.random.categorical(
             jax.random.fold_in(key, t), scaled, axis=-1
         ).astype(jnp.int32)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
-def draw_slots(logits, keys, t, temperature, top_k, top_p):
+def draw_slots(logits, keys, t, temperature, top_k, top_p, masks=None):
     """Per-slot batched draw: ``logits [B, vocab]``; ``keys [B, 2]``
     (raw PRNG key data); ``t``/``temperature``/``top_k``/``top_p`` all
-    ``[B]`` device vectors. Returns ``[B] int32``.
+    ``[B]`` device vectors. ``masks`` (optional bool ``[B, vocab]``) is
+    the per-slot constrained-decoding vocab mask — False positions are
+    dropped to the dtype minimum before either branch, so an all-True
+    row is bit-identical to the maskless path (the engine always passes
+    masks; unconstrained slots ride all-True rows). Returns ``[B]
+    int32``.
 
     Slot ``b``'s token is bit-identical to
     ``draw(logits[b:b+1], t[b], temperature=.., key=keys[b])[0]`` — the
@@ -113,7 +134,9 @@ def draw_slots(logits, keys, t, temperature, top_k, top_p):
     slots (``temperature <= 0``) take the argmax branch by ``where``
     (their sampled lane divides by a safe 1.0 and is discarded)."""
 
-    def one(lg, key, tt, temp, kk, pp):
+    def one(lg, key, tt, temp, kk, pp, mask=None):
+        if mask is not None:
+            lg = jnp.where(mask, lg, jnp.finfo(lg.dtype).min)
         safe = jnp.where(temp > 0, temp, jnp.float32(1.0))
         scaled = _filter_logits_traced(lg / safe, kk, pp)
         sampled = jax.random.categorical(
@@ -121,5 +144,9 @@ def draw_slots(logits, keys, t, temperature, top_k, top_p):
         greedy = jnp.argmax(lg, axis=-1)
         return jnp.where(temp > 0, sampled, greedy).astype(jnp.int32)
 
+    if masks is None:
+        return jax.vmap(one)(
+            logits[:, None], keys, t, temperature, top_k, top_p)[:, 0]
     return jax.vmap(one)(
-        logits[:, None], keys, t, temperature, top_k, top_p)[:, 0]
+        logits[:, None], keys, t, temperature, top_k, top_p,
+        masks[:, None])[:, 0]

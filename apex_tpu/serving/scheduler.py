@@ -75,9 +75,11 @@ from apex_tpu.serving.request import (
     FINISH_ERROR,
     FINISH_LENGTH,
     FINISH_REASONS,
+    FINISH_STOP,
     FINISH_TIMEOUT,
     Completion,
     Request,
+    StopMatcher,
     StreamEvent,
 )
 from apex_tpu.serving.resilience import (
@@ -202,15 +204,22 @@ class _RegistryMetrics:
 class _Active:
     """Host view of one occupied slot. ``suppress`` is the replay
     offset: tokens up to that count were already streamed before a
-    fault and are re-derived silently."""
+    fault and are re-derived silently. ``tokens``/``logprobs`` hold the
+    CLIENT-VISIBLE stream — tokens held back by the stop matcher (a
+    possible stop-sequence prefix) live inside ``matcher`` until
+    flushed or trimmed; replay re-derives them for free."""
 
-    __slots__ = ("request", "tokens", "first_token_time", "suppress")
+    __slots__ = ("request", "tokens", "logprobs", "first_token_time",
+                 "suppress", "matcher")
 
     def __init__(self, request: Request):
         self.request = request
         self.tokens: List[int] = []
+        self.logprobs: List[float] = []
         self.first_token_time: Optional[float] = None
         self.suppress = 0
+        self.matcher = (StopMatcher(request.stop)
+                        if request.stop else None)
 
 
 class _ReplayState:
@@ -218,12 +227,19 @@ class _ReplayState:
     tokens already streamed (the 'last known-good snapshot' replay
     re-derives), retry attempts consumed, and the backoff gate."""
 
-    __slots__ = ("tokens", "attempts", "not_before")
+    __slots__ = ("tokens", "logprobs", "attempts", "not_before")
 
     def __init__(self):
         self.tokens: List[int] = []
+        self.logprobs: List[float] = []
         self.attempts = 0
         self.not_before = float("-inf")
+
+
+#: _ingest outcomes: the slot is still decoding, was released, or a
+#: retire-seam fault triggered recovery mid-call (the caller must
+#: abandon its unpack/admission loop — scheduler state was rebuilt)
+_LIVE, _RELEASED, _RECOVERED = 0, 1, 2
 
 
 class Scheduler:
@@ -362,6 +378,19 @@ class Scheduler:
             raise ValueError(
                 f"eos_token_id {eos} outside vocab "
                 f"[0, {self.engine.cfg.vocab_size})")
+        if request.stop:
+            for s in request.stop:
+                if not len(s):
+                    raise ValueError(
+                        "stop sequences must be non-empty token lists")
+        if request.constraint is not None \
+                and ecfg.decode_chunk != 1:
+            raise ValueError(
+                f"schema-constrained requests need decode_chunk == 1 "
+                f"(the vocab mask advances host-side between "
+                f"dispatches; a {ecfg.decode_chunk}-token chunk would "
+                f"apply a stale mask), got decode_chunk="
+                f"{ecfg.decode_chunk}")
         now = self.clock()
         request.arrival_time = now
         if (request.eos_token_id is not None
@@ -469,6 +498,20 @@ class Scheduler:
         self.events.clear()
         return out
 
+    def idle(self) -> bool:
+        """True when there is nothing to do — queue, slots, and the
+        pipeline are all empty (the API driver thread sleeps instead of
+        spinning ticks)."""
+        return not (self.queue or self.active or self._inflight)
+
+    def overload_hint_s(self) -> float:
+        """The queue-drain estimate behind :class:`QueueFull`'s
+        ``retry_after_s`` (depth × measured chunk latency), exposed so
+        an ingress layer can pre-flight an all-or-nothing batch (an
+        ``n>1`` fan must not half-land) with the same hint a rejection
+        would carry."""
+        return len(self.queue) * self._chunk_ewma
+
     # -- internals ---------------------------------------------------------
 
     def _guard_alarm_count(self) -> float:
@@ -510,6 +553,15 @@ class Scheduler:
         ``decode_chunk`` — but a chunk that CANNOT pay for itself is
         never dispatched."""
         if not self.active:
+            return False
+        if self._inflight and any(
+                a.request.constraint is not None
+                for a in self.active.values()):
+            # a constrained slot's vocab mask only advances once the
+            # previous chunk's tokens are fetched — dispatching on top
+            # of an in-flight chunk would decode against a stale mask,
+            # so constrained traffic serializes the pipeline (depth
+            # effectively 1 while any constrained request is active)
             return False
         if not self._inflight:
             return True
@@ -558,7 +610,7 @@ class Scheduler:
             self._inflight.popleft()
         t0 = self.clock()
         try:
-            tokens, finished = handle.fetch()
+            tokens, logprobs, finished = handle.fetch()
         except Exception as e:  # device error escaping the fetch
             self._recover(self.clock(), cause="fetch", detail=str(e),
                           affected=[a.request
@@ -630,15 +682,13 @@ class Scheduler:
         for j in range(n_cols):
             for slot, act in snapshot.items():
                 # a slot released since dispatch (earlier chunk/column
-                # finish, or a deadline retire landing mid-flight) is
-                # skipped: the device emits pad for done lanes, and a
-                # retired request's in-flight tokens belong to a
-                # completion that already closed
+                # finish, a host-side stop, or a deadline retire
+                # landing mid-flight) is skipped: the device emits pad
+                # for done lanes, and a retired request's in-flight
+                # tokens belong to a completion that already closed
                 if self.active.get(slot) is not act:
                     continue
                 tok = int(tokens[slot, j])
-                act.tokens.append(tok)
-                replayed = len(act.tokens) <= act.suppress
                 done = bool(finished[slot, j])
                 reason = None
                 if done:
@@ -646,26 +696,116 @@ class Scheduler:
                     reason = (FINISH_EOS
                               if eos is not None and tok == eos
                               else FINISH_LENGTH)
-                if replayed:
-                    # re-derived token, already streamed before the
-                    # fault — suppress the duplicate event
-                    if tele is not None:
-                        tele.replayed.inc()
-                else:
-                    self._tokens_emitted += 1
-                    self._decode_tokens += 1
-                    self.token_latency_stats.add(per_tok)
-                    if tele is not None:
-                        tele.tokens.inc()
-                        tele.token_latency.observe(per_tok)
-                    self.events.append(StreamEvent(
-                        act.request.request_id, tok, done, reason))
-                if done:
-                    self._release(slot, reason)
+                if self._ingest(slot, act, tok,
+                                float(logprobs[slot, j]), now,
+                                device_done=done, device_reason=reason,
+                                latency=per_tok) == _RECOVERED:
+                    return  # recovery rebuilt everything mid-unpack
         # a chunk landed end-to-end: recovery streak for the health
         # machine, and the rebuild-storm counter resets
         self._consecutive_rebuilds = 0
         self.health.record_progress()
+
+    # -- token emission (stop sequences, constraints, logprobs) -------------
+
+    def _emit(self, act: _Active, tok: int, lp: float, *,
+              finished: bool, reason: Optional[str],
+              latency: Optional[float] = None) -> None:
+        """Append one client-visible token to ``act``'s stream and its
+        :class:`StreamEvent` — suppressed (counted, no event) while the
+        token re-derives a pre-fault stream prefix during replay."""
+        act.tokens.append(tok)
+        act.logprobs.append(lp)
+        tele = self.telemetry
+        if len(act.tokens) <= act.suppress:
+            # re-derived token, already streamed before the fault —
+            # suppress the duplicate event
+            if tele is not None:
+                tele.replayed.inc()
+            return
+        self._tokens_emitted += 1
+        if latency is not None:
+            self._decode_tokens += 1
+            self.token_latency_stats.add(latency)
+            if tele is not None:
+                tele.token_latency.observe(latency)
+        if tele is not None:
+            tele.tokens.inc()
+        self.events.append(StreamEvent(
+            act.request.request_id, tok, finished, reason, logprob=lp))
+
+    def _flush_held(self, act: _Active,
+                    latency: Optional[float] = None) -> None:
+        """Stream every token the stop matcher held back — a non-stop
+        finish (eos/length/deadline/error) emits the tail instead of
+        trimming it."""
+        if act.matcher is None:
+            return
+        for t, l in act.matcher.flush():
+            self._emit(act, t, l, finished=False, reason=None,
+                       latency=latency)
+
+    def _ingest(self, slot: int, act: _Active, tok: int, lp: float,
+                now: float, *, device_done: bool,
+                device_reason: Optional[str],
+                latency: Optional[float] = None) -> int:
+        """Fold ONE generated token into a live request: stop-sequence
+        matching (with trimmed emission), schema-constraint advance +
+        next-mask upload, event emission, and release when the token
+        finishes the request (device eos/budget, stop match, or
+        constraint completion). Returns an ``_LIVE`` / ``_RELEASED`` /
+        ``_RECOVERED`` outcome; ``_RECOVERED`` means a retire-seam
+        fault rebuilt the engine mid-call and the caller's loop state
+        is stale."""
+        matched = False
+        if act.matcher is not None:
+            flushed, matched = act.matcher.push(tok, lp)
+        else:
+            flushed = [(tok, lp)]
+        cons = act.request.constraint
+        cons_done = False
+        if cons is not None and not matched:
+            cons.advance(tok)
+            cons_done = bool(cons.done)
+            if not cons_done and not device_done:
+                # the DFA advanced: the NEXT dispatch must decode this
+                # slot against the new allowed set
+                self.engine.set_slot_mask(slot, cons.allowed_tokens())
+        if (device_done or cons_done) and act.matcher is not None \
+                and not matched:
+            # non-trim finish: the held tail streams out
+            flushed = flushed + act.matcher.flush()
+        host_stop = matched or cons_done
+        finishing = device_done or host_stop
+        reason = ((FINISH_STOP if host_stop else device_reason)
+                  if finishing else None)
+        last = len(flushed) - 1
+        for i, (t, l) in enumerate(flushed):
+            fin = finishing and not matched and i == last
+            self._emit(act, t, l, finished=fin,
+                       reason=reason if fin else None, latency=latency)
+        if matched:
+            # trimmed stop: no token carries the finish — close the
+            # stream with a token-less finished event (the deadline/
+            # abort pattern)
+            self.events.append(StreamEvent(
+                act.request.request_id, None, True, reason))
+        if not finishing:
+            return _LIVE
+        if host_stop and not device_done:
+            # host-side finish: the device lane is still live — retire
+            # it so later chunks stop burning its budget (in-flight
+            # chunks' columns for this slot are dropped by the
+            # snapshot identity check, exactly like a deadline retire)
+            try:
+                self.engine.retire(slot)
+            except Exception as e:  # device error escaping retire
+                self._release(slot, reason)
+                self._recover(now, cause="retire", detail=str(e),
+                              affected=[])
+                return _RECOVERED
+        self._release(slot, reason)
+        return _RELEASED
 
     def _reset_free(self) -> List[int]:
         """Every slot free, pop order = slot order."""
@@ -680,16 +820,20 @@ class Scheduler:
         longest stream the client saw — the live slot's tokens, or the
         replay snapshot when a fault interrupted mid-replay and the
         re-derivation had not caught up."""
+        if act is not None:
+            self._flush_held(act)
         st = self._replay.pop(request.request_id, None)
         tokens = list(act.tokens) if act is not None else []
+        lps = list(act.logprobs) if act is not None else []
         if st is not None and len(st.tokens) > len(tokens):
-            tokens = st.tokens
+            tokens, lps = st.tokens, st.logprobs
         ttft = None
         if act is not None and act.first_token_time is not None:
             ttft = act.first_token_time - request.arrival_time
         self.events.append(StreamEvent(
             request.request_id, None, True, reason, error=error))
-        self._complete(request, tokens, reason, ttft=ttft, now=now)
+        self._complete(request, tokens, reason, ttft=ttft, now=now,
+                       logprobs=lps)
 
     # -- failure isolation + recovery --------------------------------------
 
@@ -752,8 +896,12 @@ class Scheduler:
                 # ever GROW it — a second fault landing mid-replay sees
                 # act.tokens shorter than what was already streamed
                 # (the replay had not caught up yet), and shrinking the
-                # snapshot would re-emit the tail as duplicates
+                # snapshot would re-emit the tail as duplicates.
+                # Matcher-held tokens are NOT in the snapshot: they
+                # were never streamed, and the replayed matcher
+                # re-derives (and re-holds) them deterministically
                 st.tokens = list(act.tokens)
+                st.logprobs = list(act.logprobs)
             if r.request_id in affected_ids:
                 st.attempts += 1
                 if st.attempts > rcfg.max_retries:
@@ -833,6 +981,9 @@ class Scheduler:
                 continue  # a retire-seam recovery below cleared it
             dl = act.request.deadline
             if dl is not None and now >= dl:
+                # a timeout streams the matcher-held tail (nothing
+                # matched — there is nothing to trim)
+                self._flush_held(act)
                 try:
                     self.engine.retire(slot)
                 except Exception as e:  # device error escaping retire
@@ -889,6 +1040,12 @@ class Scheduler:
                 for r, slot in zip(reqs, slots):
                     self.spans.mark(r.request_id, spans_mod.PHASE_PREFILL,
                                     note=f"slot {slot}")
+            for r in reqs:
+                # (re-)admission restarts the schema DFA from its
+                # initial state — fault replay re-derives the stream
+                # from the prompt, and the constraint must follow it
+                if r.constraint is not None:
+                    r.constraint.reset()
             t_admit = self.clock()
             try:
                 results = self.engine.admit_many([
@@ -898,7 +1055,10 @@ class Scheduler:
                               top_k=r.sampling.top_k,
                               top_p=r.sampling.top_p,
                               seed=r.sampling.seed,
-                              eos_token_id=r.eos_token_id)
+                              eos_token_id=r.eos_token_id,
+                              allowed_tokens=(
+                                  tuple(r.constraint.allowed_tokens())
+                                  if r.constraint is not None else None))
                     for r, slot in zip(reqs, slots)])
             except Exception as e:  # device error escaping the admit
                 self._recover(self.clock(), cause="admit", detail=str(e),
@@ -926,41 +1086,43 @@ class Scheduler:
             if tele is not None:
                 tele.admit_dispatches.inc(n_groups)
                 tele.queue_depth.set(len(self.queue))
-            for r, slot, res in zip(reqs, slots, results):
+            rows = list(zip(reqs, slots, results))
+            for idx, (r, slot, res) in enumerate(rows):
                 st = self._replay.get(r.request_id)
                 act = _Active(r)
                 act.suppress = 0 if st is None else len(st.tokens)
                 act.first_token_time = t_first
-                act.tokens.append(res.first_token)
-                replayed = len(act.tokens) <= act.suppress
+                self.active[slot] = act
                 if tele is not None:
                     tele.admitted.inc()
                     tele.admit_batch[res.batch_size].inc()
                     tele.bucket[res.bucket].inc()
-                if replayed:
-                    # the first token was streamed before the fault;
-                    # its re-derivation is silent
-                    if tele is not None:
-                        tele.replayed.inc()
-                else:
-                    self._tokens_emitted += 1
+                if act.suppress < 1:
+                    # TTFT is "first token computed", recorded even
+                    # when the stop matcher holds that token back from
+                    # the wire; a replaying request's re-derived first
+                    # token is not a first token
                     self.ttft_stats.add(t_first - r.arrival_time)
                     if self.spans is not None:
                         self.spans.mark(r.request_id,
                                         spans_mod.PHASE_FIRST_TOKEN)
                     if tele is not None:
-                        tele.tokens.inc()
                         tele.ttft.observe(t_first - r.arrival_time)
                 reason = None
                 if res.finished:
                     reason = FINISH_EOS if res.hit_eos else FINISH_LENGTH
-                if not replayed:
-                    self.events.append(StreamEvent(
-                        r.request_id, res.first_token, res.finished,
-                        reason))
-                self.active[slot] = act
-                if res.finished:
-                    self._release(slot, reason)
+                if self._ingest(slot, act, res.first_token, res.logprob,
+                                t_first, device_done=res.finished,
+                                device_reason=reason) == _RECOVERED:
+                    # a retire-seam fault rebuilt the engine mid-batch:
+                    # rows not yet processed lost their slots — back to
+                    # the queue's front (their events never emitted, so
+                    # re-admission is a clean restart)
+                    rest = [rr for rr, _, _ in rows[idx + 1:]]
+                    self.queue.extendleft(reversed(rest))
+                    if tele is not None:
+                        tele.queue_depth.set(len(self.queue))
+                    return
 
     def _release(self, slot: int, reason: str) -> None:
         act = self.active.pop(slot)
@@ -969,20 +1131,23 @@ class Scheduler:
         ttft = (None if act.first_token_time is None
                 else act.first_token_time - act.request.arrival_time)
         st = self._replay.pop(act.request.request_id, None)
-        tokens = act.tokens
+        tokens, lps = act.tokens, act.logprobs
         if st is not None and len(st.tokens) > len(tokens):
             # retired mid-replay: the pre-fault stream is longer than
             # what the replay re-derived — the completion must carry
             # everything the client was streamed
-            tokens = st.tokens
-        self._complete(act.request, tokens, reason, ttft=ttft, now=now)
+            tokens, lps = st.tokens, st.logprobs
+        self._complete(act.request, tokens, reason, ttft=ttft, now=now,
+                       logprobs=lps)
 
     def _complete(self, request: Request, tokens: List[int], reason: str,
-                  *, ttft: Optional[float], now: float) -> None:
+                  *, ttft: Optional[float], now: float,
+                  logprobs: Optional[List[float]] = None) -> None:
         arrival = request.arrival_time if request.arrival_time is not None \
             else now
         comp = Completion(request.request_id, list(tokens), reason,
-                          ttft=ttft, latency=now - arrival)
+                          ttft=ttft, latency=now - arrival,
+                          logprobs=list(logprobs or []))
         self.completions[request.request_id] = comp
         if reason == FINISH_EOS and not tokens:
             # eos-terminal prompt: completes at submit, emits only the

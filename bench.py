@@ -111,14 +111,112 @@ def chaos_smoke():
     }))
 
 
-def serve(telemetry_out=None):
+def _api_wire_load(engine, reqs, inproc_tokens, vocab_size):
+    """``--mode serve --api``: drive the burst trace through a LIVE
+    local ``apex_tpu.serving.api`` server — one SSE streaming
+    connection per request, all launched at t=0 — and report served
+    tok/s + client-measured TTFT next to the in-process numbers.
+    Asserts zero token drift: every wire stream must be bit-identical
+    to the in-process engine's stream for the same request (replay/
+    suppression guarantees extend to the wire)."""
+    import http.client
+    import threading
+    import time as _time
+
+    from apex_tpu.serving.api import ApiServer, ByteTokenizer
+    from apex_tpu.serving.scheduler import Scheduler
+
+    sched = Scheduler(engine, max_queue=max(256, len(reqs)),
+                      pipeline_depth=2)
+    server = ApiServer(sched, ByteTokenizer(vocab_size)).start()
+    n = len(reqs)
+    tokens = [None] * n
+    ttft = [0.0] * n
+    done_at = [0.0] * n
+    errors = []
+
+    def worker(i, r):
+        try:
+            body = {"prompt": list(r.prompt), "max_tokens": r.max_tokens,
+                    "stream": True, "return_token_ids": True}
+            if r.sampling.temperature > 0:
+                body.update(temperature=r.sampling.temperature,
+                            top_k=r.sampling.top_k,
+                            top_p=r.sampling.top_p,
+                            seed=r.sampling.seed)
+            if r.stop:
+                body["stop_token_ids"] = [list(s) for s in r.stop]
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.port, timeout=600)
+            t0 = _time.perf_counter()
+            conn.request("POST", "/v1/completions", json.dumps(body),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200, resp.read()[:200]
+            toks, first = [], None
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                if not line.startswith(b"data: "):
+                    continue
+                if line.strip() == b"data: [DONE]":
+                    break
+                chunk = json.loads(line[len(b"data: "):])
+                for ch in chunk.get("choices", ()):
+                    ids = ch.get("token_ids")
+                    if ids:
+                        if first is None:
+                            first = _time.perf_counter()
+                        toks.extend(ids)
+            conn.close()
+            tokens[i] = toks
+            ttft[i] = (first or _time.perf_counter()) - t0
+            done_at[i] = _time.perf_counter()
+        except Exception as e:  # surfaced after join
+            errors.append((i, repr(e)))
+
+    t_start = _time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(i, r))
+               for i, r in enumerate(reqs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = max(done_at) - t_start
+    server.stop()
+    assert not errors, f"wire load failures: {errors[:3]}"
+    drift = [r.request_id for i, r in enumerate(reqs)
+             if tokens[i] != inproc_tokens[r.request_id]]
+    assert not drift, f"wire-vs-inprocess token drift: {drift}"
+    total = sum(len(t) for t in tokens)
+    return {
+        "served_tokens_per_sec": round(total / wall, 1),
+        "ttft_mean_ms": round(1e3 * sum(ttft) / n, 2),
+        "ttft_p99_ms": round(1e3 * sorted(ttft)[int(0.99 * (n - 1))], 2),
+        "requests": n,
+        "tokens": total,
+        "token_drift": 0,
+    }
+
+
+def serve(telemetry_out=None, api=False):
     """Serving throughput/latency at a fixed seeded BURST trace (every
     request arrives at t=0 — the admission-pressure regime batched
     admission exists for): one JSON line with tokens/s, the
     TTFT-vs-steady-decode split, a ``decode_chunk`` sweep, a
     pipelined-vs-serial loop A/B, and a bucketed-vs-flat admission
     A/B — with a sweep-WIDE token-drift assert (every configuration
-    must emit bit-identical per-request streams).
+    must emit bit-identical per-request streams). Every 4th request
+    carries a stop sequence (host-side tail match, trimmed emission),
+    so the sweep also pins stop handling chunk/pipeline-invariant.
+
+    ``api=True`` (``--api``): additionally drive the SAME burst trace
+    through a live ``apex_tpu.serving.api`` HTTP server — one SSE
+    streaming connection per request — reporting wire-level served
+    tok/s + client-measured TTFT next to the in-process numbers, and
+    asserting ZERO token drift between the wire stream and the
+    in-process engine (the wire-realism oracle).
 
     ``telemetry_out``: dump a telemetry-registry snapshot of the
     headline (chunk=8, pipelined) trace, replayed instrumented AFTER
@@ -156,13 +254,19 @@ def serve(telemetry_out=None):
         reqs = []
         for i in range(n):
             p_len = 1 + (11 * i + 5) % (mpl or ecfg.max_prompt_len)
+            v = vocab or cfg.vocab_size
             prompt = [int(t) for t in jax.random.randint(
-                jax.random.PRNGKey(seed0 + i), (p_len,), 0,
-                vocab or cfg.vocab_size)]
+                jax.random.PRNGKey(seed0 + i), (p_len,), 0, v)]
             sp = (SamplingParams(temperature=0.9, top_k=40, seed=i)
                   if i % 2 else SamplingParams())
+            # every 4th request: a stop sequence on the streamed tail
+            # (fires or not deterministically; either way the sweep's
+            # bit-identical assert pins it chunk/pipeline-invariant)
+            stop = ([[(13 * i + 1) % v, (13 * i + 2) % v]]
+                    if i % 4 == 0 else None)
             reqs.append(Request(f"r{i}", prompt,
-                                max_tokens=mt or max_tokens, sampling=sp))
+                                max_tokens=mt or max_tokens, sampling=sp,
+                                stop=stop))
         return reqs
 
     def run(engine, reqs, **sched_kw):
@@ -302,6 +406,10 @@ def serve(telemetry_out=None):
     base = tokens_by_cfg["chunk1"]
     drift = [k for k, v in tokens_by_cfg.items() if v != base]
     assert not drift, f"serve sweep token drift in {drift}"
+    api_line = None
+    if api:
+        api_line = _api_wire_load(engine, trace(100, n_requests), base,
+                                  cfg.vocab_size)
     if telemetry_out:
         # snapshot from a SEPARATE instrumented replay of the headline
         # (chunk=8, pipelined) trace on the already-warm engine — the
@@ -333,6 +441,8 @@ def serve(telemetry_out=None):
     }
     if not on_tpu:
         line["probe_ab_1l32h"] = line_probe
+    if api_line is not None:
+        line["api"] = api_line
     if telemetry_out == "-":
         line["telemetry"] = registry.to_dict()
     elif telemetry_out:
@@ -417,9 +527,15 @@ if __name__ == "__main__":
                     "smoke (one fault per engine seam) instead of the "
                     "throughput sweep — asserts recovery + zero token "
                     "drift for unaffected requests")
+    ap.add_argument("--api", action="store_true",
+                    help="serve mode: additionally drive the burst "
+                    "trace through a live apex_tpu.serving.api HTTP "
+                    "server (SSE streaming) — wire-level served tok/s "
+                    "+ TTFT, with a zero-token-drift assert against "
+                    "the in-process engine")
     args = ap.parse_args()
     if args.mode == "serve":
         chaos_smoke() if args.chaos else serve(
-            telemetry_out=args.telemetry_out)
+            telemetry_out=args.telemetry_out, api=args.api)
     else:
         main()

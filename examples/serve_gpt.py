@@ -8,11 +8,26 @@ that: a file of requests (one JSON object per line) flows through
 sampling params and stop token, outputs token-identical to a solo
 ``gpt.generate`` call per request (the engine's oracle test pins this).
 
-Request-file line format (all but ``id``/``prompt`` optional)::
+Request-file line format (all but ``id``/``prompt`` optional; ``stop``
+is a list of stop TOKEN sequences, matched host-side on the streamed
+tail with the matched tokens trimmed)::
 
   {"id": "r0", "prompt": [17, 4, 99], "max_tokens": 16,
    "temperature": 0.8, "top_k": 40, "top_p": 0.95, "seed": 7,
-   "eos_token_id": 50256}
+   "eos_token_id": 50256, "stop": [[11, 12]]}
+
+HTTP front end (``apex_tpu.serving.api``): ``--api-port N`` serves the
+OpenAI surface (``/v1/chat/completions``, ``/v1/completions`` with SSE
+streaming, ``/v1/models``, ``/healthz``) after the batch drains, for
+``--api-linger`` seconds (0 = until Ctrl-C). Chat prompts are
+byte-level, so give the engine prompt room::
+
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python examples/serve_gpt.py --num-requests 0 --api-port 8000 \
+    --max-prompt-len 64 --max-seq-len 128
+  curl -N localhost:8000/v1/chat/completions -d '{
+    "messages": [{"role": "user", "content": "hi"}],
+    "max_tokens": 16, "stream": true}'
 
 Run (CPU simulation; omit --requests for a synthetic trace):
   PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
@@ -68,15 +83,19 @@ def load_requests(path, vocab_size):
                 temperature=d.get("temperature", 0.0),
                 top_k=d.get("top_k", 0), top_p=d.get("top_p", 1.0),
                 seed=d.get("seed"))
+            stop = d.get("stop")
             reqs.append(Request(
                 str(d.get("id", f"r{i}")), list(d["prompt"]),
                 max_tokens=int(d.get("max_tokens", 16)), sampling=sp,
-                eos_token_id=d.get("eos_token_id")))
+                eos_token_id=d.get("eos_token_id"),
+                stop=[[int(t) for t in s] for s in stop]
+                if stop else None))
     return reqs
 
 
 def synthetic_requests(n, prompt_len, max_tokens, vocab_size):
-    """Seeded stand-in trace: half greedy, half sampled."""
+    """Seeded stand-in trace: half greedy, half sampled; every third
+    request carries a stop sequence (trimmed emission when it fires)."""
     reqs = []
     for i in range(n):
         prompt = [int(t) for t in jax.random.randint(
@@ -84,8 +103,10 @@ def synthetic_requests(n, prompt_len, max_tokens, vocab_size):
                                            prompt_len,), 0, vocab_size)]
         sp = (SamplingParams(temperature=0.9, top_k=20, seed=i)
               if i % 2 else SamplingParams())
+        stop = [[(17 * i + 3) % vocab_size,
+                 (17 * i + 4) % vocab_size]] if i % 3 == 0 else None
         reqs.append(Request(f"r{i}", prompt, max_tokens=max_tokens,
-                            sampling=sp))
+                            sampling=sp, stop=stop))
     return reqs
 
 
@@ -115,6 +136,13 @@ def main():
     ap.add_argument("--metrics-port", type=int, default=None,
                     help="serve /metrics /healthz /vars on this port "
                     "(0 = ephemeral, printed at startup)")
+    ap.add_argument("--api-port", type=int, default=None,
+                    help="serve the OpenAI-compatible front end "
+                    "(apex_tpu.serving.api) on this port after the "
+                    "batch drains (0 = ephemeral, printed at startup)")
+    ap.add_argument("--api-linger", type=float, default=0.0,
+                    help="keep the API endpoint up this many seconds "
+                    "(0 = until Ctrl-C)")
     ap.add_argument("--metrics-linger", type=float, default=0.0,
                     help="keep the metrics endpoint up this many "
                     "seconds after the batch drains")
@@ -212,6 +240,26 @@ def main():
             json.dump(spans.to_chrome_trace(), f)
         print(f"span trace: {args.span_trace} "
               f"({spans.summary()['events']} events)")
+    if args.api_port is not None:
+        import time
+
+        from apex_tpu.serving.api import start_api_server
+
+        # the ApiServer's driver thread takes over the (now idle)
+        # scheduler; the main thread just waits out the linger
+        api = start_api_server(sched, port=args.api_port,
+                               registry=registry)
+        print(f"api: {api.url}/v1/chat/completions  /v1/completions  "
+              f"/v1/models  /healthz")
+        try:
+            if args.api_linger > 0:
+                time.sleep(args.api_linger)
+            else:
+                while True:
+                    time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        api.stop()
     if server is not None:
         if args.metrics_linger > 0:
             import time

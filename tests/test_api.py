@@ -1,0 +1,640 @@
+"""apex_tpu.serving.api — wire-protocol end-to-end oracles.
+
+A LIVE stdlib HTTP server over a warmed engine, driven through real
+sockets (``http.client``), pinned against the same oracles the engine
+itself is: an SSE chat stream's token sequence is bit-identical to a
+solo ``gpt.generate`` run of the rendered prompt; stop sequences trim
+exactly what a host-side reference scan trims; schema-constrained
+requests always return parseable, schema-shaped JSON; overload and
+terminal-failure map to 429 (+ Retry-After) and 503; an injected
+mid-stream fault produces zero duplicate SSE chunks; and the compiled
+program caches stay at one entry across the whole varied-request mix
+(the wire layer adds no retrace). The dependency-free contract —
+``apex_tpu.serving.api`` imports with jax/numpy/torch purged — runs in
+a blocked-import subprocess like telemetry's."""
+
+import http.client
+import json
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import mesh as mx
+from apex_tpu.models import gpt
+from apex_tpu.serving.api import (
+    ApiServer,
+    ByteTokenizer,
+    JsonSchemaConstraint,
+    render_chat_prompt,
+)
+from apex_tpu.serving.engine import Engine, EngineConfig
+from apex_tpu.serving.resilience import (
+    FaultPlan,
+    FaultSpec,
+    ResilienceConfig,
+)
+from apex_tpu.serving.scheduler import Scheduler
+from apex_tpu.transformer.testing import standalone_gpt_config
+
+#: byte-level codec needs >= 256; the surplus exercises non-byte ids
+VOCAB = 320
+
+
+def _cfg(**overrides):
+    base = dict(vocab_size=VOCAB, seq_len=128)
+    base.update(overrides)
+    return standalone_gpt_config(**base)
+
+
+def _post(port, path, body, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", path, json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, data, headers
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def _sse_events(raw: bytes):
+    """Parse an SSE byte stream into (json payloads, comment lines)."""
+    payloads, comments = [], []
+    for line in raw.decode("utf-8").split("\n"):
+        if line.startswith(": "):
+            comments.append(line[2:])
+        elif line.startswith("data: ") and line != "data: [DONE]":
+            payloads.append(json.loads(line[len("data: "):]))
+    assert raw.rstrip().endswith(b"data: [DONE]"), "missing terminator"
+    return payloads, comments
+
+
+def _stream_tokens(payloads, index=0):
+    toks = []
+    for p in payloads:
+        for ch in p.get("choices", ()):
+            if ch.get("index", 0) == index:
+                toks.extend(ch.get("token_ids") or [])
+    return toks
+
+
+def _solo_generate(cfg, params, mesh, prompt, n_new, *,
+                   temperature=0.0, top_k=0, top_p=1.0, seed=None):
+    pspecs = gpt.param_specs(cfg)
+    key = jax.random.PRNGKey(seed) if seed is not None else None
+    out = jax.jit(jax.shard_map(
+        lambda p, t: gpt.generate(
+            cfg, p, t, n_new, temperature=temperature, top_k=top_k,
+            top_p=top_p, key=key, pad_token_id=0),
+        mesh=mesh, in_specs=(pspecs, P(None, None)),
+        out_specs=P(None, None), check_vma=False))(
+            params, jnp.asarray([prompt], jnp.int32))
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+@pytest.fixture(scope="module")
+def served(devices8):
+    """One warmed engine + scheduler + live ApiServer for the module
+    (compile once; every test drives it over real sockets)."""
+    from apex_tpu.telemetry import Registry
+
+    cfg = _cfg()
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    engine = Engine(cfg, params, mesh, EngineConfig(
+        slots=2, max_prompt_len=48, max_seq_len=128, decode_chunk=1,
+        prompt_buckets=(16, 48), admit_batch_sizes=(1, 2)))
+    engine.warmup()
+    registry = Registry()
+    sched = Scheduler(engine, registry=registry, pipeline_depth=2)
+    tok = ByteTokenizer(cfg.vocab_size)
+    server = ApiServer(sched, tok, model="apex-test",
+                       registry=registry).start()
+    yield dict(server=server, engine=engine, sched=sched, cfg=cfg,
+               params=params, mesh=mesh, tok=tok, registry=registry)
+    server.stop()
+    engine.close()
+
+
+def _tiny_engine(devices8, fault_plan=None):
+    """A minimal fast-compiling engine for fault-path servers."""
+    cfg = _cfg(hidden_size=32, num_layers=1, num_heads=2, seq_len=64)
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    params = gpt.init(cfg, jax.random.PRNGKey(1))
+    engine = Engine(cfg, params, mesh, EngineConfig(
+        slots=2, max_prompt_len=8, max_seq_len=32, decode_chunk=1,
+        prompt_buckets=(8,), admit_batch_sizes=(1,)),
+        fault_plan=fault_plan)
+    engine.warmup()
+    return cfg, params, mesh, engine
+
+
+# --- happy path: streams, buffering, logprobs, n>1 --------------------------
+
+
+def test_chat_sse_stream_matches_solo_generate(served):
+    """The headline wire oracle: a streamed chat completion's token
+    sequence (SSE-reassembled) is bit-identical to solo gpt.generate
+    on the rendered prompt, and the streamed text is its decode."""
+    s = served
+    messages = [{"role": "system", "content": "be brief"},
+                {"role": "user", "content": "hi"}]
+    status, raw, _ = _post(s["server"].port, "/v1/chat/completions", {
+        "messages": messages, "max_tokens": 10, "stream": True,
+        "return_token_ids": True})
+    assert status == 200
+    payloads, _ = _sse_events(raw)
+    toks = _stream_tokens(payloads)
+    prompt = s["tok"].encode(render_chat_prompt(messages))
+    solo = _solo_generate(s["cfg"], s["params"], s["mesh"], prompt, 10)
+    assert toks == solo, "wire stream drifted from the solo oracle"
+    text = "".join(
+        ch["delta"].get("content", "")
+        for p in payloads for ch in p["choices"] if "delta" in ch)
+    assert text == s["tok"].decode(solo)
+    fins = [ch["finish_reason"] for p in payloads
+            for ch in p["choices"] if ch.get("finish_reason")]
+    assert fins == ["length"]
+
+
+def test_completions_buffered_usage_and_logprobs(served):
+    s = served
+    status, raw, _ = _post(s["server"].port, "/v1/completions", {
+        "prompt": "ab", "max_tokens": 6, "logprobs": 1, "echo": True,
+        "return_token_ids": True})
+    assert status == 200
+    d = json.loads(raw)
+    assert d["object"] == "text_completion"
+    (choice,) = d["choices"]
+    assert choice["text"].startswith("ab")  # echo
+    assert len(choice["token_ids"]) == 6
+    lps = choice["logprobs"]["token_logprobs"]
+    assert len(lps) == 6
+    assert all(np.isfinite(lp) and lp <= 0.0 for lp in lps)
+    assert d["usage"] == {"prompt_tokens": 2, "completion_tokens": 6,
+                          "total_tokens": 8}
+
+
+def test_token_id_prompt_and_n_sampling(served):
+    """Legacy token-id prompts; n=2 fans into two slots sharing the
+    prompt with derived seeds — two distinct sampled streams merged
+    into one indexed response."""
+    s = served
+    status, raw, _ = _post(s["server"].port, "/v1/completions", {
+        "prompt": [5, 6, 7], "max_tokens": 6, "n": 2,
+        "temperature": 0.9, "top_k": 20, "seed": 7,
+        "return_token_ids": True})
+    assert status == 200
+    d = json.loads(raw)
+    ids = {c["index"]: c["token_ids"] for c in d["choices"]}
+    assert set(ids) == {0, 1}
+    assert ids[0] != ids[1], "choices shared a PRNG stream"
+    # choice 0 is exactly a seed=7 solo run
+    solo = _solo_generate(s["cfg"], s["params"], s["mesh"], [5, 6, 7],
+                          6, temperature=0.9, top_k=20, seed=7)
+    assert ids[0] == solo
+    assert d["usage"]["completion_tokens"] == 12
+
+
+def test_validation_errors_are_400(served):
+    port = served["server"].port
+    for body, frag in [
+            ({}, "messages"),
+            ({"messages": [{"role": "u", "content": "x"}],
+              "top_k": 5}, "temperature"),
+            ({"messages": [{"role": "u", "content": "x"}],
+              "n": 99}, "n"),
+            ({"messages": [{"role": "u", "content": "x" * 500}]},
+             "admits at most"),
+    ]:
+        status, raw, _ = _post(port, "/v1/chat/completions", body)
+        assert status == 400, raw
+        err = json.loads(raw)["error"]
+        assert err["type"] == "invalid_request_error"
+        assert frag in (err.get("param") or "") + err["message"]
+
+
+def test_models_and_healthz_routes(served):
+    status, raw = _get(served["server"].port, "/v1/models")
+    assert status == 200
+    assert json.loads(raw)["data"][0]["id"] == "apex-test"
+    status, raw = _get(served["server"].port, "/healthz")
+    assert status == 200 and raw.startswith(b"ok")
+
+
+# --- stop sequences ----------------------------------------------------------
+
+
+def _reference_trim(stream, stops):
+    """Independent host reference: cut the stream at the first point a
+    stop sequence completes, excluding the stop itself."""
+    for i in range(len(stream)):
+        for stop in stops:
+            if i + 1 >= len(stop) and \
+                    stream[i + 1 - len(stop):i + 1] == list(stop):
+                return stream[:i + 1 - len(stop)], True
+    return list(stream), False
+
+
+def test_stop_sequence_trim_parity(served):
+    """Wire-level stop: the served stream equals the solo-generate
+    stream trimmed at the first stop occurrence (stop tokens never
+    reach the wire), finish_reason 'stop'."""
+    s = served
+    prompt = [11, 12, 13]
+    solo = _solo_generate(s["cfg"], s["params"], s["mesh"], prompt, 12)
+    stop = solo[3:5]  # guaranteed to occur
+    expect, matched = _reference_trim(solo, [stop])
+    assert matched
+    status, raw, _ = _post(s["server"].port, "/v1/completions", {
+        "prompt": prompt, "max_tokens": 12, "stream": True,
+        "stop_token_ids": [stop], "return_token_ids": True})
+    assert status == 200
+    payloads, _ = _sse_events(raw)
+    toks = _stream_tokens(payloads)
+    assert toks == expect, f"trimmed stream {toks} != expected {expect}"
+    fins = [ch["finish_reason"] for p in payloads
+            for ch in p["choices"] if ch.get("finish_reason")]
+    assert fins == ["stop"]
+
+
+def test_stop_string_via_text_roundtrip(served):
+    """ASCII stop strings compile to byte sequences; a stop that never
+    occurs leaves the stream untouched (held tokens flush at the
+    device finish)."""
+    s = served
+    prompt = [40, 41]
+    solo = _solo_generate(s["cfg"], s["params"], s["mesh"], prompt, 8)
+    status, raw, _ = _post(s["server"].port, "/v1/completions", {
+        "prompt": prompt, "max_tokens": 8,
+        "stop": "NEVER", "return_token_ids": True})
+    assert status == 200
+    d = json.loads(raw)
+    assert d["choices"][0]["token_ids"] == solo
+    assert d["choices"][0]["finish_reason"] == "length"
+
+
+# --- schema-constrained decoding --------------------------------------------
+
+_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "name": {"type": "string", "maxLength": 8},
+        "age": {"type": "integer"},
+        "tags": {"type": "array",
+                 "items": {"type": "string", "maxLength": 6},
+                 "minItems": 1, "maxItems": 2},
+        "kind": {"enum": ["x", "y"]},
+    },
+}
+
+
+def test_constrained_json_schema_always_valid(served):
+    """Greedy AND sampled constrained requests return parseable JSON
+    matching the schema shape, finishing via the constraint (reason
+    'stop'), whatever the logits wanted."""
+    s = served
+    for extra in ({}, {"temperature": 0.9, "seed": 3}):
+        status, raw, _ = _post(s["server"].port, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "emit json"}],
+            "max_tokens": 90,
+            "response_format": {
+                "type": "json_schema",
+                "json_schema": {"schema": _SCHEMA}},
+            **extra})
+        assert status == 200, raw
+        choice = json.loads(raw)["choices"][0]
+        assert choice["finish_reason"] == "stop"
+        v = json.loads(choice["message"]["content"])
+        assert set(v) == {"name", "age", "tags", "kind"}
+        assert isinstance(v["name"], str) and len(v["name"]) <= 8
+        assert isinstance(v["age"], int)
+        assert isinstance(v["tags"], list) and 1 <= len(v["tags"]) <= 2
+        assert all(isinstance(t, str) for t in v["tags"])
+        assert v["kind"] in ("x", "y")
+
+
+def test_constrained_json_object_mode(served):
+    s = served
+    status, raw, _ = _post(s["server"].port, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "emit json"}],
+        "max_tokens": 100,
+        "response_format": {"type": "json_object",
+                            "bounds": {"max_string_len": 6,
+                                       "max_keys": 2, "max_items": 2,
+                                       "max_depth": 1}}})
+    assert status == 200, raw
+    choice = json.loads(raw)["choices"][0]
+    assert choice["finish_reason"] == "stop"
+    assert isinstance(json.loads(choice["message"]["content"]), dict)
+
+
+def test_invalid_schema_is_400_not_connection_drop(served):
+    """A schema that parses as a dict but fails automaton compile
+    (empty enum, maxItems < minItems) must come back as a clean 400,
+    not an uncaught exception dropping the socket — and a max_tokens
+    below the schema's closure bound is rejected up front instead of
+    truncating mid-value (the always-valid guarantee is enforced)."""
+    port = served["server"].port
+    for bad in ({"enum": []},
+                {"type": "array", "minItems": 5, "maxItems": 2}):
+        status, raw, _ = _post(port, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "x"}],
+            "max_tokens": 8,
+            "response_format": {"type": "json_schema",
+                                "json_schema": {"schema": bad}}})
+        assert status == 400, raw
+        err = json.loads(raw)["error"]
+        assert err["param"] == "response_format"
+        assert "rejected" in err["message"]
+    status, raw, _ = _post(port, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "x"}],
+        "max_tokens": 3,
+        "response_format": {"type": "json_schema",
+                            "json_schema": {"schema": _SCHEMA}}})
+    assert status == 400, raw
+    assert json.loads(raw)["error"]["code"] == \
+        "max_tokens_below_schema_bound"
+
+
+def test_constraint_bounds_and_prefix_enums():
+    """Pure-automaton oracles: token_bound() dominates every random
+    walk's actual length, and non-prefix-free enums (1 vs 12) keep
+    BOTH members reachable (the shorter closes via the parent's
+    terminator or the end token, the longer stays offered)."""
+    import random
+
+    schema = {"type": "object", "properties": {
+        "n": {"enum": [1, 12, 3.5]},
+        "s": {"type": "string", "maxLength": 5}}}
+    c = JsonSchemaConstraint(schema)
+    bound = c.token_bound()
+    rng = random.Random(7)
+    seen = set()
+    for _ in range(120):
+        c.reset()
+        out = []
+        while not c.done:
+            b = rng.choice(c.allowed_tokens())
+            c.advance(b)
+            out.append(b)
+        assert len(out) <= bound, (len(out), bound)
+        v = json.loads(bytes(out).decode())
+        assert v["n"] in (1, 12, 3.5)
+        seen.add(v["n"])
+    assert seen == {1, 12, 3.5}, f"enum members unreachable: {seen}"
+    # bare scalar with an end token: the model can stop a value whose
+    # grammar could continue
+    c = JsonSchemaConstraint({"type": "integer"}, end_token_id=300)
+    c.advance(ord("7"))
+    assert 300 in c.allowed_tokens()
+    c.advance(300)
+    assert c.done
+
+
+def test_recompile_flat_across_varied_requests(served):
+    """The acceptance pin: after the whole varied mix above (stop, n,
+    logprobs, schema, sampled/greedy) every compiled program cache is
+    still at one entry, and a guard stays silent through one more mixed
+    round served entirely over the wire."""
+    s = served
+    with s["engine"].recompile_guard():
+        _post(s["server"].port, "/v1/completions", {
+            "prompt": [9, 9], "max_tokens": 4,
+            "stop_token_ids": [[1, 2, 3]], "logprobs": 1})
+        _post(s["server"].port, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "again"}],
+            "max_tokens": 30, "n": 2, "temperature": 0.8, "seed": 11,
+            "response_format": {"type": "json_object"}})
+    sizes = s["engine"].compiled_cache_sizes()
+    assert all(v == 1 for v in sizes.values() if v is not None), sizes
+
+
+# --- overload + failure mapping ---------------------------------------------
+
+
+def test_queue_full_429_and_engine_failed_503(devices8):
+    """PR-5 resilience → wire codes: an injected queue flood maps to
+    429 with a Retry-After header and a rate_limit_error body; a
+    terminally failed health machine maps to 503 on submit and on
+    /healthz."""
+    plan = FaultPlan([FaultSpec("submit", 0, "flood")])
+    cfg, params, mesh, engine = _tiny_engine(devices8, fault_plan=plan)
+    sched = Scheduler(engine)
+    server = ApiServer(sched, ByteTokenizer(cfg.vocab_size)).start()
+    try:
+        status, raw, headers = _post(server.port, "/v1/completions", {
+            "prompt": [1, 2], "max_tokens": 4})
+        assert status == 429, raw
+        err = json.loads(raw)["error"]
+        assert err["type"] == "rate_limit_error"
+        assert "Retry-After" in headers
+        assert len(plan.injected) == 1
+        # terminal health: submissions and probes both answer 503
+        sched.health.fail("test: terminal")
+        status, raw, _ = _post(server.port, "/v1/completions", {
+            "prompt": [1, 2], "max_tokens": 4})
+        assert status == 503, raw
+        assert json.loads(raw)["error"]["type"] == "server_error"
+        status, raw = _get(server.port, "/healthz")
+        assert status == 503
+    finally:
+        server.stop()
+        engine.close()
+
+
+def test_sse_no_duplicate_chunks_under_fault(devices8):
+    """The wire half of the replay guarantee: a fetch-seam fault mid
+    stream produces a retry comment, zero duplicate token chunks, and
+    a final stream bit-identical to a fault-free engine's."""
+    cfg, params, mesh, clean_eng = _tiny_engine(devices8)
+    sched_clean = Scheduler(clean_eng)
+    server_clean = ApiServer(
+        sched_clean, ByteTokenizer(cfg.vocab_size)).start()
+    body = {"prompt": [3, 4, 5], "max_tokens": 8, "stream": True,
+            "return_token_ids": True}
+    try:
+        _, raw, _ = _post(server_clean.port, "/v1/completions", body)
+        clean_toks = _stream_tokens(_sse_events(raw)[0])
+        assert len(clean_toks) == 8
+    finally:
+        server_clean.stop()
+        clean_eng.close()
+
+    plan = FaultPlan([FaultSpec("fetch", 2, "error")])
+    _, _, _, fault_eng = _tiny_engine(devices8, fault_plan=plan)
+    sched = Scheduler(fault_eng, resilience=ResilienceConfig(
+        backoff_base_s=0.001))
+    server = ApiServer(sched, ByteTokenizer(cfg.vocab_size)).start()
+    try:
+        status, raw, _ = _post(server.port, "/v1/completions", body)
+        assert status == 200
+        payloads, comments = _sse_events(raw)
+        toks = _stream_tokens(payloads)
+        assert len(plan.injected) == 1, "fault did not fire"
+        assert any("retrying" in c for c in comments)
+        assert toks == clean_toks, (
+            f"fault stream {toks} drifted from clean {clean_toks} "
+            f"(duplicate or lost SSE chunks)")
+    finally:
+        server.stop()
+        fault_eng.close()
+
+
+# --- dependency-free contract ------------------------------------------------
+
+
+def test_api_imports_stdlib_only(tmp_path):
+    """The front end must add NOTHING beyond the stdlib: load the
+    parent packages (the baked jax toolchain), then purge jax/numpy/
+    scipy/torch from sys.modules AND block any re-import — every
+    serving.api module must import and run its pure logic anyway."""
+    code = """
+import sys
+
+import apex_tpu.serving  # parents (jax toolchain) load normally
+
+BLOCKED = ("jax", "jaxlib", "numpy", "scipy", "torch", "tensorboard")
+
+
+class _Blocker:
+    def find_spec(self, name, path=None, target=None):
+        if name.split(".")[0] in BLOCKED:
+            raise ImportError(f"blocked by test: {name}")
+        return None
+
+
+for mod in list(sys.modules):
+    if mod.split(".")[0] in BLOCKED:
+        del sys.modules[mod]
+sys.meta_path.insert(0, _Blocker())
+
+import apex_tpu.serving.api as api
+import apex_tpu.serving.api.tokenizer
+import apex_tpu.serving.api.protocol
+import apex_tpu.serving.api.constrain
+import apex_tpu.serving.api.server
+
+tok = api.ByteTokenizer(320)
+assert tok.decode(tok.encode("hello")) == "hello"
+dec = tok.stream_decoder()
+assert "".join(dec.push(t) for t in tok.encode("héllo")) == "héllo"
+
+from apex_tpu.serving.api.protocol import parse_chat_request, sse
+p = parse_chat_request({"messages": [{"role": "user", "content": "x"}],
+                        "stop": ["end"], "max_tokens": 4})
+assert p.stop == ["end"] and p.max_tokens == 4
+assert sse({"a": 1}) == b'data: {"a":1}\\n\\n'
+
+c = api.JsonSchemaConstraint({"type": "object", "properties":
+                              {"k": {"type": "integer"}}})
+out = []
+while not c.done:
+    b = min(c.allowed_tokens())
+    c.advance(b)
+    out.append(b)
+import json as _json
+assert _json.loads(bytes(out).decode())["k"] is not None
+
+assert not any(m.split(".")[0] in BLOCKED for m in sys.modules)
+print("API_DEP_FREE_OK")
+"""
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    assert "API_DEP_FREE_OK" in out.stdout
+
+
+# --- scheduler-level stop/constraint/logprob oracles (no HTTP) ---------------
+
+
+def test_scheduler_stop_across_chunk_boundary(devices8):
+    """Engine-level stop with decode_chunk=4: a stop sequence whose
+    tokens split across chunk boundaries still trims exactly, and the
+    event stream never contains a trimmed token."""
+    from apex_tpu.serving import Request
+
+    cfg = _cfg(hidden_size=32, num_layers=1, num_heads=2, seq_len=64)
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    params = gpt.init(cfg, jax.random.PRNGKey(1))
+    solo = _solo_generate(cfg, params, mesh, [3, 4, 5], 12)
+    stop = solo[5:7]
+    expect, matched = _reference_trim(solo, [stop])
+    assert matched
+    engine = Engine(cfg, params, mesh, EngineConfig(
+        slots=2, max_prompt_len=8, max_seq_len=32, decode_chunk=4,
+        prompt_buckets=(8,), admit_batch_sizes=(1,)))
+    engine.warmup()
+    try:
+        sched = Scheduler(engine, pipeline_depth=2)
+        sched.submit(Request("r0", [3, 4, 5], max_tokens=12,
+                             stop=[stop]))
+        sched.run_until_idle()
+        comp = sched.completions["r0"]
+        assert comp.tokens == expect
+        assert comp.finish_reason == "stop"
+        assert len(comp.logprobs) == len(comp.tokens)
+        streamed = [e.token for e in sched.pop_events()
+                    if e.token is not None]
+        assert streamed == expect
+    finally:
+        engine.close()
+
+
+def test_scheduler_constraint_forces_token_sequence(devices8):
+    """The whole mask path, oracled end to end: a single-value enum
+    constraint forces the engine to emit exactly that JSON literal's
+    bytes, regardless of what the unconstrained logits preferred."""
+    from apex_tpu.serving import Request
+
+    cfg = _cfg(hidden_size=32, num_layers=1, num_heads=2, seq_len=64)
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    params = gpt.init(cfg, jax.random.PRNGKey(1))
+    engine = Engine(cfg, params, mesh, EngineConfig(
+        slots=2, max_prompt_len=8, max_seq_len=32, decode_chunk=1,
+        prompt_buckets=(8,), admit_batch_sizes=(1,)))
+    engine.warmup()
+    try:
+        sched = Scheduler(engine)
+        forced = list(b'"ab"')
+        sched.submit(Request(
+            "r0", [3, 4, 5], max_tokens=12,
+            constraint=JsonSchemaConstraint({"enum": ["ab"]})))
+        sched.run_until_idle()
+        comp = sched.completions["r0"]
+        assert comp.tokens == forced
+        assert comp.finish_reason == "stop"
+        # constrained requests need chunk=1 — enforced at submit
+        engine8 = Engine(cfg, params, mesh, EngineConfig(
+            slots=2, max_prompt_len=8, max_seq_len=32, decode_chunk=2,
+            prompt_buckets=(8,), admit_batch_sizes=(1,)))
+        engine8.warmup()
+        try:
+            with pytest.raises(ValueError, match="decode_chunk"):
+                Scheduler(engine8).submit(Request(
+                    "r1", [3], max_tokens=4,
+                    constraint=JsonSchemaConstraint({"enum": ["a"]})))
+        finally:
+            engine8.close()
+    finally:
+        engine.close()

@@ -466,17 +466,22 @@ def test_traced_filter_matches_static():
 
 def _singles_reference(cfg, params, cache, state, n, pad):
     """n SINGLE per-token steps — the pre-chunk engine step body
-    verbatim (decode_step + draw_slots + eos/budget masking), the
-    reference ``gpt.decode_steps(n)`` is pinned against."""
-    toks, fins = [], []
+    verbatim (decode_step + draw_slots + eos/budget masking + the
+    logprob gather), the reference ``gpt.decode_steps(n)`` is pinned
+    against."""
+    toks, lps, fins = [], [], []
     for _ in range(n):
         logits, cache = gpt.decode_step(
             cfg, params, cache, state["tok"], state["pos"])
         nxt = sampling.draw_slots(
             logits, state["key"], state["pos"], state["temp"],
             state["top_k"], state["top_p"])
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1), nxt[:, None],
+            axis=1)[:, 0]
         live = ~state["done"]
         emit = jnp.where(live, nxt, jnp.int32(pad))
+        lp = jnp.where(live, lp, jnp.float32(0.0))
         remaining = state["remaining"] - live.astype(jnp.int32)
         hit_eos = live & (state["eos"] >= 0) & (emit == state["eos"])
         finished = live & (hit_eos | (remaining <= 0))
@@ -488,8 +493,10 @@ def _singles_reference(cfg, params, cache, state, n, pad):
             "done": state["done"] | finished,
         }
         toks.append(emit)
+        lps.append(lp)
         fins.append(finished)
-    return cache, state, jnp.stack(toks, 1), jnp.stack(fins, 1)
+    return (cache, state, jnp.stack(toks, 1), jnp.stack(lps, 1),
+            jnp.stack(fins, 1))
 
 
 def _chunk_state(b):
@@ -530,21 +537,25 @@ def _run_decode_steps(cfg, params, mesh, n, chunked: bool):
         fn = jax.jit(jax.shard_map(
             lambda p, c, st: gpt.decode_steps(cfg, p, c, st, n),
             mesh=mesh, in_specs=(pspecs, cache_spec, st_spec),
-            out_specs=(cache_spec, st_spec, P(), P()), check_vma=False))
-        _, _, toks, fins = fn(params, cache, state)
+            out_specs=(cache_spec, st_spec, P(), P(), P()),
+            check_vma=False))
+        _, _, toks, lps, fins = fn(params, cache, state)
     else:
         fn = jax.jit(jax.shard_map(
             lambda p, c, st: _singles_reference(cfg, p, c, st, 1, 0),
             mesh=mesh, in_specs=(pspecs, cache_spec, st_spec),
-            out_specs=(cache_spec, st_spec, P(), P()), check_vma=False))
-        cols_t, cols_f = [], []
+            out_specs=(cache_spec, st_spec, P(), P(), P()),
+            check_vma=False))
+        cols_t, cols_l, cols_f = [], [], []
         for _ in range(n):
-            cache, state, t1, f1 = fn(params, cache, state)
+            cache, state, t1, l1, f1 = fn(params, cache, state)
             cols_t.append(t1)
+            cols_l.append(l1)
             cols_f.append(f1)
         toks = jnp.concatenate(cols_t, axis=1)
+        lps = jnp.concatenate(cols_l, axis=1)
         fins = jnp.concatenate(cols_f, axis=1)
-    return np.asarray(toks), np.asarray(fins)
+    return np.asarray(toks), np.asarray(lps), np.asarray(fins)
 
 
 def test_decode_steps_matches_single_steps(devices8):
@@ -559,12 +570,20 @@ def test_decode_steps_matches_single_steps(devices8):
         got[(tp, "chunk")] = _run_decode_steps(cfg, params, mesh, 6, True)
         got[(tp, "single")] = _run_decode_steps(cfg, params, mesh, 6,
                                                 False)
+    def check(lhs, rhs, msg):
+        # tokens/finished pin bitwise; the logprob floats ride
+        # different XLA programs (scan vs unrolled, tp1 vs tp2), so
+        # they pin to fp32 tolerance instead
+        np.testing.assert_array_equal(lhs[0], rhs[0], err_msg=msg)
+        np.testing.assert_allclose(lhs[1], rhs[1], rtol=1e-5,
+                                   atol=1e-5, err_msg=msg)
+        np.testing.assert_array_equal(lhs[2], rhs[2], err_msg=msg)
+
     for tp in (1, 2):
-        for a, b in zip(got[(tp, "chunk")], got[(tp, "single")]):
-            np.testing.assert_array_equal(a, b, err_msg=f"tp{tp}")
-    for a, b in zip(got[(1, "chunk")], got[(2, "chunk")]):
-        np.testing.assert_array_equal(a, b, err_msg="tp2 vs tp1")
-    toks, fins = got[(1, "chunk")]
+        check(got[(tp, "chunk")], got[(tp, "single")], f"tp{tp}")
+    check(got[(1, "chunk")], got[(2, "chunk")], "tp2 vs tp1")
+    toks, lps, fins = got[(1, "chunk")]
+    assert np.isfinite(lps).all() and (lps <= 0.0).all()
     assert fins.any(), "expected a mid-chunk finish in the fixture"
     # the budget-starved lane (remaining=3) pads after its 3rd token
     assert (toks[1, 3:] == 0).all()
@@ -704,9 +723,10 @@ def test_admit_many_matches_single_admits(devices8):
     assert [(r.first_token, r.hit_eos, r.finished) for r in batched] == \
         singles
     for _ in range(4):  # the inserted caches/state rows decode the same
-        tb, fb = eng_b.step()
-        ts, fs = eng_s.step()
+        tb, lb, fb = eng_b.step()
+        ts, ls, fs = eng_s.step()
         np.testing.assert_array_equal(tb, ts)
+        np.testing.assert_array_equal(lb, ls)
         np.testing.assert_array_equal(fb, fs)
     # a 3-item call decomposes over the ladder largest-first: 2 + 1
     eng_b2 = Engine(cfg, params, mesh, ecfg)
@@ -873,7 +893,7 @@ def test_unseeded_requests_get_distinct_default_keys(devices8):
             first, _, _ = eng.admit(s, [5, 6, 7], 8, temperature=1.0)
             streams[s].append(first)
         for _ in range(7):
-            toks, _ = eng.step()
+            toks, _, _ = eng.step()
             for s in (0, 1):
                 streams[s].append(int(toks[s, 0]))
         return streams
@@ -889,6 +909,51 @@ def test_unseeded_requests_get_distinct_default_keys(devices8):
     s1 = eng1.admit(0, [5, 6, 7], 4, temperature=0.9, seed=42)
     s2 = eng2.admit(0, [5, 6, 7], 4, temperature=0.9, seed=42)
     assert s1 == s2
+
+
+def test_stop_matcher_hold_trim_flush():
+    """StopMatcher unit semantics: the longest possible-stop-prefix
+    tail is held back (never streamed), a completed stop is trimmed,
+    overlapping candidates resolve to the earliest match, and flush()
+    releases the held tail on non-stop finishes."""
+    from apex_tpu.serving.request import StopMatcher
+
+    def feed(stops, tokens):
+        m = StopMatcher(stops)
+        out, matched = [], False
+        for t in tokens:
+            flushed, matched = m.push(t, 0.0)
+            out += [tok for tok, _ in flushed]
+            if matched:
+                break
+        return out, matched, m
+
+    # exact trim: stop [3, 4] inside the stream
+    out, matched, _ = feed([[3, 4]], [1, 2, 3, 4, 5])
+    assert (out, matched) == ([1, 2], True)
+    # holdback: prefix [3] is held until disambiguated
+    m = StopMatcher([[3, 4]])
+    assert m.push(3, 0.0) == ([], False)      # possible stop start
+    assert m.push(9, 0.0) == ([(3, 0.0), (9, 0.0)], False)  # broke
+    # self-overlapping stop: [7, 7] in stream 5,7,7
+    out, matched, _ = feed([[7, 7]], [5, 7, 7, 7])
+    assert (out, matched) == ([5], True)
+    # a stop crossing a would-be flush boundary: [1, 2, 3] with the
+    # stream teasing 1,2 then completing
+    out, matched, _ = feed([[1, 2, 3]], [9, 1, 2, 3])
+    assert (out, matched) == ([9], True)
+    # two stops completing on the same token: list order decides the
+    # trim ([2, 5] first trims both tokens; [5] first would keep the 2)
+    out, matched, _ = feed([[2, 5], [5]], [2, 5])
+    assert matched and out == []
+    out, matched, _ = feed([[5], [2, 5]], [2, 5])
+    assert matched and out == [2]
+    # flush releases held tokens (device finish without a match)
+    m = StopMatcher([[1, 2, 3]])
+    m.push(1, 0.1)
+    m.push(2, 0.2)
+    assert m.flush() == [(1, 0.1), (2, 0.2)]
+    assert m.pending == []
 
 
 def test_threefry_key_data_matches_prngkey():
