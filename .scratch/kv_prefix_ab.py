@@ -1,0 +1,162 @@
+"""In-process A/B for PR 7's capacity plays (perf-claims convention:
+whole-step, interleaved sides, value-fetch sync — Engine.step()'s
+fetch IS the sync barrier).
+
+A: quantized KV cache — int8 storage vs the compute-dtype cache.
+   Steady-decode tok/s (admissions excluded: one admit wave, then pure
+   chunked decode to the budget) + cache bytes per slot. Run at the
+   dispatch-dominated 1L/32h probe AND the 4L/256h smoke shape — on
+   CPU the XLA fallback DEQUANTIZES the materialised cache per step
+   (extra O(B·h·S·d) multiplies the chip kernel does per split-K chunk
+   in VMEM), so the smoke shape is the worst case for the fallback and
+   the probe shape isolates dispatch overhead.
+
+B: shared-prefix reuse — per-admission latency (TTFT) of a prefix-hit
+   admission (compiled gather + tail-bucket prefill) vs cold prefill
+   of the same prompt at its full bucket, k=1 both sides.
+
+Usage: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+       XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+       PYTHONPATH=/root/repo python .scratch/kv_prefix_ab.py
+"""
+
+import dataclasses
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import mesh as mx
+from apex_tpu.models import gpt
+from apex_tpu.serving import Request, SamplingParams
+from apex_tpu.serving.engine import Admission, Engine, EngineConfig
+from apex_tpu.serving.scheduler import Scheduler
+
+REPS = 7
+
+
+def steady_decode_tps(eng, n_chunks=24):
+    """One admit wave filling every slot, then n_chunks chunked decode
+    dispatches; the value fetch in step() is the sync."""
+    eng.rebuild_slots()
+    items = [Admission(slot=s, prompt=[1 + s, 2, 3], max_tokens=10_000)
+             for s in range(eng.slots)]
+    # budget beyond horizon is rejected; give each slot the max room
+    items = [dataclasses.replace(
+        a, max_tokens=eng.engine_cfg.max_seq_len - 3) for a in items]
+    eng.admit_many(items)
+    chunk = eng.engine_cfg.decode_chunk
+    t0 = time.perf_counter()
+    toks = 0
+    for _ in range(n_chunks):
+        out, _, _ = eng.step()   # fetch = sync
+        toks += out.size
+    dt = time.perf_counter() - t0
+    return toks / dt
+
+
+def ab_quant(cfg, ecfg, label):
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=jax.devices()[:1])
+    eng_b = Engine(cfg, params, mesh, ecfg).warmup()
+    eng_q = Engine(dataclasses.replace(cfg, kv_cache_dtype="int8"),
+                   params, mesh, ecfg).warmup()
+    best = {"base": 0.0, "int8": 0.0}
+    for _ in range(REPS):  # interleaved: host drift hits both alike
+        best["base"] = max(best["base"], steady_decode_tps(eng_b))
+        best["int8"] = max(best["int8"], steady_decode_tps(eng_q))
+    out = {
+        "shape": label,
+        "base_tps": round(best["base"], 1),
+        "int8_tps": round(best["int8"], 1),
+        "int8_over_base": round(best["int8"] / best["base"], 3),
+        "base_bytes_per_slot": eng_b.cache_bytes() // ecfg.slots,
+        "int8_bytes_per_slot": eng_q.cache_bytes() // ecfg.slots,
+        "bytes_ratio": round(eng_b.cache_bytes() / eng_q.cache_bytes(),
+                             3),
+    }
+    eng_b.close()
+    eng_q.close()
+    return out
+
+
+def ab_prefix():
+    cfg = gpt.GPTConfig(
+        vocab_size=1024, hidden_size=256, num_layers=4, num_heads=8,
+        seq_len=256, remat=False, compute_dtype=jnp.float32)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=jax.devices()[:1])
+    ecfg = EngineConfig(slots=4, max_prompt_len=32, max_seq_len=48,
+                        decode_chunk=8)
+    template = [int(t) for t in jax.random.randint(
+        jax.random.PRNGKey(900), (16,), 0, cfg.vocab_size)]
+    eng_h = Engine(cfg, params, mesh, dataclasses.replace(
+        ecfg, prefix_pool_slots=1)).warmup()
+    eng_h.register_prefix(template)
+    eng_c = Engine(cfg, params, mesh, ecfg).warmup()
+
+    def trace():
+        reqs = []
+        for i in range(12):
+            tail = [int(t) for t in jax.random.randint(
+                jax.random.PRNGKey(910 + i), (1 + i % 8,), 0,
+                cfg.vocab_size)]
+            sp = (SamplingParams(temperature=0.9, top_k=40, seed=i)
+                  if i % 2 else SamplingParams())
+            reqs.append(Request(f"p{i}", template + tail, max_tokens=8,
+                                sampling=sp))
+        return reqs
+
+    best = {}
+    toks = {}
+    for _ in range(REPS):
+        for name, eng in (("hit", eng_h), ("cold", eng_c)):
+            sched = Scheduler(eng, pipeline_depth=2, max_admit_batch=1)
+            for r in trace():
+                sched.submit(r)
+            sched.run_until_idle()
+            t = {rid: c.tokens for rid, c in sched.completions.items()}
+            toks.setdefault(name, t)
+            assert toks[name] == t, f"{name} rerun drift"
+            s = sched.summary()
+            if name not in best or s["ttft_mean_ms"] < \
+                    best[name]["ttft_mean_ms"]:
+                best[name] = s
+    assert toks["hit"] == toks["cold"], "prefix-hit token drift"
+    out = {
+        "split": 16, "cold_bucket": 32,
+        "hit_ttft_ms": round(best["hit"]["ttft_mean_ms"], 2),
+        "cold_ttft_ms": round(best["cold"]["ttft_mean_ms"], 2),
+        "ttft_speedup": round(best["cold"]["ttft_mean_ms"]
+                              / best["hit"]["ttft_mean_ms"], 3),
+        "token_drift": 0,
+    }
+    eng_h.close()
+    eng_c.close()
+    return out
+
+
+def main():
+    probe = ab_quant(
+        gpt.GPTConfig(vocab_size=256, hidden_size=32, num_layers=1,
+                      num_heads=2, seq_len=128, remat=False,
+                      compute_dtype=jnp.float32),
+        EngineConfig(slots=4, max_prompt_len=8, max_seq_len=96,
+                     decode_chunk=8, prompt_buckets=(8,),
+                     admit_batch_sizes=(1, 2, 4)),
+        "probe_1l32h")
+    smoke = ab_quant(
+        gpt.GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4,
+                      num_heads=8, seq_len=256, remat=False,
+                      compute_dtype=jnp.float32),
+        EngineConfig(slots=4, max_prompt_len=8, max_seq_len=64,
+                     decode_chunk=8, prompt_buckets=(8,),
+                     admit_batch_sizes=(1, 2, 4)),
+        "smoke_4l256h")
+    print(json.dumps({"quant": [probe, smoke], "prefix": ab_prefix()},
+                     indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,7 +13,12 @@ from apex_tpu.kernels.softmax import (
     scaled_upper_triang_masked_softmax,
 )
 from apex_tpu.kernels.xentropy import softmax_cross_entropy
-from apex_tpu.kernels.decode_attention import decode_attention
+from apex_tpu.kernels.decode_attention import (
+    decode_attention,
+    decode_attention_quantized,
+    kv_storage_dtype,
+    quantize_kv_rows,
+)
 from apex_tpu.kernels.flash_attention import (
     flash_attention,
     flash_attention_bsh,
@@ -39,6 +44,9 @@ __all__ = [
     "scaled_upper_triang_masked_softmax",
     "softmax_cross_entropy",
     "decode_attention",
+    "decode_attention_quantized",
+    "kv_storage_dtype",
+    "quantize_kv_rows",
     "flash_attention",
     "flash_attention_bsh",
     "flash_attention_with_lse",

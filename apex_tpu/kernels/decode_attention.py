@@ -29,6 +29,17 @@ scale is applied (fp32 scores here vs compute-dtype q there), which the
 oracle test covers with per-dtype tolerances
 (``tests/test_decode_attention.py``).
 
+**Quantized cache layout** (:func:`decode_attention_quantized`): K/V
+stored int8 (or fp8 e4m3) with per-head, per-slot, per-position fp32
+scales. The one-column write quantizes the incoming ``[h, d]`` rows
+IN-KERNEL (symmetric absmax per head — the same deterministic
+round-to-nearest quantizer every other cache-write path calls, see
+:func:`quantize_kv_rows`) and lands one quantized column
+plus one scale column per batch row; the split-K read streams int8
+chunks from HBM — ~2x less read traffic than bf16, ~4x less than f32 —
+and dequantizes each ``[block_k, d]`` chunk in VMEM before the fp32
+score dot.
+
 Like every kernel in this package it runs interpreted off-TPU, so the
 CPU test backbone exercises identical semantics; the model-level
 dispatch (``GPTConfig.decode_attn_impl="auto"``) keeps the XLA path for
@@ -240,3 +251,225 @@ def decode_attention(q, k_new, v_new, k_cache, v_cache, pos, *,
         k_cache = k_cache.astype(jnp.float16)
         v_cache = v_cache.astype(jnp.float16)
     return out, k_cache, v_cache
+
+
+# ---------------------------------------------------------------------------
+# quantized cache layout: int8/fp8 storage + per-row fp32 scales
+# ---------------------------------------------------------------------------
+
+#: symmetric quantization range per storage kind (int8 keeps the signed
+#: range symmetric at ±127; fp8 e4m3fn saturates at ±448)
+KV_QMAX = {"int8": 127.0, "fp8": 448.0}
+
+
+def kv_storage_dtype(kind: str):
+    """jnp storage dtype of a quantized-KV kind."""
+    if kind == "int8":
+        return jnp.int8
+    if kind == "fp8":
+        return jnp.float8_e4m3fn
+    raise ValueError(f"unknown quantized-KV kind {kind!r}")
+
+
+def quantize_kv_rows(x, kind: str):
+    """THE KV quantizer: ``x [..., head_dim]`` (one K or V row per
+    leading coordinate) → ``(q [..., head_dim] storage, scale [...]
+    fp32)``. Symmetric absmax per row, deterministic round-to-nearest-
+    even — the in-kernel column write, the XLA-fallback write, bulk
+    prefill, and the prefix pool all call exactly this, so any two
+    paths fed the same K/V bits produce the same cache bytes (the
+    prefix-reuse bit-parity oracle leans on that; kernel-vs-XLA decode
+    runs are separate compiled programs whose K/V inputs already differ
+    at the usual ulp level, so THAT pair is tolerance-bounded like
+    every other kernel oracle)."""
+    xf = x.astype(jnp.float32)
+    qmax = KV_QMAX[kind]
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    # multiply by the reciprocal EXPLICITLY: XLA rewrites x / <const>
+    # into x * (1/<const>) in some lowerings but not others — spelling
+    # it one way keeps every lowering of THIS function bit-identical
+    scale = jnp.maximum(amax, jnp.float32(1e-12)) * jnp.float32(
+        1.0 / qmax)
+    y = xf / scale[..., None]
+    if kind == "int8":
+        q = jnp.clip(jnp.round(y), -qmax, qmax).astype(jnp.int8)
+    else:
+        q = jnp.clip(y, -qmax, qmax).astype(jnp.float8_e4m3fn)
+    return q, scale
+
+
+def _write_kernel_quant(pos_ref, kn_ref, vn_ref, kqi_ref, ksi_ref,
+                        vqi_ref, vsi_ref, kq_ref, ks_ref, vq_ref,
+                        vs_ref, *, kind):
+    del pos_ref, kqi_ref, ksi_ref, vqi_ref, vsi_ref  # pos drives the
+    #   index map; the four cache planes are aliased to the outputs
+    kq, ks = quantize_kv_rows(kn_ref[...], kind)      # (1, h, d)/(1, h)
+    vq, vs = quantize_kv_rows(vn_ref[...], kind)
+    kq_ref[...] = kq[:, :, None]
+    ks_ref[...] = ks[:, :, None]
+    vq_ref[...] = vq[:, :, None]
+    vs_ref[...] = vs[:, :, None]
+
+
+def _write_column_quant(k_new, v_new, k_q, k_s, v_q, v_s, pos, kind):
+    """Quantize the incoming ``[b, h, d]`` K/V rows IN-KERNEL and land
+    one quantized column plus one fp32 scale column at each row's own
+    ``pos`` — the quantized form of :func:`_write_column` (same
+    scalar-prefetch index map, all four cache planes aliased
+    input→output so nothing else is touched)."""
+    b, h, sk, d = k_q.shape
+    new_spec = pl.BlockSpec((1, h, d), lambda i, pos_ref: (i, 0, 0))
+    col_spec = pl.BlockSpec((1, h, 1, d),
+                            lambda i, pos_ref: (i, 0, pos_ref[i], 0))
+    scol_spec = pl.BlockSpec((1, h, 1),
+                             lambda i, pos_ref: (i, 0, pos_ref[i]))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b,),
+        in_specs=[new_spec, new_spec]
+        + [pl.BlockSpec(memory_space=pltpu.ANY)] * 4,
+        out_specs=[col_spec, scol_spec, col_spec, scol_spec],
+    )
+    return pl.pallas_call(
+        functools.partial(_write_kernel_quant, kind=kind),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(k_q.shape, k_q.dtype),
+                   jax.ShapeDtypeStruct(k_s.shape, k_s.dtype),
+                   jax.ShapeDtypeStruct(v_q.shape, v_q.dtype),
+                   jax.ShapeDtypeStruct(v_s.shape, v_s.dtype)],
+        # operand order: (pos, k_new, v_new, k_q, k_s, v_q, v_s)
+        input_output_aliases={3: 0, 4: 1, 5: 2, 6: 3},
+        interpret=use_interpret(),
+    )(pos, k_new, v_new, k_q, k_s, v_q, v_s)
+
+
+def _attn_kernel_quant(pos_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
+                       o_ref, acc_ref, m_ref, l_ref, *, scale, bk, sk,
+                       h):
+    r = pl.program_id(0)        # (batch, head) row
+    j = pl.program_id(1)        # split-K chunk of the horizon
+    nk = pl.num_programs(1)
+    pos = pos_ref[lax.div(r, h)]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * bk <= pos)
+    def _block():
+        q = q_ref[0].astype(jnp.float32)              # (1, d)
+        col = lax.broadcasted_iota(jnp.int32, (1, bk), 1) + j * bk
+        valid = (col <= pos) & (col < sk)
+        # int8/fp8 chunk straight from HBM; the per-column scale folds
+        # into the SCORE (q·(k_int·s) == (q·k_int)·s) so the chunk is
+        # never materialised dequantized
+        kq = k_ref[0].astype(jnp.float32)             # (bk, d)
+        s = jax.lax.dot_general(
+            q, kq, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        s = s * ks_ref[0][None, :] * scale            # (1, bk)
+        s = jnp.where(valid, s, _NEG)
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        l_ref[:] = jnp.broadcast_to(
+            corr * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True),
+            l_ref.shape)
+        # the V scale folds into p the same way (Σ p_j·(v_j·s_j) ==
+        # Σ (p_j·s_j)·v_j); masked columns zero BOTH the int chunk and
+        # the scale — uninitialised fp8/fp32 garbage can be NaN, and
+        # 0·NaN would poison the accumulator
+        vq = v_ref[0].astype(jnp.float32)
+        vq = jnp.where(jnp.transpose(valid), vq, 0.0)
+        vs = jnp.where(valid[0], vs_ref[0], 0.0)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p * vs[None, :], vq, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
+def _run_attn_quant(q, k_q, k_s, v_q, v_s, pos, scale, h, block_k):
+    bh, sk, d = k_q.shape
+    bk = _fit_block_k(block_k or _DEFAULT_BLOCK_K, sk)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, -(-sk // bk)),
+        in_specs=[
+            pl.BlockSpec((1, 1, d), lambda r, j, pos_ref: (r, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda r, j, pos_ref: (r, j, 0)),
+            pl.BlockSpec((1, bk), lambda r, j, pos_ref: (r, j)),
+            pl.BlockSpec((1, bk, d), lambda r, j, pos_ref: (r, j, 0)),
+            pl.BlockSpec((1, bk), lambda r, j, pos_ref: (r, j)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d),
+                               lambda r, j, pos_ref: (r, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, d), jnp.float32),
+            pltpu.VMEM((1, _LANES), jnp.float32),
+            pltpu.VMEM((1, _LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_attn_kernel_quant, scale=scale, bk=bk,
+                          sk=sk, h=h),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, 1, d), q.dtype),
+        interpret=use_interpret(),
+    )(pos, q[:, None], k_q, k_s, v_q, v_s)
+    return out[:, 0]
+
+
+def decode_attention_quantized(q, k_new, v_new, k_q, k_scale, v_q,
+                               v_scale, pos, *, kind: str = "int8",
+                               scale: Optional[float] = None,
+                               block_k: Optional[int] = None):
+    """:func:`decode_attention` over the quantized cache layout: K/V
+    stored as ``kind`` (``"int8"``/``"fp8"``) ``[b, h, S, d]`` with
+    per-head, per-slot, per-position fp32 scales ``[b, h, S]``. The
+    incoming ``k_new``/``v_new [b, h, d]`` rows are quantized in-kernel
+    (:func:`quantize_kv_rows` — bit-identical to the XLA fallback and
+    bulk prefill) and written as one quantized + one scale column at
+    each row's ``pos``; the split-K sweep reads the narrow cache and
+    folds the scales into the fp32 scores/probabilities per chunk, so
+    the steady-decode HBM read traffic shrinks with the storage width.
+    Returns ``(out [b, h, d], k_q, k_scale, v_q, v_scale)``; masking
+    semantics identical to :func:`decode_attention` (positions past a
+    row's ``pos`` are exact softmax zeros — stale quantized garbage,
+    NaN bit patterns included, never leaks)."""
+    if q.ndim != 3 or k_q.ndim != 4:
+        raise ValueError(
+            f"expected q [b, h, d] and quantized caches [b, h, S, d], "
+            f"got {q.shape} / {k_q.shape}")
+    b, h, d = q.shape
+    sk = k_q.shape[2]
+    if k_q.shape != (b, h, sk, d) or k_scale.shape != (b, h, sk):
+        raise ValueError(
+            f"cache shapes {k_q.shape} / {k_scale.shape} inconsistent "
+            f"with q {q.shape}")
+    if pos.shape != (b,):
+        raise ValueError(f"pos must be [{b}], got {pos.shape}")
+    if kind not in KV_QMAX:
+        raise ValueError(f"unknown quantized-KV kind {kind!r}")
+    s = float(scale) if scale is not None else 1.0 / d ** 0.5
+    q, was16 = widen_f16(q)
+    k_new, _ = widen_f16(k_new)
+    v_new, _ = widen_f16(v_new)
+    pos = jnp.asarray(pos, jnp.int32)
+    k_q, k_scale, v_q, v_scale = _write_column_quant(
+        k_new, v_new, k_q, k_scale, v_q, v_scale, pos, kind)
+    out = _run_attn_quant(
+        q.reshape(b * h, d), k_q.reshape(b * h, sk, d),
+        k_scale.reshape(b * h, sk), v_q.reshape(b * h, sk, d),
+        v_scale.reshape(b * h, sk), pos, s, h, block_k,
+    ).reshape(b, h, d)
+    if was16:
+        out = out.astype(jnp.float16)
+    return out, k_q, k_scale, v_q, v_scale

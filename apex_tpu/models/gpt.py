@@ -41,9 +41,14 @@ import numpy as np
 
 from apex_tpu.kernels import (
     decode_attention,
+    decode_attention_quantized,
     flash_attention,
     flash_attention_bsh,
     layer_norm,
+)
+from apex_tpu.kernels.decode_attention import (
+    kv_storage_dtype as _kv_storage_dtype,
+    quantize_kv_rows as _quantize_kv_rows_impl,
 )
 from apex_tpu.kernels.blockwise_attention import blockwise_attention
 from apex_tpu.mesh.topology import AXIS_CP, AXIS_DP, AXIS_EP, AXIS_PP, AXIS_TP
@@ -154,12 +159,26 @@ class GPTConfig:
     #: [b, h, S, d] K/V caches per layer per token (O(B·h·S·d) HBM
     #: traffic that scales with horizon). "xla" → materialised-scores
     #: einsum attention (the only fast path off-TPU, where Pallas runs
-    #: interpreted). "auto" picks kernel on TPU from horizon 128
-    #: (provisional crossover — no chip was attached when this shipped;
-    #: re-measure whole-step per the perf-claims convention), except
-    #: under f16 compute, whose widen-at-kernel-boundary cost would
-    #: copy both full caches per layer per token.
+    #: interpreted). "auto" resolves through :func:`_decode_attn_impl` —
+    #: THE one documented predicate, shared by the plain and quantized
+    #: cache layouts.
     decode_attn_impl: str = "auto"
+    #: KV-cache storage dtype for the decode path (:func:`init_cache` /
+    #: prefill / :func:`decode_step`(s) / the serving engine's donated
+    #: buffers). "bf16" (and today "auto") stores K/V in
+    #: ``compute_dtype`` — the historical layout, bit-identical to every
+    #: pre-quantization oracle. "int8" / "fp8" store K/V quantized with
+    #: per-head, per-slot, per-position fp32 scales (symmetric absmax
+    #: over each written ``[head_dim]`` row): cache footprint and decode
+    #: HBM read traffic shrink ~2x (bf16) / ~4x (fp32 compute), at a
+    #: small dequantization error the oracle tests bound per dtype. The
+    #: cache becomes a ``{"kv", "scale"}`` pytree; every cache-layout
+    #: seam (insert/gather/spec) handles both forms. "fp8" uses
+    #: ``float8_e4m3fn`` where the jax build provides it. "auto" stays
+    #: unquantized until a chip-measured crossover justifies flipping it
+    #: (perf-claims convention — quantization changes numerics, so the
+    #: default must not silently break bit-parity oracles).
+    kv_cache_dtype: str = "auto"
     #: Long-context mode (no reference analogue — SURVEY.md §5 "no ring
     #: attention"): activations stay sequence-sharded over the ``cp`` mesh
     #: axis through the whole stack; attention is exact ring attention
@@ -509,35 +528,47 @@ def _attention_ctx(cfg: GPTConfig, q, k, v, heads_local: int):
     elif impl == "xla_chunked":
         out = blockwise_attention(q, k, v, causal=cfg.causal)
     else:
-        sc = 1.0 / d ** 0.5
         tri = None
         if cfg.causal:
             tri = lax.broadcasted_iota(jnp.int32, (s, s), 0) >= (
                 lax.broadcasted_iota(jnp.int32, (s, s), 1))
-        if cfg.attn_score_dtype == "compute":
-            # scores stay in compute dtype; the scale is folded into q
-            # BEFORE the einsum so the truncated output never holds the
-            # unscaled dot product (which overflows fp16's 65504 range)
-            scores = jnp.einsum("bhqd,bhkd->bhqk", q * jnp.asarray(
-                sc, q.dtype), k)
-            if tri is not None:
-                finfo = jnp.finfo(scores.dtype)
-                scores = jnp.where(tri, scores, finfo.min)
-            m = jnp.max(scores, axis=-1, keepdims=True).astype(jnp.float32)
-            e = jnp.exp(scores.astype(jnp.float32) - m)
-            p_attn = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(q.dtype)
-        elif cfg.attn_score_dtype == "f32":
-            scores = jnp.einsum(
-                "bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sc
-            if tri is not None:
-                scores = jnp.where(tri, scores, -1e30)
-            p_attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-        else:
-            raise ValueError(
-                f"unknown attn_score_dtype {cfg.attn_score_dtype!r} "
-                "(expected 'f32' or 'compute')")
+        p_attn = _xla_attn_probs(cfg, q, k, tri)
         out = jnp.einsum("bhqk,bhkd->bhqd", p_attn, v)
     return jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, heads_local * d)
+
+
+def _xla_attn_probs(cfg: GPTConfig, q, k, mask):
+    """THE materialised-scores attention-probability expression:
+    ``q [b, h, Q, d]`` x ``k [b, h, K, d]`` → ``p_attn [b, h, Q, K]``
+    under boolean ``mask`` (True = attend; any shape broadcasting over
+    the scores, or None). Single-sourced so the square training/prefill
+    path and :func:`prefill_extend`'s rectangular prefix+tail path can
+    never diverge — ``attn_score_dtype`` semantics included, which is
+    what the prefix-hit == cold-prefill bit-parity contract stands
+    on."""
+    d = q.shape[-1]
+    sc = 1.0 / d ** 0.5
+    if cfg.attn_score_dtype == "compute":
+        # scores stay in compute dtype; the scale is folded into q
+        # BEFORE the einsum so the truncated output never holds the
+        # unscaled dot product (which overflows fp16's 65504 range)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q * jnp.asarray(
+            sc, q.dtype), k)
+        if mask is not None:
+            finfo = jnp.finfo(scores.dtype)
+            scores = jnp.where(mask, scores, finfo.min)
+        m = jnp.max(scores, axis=-1, keepdims=True).astype(jnp.float32)
+        e = jnp.exp(scores.astype(jnp.float32) - m)
+        return (e / jnp.sum(e, axis=-1, keepdims=True)).astype(q.dtype)
+    if cfg.attn_score_dtype == "f32":
+        scores = jnp.einsum(
+            "bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sc
+        if mask is not None:
+            scores = jnp.where(mask, scores, -1e30)
+        return jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    raise ValueError(
+        f"unknown attn_score_dtype {cfg.attn_score_dtype!r} "
+        "(expected 'f32' or 'compute')")
 
 
 def _mlp(cfg: GPTConfig, p, h):
@@ -946,37 +977,126 @@ def pipeline_loss(
 # inference path at all; the flagship model should be servable
 # ---------------------------------------------------------------------------
 
+def _kv_cache_dtype(cfg: GPTConfig) -> str:
+    """Resolve ``cfg.kv_cache_dtype`` to the storage kind —
+    ``"compute"`` (unquantized, the historical layout), ``"int8"`` or
+    ``"fp8"``. ``"auto"`` resolves to ``"compute"``: quantization
+    changes numerics, so flipping the default needs a chip-measured
+    case (docs/DESIGN.md); ``"bf16"`` is the explicit spelling of the
+    same unquantized layout (the cache stores ``compute_dtype``,
+    whatever that is)."""
+    kind = cfg.kv_cache_dtype
+    if kind in ("auto", "bf16", "compute"):
+        return "compute"
+    if kind == "fp8":
+        if not hasattr(jnp, "float8_e4m3fn"):
+            raise ValueError(
+                "kv_cache_dtype='fp8' needs a jax build with "
+                "float8_e4m3fn; use 'int8'")
+        return "fp8"
+    if kind == "int8":
+        return "int8"
+    raise ValueError(
+        f"unknown kv_cache_dtype {kind!r} "
+        "(expected auto|bf16|int8|fp8)")
+
+
+#: one quantizer for every cache-write path — the kernel package owns
+#: it (:func:`apex_tpu.kernels.quantize_kv_rows`), this alias keeps the
+#: model-level name
+quantize_kv_rows = _quantize_kv_rows_impl
+
+
+def dequantize_kv(q, scale, dtype):
+    """Inverse of :func:`quantize_kv_rows`: ``q [..., d]`` × per-row
+    ``scale [...]`` → ``dtype``."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def quantize_cache_block(cfg: GPTConfig, block):
+    """Compute-dtype cache block ``[l, 2, b, hl, P, d]`` → the storage
+    form of ``cfg.kv_cache_dtype`` (identity when unquantized). The one
+    place a raw K/V block becomes cache bytes, so prefill, the prefix
+    pool, and the tail-extend admission can never quantize
+    differently."""
+    kind = _kv_cache_dtype(cfg)
+    if kind == "compute":
+        return block.astype(cfg.compute_dtype)
+    q, scale = quantize_kv_rows(block, kind)
+    return {"kv": q, "scale": scale}
+
+
+def dequantize_cache_block(cfg: GPTConfig, block):
+    """Inverse of :func:`quantize_cache_block` (identity when
+    unquantized): storage form → compute-dtype ``[l, 2, b, hl, P,
+    d]``."""
+    if isinstance(block, dict):
+        return dequantize_kv(block["kv"], block["scale"],
+                             cfg.compute_dtype)
+    return block
+
+
 def init_cache(cfg: GPTConfig, params, batch: int,
                max_len: Optional[int] = None):
-    """Local KV cache ``[L_local, 2, batch, heads_local, max_len,
-    head_dim]`` (zeros) sized from this rank's layer/qkv shards — call
-    inside ``shard_map`` like the rest of the model. ``max_len`` defaults
-    to ``cfg.seq_len``; size it to the actual decode horizon (attention
-    runs over every cache slot each step)."""
+    """Local KV cache (zeros) sized from this rank's layer/qkv shards —
+    call inside ``shard_map`` like the rest of the model. ``max_len``
+    defaults to ``cfg.seq_len``; size it to the actual decode horizon
+    (attention runs over every cache slot each step).
+
+    Layout: ``[L_local, 2, batch, heads_local, max_len, head_dim]`` in
+    ``compute_dtype`` — or, under a quantized ``cfg.kv_cache_dtype``,
+    the ``{"kv": int8/fp8 [same shape], "scale": fp32 [..., max_len]}``
+    pytree (every cache consumer is pytree-agnostic; see
+    :func:`cache_specs` for the matching PartitionSpecs)."""
     qkv_k = params["layers"]["attn"]["qkv"]["kernel"]  # [L, h, 3, hl]
     l_local = qkv_k.shape[0]
     heads_local = qkv_k.shape[-1] // cfg.head_dim
-    return jnp.zeros(
-        (l_local, 2, batch, heads_local, max_len or cfg.seq_len,
-         cfg.head_dim),
-        cfg.compute_dtype)
+    shape = (l_local, 2, batch, heads_local, max_len or cfg.seq_len,
+             cfg.head_dim)
+    kind = _kv_cache_dtype(cfg)
+    if kind == "compute":
+        return jnp.zeros(shape, cfg.compute_dtype)
+    return {"kv": jnp.zeros(shape, _kv_storage_dtype(kind)),
+            "scale": jnp.zeros(shape[:-1], jnp.float32)}
+
+
+def cache_specs(cfg: GPTConfig):
+    """PartitionSpecs matching :func:`init_cache`'s structure (heads are
+    the tp-sharded dim; the quantized scale plane shards the same
+    way) — the serving engine's cache/pool in/out specs."""
+    data = P(None, None, None, cfg.axis, None, None)
+    if _kv_cache_dtype(cfg) == "compute":
+        return data
+    return {"kv": data, "scale": P(None, None, None, cfg.axis, None)}
 
 
 def _decode_attn_impl(cfg: GPTConfig, s_max: int) -> str:
-    """Resolve ``cfg.decode_attn_impl`` for a cache horizon of
-    ``s_max`` — the decode-side instance of the repo's crossover
-    convention (kernel on TPU from horizon 128, XLA off-TPU where
-    Pallas runs interpreted and at short horizons)."""
+    """THE decode-attention dispatch predicate, for a cache horizon of
+    ``s_max`` — single-sourced so the plain and quantized cache layouts
+    can never gate differently. ``"auto"`` resolves to the Pallas
+    flash-decode kernel exactly when ALL of:
+
+    - a real Mosaic backend exists (off-TPU Pallas runs interpreted,
+      orders of magnitude slower — XLA is the only fast path there);
+    - ``s_max >= 128`` (below one split-K chunk the swept kernel buys
+      nothing over the materialised scores — PROVISIONAL crossover, no
+      chip attached when measured; re-measure whole-step per the
+      perf-claims convention);
+    - the cache is not f16-stored: Mosaic has no f16, so the kernel
+      boundary would widen BOTH full caches to f32 and back every layer
+      every token — strictly more HBM traffic than the one-hot rewrite
+      the kernel exists to remove. Quantized caches (int8/fp8 storage)
+      are exempt: they cross the boundary in their storage dtype
+      regardless of a f16 ``compute_dtype`` (only the tiny ``[b, h,
+      d]`` q/k_new/v_new rows widen).
+    """
     impl = cfg.decode_attn_impl
     if impl == "auto":
         from apex_tpu.kernels._utils import use_interpret
 
-        # f16 stays on XLA: Mosaic has no f16, so the kernel boundary
-        # would widen BOTH full caches to f32 and cast back every layer
-        # every token — strictly more HBM traffic than the one-hot
-        # rewrite the kernel exists to remove
-        f16 = jnp.dtype(cfg.compute_dtype) == jnp.float16
-        impl = ("xla" if use_interpret() or f16 or s_max < 128
+        f16_cache = (jnp.dtype(cfg.compute_dtype) == jnp.float16
+                     and _kv_cache_dtype(cfg) == "compute")
+        impl = ("xla" if use_interpret() or f16_cache or s_max < 128
                 else "kernel")
     if impl not in ("kernel", "xla"):
         raise ValueError(
@@ -984,8 +1104,79 @@ def _decode_attn_impl(cfg: GPTConfig, s_max: int) -> str:
     return impl
 
 
+def _decode_attend(cfg: GPTConfig, q, k_new, v_new, kv, pos):
+    """The decode-attention core shared by both cache layouts: write
+    this token's K/V at ``pos`` and attend ``q`` over ``0..pos`` —
+    returns ``(ctx [b, heads, d], new_kv)`` with ``new_kv`` in the
+    SAME layout ``kv`` came in (array ``[2, b, hl, S, d]``, or the
+    quantized ``{"kv", "scale"}`` pytree). Dispatches on
+    :func:`_decode_attn_impl`; under a quantized layout the kernel
+    quantizes the incoming row in-kernel and dequantizes per split-K
+    chunk, while the XLA fallback quantizes/one-hot-writes both planes
+    and dequantizes the materialised cache before the score einsum
+    (same semantics, CPU-testable)."""
+    b, heads, d = q.shape
+    kind = _kv_cache_dtype(cfg)
+    quant = kind != "compute"
+    kvq = kv["kv"] if quant else kv
+    s_max = kvq.shape[3]
+    if _decode_attn_impl(cfg, s_max) == "kernel":
+        posv = (jnp.full((b,), pos, jnp.int32) if pos.ndim == 0
+                else pos)
+        if quant:
+            ctx, kq, ks, vq, vs = decode_attention_quantized(
+                q, k_new, v_new, kvq[0], kv["scale"][0], kvq[1],
+                kv["scale"][1], posv, scale=1.0 / np.sqrt(d), kind=kind)
+            return ctx, {"kv": jnp.stack([kq, vq]),
+                         "scale": jnp.stack([ks, vs])}
+        ctx, k_cache, v_cache = decode_attention(
+            q, k_new, v_new, kvq[0], kvq[1], posv,
+            scale=1.0 / np.sqrt(d))
+        return ctx, jnp.stack([k_cache, v_cache])
+    if quant:
+        # quantize the incoming rows ONCE (bit-identical to the kernel
+        # and prefill quantizers), then write both planes
+        k_new, k_s = quantize_kv_rows(k_new, kind)
+        v_new, v_s = quantize_kv_rows(v_new, kind)
+    if pos.ndim == 0:
+        upd = lambda c, n: lax.dynamic_update_slice_in_dim(
+            c, n[:, :, None].astype(c.dtype), pos, axis=2)
+        valid = (jnp.arange(s_max) <= pos)[None, None]        # [1, 1, S]
+    else:
+        hit4 = (jnp.arange(s_max)[None]
+                == pos[:, None])[:, None, :, None]
+        upd = lambda c, n: jnp.where(
+            hit4[..., 0] if c.ndim == 3 else hit4,
+            n[:, :, None].astype(c.dtype), c)
+        valid = (jnp.arange(s_max)[None] <= pos[:, None])[:, None]
+    k_cache = upd(kvq[0], k_new)
+    v_cache = upd(kvq[1], v_new)
+    if quant:
+        k_scale = upd(kv["scale"][0], k_s)
+        v_scale = upd(kv["scale"][1], v_s)
+        new_kv = {"kv": jnp.stack([k_cache, v_cache]),
+                  "scale": jnp.stack([k_scale, v_scale])}
+        # dequantize for the materialised-scores read (semantically the
+        # per-chunk dequant the kernel does in VMEM; off-TPU this is
+        # the correctness backbone, not the fast path)
+        k_cache = dequantize_kv(k_cache, k_scale, cfg.compute_dtype)
+        v_cache = dequantize_kv(v_cache, v_scale, cfg.compute_dtype)
+    else:
+        new_kv = jnp.stack([k_cache, v_cache])
+    # scale folded into q BEFORE the einsum: the unscaled dot
+    # product overflows fp16's 65504 range (same guard as the
+    # training path's compute-dtype branch)
+    q = q * jnp.asarray(1.0 / np.sqrt(d), q.dtype)
+    scores = jnp.einsum(
+        "bhd,bhsd->bhs", q, k_cache).astype(jnp.float32)
+    scores = jnp.where(valid, scores, -1e30)
+    p_attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhs,bhsd->bhd", p_attn, v_cache), new_kv
+
+
 def _decode_layer(cfg: GPTConfig, p, x, kv, pos):
-    """One layer for one token: x [b, hidden], kv [2, b, hl, S, d].
+    """One layer for one token: x [b, hidden], kv [2, b, hl, S, d] (or
+    the quantized ``{"kv", "scale"}`` pytree of the same shape family).
 
     ``pos`` is the write/attend position — a scalar (whole batch at one
     position: generate/beam) or a ``[b]`` vector (per-slot positions:
@@ -1004,36 +1195,8 @@ def _decode_layer(cfg: GPTConfig, p, x, kv, pos):
     q, k_new, v_new = (
         t.reshape(b, hl // d, d)
         for t in _qkv_project(cfg, p["attn"]["qkv"], xa))
-    s_max = kv.shape[3]
-    if _decode_attn_impl(cfg, s_max) == "kernel":
-        posv = (jnp.full((b,), pos, jnp.int32) if pos.ndim == 0
-                else pos)
-        ctx, k_cache, v_cache = decode_attention(
-            q, k_new, v_new, kv[0], kv[1], posv,
-            scale=1.0 / np.sqrt(d))
-        out = ctx.reshape(b, hl)
-    else:
-        if pos.ndim == 0:
-            k_cache = lax.dynamic_update_slice_in_dim(
-                kv[0], k_new[:, :, None], pos, axis=2)
-            v_cache = lax.dynamic_update_slice_in_dim(
-                kv[1], v_new[:, :, None], pos, axis=2)
-            valid = (jnp.arange(s_max) <= pos)[None, None]    # [1, 1, S]
-        else:
-            hit = (jnp.arange(s_max)[None]
-                   == pos[:, None])[:, None, :, None]
-            k_cache = jnp.where(hit, k_new[:, :, None], kv[0])
-            v_cache = jnp.where(hit, v_new[:, :, None], kv[1])
-            valid = (jnp.arange(s_max)[None] <= pos[:, None])[:, None]
-        # scale folded into q BEFORE the einsum: the unscaled dot
-        # product overflows fp16's 65504 range (same guard as the
-        # training path's compute-dtype branch)
-        q = q * jnp.asarray(1.0 / np.sqrt(d), q.dtype)
-        scores = jnp.einsum(
-            "bhd,bhsd->bhs", q, k_cache).astype(jnp.float32)
-        scores = jnp.where(valid, scores, -1e30)
-        p_attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-        out = jnp.einsum("bhs,bhsd->bhd", p_attn, v_cache).reshape(b, hl)
+    ctx, new_kv = _decode_attend(cfg, q, k_new, v_new, kv, pos)
+    out = ctx.reshape(b, hl)
     attn = row_parallel_linear(
         out, p["attn"]["proj"]["kernel"], p["attn"]["proj"]["bias"],
         axis=cfg.axis)
@@ -1043,7 +1206,7 @@ def _decode_layer(cfg: GPTConfig, p, x, kv, pos):
         y, _ = moe_mod.moe_ffn(_moe_cfg(cfg), p["moe"], xb)  # aux unused
     else:
         y = _mlp(cfg, p["mlp"], xb)
-    return x + y, jnp.stack([k_cache, v_cache])
+    return x + y, new_kv
 
 
 def _lm_head(cfg: GPTConfig, params, h):
@@ -1233,7 +1396,10 @@ def _prefill_states(cfg: GPTConfig, params, prompt, max_len: int):
     # ks/vs [l_local, b, heads_local, p_len, d] → cache [l, 2, b, hl, S, d]
     pad = ((0, 0),) * 3 + ((0, max_len - p_len), (0, 0))
     cache = jnp.stack([jnp.pad(ks, pad), jnp.pad(vs, pad)], axis=1)
-    return cache, h
+    # quantized storage quantizes here (identity otherwise) — the SAME
+    # per-row quantizer the decode write and prefix pool use, so every
+    # path produces bit-identical cache bytes for the same K/V values
+    return quantize_cache_block(cfg, cache), h
 
 
 def prefill(cfg: GPTConfig, params, prompt, *, max_len: Optional[int] = None):
@@ -1291,20 +1457,123 @@ def prefill_many(cfg: GPTConfig, params, prompts, last, *,
     return cache, _lm_head(cfg, params, h_last)
 
 
-def cache_insert_slot(cache, block, slot):
+def prefill_extend(cfg: GPTConfig, params, prefix_kv, tail, last, *,
+                   prefix_len: int):
+    """Tail-only prefill over an already-prefilled shared prefix: run
+    ONE forward over the right-padded tail tokens ``tail [b, T]``
+    (positions ``prefix_len .. prefix_len + T - 1``; real tokens end at
+    per-row ``last [b]``, tail-local indices) attending causally over
+    ``prefix_kv [l, 2, b, hl, prefix_len, d]`` (compute dtype, every
+    position real — the pooled prefix) plus the tail's own K/V. Returns
+    ``(tail_kv [l, 2, b, hl, T, d] compute dtype, logits [b, vocab])``
+    where row ``i``'s logits predict position ``prefix_len + last[i] +
+    1``.
+
+    This is the prefix-reuse admission's compute: cost scales with the
+    TAIL bucket, not the full prompt. Numerics are the cold path's:
+    projections/LN/MLP are per-position (row-independent matmuls — same
+    bits as the full padded forward), and attention uses the
+    materialised-scores expression with keys ordered prefix-then-tail —
+    ascending prompt positions, exactly the cold forward's column
+    order, with masked columns exact softmax zeros — so when cold
+    prefill ALSO runs the materialised-scores attention (``attn_impl``
+    resolving to "xla" — every off-TPU config, and short prompts
+    on-TPU) every real position's hidden state, K/V entry, and the end
+    logits are bit-identical to a cold :func:`prefill_many` of the
+    concatenated prompt (the causal-padding-exactness argument of
+    :func:`prefill_at`, applied to a split prompt; the prefix-hit
+    oracle pins it). Under flash prefill the cold side's online-softmax
+    reduction order differs at the ulp level, so hit-vs-cold parity is
+    numerical there, not bitwise (docs/DESIGN.md "Serving round 6").
+    ``prefix_len`` is static — one compiled program per (prefix
+    bucket, tail bucket), which is what keeps the serving engine's
+    prefix admissions trace-stable."""
+    b, tb = tail.shape
+    cfg = _decode_entry_cfg(cfg, prefix_len + 1)
+    if prefix_len + tb > cfg.seq_len:
+        raise ValueError(
+            f"prefix_len {prefix_len} + tail width {tb} exceeds the "
+            f"position table (cfg.seq_len={cfg.seq_len})")
+    if cfg.num_experts:
+        # MoE expert capacity is a function of the routed token count
+        # (capacity_factor x tokens / experts): routing only the tail
+        # drops DIFFERENT tokens than the cold full-prompt forward, so
+        # hit/cold parity would break far beyond ulp level — loud, not
+        # silent
+        raise ValueError(
+            "prefill_extend does not support num_experts > 0 (expert "
+            "capacity depends on the routed token count; tail-only "
+            "routing breaks prefix-hit == cold-prefill parity)")
+    d = cfg.head_dim
+    table = params["embedding"]["word"]["table"].astype(cfg.compute_dtype)
+    emb = vocab_parallel_embedding(tail.astype(jnp.int32), table,
+                                   axis=cfg.axis)
+    pos_e = params["embedding"]["position"][prefix_len:prefix_len + tb]
+    h = emb + pos_e[None].astype(cfg.compute_dtype)
+    # static causal mask over [tail rows, prefix+tail cols]: a tail
+    # query at local i (global prefix_len + i) sees the whole prefix
+    # and tail columns j <= i; pad tail columns are only ever visible
+    # to pad rows (right padding + causality — the prefill_at argument)
+    colg = jnp.concatenate([jnp.arange(prefix_len),
+                            prefix_len + jnp.arange(tb)])
+    rowg = prefix_len + jnp.arange(tb)
+    mask = (colg[None] <= rowg[:, None])[None, None]  # [1, 1, T, P+T]
+
+    def body(carry, inp):
+        layer_p, pkv = inp  # pkv [2, b, hl, prefix_len, d]
+        p = _cast_layer(cfg, layer_p)
+        x = _layer_norm(cfg, carry, p["ln1"]["scale"], p["ln1"]["bias"])
+        qh, kh, vh = _qkv_project(cfg, p["attn"]["qkv"], x)
+        heads = qh.shape[-1] // d
+        split = lambda t: jnp.transpose(
+            t.reshape(b, tb, heads, d), (0, 2, 1, 3))
+        qs, kt, vt = split(qh), split(kh), split(vh)
+        k_full = jnp.concatenate([pkv[0], kt], axis=2)
+        v_full = jnp.concatenate([pkv[1], vt], axis=2)
+        # THE shared score expression — attn_score_dtype semantics
+        # included, so hit and cold can never diverge here
+        p_attn = _xla_attn_probs(cfg, qs, k_full, mask)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", p_attn, v_full)
+        attn = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(b, tb, heads * d)
+        attn = row_parallel_linear(
+            attn, p["attn"]["proj"]["kernel"], p["attn"]["proj"]["bias"],
+            axis=cfg.axis)
+        hh = carry + attn
+        x2 = _layer_norm(cfg, hh, p["ln2"]["scale"], p["ln2"]["bias"])
+        hh = hh + _mlp(cfg, p["mlp"], x2)
+        return hh, jnp.stack([kt, vt])
+
+    h, tail_kv = lax.scan(body, h, (params["layers"], prefix_kv))
+    last = jnp.asarray(last, jnp.int32)
+    h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]
+    return tail_kv, _lm_head(cfg, params, h_last)
+
+
+def cache_insert_slot(cache, block, slot, *, pos: int = 0):
     """Insert one request's prefilled cache block ``[l, 2, 1, hl, P, d]``
     into slot ``slot`` of a shared decode cache ``[l, 2, B, hl, S, d]``
     (``P <= S``) — the slot-admission write, and the one place outside
     :func:`init_cache` that knows the cache layout. ``slot`` may be a
     traced scalar (admission is trace-stable); entries past ``P`` keep
-    whatever the slot last held, which decode masks until overwritten."""
-    if block.ndim != cache.ndim:
-        raise ValueError(
-            f"cache block rank {block.ndim} != cache rank {cache.ndim}")
-    zero = jnp.int32(0)
-    return lax.dynamic_update_slice(
-        cache, block.astype(cache.dtype),
-        (zero, zero, jnp.asarray(slot, jnp.int32), zero, zero, zero))
+    whatever the slot last held, which decode masks until overwritten.
+
+    Handles both cache layouts (the quantized ``{"kv", "scale"}``
+    pytree inserts both planes — slot dim 2 and horizon dim 4 line up
+    across leaves by construction). ``pos`` (static) offsets the write
+    on the horizon dim — the tail-extend admission appends its tail
+    block AFTER the copied prefix block."""
+    def ins(c, b):
+        if b.ndim != c.ndim:
+            raise ValueError(
+                f"cache block rank {b.ndim} != cache rank {c.ndim}")
+        zero = jnp.int32(0)
+        starts = [zero] * c.ndim
+        starts[2] = jnp.asarray(slot, jnp.int32)
+        starts[4] = jnp.int32(pos)
+        return lax.dynamic_update_slice(
+            c, b.astype(c.dtype), tuple(starts))
+
+    return jax.tree.map(ins, cache, block)
 
 
 def cache_insert_slots(cache, blocks, slots):
@@ -1315,12 +1584,30 @@ def cache_insert_slots(cache, blocks, slots):
     shape, so this unrolls into k one-slot ``dynamic_update_slice``
     writes — each touching only its own ``[.., 1, .., P, ..]`` column
     of the shared cache."""
-    if blocks.ndim != cache.ndim:
-        raise ValueError(
-            f"cache blocks rank {blocks.ndim} != cache rank {cache.ndim}")
-    for i in range(blocks.shape[2]):
-        cache = cache_insert_slot(cache, blocks[:, :, i:i + 1], slots[i])
+    k = jax.tree.leaves(blocks)[0].shape[2]
+    for i in range(k):
+        cache = cache_insert_slot(
+            cache, jax.tree.map(lambda x: x[:, :, i:i + 1], blocks),
+            slots[i])
     return cache
+
+
+def cache_gather_page(cache, page, length: int):
+    """The prefix pool's compiled gather: slice page ``page`` (traced
+    scalar, dim 2) of a pool cache down to its first ``length`` (static)
+    horizon positions — ``[l, 2, 1, hl, length, d]`` in the pool's
+    layout (compute-dtype master copies in the serving engine's pool;
+    the slot insert quantizes, exactly where a cold prefill
+    quantizes)."""
+    def g(c):
+        starts = [jnp.int32(0)] * c.ndim
+        starts[2] = jnp.asarray(page, jnp.int32)
+        sizes = list(c.shape)
+        sizes[2] = 1
+        sizes[4] = length
+        return lax.dynamic_slice(c, tuple(starts), tuple(sizes))
+
+    return jax.tree.map(g, cache)
 
 
 # re-exported from the serving sampler (one implementation for generate
@@ -1446,7 +1733,8 @@ def beam_search(cfg: GPTConfig, params, prompt, n_new: int,
     scores, first = lax.top_k(logp0, k)            # [b, k] each
     first = first.astype(jnp.int32)
     # beams become the decode batch: row (i, j) = batch i, beam j
-    cache = jnp.repeat(cache0, k, axis=2)          # [l, 2, b*k, hl, S, d]
+    cache = jax.tree.map(lambda c: jnp.repeat(c, k, axis=2),
+                         cache0)                   # [l, 2, b*k, hl, S, d]
     eos = eos_token_id
     done0 = ((first == eos) if eos is not None
              else jnp.zeros((b, k), bool))
@@ -1469,7 +1757,8 @@ def beam_search(cfg: GPTConfig, params, prompt, n_new: int,
             done = (jnp.take_along_axis(done, parent, axis=1)
                     | (tok == eos))
         gather = (jnp.arange(b)[:, None] * k + parent).reshape(b * k)
-        cache = jnp.take(cache, gather, axis=2)
+        cache = jax.tree.map(lambda c: jnp.take(c, gather, axis=2),
+                             cache)
         return (tok.reshape(b * k), cache, scores, done), (tok, parent)
 
     (_, _, scores, _), (toks, parents) = lax.scan(

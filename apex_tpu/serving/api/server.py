@@ -49,7 +49,7 @@ import queue
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from apex_tpu.serving.api import protocol
 from apex_tpu.serving.api.constrain import JsonSchemaConstraint
@@ -114,10 +114,17 @@ class ApiServer:
                  health: Optional[Callable[[], Tuple[int, str]]] = None,
                  max_tokens_default: int = 16,
                  request_timeout_s: float = 120.0,
-                 poll_interval_s: float = 0.0005):
+                 poll_interval_s: float = 0.0005,
+                 prefix_templates: Optional[Sequence[Any]] = None):
         self.scheduler = scheduler
         self.tokenizer = tokenizer
         self.model = model
+        #: shared-prompt templates (strings, or token-id lists)
+        #: registered into the engine's prefix pool at :meth:`start` —
+        #: the wire-level surface of prefix reuse: any request whose
+        #: prompt starts with a registered template admits by pooled
+        #: K/V copy + tail-only prefill, transparently
+        self.prefix_templates = list(prefix_templates or ())
         self.max_tokens_default = max_tokens_default
         self.request_timeout_s = request_timeout_s
         self.poll_interval_s = poll_interval_s
@@ -151,6 +158,12 @@ class ApiServer:
     def start(self) -> "ApiServer":
         if self._httpd is not None:
             return self
+        for tpl in self.prefix_templates:
+            # BEFORE the driver thread exists — registration is the
+            # last main-thread device work (a compiled pool insert)
+            toks = (self.tokenizer.encode(tpl) if isinstance(tpl, str)
+                    else [int(t) for t in tpl])
+            self.scheduler.engine.register_prefix(toks)
         self._running = True
         self._driver = threading.Thread(
             target=self._drive, name="apex-tpu-api-driver", daemon=True)

@@ -113,6 +113,18 @@ class EngineConfig:
     #: (1, 2, 4) capped at ``slots``. Must be strictly increasing and
     #: start at 1 (any group count decomposes).
     admit_batch_sizes: Optional[Tuple[int, ...]] = None
+    #: shared-prefix pool pages (0 disables — no extra compiled
+    #: programs, no pool buffer). A common prompt prefix
+    #: (:meth:`Engine.register_prefix` — a system-prompt template) is
+    #: prefilled ONCE into a pool page; a request whose prompt starts
+    #: with it (:meth:`Engine.match_prefix`, hash-keyed at
+    #: bucket-aligned split points) admits by COPYING the pooled K/V
+    #: into its slot via a compiled gather and prefilling only the
+    #: tail — admission cost drops from the full prompt bucket to the
+    #: tail bucket. One compiled program per (prefix bucket, tail
+    #: bucket) pair plus one pool-insert per prefix bucket, all
+    #: compiled by :meth:`Engine.warmup`.
+    prefix_pool_slots: int = 0
 
 
 #: eos sentinel in the per-slot eos vector: no stop token for this slot
@@ -130,7 +142,17 @@ class Admission:
     whitelist for the FIRST token — the schema DFA's initial allowed
     set; it also seeds the slot's per-step mask
     (:meth:`Engine.set_slot_mask` advances it between chunks). ``None``
-    = unconstrained (and resets any stale mask the slot carried)."""
+    = unconstrained (and resets any stale mask the slot carried).
+
+    ``prefix_page``/``prefix_len`` (optional) ride a prefix-pool hit
+    (:meth:`Engine.match_prefix`): ``prompt`` is still the FULL token
+    sequence, but its first ``prefix_len`` tokens (which must equal the
+    registered prefix — validated) are copied from pool page
+    ``prefix_page`` instead of prefilled, and only the tail runs a
+    forward. Streams are bit-identical to a cold admission of the same
+    prompt whenever cold prefill runs the materialised-scores
+    attention (every off-TPU config; flash prefill differs at the
+    reduction-order ulp level — see ``gpt.prefill_extend``)."""
 
     slot: int
     prompt: Any
@@ -141,6 +163,8 @@ class Admission:
     seed: Optional[int] = None
     eos_token_id: Optional[int] = None
     allowed_tokens: Optional[Sequence[int]] = None
+    prefix_page: Optional[int] = None
+    prefix_len: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -273,6 +297,16 @@ class Engine:
                     f"{axis}={mesh.shape[axis]}")
         self._buckets = self._resolve_buckets(ecfg)
         self._batch_sizes = self._resolve_batch_sizes(ecfg)
+        if ecfg.prefix_pool_slots > 0 and cfg.num_experts:
+            raise ValueError(
+                "prefix_pool_slots > 0 does not compose with "
+                "num_experts > 0: MoE expert capacity depends on the "
+                "routed token count, so a tail-only extend forward "
+                "drops different tokens than the cold full-prompt "
+                "prefill and prefix-hit streams would silently "
+                "diverge (see gpt.prefill_extend)")
+        self._prefix_splits, self._extend_variants = \
+            self._resolve_prefix_variants(ecfg, self._buckets)
         self.cfg = cfg
         self.engine_cfg = ecfg
         self._mesh = mesh
@@ -298,8 +332,17 @@ class Engine:
         #: check per dispatch, not a [B, vocab] transfer.
         self._masks = np.ones((ecfg.slots, cfg.vocab_size), bool)
         self._masks_dev: Optional[Any] = None
+        #: prefix-pool host registry: bucket-aligned key (exact token
+        #: tuple) → (page, split); pages hold the registered tokens for
+        #: admission-time validation. Device pool built in _build.
+        self._prefix_index: Dict[Tuple[int, ...], Tuple[int, int]] = {}
+        self._prefix_tokens: Dict[int, Tuple[int, ...]] = {}
+        self._prefix_used = 0
+        self.pool: Optional[Any] = None
         self._build()
         self.cache, self.state = self._init(params)
+        if self._prefix_splits:
+            self.pool = self._pool_init(params)
 
     @staticmethod
     def _resolve_buckets(ecfg: EngineConfig) -> Tuple[int, ...]:
@@ -337,6 +380,44 @@ class Engine:
                 f"fills")
         return sizes
 
+    @staticmethod
+    def _resolve_prefix_variants(ecfg: EngineConfig,
+                                 buckets: Tuple[int, ...]):
+        """The prefix pool's static-shape families: usable SPLIT points
+        (bucket values that leave >= 1 tail token) and the compiled
+        (split, tail bucket) extend variants — a tail bucket is only
+        admitted when the combined block ``split + tail_bucket`` fits
+        the slot horizon (the tail block is written at offset
+        ``split``, and a clamped ``dynamic_update_slice`` would
+        silently corrupt a neighbour's columns)."""
+        if ecfg.prefix_pool_slots < 0:
+            raise ValueError(
+                f"prefix_pool_slots {ecfg.prefix_pool_slots} must be "
+                f">= 0")
+        if ecfg.prefix_pool_slots == 0:
+            return (), ()
+        mpl = ecfg.max_prompt_len
+        splits: List[int] = []
+        variants: List[Tuple[int, int]] = []
+        for ps in buckets:
+            if ps > mpl - 1:
+                continue
+            tbs = sorted({min(b for b in buckets if b >= tl)
+                          for tl in range(1, mpl - ps + 1)})
+            tbs = [tb for tb in tbs if ps + tb <= ecfg.max_seq_len]
+            if not tbs:
+                continue
+            splits.append(ps)
+            variants.extend((ps, tb) for tb in tbs)
+        if not splits:
+            raise ValueError(
+                f"prefix_pool_slots={ecfg.prefix_pool_slots} but no "
+                f"usable split point: no prompt bucket b satisfies "
+                f"b <= max_prompt_len-1 with a tail bucket fitting "
+                f"max_seq_len (buckets {buckets}, max_prompt_len "
+                f"{mpl}, max_seq_len {ecfg.max_seq_len})")
+        return tuple(splits), tuple(variants)
+
     # -- compiled programs -------------------------------------------------
 
     def _build(self):
@@ -345,7 +426,9 @@ class Engine:
         B = ecfg.slots
         pad = jnp.int32(ecfg.pad_token_id)
         # cache [l, 2, B, heads, S, d]: heads are the tp-sharded dim
-        cache_spec = P(None, None, None, cfg.axis, None, None)
+        # (under a quantized kv_cache_dtype this is the {"kv", "scale"}
+        # spec pytree — same sharding on both planes)
+        cache_spec = gpt.cache_specs(cfg)
         state_spec = {k: P() for k in (
             "tok", "pos", "remaining", "done", "temp", "top_k", "top_p",
             "key", "eos")}
@@ -451,6 +534,114 @@ class Engine:
         self._retire = sm(retire_local, (state_spec, scalar), state_spec,
                           donate=(0,))
 
+        # -- shared-prefix pool programs (prefix_pool_slots > 0) ----------
+        self._pool_inserts: Dict[int, Any] = {}
+        self._admit_prefix: Dict[Tuple[int, int], Any] = {}
+        if not self._prefix_splits:
+            return
+        pool_pages = ecfg.prefix_pool_slots
+        pool_horizon = max(self._prefix_splits)
+        # the pool stores COMPUTE-dtype K/V even under a quantized
+        # kv_cache_dtype — the amp master-copy idea: the tail-extend
+        # forward attends over the EXACT prefix values (what a cold
+        # prefill of the full prompt would see), and quantization
+        # happens once at slot insert, exactly where the cold path
+        # quantizes. A quantized pool would make hits attend over
+        # dequantize(quantize(prefix)) while cold admissions attend
+        # over the exact prefix — a quantization-error divergence the
+        # bit-parity oracle would only catch when a token lands near a
+        # tie. The pool is tiny next to the slot cache; the capacity
+        # play is the slots.
+        cfg_pool = dataclasses.replace(cfg, kv_cache_dtype="bf16")
+        pool_spec = gpt.cache_specs(cfg_pool)
+
+        def pool_init_local(params):
+            return gpt.init_cache(cfg_pool, params, pool_pages,
+                                  max_len=pool_horizon)
+
+        # the pool rides its own init (NOT the slot init): a fault
+        # rebuild re-inits slots but leaves registered prefixes intact
+        self._pool_init = sm(pool_init_local, (pspecs,), pool_spec)
+
+        def make_pool_insert(pb: int):
+            def pool_insert_local(params, pool, tokens, page):
+                # the whole [1, pb] prefix is real — register slices
+                # the template AT the bucket — so every stored K/V
+                # position is valid for any prompt sharing it
+                blocks, _ = gpt.prefill_many(
+                    cfg_pool, params, tokens,
+                    jnp.full((1,), pb - 1, jnp.int32), max_len=pb)
+                return gpt.cache_insert_slot(pool, blocks, page)
+
+            return pool_insert_local
+
+        for pb in self._prefix_splits:
+            self._pool_inserts[pb] = sm(
+                make_pool_insert(pb),
+                (pspecs, pool_spec, scalar, scalar), pool_spec,
+                donate=(1,))
+
+        def make_admit_prefix(ps: int, tb: int):
+            def admit_prefix_local(params, cache, state, pool, slots,
+                                   tails, t_lens, max_tokens, temp,
+                                   top_k, top_p, keys, eos, req_idx,
+                                   seeded, masks, page):
+                # the compiled gather: page -> [l, 2, 1, hl, ps, d]
+                # block of EXACT compute-dtype prefix K/V (the pool's
+                # master copy)
+                block = gpt.cache_gather_page(pool, page, ps)
+                tail_kv, logits0 = gpt.prefill_extend(
+                    cfg, params, block, tails, t_lens - 1,
+                    prefix_len=ps)
+                base = jnp.zeros((2,), jnp.uint32)
+                folded = jax.vmap(
+                    lambda i: jax.random.fold_in(base, i))(req_idx)
+                keys = jnp.where(seeded[:, None], keys, folded)
+                p_lens = ps + t_lens
+                first = sampling.draw_slots(
+                    logits0, keys, p_lens - 1, temp, top_k, top_p,
+                    masks=masks)
+                first_lp = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits0, axis=-1),
+                    first[:, None], axis=1)[:, 0]
+                # the prefix block quantizes at INSERT (same quantizer,
+                # same exact input values as a cold prefill of those
+                # positions), the tail block appends at offset ps —
+                # together exactly the cache bytes a cold admission of
+                # the full prompt would hold
+                cache = gpt.cache_insert_slot(
+                    cache, gpt.quantize_cache_block(cfg, block),
+                    slots[0])
+                cache = gpt.cache_insert_slot(
+                    cache, gpt.quantize_cache_block(cfg, tail_kv),
+                    slots[0], pos=ps)
+                hit_eos = (eos >= 0) & (first == eos)
+                done0 = hit_eos | (max_tokens <= 1)
+                state = {
+                    "tok": state["tok"].at[slots].set(first),
+                    "pos": state["pos"].at[slots].set(p_lens),
+                    "remaining": state["remaining"].at[slots].set(
+                        max_tokens - 1),
+                    "done": state["done"].at[slots].set(done0),
+                    "temp": state["temp"].at[slots].set(temp),
+                    "top_k": state["top_k"].at[slots].set(top_k),
+                    "top_p": state["top_p"].at[slots].set(top_p),
+                    "key": state["key"].at[slots].set(keys),
+                    "eos": state["eos"].at[slots].set(eos),
+                }
+                return cache, state, first, first_lp, hit_eos, done0
+
+            return admit_prefix_local
+
+        for (ps, tb) in self._extend_variants:
+            self._admit_prefix[(ps, tb)] = sm(
+                make_admit_prefix(ps, tb),
+                (pspecs, cache_spec, state_spec, pool_spec)
+                + (scalar,) * 13,
+                (cache_spec, state_spec, scalar, scalar, scalar,
+                 scalar),
+                donate=(1, 2))
+
     # -- host API ----------------------------------------------------------
 
     @property
@@ -468,6 +659,118 @@ class Engine:
         """The resolved admission batch-size ladder (ascending; starts
         at 1)."""
         return self._batch_sizes
+
+    @property
+    def prefix_pool_enabled(self) -> bool:
+        """True when ``EngineConfig.prefix_pool_slots > 0`` resolved to
+        at least one usable split point."""
+        return bool(self._prefix_splits)
+
+    @property
+    def prefix_splits(self) -> Tuple[int, ...]:
+        """Bucket-aligned split points the prefix pool can reuse at
+        (ascending; empty when the pool is disabled)."""
+        return self._prefix_splits
+
+    def register_prefix(self, tokens) -> int:
+        """Prefill a shared prompt prefix (a system-prompt template)
+        ONCE into a pool page; returns the page index. The template is
+        sliced AT its largest usable split bucket (every stored K/V
+        position is real), and indexed at every smaller split too, so
+        :meth:`match_prefix` can reuse the longest bucket-aligned
+        piece a prompt shares. Registering a template whose
+        bucket-aligned slice is already pooled returns the existing
+        page (no device work). Raises when the pool is disabled, full,
+        or the template is shorter than the smallest split bucket.
+        Call AFTER :meth:`warmup` (which resets the pool); the insert
+        rides a program warmup already compiled, so a recompile guard
+        stays armed through registration."""
+        if not self._prefix_splits:
+            raise ValueError(
+                "prefix pool disabled (EngineConfig.prefix_pool_slots "
+                "== 0)")
+        tokens = np.asarray(tokens, np.int32)
+        if tokens.ndim != 1 or tokens.size < 1:
+            raise ValueError("prefix template must be a 1-D token list")
+        if tokens.min() < 0 or tokens.max() >= self.cfg.vocab_size:
+            raise ValueError(
+                f"prefix template tokens outside vocab "
+                f"[0, {self.cfg.vocab_size})")
+        usable = [b for b in self._prefix_splits if b <= tokens.size]
+        if not usable:
+            raise ValueError(
+                f"prefix template of {tokens.size} tokens is shorter "
+                f"than the smallest split bucket "
+                f"{self._prefix_splits[0]} — nothing to pool")
+        pb = max(usable)
+        t = tuple(int(x) for x in tokens[:pb])
+        hit = self._prefix_index.get(t)
+        if hit is not None and hit[1] == pb:
+            return hit[0]
+        if self._prefix_used >= self.engine_cfg.prefix_pool_slots:
+            raise ValueError(
+                f"prefix pool full "
+                f"({self.engine_cfg.prefix_pool_slots} pages)")
+        page = self._prefix_used
+        try:
+            self.pool = self._pool_inserts[pb](
+                self._params, self.pool,
+                np.asarray([t], np.int32), np.int32(page))
+        except Exception:
+            # the insert DONATES the pool buffer: an error escaping the
+            # call may have consumed it, and every already-registered
+            # page lives inside it — reset pool + registry to a clean
+            # empty state (callers re-register) rather than leave the
+            # index pointing into a dead buffer
+            self._prefix_index.clear()
+            self._prefix_tokens.clear()
+            self._prefix_used = 0
+            self.pool = self._pool_init(self._params)
+            raise
+        # page committed only after the insert landed — a failed call
+        # must not leak the page
+        self._prefix_used += 1
+        self._prefix_tokens[page] = t
+        for b in usable:
+            # first registration wins a shorter shared key — the K/V
+            # of tokens[:b] is identical whichever template stored it
+            self._prefix_index.setdefault(t[:b], (page, b))
+        return page
+
+    def match_prefix(self, prompt) -> Optional[Tuple[int, int]]:
+        """Longest-split prefix-pool hit for ``prompt``: returns
+        ``(page, split)`` such that ``prompt[:split]`` equals a pooled
+        prefix, ``split`` is bucket-aligned, at least one tail token
+        remains, and a compiled (split, tail bucket) extend variant
+        exists — or ``None`` (cold prefill). O(splits) tuple-hash
+        lookups; no device work."""
+        if not self._prefix_index:
+            return None
+        t = tuple(int(x) for x in prompt)
+        for split in sorted(self._prefix_splits, reverse=True):
+            if split >= len(t):
+                continue
+            tb = self.bucket_for(len(t) - split)
+            if (split, tb) not in self._admit_prefix:
+                continue
+            hit = self._prefix_index.get(t[:split])
+            if hit is not None:
+                return hit[0], split
+        return None
+
+    def cache_bytes(self) -> int:
+        """Device bytes held by the slot KV cache — under a quantized
+        ``kv_cache_dtype`` the int8/fp8 data plane plus the fp32 scale
+        plane (the capacity number the quantization exists to shrink).
+        Shape/dtype metadata only; no transfer."""
+        return int(sum(x.nbytes for x in jax.tree.leaves(self.cache)))
+
+    def pool_bytes(self) -> int:
+        """Device bytes held by the shared-prefix pool (0 when
+        disabled)."""
+        if self.pool is None:
+            return 0
+        return int(sum(x.nbytes for x in jax.tree.leaves(self.pool)))
 
     def bucket_for(self, prompt_len: int) -> int:
         """The smallest prefill bucket that fits ``prompt_len``."""
@@ -523,6 +826,42 @@ class Engine:
             # dispatch if any row is invalid); the expansion itself is
             # owned by set_slot_mask
             self._check_allowed_tokens(a.allowed_tokens)
+        if a.prefix_page is not None:
+            ps = a.prefix_len
+            if not self._prefix_splits:
+                raise ValueError(
+                    "admission carries a prefix_page but the prefix "
+                    "pool is disabled (EngineConfig.prefix_pool_slots "
+                    "== 0)")
+            if ps not in self._prefix_splits:
+                raise ValueError(
+                    f"prefix_len {ps} is not a usable split point "
+                    f"{self._prefix_splits}")
+            if not 0 <= a.prefix_page < self._prefix_used:
+                raise ValueError(
+                    f"prefix_page {a.prefix_page} outside the "
+                    f"{self._prefix_used} registered pages")
+            if prompt.size <= ps:
+                raise ValueError(
+                    f"prompt of {prompt.size} tokens leaves no tail "
+                    f"beyond prefix_len {ps}")
+            tb = self.bucket_for(prompt.size - ps)
+            if (ps, tb) not in self._admit_prefix:
+                raise ValueError(
+                    f"no compiled extend variant for (split {ps}, "
+                    f"tail bucket {tb}) — the combined block exceeds "
+                    f"max_seq_len")
+            stored = self._prefix_tokens[a.prefix_page]
+            if tuple(int(x) for x in prompt[:ps]) != stored[:ps]:
+                raise ValueError(
+                    f"prompt[:{ps}] does not match the tokens "
+                    f"registered on prefix page {a.prefix_page} — a "
+                    f"mismatched copy would silently decode against "
+                    f"another template's K/V")
+        elif a.prefix_len:
+            raise ValueError(
+                "prefix_len without prefix_page — pass both (a "
+                "match_prefix hit) or neither")
         return prompt, prompt.size
 
     def _check_allowed_tokens(self, allowed: Sequence[int]) -> List[int]:
@@ -580,7 +919,25 @@ class Engine:
         pending = []  # (device futures, bucket, k, group) per dispatch
         i, group = 0, 0
         while i < len(items):
-            k = max(s for s in self._batch_sizes if s <= len(items) - i)
+            if items[i].prefix_page is not None:
+                # a prefix-pool hit rides its own compiled (split,
+                # tail-bucket) extend program, k=1: the copied prefix
+                # replaces most of the prefill forward, so batching it
+                # with cold admissions would drag it back to the full
+                # bucket
+                pending.append(
+                    (self._dispatch_prefix_admit(items[i],
+                                                 validated[i]),
+                     self.bucket_for(
+                         validated[i][1] - items[i].prefix_len),
+                     1, group))
+                i += 1
+                group += 1
+                continue
+            run = i
+            while run < len(items) and items[run].prefix_page is None:
+                run += 1
+            k = max(s for s in self._batch_sizes if s <= run - i)
             batch = items[i:i + k]
             proms = validated[i:i + k]
             bucket = self.bucket_for(max(n for _, n in proms))
@@ -636,6 +993,38 @@ class Engine:
                     bucket=bucket, batch_size=k, group=group,
                     logprob=float(first_lp[j])))
         return results
+
+    def _dispatch_prefix_admit(self, a: Admission,
+                               validated: Tuple[np.ndarray, int]):
+        """Dispatch ONE prefix-hit admission through its (split, tail
+        bucket) extend program; returns the (first, first_lp, hit_eos,
+        done) device futures (fetch deferred like every admission
+        group)."""
+        prompt, n = validated
+        ps = a.prefix_len
+        tb = self.bucket_for(n - ps)
+        tails = np.full((1, tb), self.engine_cfg.pad_token_id, np.int32)
+        tails[0, :n - ps] = prompt[ps:]
+        keys = (_threefry_key_data(a.seed) if a.seed is not None
+                else np.zeros((2,), np.uint32))[None]
+        seeded = np.asarray([a.seed is not None], bool)
+        req_idx = np.asarray([self._req_counter], np.int32)
+        self._req_counter += 1
+        self.set_slot_mask(a.slot, a.allowed_tokens)
+        masks = self._masks[a.slot][None]
+        fn = self._admit_prefix[(ps, tb)]
+        self.cache, self.state, first, first_lp, hit_eos, done = fn(
+            self._params, self.cache, self.state, self.pool,
+            np.asarray([a.slot], np.int32), tails,
+            np.asarray([n - ps], np.int32),
+            np.asarray([a.max_tokens], np.int32),
+            np.asarray([a.temperature], np.float32),
+            np.asarray([a.top_k], np.int32),
+            np.asarray([a.top_p], np.float32), keys,
+            np.asarray([_NO_EOS if a.eos_token_id is None
+                        else int(a.eos_token_id)], np.int32),
+            req_idx, seeded, masks, np.int32(a.prefix_page))
+        return first, first_lp, hit_eos, done
 
     def step_async(self) -> StepHandle:
         """Dispatch one decode chunk WITHOUT fetching its outputs: the
@@ -747,7 +1136,10 @@ class Engine:
         its host-side slot snapshot, see
         :mod:`apex_tpu.serving.resilience`). No recompilation: ``init``
         was compiled at construction, so a recompile guard stays armed
-        through recovery."""
+        through recovery. The shared-prefix pool is untouched — it is
+        never donated to a failing step/admit call, so registered
+        templates survive recovery and replayed prefix hits reuse
+        them."""
         self.cache, self.state = self._init(self._params)
         self._masks[:, :] = True
         self._masks_dev = None
@@ -790,23 +1182,71 @@ class Engine:
                 np.zeros((k,), np.int32), np.zeros((k,), bool),
                 np.ones((k, self.cfg.vocab_size), bool))
             np.asarray(first)
+        # prefix pool: compile every pool-insert and (split, tail
+        # bucket) extend variant against page 0 junk
+        if self._prefix_used:
+            raise ValueError(
+                "register_prefix() was called before warmup(): warmup "
+                "resets the pool to shed its compile-time junk, which "
+                "would silently drop the registered templates — call "
+                "warmup() first, then register")
+        for pb, fn in sorted(self._pool_inserts.items()):
+            self.pool = fn(self._params, self.pool,
+                           np.full((1, pb), ecfg.pad_token_id,
+                                   np.int32), np.int32(0))
+        for (ps, tb), fn in sorted(self._admit_prefix.items()):
+            self.cache, self.state, first, _, _, _ = fn(
+                self._params, self.cache, self.state, self.pool,
+                np.zeros((1,), np.int32),
+                np.full((1, tb), ecfg.pad_token_id, np.int32),
+                np.ones((1,), np.int32), np.ones((1,), np.int32),
+                np.zeros((1,), np.float32), np.zeros((1,), np.int32),
+                np.ones((1,), np.float32), np.zeros((1, 2), np.uint32),
+                np.full((1,), _NO_EOS, np.int32),
+                np.zeros((1,), np.int32), np.zeros((1,), bool),
+                np.ones((1, self.cfg.vocab_size), bool), np.int32(0))
+            np.asarray(first)
         handle = self.step_async()
         handle.fetch()
         self.state = self._retire(self.state, np.int32(0))
         # drop the warmup junk: a fresh init (compiled at construction)
         # frees every slot again
         self.cache, self.state = self._init(self._params)
+        if self._prefix_splits:
+            # warmup wrote junk into pool page 0 — reset the pool AND
+            # the host registry, so templates register on clean pages
+            # (register AFTER warmup; the insert programs are compiled
+            # now, so registration never trips a recompile guard)
+            self.pool = self._pool_init(self._params)
+            self._prefix_index.clear()
+            self._prefix_tokens.clear()
+            self._prefix_used = 0
 
     def _admit_variant_name(self, bucket: int, k: int) -> str:
         return f"admit_p{bucket}_k{k}"
+
+    def _prefix_program_items(self):
+        """(name, compiled fn) for every prefix-pool program — shared
+        by :meth:`compiled_cache_sizes` and the recompile sentinel so
+        the two can never disagree on what is tracked."""
+        items = []
+        if self._prefix_splits:
+            items.append(("pool_init", self._pool_init))
+            for pb, fn in sorted(self._pool_inserts.items()):
+                items.append((f"pool_p{pb}", fn))
+            for (ps, tb), fn in sorted(self._admit_prefix.items()):
+                items.append((f"admit_prefix_p{ps}_t{tb}", fn))
+        return items
 
     def compiled_cache_sizes(self) -> Dict[str, Any]:
         """jit-cache entry count per program — the trace-stability
         probe: after warmup each must stay at 1 no matter how many
         requests were admitted (the oracle test asserts this). The
         aggregate ``"admit"`` key is the MAX over the per-(bucket, k)
-        variants (each also reported under ``admit_p{bucket}_k{k}``),
-        so it reads exactly like the single-program days: 1 = stable."""
+        variants (each also reported under ``admit_p{bucket}_k{k}``;
+        prefix-pool extend variants ``admit_prefix_p{split}_t{tail}``
+        count too — they ARE admissions), so it reads exactly like the
+        single-program days: 1 = stable."""
         size_of = lambda fn: (fn._cache_size()
                               if callable(getattr(fn, "_cache_size", None))
                               else None)
@@ -817,6 +1257,11 @@ class Engine:
             s = size_of(fn)
             out[self._admit_variant_name(bucket, k)] = s
             if s is not None:
+                admit_sizes.append(s)
+        for name, fn in self._prefix_program_items():
+            s = size_of(fn)
+            out[name] = s
+            if s is not None and name.startswith("admit_prefix"):
                 admit_sizes.append(s)
         out["admit"] = max(admit_sizes) if admit_sizes else None
         return out
@@ -850,6 +1295,8 @@ class Engine:
                 sentinel.track(name, getattr(self, f"_{name}"))
             for (bucket, k), fn in sorted(self._admits.items()):
                 sentinel.track(self._admit_variant_name(bucket, k), fn)
+            for name, fn in self._prefix_program_items():
+                sentinel.track(name, fn)
             self._sentinel = sentinel
         return self._sentinel
 

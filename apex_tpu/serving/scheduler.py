@@ -199,6 +199,20 @@ class _RegistryMetrics:
             "serving_replayed_tokens_total",
             "tokens re-derived (and suppressed) during deterministic "
             "replay after a rebuild")
+        # -- KV-cache capacity (quantized cache + prefix pool) ------------
+        registry.gauge(
+            "serving_kv_cache_bytes",
+            "device bytes held by the slot KV cache (quantized data + "
+            "scale planes under a quantized kv_cache_dtype)"
+        ).set(engine.cache_bytes())
+        self.prefix_hits = registry.counter(
+            "serving_prefix_hits_total",
+            "submitted requests that matched a pooled shared prefix "
+            "(admission pays the tail bucket only)")
+        self.prefix_misses = registry.counter(
+            "serving_prefix_misses_total",
+            "submitted requests that missed the prefix pool (cold "
+            "prefill at the full prompt bucket)")
 
 
 class _Active:
@@ -316,6 +330,13 @@ class Scheduler:
         #: recovery bookkeeping per interrupted request (cleared at
         #: completion)
         self._replay: Dict[str, _ReplayState] = {}
+        #: prefix-pool hits keyed by request_id — resolved ONCE at
+        #: submit (match_prefix is pure host work) and reused at every
+        #: (re-)admission, so fault replay rides the same (page, split)
+        #: and stays bit-identical
+        self._prefix_hits: Dict[str, Tuple[int, int]] = {}
+        self._prefix_hit_count = 0
+        self._prefix_miss_count = 0
         self._steps = 0
         self._tokens_emitted = 0
         self._admitted_requests = 0
@@ -413,6 +434,16 @@ class Scheduler:
                 f"queue at capacity ({depth}"
                 f"{', injected flood' if flooded else ''}); retry in "
                 f"~{hint:.3f}s", queue_depth=depth, retry_after_s=hint)
+        if self.engine.prefix_pool_enabled:
+            hit = self.engine.match_prefix(prompt)
+            if hit is not None:
+                self._prefix_hits[request.request_id] = hit
+                self._prefix_hit_count += 1
+            else:
+                self._prefix_miss_count += 1
+            if self.telemetry is not None:
+                (self.telemetry.prefix_hits if hit is not None
+                 else self.telemetry.prefix_misses).inc()
         self.queue.append(request)
         if self.telemetry is not None:
             self.telemetry.submitted.inc()
@@ -1047,18 +1078,26 @@ class Scheduler:
                 if r.constraint is not None:
                     r.constraint.reset()
             t_admit = self.clock()
+
+            def _admission(r: Request, slot: int) -> Admission:
+                hit = self._prefix_hits.get(r.request_id)
+                return Admission(
+                    slot=slot, prompt=r.prompt,
+                    max_tokens=r.max_tokens,
+                    temperature=r.sampling.temperature,
+                    top_k=r.sampling.top_k,
+                    top_p=r.sampling.top_p,
+                    seed=r.sampling.seed,
+                    eos_token_id=r.eos_token_id,
+                    allowed_tokens=(
+                        tuple(r.constraint.allowed_tokens())
+                        if r.constraint is not None else None),
+                    prefix_page=None if hit is None else hit[0],
+                    prefix_len=0 if hit is None else hit[1])
+
             try:
                 results = self.engine.admit_many([
-                    Admission(slot=slot, prompt=r.prompt,
-                              max_tokens=r.max_tokens,
-                              temperature=r.sampling.temperature,
-                              top_k=r.sampling.top_k,
-                              top_p=r.sampling.top_p,
-                              seed=r.sampling.seed,
-                              eos_token_id=r.eos_token_id,
-                              allowed_tokens=(
-                                  tuple(r.constraint.allowed_tokens())
-                                  if r.constraint is not None else None))
+                    _admission(r, slot)
                     for r, slot in zip(reqs, slots)])
             except Exception as e:  # device error escaping the admit
                 self._recover(self.clock(), cause="admit", detail=str(e),
@@ -1143,6 +1182,7 @@ class Scheduler:
     def _complete(self, request: Request, tokens: List[int], reason: str,
                   *, ttft: Optional[float], now: float,
                   logprobs: Optional[List[float]] = None) -> None:
+        self._prefix_hits.pop(request.request_id, None)
         arrival = request.arrival_time if request.arrival_time is not None \
             else now
         comp = Completion(request.request_id, list(tokens), reason,
@@ -1196,6 +1236,11 @@ class Scheduler:
             "shed": float(self._shed),
             "watchdog_trips": float(self._watchdog_trips),
             "health_state": float(self.health.code),
+            # KV-cache capacity: slot-cache device bytes (quantized
+            # data + scales) and the prefix pool's admission savings
+            "cache_bytes": float(self.engine.cache_bytes()),
+            "prefix_hits": float(self._prefix_hit_count),
+            "prefix_misses": float(self._prefix_miss_count),
         }
         if elapsed:
             out["tokens_per_sec"] = self._tokens_emitted / elapsed

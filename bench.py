@@ -16,6 +16,7 @@ measured / 58600.
 
 import argparse
 import json
+import os
 import time
 
 import jax
@@ -400,11 +401,113 @@ def serve(telemetry_out=None, api=False):
             "pipelined_ttft_mean_ms": round(
                 best["pipelined"]["ttft_mean_ms"], 2),
         }
+    # KV-cache capacity A/B #1 — quantized cache: int8 storage vs the
+    # compute-dtype cache on the warm chunk=8 trace (interleaved
+    # best-of-reps). Cache bytes per slot is the headline (the
+    # throughput ceiling under heavy traffic); steady decode rides
+    # along. Quantization CHANGES numerics, so the int8 side is
+    # excluded from the sweep-wide bit-parity assert — its own rerun
+    # stability is still pinned by measure_ab.
+    cfg_q = dataclasses.replace(cfg, kv_cache_dtype="int8")
+    eng_q = Engine(cfg_q, params, mesh,
+                   dataclasses.replace(ecfg, decode_chunk=8))
+    eng_q.warmup()
+    kv_sides = measure_ab([
+        ("kv_int8", eng_q, dict(pipeline_depth=2)),
+        ("kv_base", engine, dict(pipeline_depth=2)),
+    ])
+    bytes_q, bytes_b = eng_q.cache_bytes(), engine.cache_bytes()
+    kv_ab = {
+        "base_cache_bytes_per_slot": bytes_b // ecfg.slots,
+        "int8_cache_bytes_per_slot": bytes_q // ecfg.slots,
+        "bytes_ratio": round(bytes_b / bytes_q, 3),
+        "base_decode_tokens_per_sec": round(
+            kv_sides["kv_base"].get("decode_tokens_per_sec", 0.0), 1),
+        "int8_decode_tokens_per_sec": round(
+            kv_sides["kv_int8"].get("decode_tokens_per_sec", 0.0), 1),
+    }
+    eng_q.close()
+
+    # KV-cache capacity A/B #2 — shared-prefix reuse: every request
+    # shares one long pooled template (half the prompt); the hit side
+    # admits by compiled gather + tail-only prefill at the TAIL
+    # bucket, the cold side full-prefills at the full prompt bucket.
+    # Both sides run k=1 admissions (max_admit_batch=1) so the number
+    # measured is PER-ADMISSION latency (TTFT), not the k-ladder's
+    # amortisation — prefix hits ride k=1 extend programs, and letting
+    # the cold side batch would compare different dispatch counts.
+    # Token streams must be BIT-identical (prefix reuse is an
+    # admission-cost play, not a numerics play).
+    mpl_p = min(2 * ecfg.max_prompt_len, cfg.seq_len // 2)
+    ecfg_p = dataclasses.replace(
+        ecfg, decode_chunk=8, max_prompt_len=mpl_p,
+        max_seq_len=mpl_p + 16)
+    tlen = mpl_p // 2
+    template = [int(t) for t in jax.random.randint(
+        jax.random.PRNGKey(900), (tlen,), 0, cfg.vocab_size)]
+    eng_pref = Engine(cfg, params, mesh, dataclasses.replace(
+        ecfg_p, prefix_pool_slots=1))
+    eng_pref.warmup()
+    eng_pref.register_prefix(template)
+    eng_cold = Engine(cfg, params, mesh, ecfg_p)
+    eng_cold.warmup()
+
+    def prefix_trace():
+        reqs = []
+        for i in range(n_requests):
+            tail = [int(t) for t in jax.random.randint(
+                jax.random.PRNGKey(910 + i), (1 + i % 8,), 0,
+                cfg.vocab_size)]
+            sp = (SamplingParams(temperature=0.9, top_k=40, seed=i)
+                  if i % 2 else SamplingParams())
+            reqs.append(Request(f"p{i}", template + tail,
+                                max_tokens=8, sampling=sp))
+        return reqs
+
+    best_pref = {}
+    ptoks = {}
+    for _ in range(reps):
+        for name, eng in (("hit", eng_pref), ("cold", eng_cold)):
+            toks, s = run(eng, prefix_trace(), pipeline_depth=2,
+                          max_admit_batch=1)
+            ptoks.setdefault(name, toks)
+            assert ptoks[name] == toks, f"prefix {name} rerun drift"
+            if name not in best_pref or s["ttft_mean_ms"] < \
+                    best_pref[name]["ttft_mean_ms"]:
+                best_pref[name] = s
+    # bit-parity holds when cold prefill runs the materialised-scores
+    # attention (prefill_extend's expression — the CPU mesh and any
+    # xla attn_impl config); under flash prefill the two differ at the
+    # reduction-order ulp level, so drift is REPORTED, not asserted
+    # (docs/DESIGN.md "Serving round 6" known limits)
+    pref_drift = sum(1 for k in ptoks["hit"]
+                     if ptoks["hit"][k] != ptoks["cold"][k])
+    if not on_tpu or cfg.attn_impl == "xla":
+        assert pref_drift == 0, "prefix-hit token drift"
+    hit_rate = best_pref["hit"]["prefix_hits"] / max(
+        best_pref["hit"]["prefix_hits"]
+        + best_pref["hit"]["prefix_misses"], 1)
+    prefix_ab = {
+        "split": tlen,
+        "cold_bucket": eng_cold.bucket_for(tlen + 1),
+        "hit_ttft_mean_ms": round(best_pref["hit"]["ttft_mean_ms"], 2),
+        "cold_ttft_mean_ms": round(best_pref["cold"]["ttft_mean_ms"], 2),
+        "ttft_speedup": round(best_pref["cold"]["ttft_mean_ms"]
+                              / max(best_pref["hit"]["ttft_mean_ms"],
+                                    1e-9), 3),
+        "hit_rate": round(hit_rate, 3),
+        "token_drift": pref_drift,
+    }
+    eng_pref.close()
+    eng_cold.close()
+
     # the loop/admission knobs must not change a single emitted token —
     # sweep-wide: every chunk setting, serial vs pipelined, flat vs
-    # bucketed/batched admission
+    # bucketed/batched admission (the int8 side is numerics-excluded
+    # above)
     base = tokens_by_cfg["chunk1"]
-    drift = [k for k, v in tokens_by_cfg.items() if v != base]
+    drift = [k for k, v in tokens_by_cfg.items()
+             if k != "kv_int8" and v != base]
     assert not drift, f"serve sweep token drift in {drift}"
     api_line = None
     if api:
@@ -435,9 +538,12 @@ def serve(telemetry_out=None, api=False):
         "ttft_p99_ms": head["ttft_p99_ms"],
         "decode_tokens_per_sec": head["decode_tokens_per_sec"],
         "token_latency_mean_ms": head["token_latency_mean_ms"],
+        "cache_bytes_per_slot": engine.cache_bytes() // ecfg.slots,
         "chunk_sweep": sweep,
         "pipeline_ab": pipeline_ab,
         "bucket_ab": bucket_ab,
+        "kv_cache_ab": kv_ab,
+        "prefix_ab": prefix_ab,
     }
     if not on_tpu:
         line["probe_ab_1l32h"] = line_probe
@@ -449,6 +555,24 @@ def serve(telemetry_out=None, api=False):
         with open(telemetry_out, "w") as f:
             json.dump(registry.to_dict(), f, indent=1, sort_keys=True)
         line["telemetry_out"] = telemetry_out
+    # trajectory file: one compact line per serve-bench run, appended —
+    # the BENCH_serve.json series tracks the serving headline (tok/s,
+    # TTFT, cache bytes/slot, prefix-hit economics) across PRs
+    traj = {
+        "metric": line["metric"],
+        "tokens_per_sec": line["value"],
+        "decode_tokens_per_sec": line["decode_tokens_per_sec"],
+        "ttft_mean_ms": line["ttft_mean_ms"],
+        "cache_bytes_per_slot": line["cache_bytes_per_slot"],
+        "kv_int8_bytes_ratio": kv_ab["bytes_ratio"],
+        "prefix_hit_rate": prefix_ab["hit_rate"],
+        "prefix_ttft_speedup": prefix_ab["ttft_speedup"],
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_serve.json")
+    with open(path, "a") as f:
+        f.write(json.dumps(traj) + "\n")
+    line["bench_out"] = os.path.basename(path)
     print(json.dumps(line))
 
 

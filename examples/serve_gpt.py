@@ -93,14 +93,20 @@ def load_requests(path, vocab_size):
     return reqs
 
 
-def synthetic_requests(n, prompt_len, max_tokens, vocab_size):
+def synthetic_requests(n, prompt_len, max_tokens, vocab_size,
+                       prefix=None):
     """Seeded stand-in trace: half greedy, half sampled; every third
-    request carries a stop sequence (trimmed emission when it fires)."""
+    request carries a stop sequence (trimmed emission when it fires).
+    With ``prefix`` (a pooled template's token list), every other
+    request's prompt starts with it — the many-users-one-template
+    workload prefix reuse exists for."""
     reqs = []
     for i in range(n):
-        prompt = [int(t) for t in jax.random.randint(
+        tail = [int(t) for t in jax.random.randint(
             jax.random.PRNGKey(1000 + i), (1 + (prompt_len + i) %
                                            prompt_len,), 0, vocab_size)]
+        prompt = (list(prefix) + tail[:2]) if prefix and i % 2 == 0 \
+            else tail
         sp = (SamplingParams(temperature=0.9, top_k=20, seed=i)
               if i % 2 else SamplingParams())
         stop = [[(17 * i + 3) % vocab_size,
@@ -154,11 +160,24 @@ def main():
                     "seams: 'random:SEED[:N]' or a comma list of "
                     "point:index:kind[:arg] (see "
                     "apex_tpu.serving.resilience.parse_fault_plan)")
+    ap.add_argument("--kv-cache-dtype", default="auto",
+                    choices=("auto", "bf16", "int8", "fp8"),
+                    help="KV-cache storage: int8/fp8 store quantized "
+                    "K/V with per-head per-position fp32 scales "
+                    "(~2x bf16 / ~4x f32 fewer cache bytes per slot)")
+    ap.add_argument("--prefix-template", metavar="IDS", action="append",
+                    default=None,
+                    help="comma-separated token ids of a shared prompt "
+                    "prefix to pool (repeatable): prompts starting "
+                    "with it admit by pooled-K/V copy + tail-only "
+                    "prefill; synthetic traces prepend the first "
+                    "template to half the prompts")
     args = ap.parse_args()
 
     cfg = gpt.GPTConfig(vocab_size=1024, hidden_size=128, num_layers=4,
                         num_heads=4, seq_len=128, remat=False,
-                        compute_dtype=jnp.float32)
+                        compute_dtype=jnp.float32,
+                        kv_cache_dtype=args.kv_cache_dtype)
     # tp-only mesh: decode state is replicated over dp/pp, so the engine
     # takes exactly tp devices (build_mesh would default dp to fill)
     mesh = mx.build_mesh(tp=args.tp, devices=jax.devices()[:args.tp])
@@ -180,17 +199,25 @@ def main():
 
         fault_plan = parse_fault_plan(args.fault_plan)
         print(f"fault plan: {[s.describe() for s in fault_plan.specs]}")
+    templates = [[int(t) for t in spec.split(",")]
+                 for spec in (args.prefix_template or ())]
     engine = Engine(cfg, params, mesh, EngineConfig(
         slots=args.slots, max_prompt_len=args.max_prompt_len,
-        max_seq_len=args.max_seq_len, decode_chunk=args.decode_chunk),
+        max_seq_len=args.max_seq_len, decode_chunk=args.decode_chunk,
+        prefix_pool_slots=len(templates)),
         fault_plan=fault_plan)
     # compile every program (init/step/retire + each (bucket, k)
-    # admission variant) before the first request — admission never
-    # traces mid-serve, and recompile_guard could be armed right here
+    # admission variant + prefix pool inserts/extends) before the first
+    # request — admission never traces mid-serve, and recompile_guard
+    # could be armed right here
     engine.warmup()
+    for t in templates:  # after warmup (which resets the pool)
+        engine.register_prefix(t)
     reqs = (load_requests(args.requests, cfg.vocab_size) if args.requests
             else synthetic_requests(args.num_requests, 8, args.max_tokens,
-                                    cfg.vocab_size))
+                                    cfg.vocab_size,
+                                    prefix=templates[0] if templates
+                                    else None))
 
     # telemetry: spans whenever a trace is requested; the registry +
     # process-wide recompile sentinel only when there is a /metrics

@@ -48,3 +48,61 @@ def devices8():
         "tests require 8 simulated devices; conftest must run before backend init"
     )
     return devs[:8]
+
+
+# --- tier-1 marker audit -----------------------------------------------------
+#
+# The tier-1 run (-m 'not slow') has a hard wall-clock budget
+# (ROADMAP.md). A test that quietly grows past ~60 s belongs behind the
+# `slow` marker — this hook turns such a test's own PASSING report into
+# a failure naming it, so the budget stays honest as suites grow
+# instead of eroding one slow test at a time. Tunable/disable-able via
+# APEX_TPU_TIER1_BUDGET_S (0 disables — e.g. profiling runs under a
+# debugger, where wall time means nothing).
+#
+# The audit only arms on a WARM compile cache: per-test wall time
+# includes XLA compiles, and a cold .jax_cache (fresh clone, wiped
+# cache — the suite is ~25 min cold vs ~10 min warm) would spuriously
+# fail compile-heavy tests that are well inside budget warm. An
+# explicit APEX_TPU_TIER1_BUDGET_S overrides the heuristic either way.
+
+
+def _compile_cache_warm(min_entries: int = 500) -> bool:
+    d = jax.config.jax_compilation_cache_dir
+    try:
+        return d is not None and len(os.listdir(d)) >= min_entries
+    except OSError:
+        return False
+
+
+TIER1_BUDGET_S = (
+    float(os.environ["APEX_TPU_TIER1_BUDGET_S"])
+    if "APEX_TPU_TIER1_BUDGET_S" in os.environ
+    else (60.0 if _compile_cache_warm() else 0.0))
+
+
+def audit_overtime(duration_s: float, has_slow_marker: bool,
+                   budget_s: float = TIER1_BUDGET_S) -> bool:
+    """THE audit predicate (unit-tested in test_marker_audit.py): an
+    unmarked test over the budget is an offender; slow-marked tests are
+    exempt at any duration, and a non-positive budget disables the
+    audit."""
+    return budget_s > 0 and duration_s > budget_s and not has_slow_marker
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.when != "call" or not rep.passed:
+        return  # only audit tests that would otherwise pass
+    if audit_overtime(rep.duration,
+                      item.get_closest_marker("slow") is not None):
+        rep.outcome = "failed"
+        rep.longrepr = (
+            f"tier-1 marker audit: {item.nodeid} took "
+            f"{rep.duration:.1f}s > {TIER1_BUDGET_S:.0f}s without "
+            f"@pytest.mark.slow — mark it slow (it runs in the soak "
+            f"tier) or make it faster; the tier-1 budget is a hard "
+            f"timeout (ROADMAP.md). Set APEX_TPU_TIER1_BUDGET_S to "
+            f"tune/disable.")

@@ -17,6 +17,10 @@ from jax.sharding import PartitionSpec as P
 
 from apex_tpu import mesh as mx
 from apex_tpu.kernels import decode_attention
+from apex_tpu.kernels.decode_attention import (
+    decode_attention_quantized,
+    quantize_kv_rows,
+)
 from apex_tpu.models import gpt
 from apex_tpu.transformer.testing import standalone_gpt_config
 
@@ -141,6 +145,91 @@ def test_decode_step_kernel_matches_xla(devices8, dtype):
                                    **tol)
         np.testing.assert_allclose(got_c, want_c, err_msg=f"tp{tp}",
                                    **tol)
+
+
+_QTOL = {"int8": dict(rtol=3e-2, atol=3e-2),
+         "fp8": dict(rtol=6e-2, atol=6e-2)}
+
+
+@pytest.mark.parametrize("kind", ["int8", "fp8"])
+def test_quantized_kernel_matches_fp32_reference(kind):
+    """Quantized-cache kernel oracle: output within the quantization
+    error band of the unquantized fp32 reference, and the one-column
+    write contract holds on BOTH planes — outside the written column
+    the int8/fp8 data and fp32 scales are bit-identical to the input,
+    the column holds exactly ``quantize_kv_rows(new)``."""
+    b, h, S, d = 3, 4, 19, 32
+    ks = jax.random.split(jax.random.PRNGKey(3), 5)
+    mk = lambda k, shp: jax.random.normal(k, shp) * 0.5
+    q = mk(ks[0], (b, h, d))
+    k_new = mk(ks[1], (b, h, d))
+    v_new = mk(ks[2], (b, h, d))
+    k_raw = mk(ks[3], (b, h, S, d))
+    v_raw = mk(ks[4], (b, h, S, d))
+    kq0, ks0 = quantize_kv_rows(k_raw, kind)
+    vq0, vs0 = quantize_kv_rows(v_raw, kind)
+    pos = jnp.asarray([2, 0, 18], jnp.int32)
+    out, kq, ksc, vq, vsc = jax.jit(
+        lambda *a: decode_attention_quantized(
+            *a, kind=kind))(q, k_new, v_new, kq0, ks0, vq0, vs0, pos)
+    # reference: unquantized fp32 math over the DEQUANTIZED cache (the
+    # cache held quantized values; the new column is exact pre-quant)
+    deq = lambda qv, s: np.asarray(qv, np.float32) * np.asarray(
+        s, np.float32)[..., None]
+    ref_out, _, _ = _reference(q, k_new, v_new, deq(kq0, ks0),
+                               deq(vq0, vs0), pos)
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref_out,
+                               **_QTOL[kind])
+    # write contract, both planes
+    col = np.zeros((b, h, S), bool)
+    for i in range(b):
+        col[i, :, int(pos[i])] = True
+    for got, orig, new in ((kq, kq0, k_new), (vq, vq0, v_new)):
+        got = np.asarray(got, np.float32)
+        orig = np.asarray(orig, np.float32)
+        np.testing.assert_array_equal(got[~col], orig[~col])
+        want_q, _ = quantize_kv_rows(new, kind)
+        np.testing.assert_array_equal(
+            got[col].reshape(b, h, d), np.asarray(want_q, np.float32))
+    for got, orig, new in ((ksc, ks0, k_new), (vsc, vs0, v_new)):
+        got, orig = np.asarray(got), np.asarray(orig)
+        np.testing.assert_array_equal(got[~col], orig[~col])
+        _, want_s = quantize_kv_rows(new, kind)
+        np.testing.assert_array_equal(got[col].reshape(b, h),
+                                      np.asarray(want_s))
+
+
+def test_quantized_kernel_masks_stale_garbage():
+    """Positions past a row's ``pos`` are exact softmax zeros even when
+    the quantized tail holds saturated garbage and the scale plane
+    holds NaN (an uninitialised-HBM bit pattern a fresh fp32 plane can
+    legally contain)."""
+    b, h, S, d = 2, 2, 12, 32
+    ks = jax.random.split(jax.random.PRNGKey(5), 5)
+    q = jax.random.normal(ks[0], (b, h, d))
+    k_new = jax.random.normal(ks[1], (b, h, d))
+    v_new = jax.random.normal(ks[2], (b, h, d))
+    kq0, ks0 = quantize_kv_rows(
+        jax.random.normal(ks[3], (b, h, S, d)), "int8")
+    vq0, vs0 = quantize_kv_rows(
+        jax.random.normal(ks[4], (b, h, S, d)), "int8")
+    pos = jnp.asarray([3, 7], jnp.int32)
+    tail3 = jnp.arange(S)[None, None, :] > pos[:, None, None]
+    tail4 = tail3[..., None]
+    run = jax.jit(lambda *a: decode_attention_quantized(
+        *a, kind="int8"))
+    out_clean, *_ = run(q, k_new, v_new,
+                        jnp.where(tail4, 0, kq0),
+                        jnp.where(tail3, 0.0, ks0),
+                        jnp.where(tail4, 0, vq0),
+                        jnp.where(tail3, 0.0, vs0), pos)
+    out_junk, *_ = run(q, k_new, v_new,
+                       jnp.where(tail4, jnp.int8(-127), kq0),
+                       jnp.where(tail3, jnp.float32(jnp.nan), ks0),
+                       jnp.where(tail4, jnp.int8(127), vq0),
+                       jnp.where(tail3, jnp.float32(jnp.nan), vs0), pos)
+    np.testing.assert_array_equal(np.asarray(out_clean),
+                                  np.asarray(out_junk))
 
 
 def test_decode_attention_validation():

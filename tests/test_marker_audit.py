@@ -1,0 +1,27 @@
+"""Unit pins for the tier-1 marker audit (tests/conftest.py): any test
+over the wall-clock budget without the ``slow`` marker fails with an
+actionable message, keeping the tier-1 budget honest as suites grow.
+The predicate is tested directly; the report-mutation hook is exercised
+implicitly by every tier-1 run (each passing test flows through it)."""
+
+from conftest import TIER1_BUDGET_S, audit_overtime
+
+
+def test_audit_predicate_arms():
+    # unmarked + over budget = offender
+    assert audit_overtime(61.0, False, budget_s=60.0)
+    # slow-marked tests are exempt at any duration
+    assert not audit_overtime(10_000.0, True, budget_s=60.0)
+    # under budget passes unmarked
+    assert not audit_overtime(59.9, False, budget_s=60.0)
+    # budget <= 0 disables the audit entirely
+    assert not audit_overtime(10_000.0, False, budget_s=0.0)
+    assert not audit_overtime(10_000.0, False, budget_s=-1.0)
+
+
+def test_audit_default_budget_sane():
+    """The default budget is either 0 (cold compile cache — per-test
+    wall time would be compile-dominated, the audit auto-disarms) or
+    within the same order as the documented ~60 s CPU-mesh bound — a
+    silent bump to hours would defeat the audit."""
+    assert TIER1_BUDGET_S == 0.0 or 0 < TIER1_BUDGET_S <= 300.0
