@@ -14,6 +14,9 @@ from apex_tpu.kernels.softmax import (
 )
 from apex_tpu.kernels.xentropy import softmax_cross_entropy
 from apex_tpu.kernels.decode_attention import (
+    cache_write_columns,
+    cache_write_columns_quant,
+    cache_write_columns_xla,
     decode_attention,
     decode_attention_quantized,
     kv_storage_dtype,
@@ -43,6 +46,9 @@ __all__ = [
     "scaled_masked_softmax",
     "scaled_upper_triang_masked_softmax",
     "softmax_cross_entropy",
+    "cache_write_columns",
+    "cache_write_columns_quant",
+    "cache_write_columns_xla",
     "decode_attention",
     "decode_attention_quantized",
     "kv_storage_dtype",

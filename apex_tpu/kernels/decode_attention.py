@@ -118,6 +118,154 @@ def _write_column(k_new, v_new, k_cache, v_cache, pos):
 
 
 # ---------------------------------------------------------------------------
+# multi-column write: cache[b, :, pos[b] + j, :] = new[b, :, j, :]
+# (the speculative verify forward's cache landing — T = draft k + 1
+# columns per row per wave)
+# ---------------------------------------------------------------------------
+
+def _write_cols_kernel(pos_ref, kn_ref, vn_ref, ki_ref, vi_ref, ko_ref,
+                       vo_ref):
+    del pos_ref, ki_ref, vi_ref  # pos drives the index map; caches are
+    #                              aliased to the outputs, never read here
+    ko_ref[...] = kn_ref[...]    # blocks are (1, h, 1, d) on both sides
+    vo_ref[...] = vn_ref[...]
+
+
+def cache_write_columns(k_new, v_new, k_cache, v_cache, pos):
+    """Write ``k_new/v_new [b, h, T, d]`` into columns ``pos[b] .. pos[b]
+    + T - 1`` of the caches ``[b, h, S, d]`` — the T-column
+    generalisation of the one-column scalar-prefetch write: grid
+    ``(b, T)``, each step landing one ``[h, 1, d]`` block at block index
+    ``pos[b] + j`` with the caches aliased input→output, so only the T
+    touched columns move and the rest of the cache stays in place.
+
+    Columns past the horizon are CLAMPED onto ``S - 1``: a row whose
+    tail lanes overrun the cache end (a near-budget slot drafting past
+    its horizon, or a done slot's frozen lanes) smashes only the last
+    column. That can never corrupt an emitted token: a lane's draw is
+    only emitted when the row's remaining budget covers it, and the
+    engine bounds ``pos + remaining <= S - 1`` — so any lane whose
+    query would attend column ``S - 1`` (``pos + j = S - 1``) needs
+    ``remaining >= j + 1 = S - pos``, a contradiction. Column ``S - 1``
+    is therefore only ever read by discarded lanes, and only ever
+    holds a real token's K/V once the row is done (frozen done-row
+    writes) — the same masked-garbage contract every over-position
+    cache entry already lives under."""
+    b, h, sk, d = k_cache.shape
+    t = k_new.shape[2]
+    new_spec = pl.BlockSpec((1, h, 1, d), lambda i, j, pos_ref: (i, 0, j, 0))
+    col_spec = pl.BlockSpec(
+        (1, h, 1, d),
+        lambda i, j, pos_ref: (i, 0, jnp.minimum(pos_ref[i] + j, sk - 1),
+                               0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, t),
+        in_specs=[new_spec, new_spec,
+                  pl.BlockSpec(memory_space=pltpu.ANY),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=[col_spec, col_spec],
+    )
+    return pl.pallas_call(
+        _write_cols_kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+                   jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype)],
+        # operand order: (pos, k_new, v_new, k_cache, v_cache)
+        input_output_aliases={3: 0, 4: 1},
+        interpret=use_interpret(),
+    )(jnp.asarray(pos, jnp.int32), k_new.astype(k_cache.dtype),
+      v_new.astype(v_cache.dtype), k_cache, v_cache)
+
+
+def cache_write_columns_xla(cache, new, pos):
+    """The XLA (one-hot select) spelling of the multi-column masked
+    write, one plane at a time: ``cache [b, h, S, d]`` (or a scale
+    plane ``[b, h, S]``) gains ``new [b, h, T, d]`` (/``[b, h, T]``) at
+    columns ``pos[b] + j``; columns at or past ``S`` are dropped (the
+    write guard the verify forward relies on — an over-horizon lane
+    must not clamp into a neighbouring column). This is the vector-pos
+    one-hot rewrite the one-column Pallas kernel exists to remove,
+    generalised to T columns — the CPU-testable correctness backbone
+    and the off-TPU path, exactly like the rest of this module."""
+    sk = cache.shape[2]
+    t = new.shape[2]
+    pos = jnp.asarray(pos, jnp.int32)
+    cols = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None]  # [b, T]
+    # onehot [b, T, S]: lane j of row b lands at column pos[b] + j;
+    # over-horizon lanes have no hit (arange(S) never reaches them)
+    onehot = (jnp.arange(sk, dtype=jnp.int32)[None, None]
+              == cols[:, :, None])
+    if cache.ndim == 4:
+        gathered = jnp.einsum(
+            "bts,bhtd->bhsd", onehot.astype(cache.dtype), new.astype(
+                cache.dtype))
+        hit = onehot.any(axis=1)[:, None, :, None]
+    elif cache.ndim == 3:
+        gathered = jnp.einsum(
+            "bts,bht->bhs", onehot.astype(cache.dtype),
+            new.astype(cache.dtype))
+        hit = onehot.any(axis=1)[:, None, :]
+    else:
+        raise ValueError(
+            f"cache plane must be [b, h, S(, d)], got rank {cache.ndim}")
+    return jnp.where(hit, gathered, cache)
+
+
+def _write_cols_kernel_quant(pos_ref, kn_ref, vn_ref, kqi_ref, ksi_ref,
+                             vqi_ref, vsi_ref, kq_ref, ks_ref, vq_ref,
+                             vs_ref, *, kind):
+    del pos_ref, kqi_ref, ksi_ref, vqi_ref, vsi_ref
+    kq, ks = quantize_kv_rows(kn_ref[:, :, 0], kind)     # (1, h, d)/(1, h)
+    vq, vs = quantize_kv_rows(vn_ref[:, :, 0], kind)
+    kq_ref[...] = kq[:, :, None]
+    ks_ref[...] = ks[:, :, None]
+    vq_ref[...] = vq[:, :, None]
+    vs_ref[...] = vs[:, :, None]
+
+
+def cache_write_columns_quant(k_new, v_new, k_q, k_s, v_q, v_s, pos,
+                              kind):
+    """:func:`cache_write_columns` over the quantized cache layout:
+    each of the T incoming ``[h, d]`` rows is quantized IN-KERNEL
+    (:func:`quantize_kv_rows` — the one deterministic quantizer) and
+    lands one quantized column plus one fp32 scale column at ``pos[b] +
+    j`` across all four planes; same clamped over-horizon contract as
+    the plain variant."""
+    k_new, _ = widen_f16(k_new)   # Mosaic has no f16; the quantizer
+    v_new, _ = widen_f16(v_new)   # runs fp32 internally anyway
+    b, h, sk, d = k_q.shape
+    t = k_new.shape[2]
+    new_spec = pl.BlockSpec((1, h, 1, d),
+                            lambda i, j, pos_ref: (i, 0, j, 0))
+    col = lambda i, j, pos_ref: (i, 0, jnp.minimum(pos_ref[i] + j,
+                                                   sk - 1), 0)
+    scol = lambda i, j, pos_ref: (i, 0, jnp.minimum(pos_ref[i] + j,
+                                                    sk - 1))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, t),
+        in_specs=[new_spec, new_spec]
+        + [pl.BlockSpec(memory_space=pltpu.ANY)] * 4,
+        out_specs=[pl.BlockSpec((1, h, 1, d), col),
+                   pl.BlockSpec((1, h, 1), scol),
+                   pl.BlockSpec((1, h, 1, d), col),
+                   pl.BlockSpec((1, h, 1), scol)],
+    )
+    return pl.pallas_call(
+        functools.partial(_write_cols_kernel_quant, kind=kind),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(k_q.shape, k_q.dtype),
+                   jax.ShapeDtypeStruct(k_s.shape, k_s.dtype),
+                   jax.ShapeDtypeStruct(v_q.shape, v_q.dtype),
+                   jax.ShapeDtypeStruct(v_s.shape, v_s.dtype)],
+        # operand order: (pos, k_new, v_new, k_q, k_s, v_q, v_s)
+        input_output_aliases={3: 0, 4: 1, 5: 2, 6: 3},
+        interpret=use_interpret(),
+    )(jnp.asarray(pos, jnp.int32), k_new, v_new, k_q, k_s, v_q, v_s)
+
+
+# ---------------------------------------------------------------------------
 # split-K read: one query row against its masked cache horizon
 # ---------------------------------------------------------------------------
 

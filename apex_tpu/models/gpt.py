@@ -47,6 +47,9 @@ from apex_tpu.kernels import (
     layer_norm,
 )
 from apex_tpu.kernels.decode_attention import (
+    cache_write_columns as _cache_write_columns,
+    cache_write_columns_quant as _cache_write_columns_quant,
+    cache_write_columns_xla as _cache_write_columns_xla,
     kv_storage_dtype as _kv_storage_dtype,
     quantize_kv_rows as _quantize_kv_rows_impl,
 )
@@ -1165,7 +1168,9 @@ def _decode_attend(cfg: GPTConfig, q, k_new, v_new, kv, pos):
         new_kv = jnp.stack([k_cache, v_cache])
     # scale folded into q BEFORE the einsum: the unscaled dot
     # product overflows fp16's 65504 range (same guard as the
-    # training path's compute-dtype branch)
+    # training path's compute-dtype branch). Keep in lockstep with
+    # _decode_attend_multi's read — the spec == plain parity oracle
+    # depends on the two expressions staying per-element identical
     q = q * jnp.asarray(1.0 / np.sqrt(d), q.dtype)
     scores = jnp.einsum(
         "bhd,bhsd->bhs", q, k_cache).astype(jnp.float32)
@@ -1344,6 +1349,341 @@ def decode_steps(cfg: GPTConfig, params, cache, state, n: int, *,
     # scan stacks on the leading (step) dim → [B, n]
     return (cache, state, jnp.transpose(toks, (1, 0)),
             jnp.transpose(lps, (1, 0)), jnp.transpose(fins, (1, 0)))
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding: draft-k-verify inside the compiled chunk loop
+# ---------------------------------------------------------------------------
+
+def shift_hist(hist, toks, m):
+    """Shift ``m[b]`` newly emitted tokens (the PREFIX of ``toks [B,
+    n]`` — emitted columns are always a prefix) into the drafter's
+    history ring ``hist [B, H]`` (oldest-first). THE ring-shift
+    expression, shared by the speculative scan body and the engine's
+    plain-chunk hist refresh so the two can never drift."""
+    h = hist.shape[1]
+    ext = jnp.concatenate([hist, toks], axis=1)
+    return jnp.take_along_axis(
+        ext, m[:, None] + jnp.arange(h, dtype=jnp.int32)[None], axis=1)
+
+
+def ngram_drafts(hist, tok, k: int):
+    """Device-side n-gram drafter: propose ``k`` candidate
+    continuations of ``tok [B] int32`` from each row's recent token
+    history ``hist [B, H] int32`` (oldest-first ring, ``-1`` sentinel
+    in unfilled slots — sentinels never match a real token). Returns
+    drafts ``[B, k] int32``.
+
+    Per draft: find the LATEST earlier occurrence of the current
+    2-token suffix in the window (history + current token + drafts so
+    far) and propose the token that followed it; fall back to the
+    latest 1-token match, then to repeating the current token. Each
+    accepted draft extends the match window, so a k-draft chain can
+    replay a whole remembered cycle — exactly the repetitive-output
+    regime (greedy decode attractors, templated continuations) where
+    free drafts pay. All shapes static; ~O(B·(H+k)) integer compares
+    per draft — noise next to one target forward."""
+    if k < 1:
+        raise ValueError(f"ngram_drafts needs k >= 1, got {k}")
+    win = jnp.concatenate([jnp.asarray(hist, jnp.int32),
+                           tok[:, None].astype(jnp.int32)], axis=1)
+    out = []
+    for _ in range(k):
+        b, w = win.shape
+        ctx = win[:, -1]
+        prev = win[:, -2]
+        body = win[:, :-1]                       # candidate positions
+        # prevcol[m] = win[m-1] (m = 0 gets a never-matching sentinel)
+        prevcol = jnp.concatenate(
+            [jnp.full((b, 1), -2, jnp.int32), win[:, :-2]], axis=1)
+        idx = jnp.arange(w - 1, dtype=jnp.int32)[None]
+        hit1 = body == ctx[:, None]
+        m1 = jnp.max(jnp.where(hit1, idx, -1), axis=1)
+        m2 = jnp.max(jnp.where(hit1 & (prevcol == prev[:, None]), idx,
+                               -1), axis=1)
+        m = jnp.where(m2 >= 0, m2, m1)
+        succ = jnp.take_along_axis(
+            win, jnp.clip(m + 1, 0, w - 1)[:, None], axis=1)[:, 0]
+        d = jnp.where((m >= 0) & (succ >= 0), succ, ctx)
+        out.append(d)
+        win = jnp.concatenate([win, d[:, None]], axis=1)
+    return jnp.stack(out, axis=1)
+
+
+def _decode_attend_multi(cfg: GPTConfig, q, k_new, v_new, kv, pos):
+    """:func:`_decode_attend` for ``T`` tokens per row at positions
+    ``pos[b] .. pos[b] + T - 1`` — the speculative verify forward's
+    attention core. ``q/k_new/v_new [b, heads, T, d]``; writes all T
+    K/V columns (multi-column masked write — over-horizon lanes are
+    dropped/clamped into the masked-garbage region, see
+    :func:`apex_tpu.kernels.cache_write_columns_xla`), then attends
+    each query row ``t`` over cache columns ``0 .. pos[b] + t`` with
+    the SAME materialised-scores expression as the plain XLA decode
+    path — per-row values bit-identical to T sequential
+    :func:`_decode_attend` steps (the causal-exactness argument of
+    :func:`prefill_at`, applied to the cache horizon), which is what
+    the greedy spec == plain oracle stands on. The kernel impl uses
+    the Pallas multi-column write (one ``[h, 1, d]`` block per lane in
+    place) but keeps the materialised read: T is tiny (draft k + 1)
+    and a T-row split-K sweep is future work (docs/DESIGN.md)."""
+    b, heads, t, d = q.shape
+    kind = _kv_cache_dtype(cfg)
+    quant = kind != "compute"
+    kvq = kv["kv"] if quant else kv
+    s_max = kvq.shape[3]
+    use_kernel = _decode_attn_impl(cfg, s_max) == "kernel"
+    if use_kernel:
+        if quant:
+            kq, ks, vq, vs = _cache_write_columns_quant(
+                k_new, v_new, kvq[0], kv["scale"][0], kvq[1],
+                kv["scale"][1], pos, kind)
+            new_kv = {"kv": jnp.stack([kq, vq]),
+                      "scale": jnp.stack([ks, vs])}
+            k_cache = dequantize_kv(kq, ks, cfg.compute_dtype)
+            v_cache = dequantize_kv(vq, vs, cfg.compute_dtype)
+        else:
+            k_cache, v_cache = _cache_write_columns(
+                k_new, v_new, kvq[0], kvq[1], pos)
+            new_kv = jnp.stack([k_cache, v_cache])
+    else:
+        if quant:
+            k_new, k_s = quantize_kv_rows(k_new, kind)
+            v_new, v_s = quantize_kv_rows(v_new, kind)
+        k_cache = _cache_write_columns_xla(kvq[0], k_new, pos)
+        v_cache = _cache_write_columns_xla(kvq[1], v_new, pos)
+        if quant:
+            k_scale = _cache_write_columns_xla(kv["scale"][0], k_s, pos)
+            v_scale = _cache_write_columns_xla(kv["scale"][1], v_s, pos)
+            new_kv = {"kv": jnp.stack([k_cache, v_cache]),
+                      "scale": jnp.stack([k_scale, v_scale])}
+            k_cache = dequantize_kv(k_cache, k_scale, cfg.compute_dtype)
+            v_cache = dequantize_kv(v_cache, v_scale, cfg.compute_dtype)
+        else:
+            new_kv = jnp.stack([k_cache, v_cache])
+    # row t attends over 0 .. pos + t (its own just-written column
+    # included, like the plain path); later verify columns are masked
+    # to exact softmax zeros. This expression MUST stay in lockstep
+    # with _decode_attend's XLA branch (scale folded into q in compute
+    # dtype, einsum output cast to f32, -1e30 mask, f32 softmax cast
+    # back); the einsum subscripts intentionally differ only by the T
+    # query dim (collapsing it here would change the plain path's
+    # compiled gemv and risk every pinned stream). Matching
+    # expressions is necessary but NOT sufficient for bit-parity: the
+    # T>1 gemm lowers to different reduction orders than the plain
+    # gemv (~1e-7 relative logit drift measured off-TPU), so the
+    # spec == plain stream oracle is margin-dependent — see
+    # docs/DESIGN.md "Serving round 7" dead end (4) for the caveat
+    # and the designated mitigation (tolerance in the accept-check)
+    valid = (jnp.arange(s_max)[None, None]
+             <= (pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None])
+             [:, :, None])                        # [b, T, S]
+    q = q * jnp.asarray(1.0 / np.sqrt(d), q.dtype)
+    scores = jnp.einsum(
+        "bhtd,bhsd->bhts", q, k_cache).astype(jnp.float32)
+    scores = jnp.where(valid[:, None], scores, -1e30)
+    p_attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bhsd->bhtd", p_attn, v_cache), new_kv
+
+
+def _verify_layer(cfg: GPTConfig, p, x, kv, pos):
+    """:func:`_decode_layer` for ``T`` tokens per row: ``x [b, T,
+    hidden]`` at positions ``pos[b] + t``. Projections/LN/MLP are
+    per-position (row-independent matmuls — the :func:`prefill_extend`
+    argument), attention via :func:`_decode_attend_multi`."""
+    xa = _layer_norm(cfg, x, p["ln1"]["scale"], p["ln1"]["bias"])
+    d = cfg.head_dim
+    b, t, _ = xa.shape
+    hl = p["attn"]["qkv"]["kernel"].shape[-1]
+    q, k_new, v_new = (
+        jnp.transpose(z.reshape(b, t, hl // d, d), (0, 2, 1, 3))
+        for z in _qkv_project(cfg, p["attn"]["qkv"], xa))
+    ctx, new_kv = _decode_attend_multi(cfg, q, k_new, v_new, kv, pos)
+    out = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(b, t, hl)
+    attn = row_parallel_linear(
+        out, p["attn"]["proj"]["kernel"], p["attn"]["proj"]["bias"],
+        axis=cfg.axis)
+    x = x + attn
+    xb = _layer_norm(cfg, x, p["ln2"]["scale"], p["ln2"]["bias"])
+    return x + _mlp(cfg, p["mlp"], xb), new_kv
+
+
+def decode_verify(cfg: GPTConfig, params, cache, tokens, pos):
+    """The speculative verify forward: feed ``tokens [b, T] int32``
+    (this step's input token followed by T-1 drafted candidates) at
+    per-row positions ``pos[b] .. pos[b] + T - 1`` through ONE batched
+    target forward — returns ``(logits [b, T, vocab] fp32, new
+    cache)`` where row ``t``'s logits predict position ``pos[b] + t +
+    1``, value-matching what T sequential :func:`decode_step` calls
+    would produce for the same tokens (batched-forward causality: each
+    position's hidden state depends only on earlier positions, all of
+    which are in the cache or written by this same forward — the
+    :func:`prefill_at` exactness argument applied to the decode
+    horizon; equality is to ~1 ulp, not bitwise — the T>1 matmuls
+    reduce in a different order than the plain gemv, see docs/DESIGN.md
+    "Serving round 7" dead end (4)). All T K/V columns land in the cache; a caller that
+    accepts only a prefix leaves the rejected tail columns in place as
+    masked-invalid garbage (``pos`` advances only over the accepted
+    prefix, and decode masks/overwrites past-``pos`` columns — the
+    standing cache contract), never rewriting them.
+
+    MoE models are rejected like :func:`prefill_extend` (expert
+    capacity depends on the routed token count, so a T-token forward
+    routes differently than T single steps — divergence would be far
+    beyond ulp level)."""
+    if not cfg.causal:
+        raise ValueError(
+            "decoding is autoregressive; causal=False (the bidirectional "
+            "encoder mode) has no incremental-decode semantics")
+    if cfg.num_experts:
+        raise ValueError(
+            "decode_verify does not support num_experts > 0 (expert "
+            "capacity depends on the routed token count; a batched "
+            "verify forward routes differently than sequential steps)")
+    if cfg.sequence_parallel or cfg.context_parallel:
+        cfg = dataclasses.replace(
+            cfg, sequence_parallel=False, context_parallel=False)
+    pos = jnp.asarray(pos, jnp.int32)
+    b, t = tokens.shape
+    table = params["embedding"]["word"]["table"].astype(cfg.compute_dtype)
+    emb = vocab_parallel_embedding(tokens.astype(jnp.int32), table,
+                                   axis=cfg.axis)
+    # over-horizon lanes (a near-budget row drafting past its last
+    # position) clamp their position-embedding index — their logits
+    # are discarded by the accept logic, never emitted
+    posn = jnp.minimum(
+        pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None],
+        cfg.seq_len - 1)
+    pos_e = jnp.take(params["embedding"]["position"], posn, axis=0)
+    x = (emb + pos_e.astype(cfg.compute_dtype)).astype(cfg.compute_dtype)
+
+    def body(carry, inp):
+        layer_p, kv = inp
+        y, kv = _verify_layer(cfg, _cast_layer(cfg, layer_p), carry, kv,
+                              pos)
+        return y, kv
+
+    x, new_cache = lax.scan(body, x, (params["layers"], cache))
+    lg = _lm_head(cfg, params, x.reshape(b * t, cfg.hidden_size))
+    return lg.reshape(b, t, -1), new_cache
+
+
+def decode_steps_spec(cfg: GPTConfig, params, cache, state, n: int, *,
+                      spec_k: int, pad_token_id: int = 0, draw_fn=None,
+                      draft_fn=None, masks=None):
+    """:func:`decode_steps` with draft-k-verify speculation: ``n``
+    scan iterations (waves), each drafting ``spec_k`` candidate tokens
+    from the slot's token history (:func:`ngram_drafts`, or the
+    ``draft_fn(hist, tok, k) -> [B, k]`` hook — the seam a real draft
+    model would plug into), verifying all ``spec_k + 1`` positions in
+    ONE batched target forward (:func:`decode_verify`), and
+    accept-prefix-selecting. Accepted length varies per row per wave
+    but every shape is static: a wave emits between 1 and ``spec_k +
+    1`` tokens per live row, with rejected tail lanes emitting
+    ``pad_token_id`` under a False ``valid`` flag.
+
+    Verification is TOKEN-MATCHING: candidate ``j`` is drawn from the
+    verify logits of position ``pos + j`` with the SAME per-slot draw
+    (and key fold point) the plain path uses, and draft ``j`` is
+    accepted iff it equals that draw. Because the verify logits are
+    value-identical to the plain path's sequential logits, the emitted
+    stream is bit-identical to :func:`decode_steps` — greedy AND
+    sampled — regardless of draft quality; drafts only decide how many
+    tokens each wave yields. (This is what makes speculation a pure
+    perf knob: the serving engine's payoff gate can flip it per chunk
+    without touching a single emitted token.)
+
+    ``state`` is the :func:`decode_steps` state plus ``hist [B, H]
+    int32`` — the recent-token ring the drafter matches against
+    (oldest-first, ``-1`` sentinel padding), updated in-scan so later
+    waves draft from tokens earlier waves emitted.
+
+    Returns ``(cache, state, tokens [B, n*(spec_k+1)], logprobs,
+    finished, valid)`` — flattened wave-major columns in emission
+    order; ``valid`` is True exactly where a real token was emitted
+    (done slots and rejected tail lanes are False). Per-column
+    eos/budget semantics are identical to the plain path's per-step
+    semantics."""
+    k = int(spec_k)
+    if k < 1:
+        raise ValueError(f"decode_steps_spec needs spec_k >= 1, got {k}")
+    if "hist" not in state:
+        raise ValueError(
+            "decode_steps_spec needs a 'hist' [B, H] token-history "
+            "ring in state (see Engine spec_hist)")
+    tt = k + 1
+    pad = jnp.int32(pad_token_id)
+    drafter = draft_fn or ngram_drafts
+
+    def body(carry, _):
+        cache, st = carry
+        tok, pos = st["tok"], st["pos"]
+        drafts = jnp.clip(drafter(st["hist"], tok, k), 0,
+                          cfg.vocab_size - 1)
+        tokens_in = jnp.concatenate([tok[:, None], drafts], axis=1)
+        logits_all, cache = decode_verify(cfg, params, cache, tokens_in,
+                                          pos)
+        live0 = ~st["done"]
+        rem = st["remaining"]
+        done = st["done"]
+        tok_new, pos_new = tok, pos
+        cand_ok = jnp.ones_like(live0)
+        not_fin = jnp.ones_like(live0)
+        emits, lpout, fins, valids = [], [], [], []
+        nxt_prev = None
+        for j in range(tt):
+            lg = logits_all[:, j]
+            tj = pos + jnp.int32(j)
+            if draw_fn is None:
+                nxt = _sampling.draw_slots(
+                    lg, st["key"], tj, st["temp"], st["top_k"],
+                    st["top_p"], masks=masks)
+            else:
+                nxt = draw_fn(lg, tj)
+            if j > 0:
+                # accept-prefix: draft j survives iff it matches the
+                # target's own draw at its position (and every earlier
+                # draft matched)
+                cand_ok = cand_ok & (drafts[:, j - 1] == nxt_prev)
+            nxt_prev = nxt
+            emit_j = live0 & cand_ok & not_fin
+            lp = jnp.take_along_axis(
+                jax.nn.log_softmax(lg, axis=-1), nxt[:, None], axis=1
+            )[:, 0]
+            rem = rem - emit_j.astype(jnp.int32)
+            hit_eos = emit_j & (st["eos"] >= 0) & (nxt == st["eos"])
+            fin_j = emit_j & (hit_eos | (rem <= 0))
+            emits.append(jnp.where(emit_j, nxt, pad))
+            lpout.append(jnp.where(emit_j, lp, jnp.float32(0.0)))
+            fins.append(fin_j)
+            valids.append(emit_j)
+            tok_new = jnp.where(emit_j, nxt, tok_new)
+            pos_new = pos_new + emit_j.astype(jnp.int32)
+            done = done | fin_j
+            not_fin = not_fin & ~fin_j
+        toks_w = jnp.stack(emits, axis=1)        # [B, k+1]
+        val_w = jnp.stack(valids, axis=1)
+        # history ring: shift the emitted prefix in (per-row variable
+        # count m via a gather — emitted columns are always a prefix)
+        m = jnp.sum(val_w.astype(jnp.int32), axis=1)
+        hist_new = shift_hist(st["hist"], toks_w, m)
+        st = {
+            **st,
+            "tok": tok_new,
+            "pos": pos_new,
+            "remaining": rem,
+            "done": done,
+            "hist": hist_new,
+        }
+        return (cache, st), (toks_w, jnp.stack(lpout, axis=1),
+                             jnp.stack(fins, axis=1), val_w)
+
+    (cache, state), (toks, lps, fins, vals) = lax.scan(
+        body, (cache, state), None, length=n)
+    # [n, B, k+1] → [B, n*(k+1)] wave-major (column order = emission
+    # order)
+    flat = lambda a: jnp.transpose(a, (1, 0, 2)).reshape(
+        a.shape[1], n * tt)
+    return (cache, state, flat(toks), flat(lps), flat(fins), flat(vals))
 
 
 def _check_stop_tokens(cfg: GPTConfig, eos_token_id, pad_token_id):

@@ -47,7 +47,7 @@ __all__ = [
     "Request", "SamplingParams", "Completion", "StreamEvent",
     "StopMatcher",
     "Engine", "EngineConfig", "Scheduler", "QueueFull",
-    "Admission", "AdmitResult", "StepHandle",
+    "SpecGateConfig", "Admission", "AdmitResult", "StepHandle",
     "FaultPlan", "FaultSpec", "ResilienceConfig", "HealthMonitor",
     "EngineFault", "InjectedFault", "EngineFailed",
 ]
@@ -69,6 +69,7 @@ _LAZY = {
     "StepHandle": "apex_tpu.serving.engine",
     "Scheduler": "apex_tpu.serving.scheduler",
     "QueueFull": "apex_tpu.serving.scheduler",
+    "SpecGateConfig": "apex_tpu.serving.scheduler",
     "FaultPlan": "apex_tpu.serving.resilience",
     "FaultSpec": "apex_tpu.serving.resilience",
     "ResilienceConfig": "apex_tpu.serving.resilience",

@@ -113,6 +113,25 @@ class EngineConfig:
     #: (1, 2, 4) capped at ``slots``. Must be strictly increasing and
     #: start at 1 (any group count decomposes).
     admit_batch_sizes: Optional[Tuple[int, ...]] = None
+    #: speculative decoding: draft tokens per wave (0 disables — no
+    #: spec step program, no history buffer; the historical engine).
+    #: With ``spec_k > 0`` the engine compiles a SECOND step variant
+    #: (``gpt.decode_steps_spec``): each of the chunk's
+    #: ``decode_chunk`` scan iterations drafts ``spec_k`` candidates
+    #: from the slot's token history (device-side n-gram suffix match
+    #: — no second model), verifies all ``spec_k + 1`` positions in
+    #: ONE batched target forward, and accept-prefix-selects — a chunk
+    #: emits up to ``decode_chunk * (spec_k + 1)`` tokens per slot for
+    #: roughly one plain chunk's weight traffic when drafts hit.
+    #: Emitted streams are BIT-IDENTICAL to the plain path (greedy and
+    #: sampled — verification is token-matching against the target's
+    #: own draws at the same key fold points), so the scheduler's
+    #: payoff gate flips between the two pre-warmed variants freely.
+    spec_k: int = 0
+    #: token-history ring width per slot — the n-gram drafter's match
+    #: window (newest-last, -1 sentinel padding; seeded from the
+    #: prompt tail at admission). Only meaningful with ``spec_k > 0``.
+    spec_hist: int = 32
     #: shared-prefix pool pages (0 disables — no extra compiled
     #: programs, no pool buffer). A common prompt prefix
     #: (:meth:`Engine.register_prefix` — a system-prompt template) is
@@ -217,12 +236,14 @@ class StepHandle:
     hung dispatch is observed — at the fetch."""
 
     __slots__ = ("_emit", "_logprobs", "_finished", "_out", "_plan",
-                 "_hang", "_on_poison")
+                 "_hang", "_on_poison", "_valid_dev", "valid", "spec_k",
+                 "ncols")
 
     def __init__(self, emit, logprobs, finished, *,
                  plan: Optional[FaultPlan] = None,
                  hang: Optional[FaultSpec] = None,
-                 on_poison: Optional[Any] = None):
+                 on_poison: Optional[Any] = None,
+                 valid=None, spec_k: int = 0, ncols: int = 0):
         self._emit = emit
         self._logprobs = logprobs
         self._finished = finished
@@ -231,6 +252,26 @@ class StepHandle:
         self._plan = plan
         self._hang = hang
         self._on_poison = on_poison
+        #: speculative chunks only: the ``[B, ncols]`` bool plane
+        #: marking which columns carry REAL emissions (rejected draft
+        #: lanes and done slots emit pad under False). None for plain
+        #: chunks (where every live slot's column is real) — and until
+        #: :meth:`fetch` lands the device future.
+        self._valid_dev = valid
+        self.valid: Optional[np.ndarray] = None
+        #: draft tokens per wave of the chunk this handle carries (0 =
+        #: plain chunk)
+        self.spec_k = spec_k
+        #: token columns this chunk emits per slot — ``decode_chunk``
+        #: for plain chunks, ``decode_chunk * (spec_k + 1)`` for
+        #: speculative ones (the scheduler's in-flight budget guard
+        #: prices chunks by this)
+        self.ncols = ncols
+
+    @property
+    def spec(self) -> bool:
+        """True when this handle carries a speculative chunk."""
+        return self.spec_k > 0
 
     def fetch(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Block until the chunk lands; returns ``(tokens [B, n],
@@ -250,6 +291,8 @@ class StepHandle:
         tokens = np.asarray(self._emit)
         logprobs = np.asarray(self._logprobs)
         finished = np.asarray(self._finished)
+        if self._valid_dev is not None:
+            self.valid = np.asarray(self._valid_dev)
         if spec is not None and spec.kind == KIND_NAN:
             # what a NaN logit batch looks like by the time the host
             # sees it: garbage token ids in the poisoned lanes
@@ -289,6 +332,18 @@ class Engine:
         if ecfg.decode_chunk < 1:
             raise ValueError(
                 f"decode_chunk {ecfg.decode_chunk} must be >= 1")
+        if ecfg.spec_k < 0:
+            raise ValueError(f"spec_k {ecfg.spec_k} must be >= 0")
+        if ecfg.spec_k > 0 and ecfg.spec_hist < 2:
+            raise ValueError(
+                f"spec_hist {ecfg.spec_hist} must be >= 2 with "
+                f"spec_k > 0 (the drafter matches a 2-token suffix)")
+        if ecfg.spec_k > 0 and cfg.num_experts:
+            raise ValueError(
+                "spec_k > 0 does not compose with num_experts > 0: the "
+                "batched verify forward routes a different token count "
+                "than sequential steps, so MoE expert capacity breaks "
+                "spec == plain bit-parity (see gpt.decode_verify)")
         gpt._check_stop_tokens(cfg, None, ecfg.pad_token_id)
         for axis in ("dp", "pp", "cp", "ep"):
             if axis in mesh.shape and mesh.shape[axis] != 1:
@@ -425,13 +480,17 @@ class Engine:
         pspecs = gpt.param_specs(cfg)
         B = ecfg.slots
         pad = jnp.int32(ecfg.pad_token_id)
+        spec = ecfg.spec_k > 0
+        self._spec = spec
         # cache [l, 2, B, heads, S, d]: heads are the tp-sharded dim
         # (under a quantized kv_cache_dtype this is the {"kv", "scale"}
         # spec pytree — same sharding on both planes)
         cache_spec = gpt.cache_specs(cfg)
-        state_spec = {k: P() for k in (
-            "tok", "pos", "remaining", "done", "temp", "top_k", "top_p",
-            "key", "eos")}
+        state_keys = ["tok", "pos", "remaining", "done", "temp",
+                      "top_k", "top_p", "key", "eos"]
+        if spec:
+            state_keys.append("hist")
+        state_spec = {k: P() for k in state_keys}
 
         def init_local(params):
             cache = gpt.init_cache(cfg, params, B, max_len=ecfg.max_seq_len)
@@ -446,6 +505,10 @@ class Engine:
                 "key": jnp.zeros((B, 2), jnp.uint32),
                 "eos": jnp.full((B,), _NO_EOS, jnp.int32),
             }
+            if spec:
+                # the drafter's token-history ring, -1 = unfilled
+                state["hist"] = jnp.full((B, ecfg.spec_hist), -1,
+                                         jnp.int32)
             return cache, state
 
         def step_local(params, cache, state, masks):
@@ -454,14 +517,35 @@ class Engine:
             # compiled scan of decode_chunk steps per dispatch; masks
             # is the per-slot constrained-decoding vocab whitelist
             # (all-True rows are bit-identical to no mask)
-            return gpt.decode_steps(
+            hist = state["hist"] if spec else None
+            pos0 = state["pos"]
+            cache, state, toks, lps, fins = gpt.decode_steps(
                 cfg, params, cache, state, ecfg.decode_chunk,
                 pad_token_id=ecfg.pad_token_id, masks=masks)
+            if spec:
+                # keep the drafter's history fresh across PLAIN chunks
+                # too (a payoff-gated scheduler flips between the two
+                # variants): the chunk's emitted prefix per row is
+                # pos_after - pos_before columns — shift it into the
+                # ring so a later spec chunk drafts from real context
+                state = {**state, "hist": gpt.shift_hist(
+                    hist, toks, state["pos"] - pos0)}
+            return cache, state, toks, lps, fins
+
+        def step_spec_local(params, cache, state, masks):
+            # the speculative chunk: decode_chunk draft-verify-accept
+            # waves, emitting up to decode_chunk*(spec_k+1) columns
+            # (valid marks the real ones); bit-identical streams to
+            # step_local by the token-matching verification contract
+            return gpt.decode_steps_spec(
+                cfg, params, cache, state, ecfg.decode_chunk,
+                spec_k=ecfg.spec_k, pad_token_id=ecfg.pad_token_id,
+                masks=masks)
 
         def make_admit(bucket: int):
             def admit_local(params, cache, state, slots, prompts, p_lens,
                             max_tokens, temp, top_k, top_p, keys, eos,
-                            req_idx, seeded, masks):
+                            req_idx, seeded, masks, hist0=None):
                 # ONE padded forward admits the whole [k, bucket] batch;
                 # row i's logits/KV are exactly its solo prefill_at's
                 blocks, logits0 = gpt.prefill_many(
@@ -486,7 +570,7 @@ class Engine:
                 cache = gpt.cache_insert_slots(cache, blocks, slots)
                 hit_eos = (eos >= 0) & (first == eos)
                 done0 = hit_eos | (max_tokens <= 1)
-                state = {
+                new_state = {
                     "tok": state["tok"].at[slots].set(first),
                     "pos": state["pos"].at[slots].set(p_lens),
                     "remaining": state["remaining"].at[slots].set(
@@ -498,7 +582,14 @@ class Engine:
                     "key": state["key"].at[slots].set(keys),
                     "eos": state["eos"].at[slots].set(eos),
                 }
-                return cache, state, first, first_lp, hit_eos, done0
+                if spec:
+                    # seed the drafter's ring: the prompt tail (packed
+                    # host-side — the host knows the full prompt) plus
+                    # the first token drawn just above
+                    new_state["hist"] = state["hist"].at[slots].set(
+                        jnp.concatenate([hist0, first[:, None]],
+                                        axis=1))
+                return cache, new_state, first, first_lp, hit_eos, done0
 
             return admit_local
 
@@ -520,14 +611,26 @@ class Engine:
             step_local, (pspecs, cache_spec, state_spec, scalar),
             (cache_spec, state_spec, scalar, scalar, scalar),
             donate=(1, 2))
+        self._step_spec = None
+        if spec:
+            self._step_spec = sm(
+                step_spec_local, (pspecs, cache_spec, state_spec,
+                                  scalar),
+                (cache_spec, state_spec, scalar, scalar, scalar,
+                 scalar),
+                donate=(1, 2))
         # one admission program per (bucket, k) — the k dim and padded
         # width are static shapes, everything request-scoped is data
+        # (spec engines thread one extra data arg: the host-packed
+        # prompt-tail history seed)
+        n_admit_args = 13 if spec else 12
         self._admits: Dict[Tuple[int, int], Any] = {}
         for bucket in self._buckets:
             fn = make_admit(bucket)
             for k in self._batch_sizes:
                 self._admits[(bucket, k)] = sm(
-                    fn, (pspecs, cache_spec, state_spec) + (scalar,) * 12,
+                    fn, (pspecs, cache_spec, state_spec)
+                    + (scalar,) * n_admit_args,
                     (cache_spec, state_spec, scalar, scalar, scalar,
                      scalar),
                     donate=(1, 2))
@@ -585,7 +688,7 @@ class Engine:
             def admit_prefix_local(params, cache, state, pool, slots,
                                    tails, t_lens, max_tokens, temp,
                                    top_k, top_p, keys, eos, req_idx,
-                                   seeded, masks, page):
+                                   seeded, masks, page, hist0=None):
                 # the compiled gather: page -> [l, 2, 1, hl, ps, d]
                 # block of EXACT compute-dtype prefix K/V (the pool's
                 # master copy)
@@ -617,7 +720,7 @@ class Engine:
                     slots[0], pos=ps)
                 hit_eos = (eos >= 0) & (first == eos)
                 done0 = hit_eos | (max_tokens <= 1)
-                state = {
+                new_state = {
                     "tok": state["tok"].at[slots].set(first),
                     "pos": state["pos"].at[slots].set(p_lens),
                     "remaining": state["remaining"].at[slots].set(
@@ -629,7 +732,12 @@ class Engine:
                     "key": state["key"].at[slots].set(keys),
                     "eos": state["eos"].at[slots].set(eos),
                 }
-                return cache, state, first, first_lp, hit_eos, done0
+                if spec:
+                    new_state["hist"] = state["hist"].at[slots].set(
+                        jnp.concatenate([hist0, first[:, None]],
+                                        axis=1))
+                return (cache, new_state, first, first_lp, hit_eos,
+                        done0)
 
             return admit_prefix_local
 
@@ -637,7 +745,7 @@ class Engine:
             self._admit_prefix[(ps, tb)] = sm(
                 make_admit_prefix(ps, tb),
                 (pspecs, cache_spec, state_spec, pool_spec)
-                + (scalar,) * 13,
+                + (scalar,) * (14 if spec else 13),
                 (cache_spec, state_spec, scalar, scalar, scalar,
                  scalar),
                 donate=(1, 2))
@@ -959,6 +1067,8 @@ class Engine:
             masks = np.stack([self._masks[a.slot] for a in batch])
             arr = lambda vals, dt: np.asarray(vals, dt)
             fn = self._admits[(bucket, k)]
+            extra = ((np.stack([self._hist_seed(p) for p, _ in proms]),)
+                     if self._spec else ())
             self.cache, self.state, first, first_lp, hit_eos, done = fn(
                 self._params, self.cache, self.state,
                 arr([a.slot for a in batch], np.int32), prompts,
@@ -970,7 +1080,7 @@ class Engine:
                 keys,
                 arr([_NO_EOS if a.eos_token_id is None
                      else int(a.eos_token_id) for a in batch], np.int32),
-                req_idx, seeded, masks)
+                req_idx, seeded, masks, *extra)
             pending.append(((first, first_lp, hit_eos, done), bucket, k,
                             group))
             i += k
@@ -1013,6 +1123,7 @@ class Engine:
         self.set_slot_mask(a.slot, a.allowed_tokens)
         masks = self._masks[a.slot][None]
         fn = self._admit_prefix[(ps, tb)]
+        extra = ((self._hist_seed(prompt)[None],) if self._spec else ())
         self.cache, self.state, first, first_lp, hit_eos, done = fn(
             self._params, self.cache, self.state, self.pool,
             np.asarray([a.slot], np.int32), tails,
@@ -1023,32 +1134,67 @@ class Engine:
             np.asarray([a.top_p], np.float32), keys,
             np.asarray([_NO_EOS if a.eos_token_id is None
                         else int(a.eos_token_id)], np.int32),
-            req_idx, seeded, masks, np.int32(a.prefix_page))
+            req_idx, seeded, masks, np.int32(a.prefix_page), *extra)
         return first, first_lp, hit_eos, done
 
-    def step_async(self) -> StepHandle:
+    def _hist_seed(self, prompt) -> np.ndarray:
+        """The drafter-ring admission seed for one prompt: its last
+        ``spec_hist - 1`` tokens, left-padded with the ``-1`` sentinel
+        (the device appends the admission's first sampled token to
+        complete the ring). Host-side numpy — the variable-length
+        logic stays out of the compiled programs."""
+        h = self.engine_cfg.spec_hist
+        row = np.full((h - 1,), -1, np.int32)
+        tail = np.asarray(prompt, np.int32)[-(h - 1):]
+        if tail.size:
+            row[h - 1 - tail.size:] = tail
+        return row
+
+    def step_async(self, *, spec: bool = False) -> StepHandle:
         """Dispatch one decode chunk WITHOUT fetching its outputs: the
         engine rebinds its (donated) cache/state to the returned device
         futures immediately, so the caller may enqueue further work —
         the next chunk, an admission — behind it before syncing, and
         the device never idles through the host's fetch + event
-        processing. Returns the chunk's :class:`StepHandle`."""
+        processing. Returns the chunk's :class:`StepHandle`.
+
+        ``spec=True`` dispatches the SPECULATIVE chunk variant
+        (``EngineConfig.spec_k > 0`` required — both variants are
+        pre-warmed, so a payoff-gated scheduler switches per dispatch
+        without a recompile): the handle's tokens/logprobs/finished are
+        ``[B, decode_chunk * (spec_k + 1)]`` with ``handle.valid``
+        marking the real emissions (rejected draft lanes emit pad)."""
         self._check_poisoned()
-        spec = self._take_fault("dispatch")
-        if spec is not None and spec.kind == KIND_ERROR:
+        fspec = self._take_fault("dispatch")
+        if fspec is not None and fspec.kind == KIND_ERROR:
             self._poisoned = True
             raise InjectedFault(
-                f"injected device error at dispatch: {spec.describe()}",
-                point="dispatch", spec=spec)
+                f"injected device error at dispatch: "
+                f"{fspec.describe()}", point="dispatch", spec=fspec)
+        if spec and not self._spec:
+            raise ValueError(
+                "step_async(spec=True) needs EngineConfig.spec_k > 0")
         if self._masks_dev is None:
             self._masks_dev = jnp.asarray(self._masks)
-        self.cache, self.state, emit, logprobs, finished = self._step(
-            self._params, self.cache, self.state, self._masks_dev)
+        chunk = self.engine_cfg.decode_chunk
+        valid = None
+        if spec:
+            (self.cache, self.state, emit, logprobs, finished,
+             valid) = self._step_spec(
+                self._params, self.cache, self.state, self._masks_dev)
+            spec_k = self.engine_cfg.spec_k
+            ncols = chunk * (spec_k + 1)
+        else:
+            self.cache, self.state, emit, logprobs, finished = \
+                self._step(self._params, self.cache, self.state,
+                           self._masks_dev)
+            spec_k, ncols = 0, chunk
         plan = None if self._warming else self.fault_plan
         return StepHandle(emit, logprobs, finished, plan=plan,
-                          hang=spec if spec is not None
-                          and spec.kind == KIND_HANG else None,
-                          on_poison=self._mark_poisoned)
+                          hang=fspec if fspec is not None
+                          and fspec.kind == KIND_HANG else None,
+                          on_poison=self._mark_poisoned,
+                          valid=valid, spec_k=spec_k, ncols=ncols)
 
     def step(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """One decode chunk over every slot — ``decode_chunk`` fused
@@ -1167,6 +1313,9 @@ class Engine:
 
     def _warmup_body(self) -> None:
         ecfg = self.engine_cfg
+        hseed = lambda k: (
+            (np.full((k, ecfg.spec_hist - 1), -1, np.int32),)
+            if self._spec else ())
         for (bucket, k), fn in sorted(self._admits.items()):
             # dummy args exercise shapes only: k pad-token prompts of
             # length 1, budget 1 (done at admission), no sampling
@@ -1180,7 +1329,7 @@ class Engine:
                 np.zeros((k, 2), np.uint32),
                 np.full((k,), _NO_EOS, np.int32),
                 np.zeros((k,), np.int32), np.zeros((k,), bool),
-                np.ones((k, self.cfg.vocab_size), bool))
+                np.ones((k, self.cfg.vocab_size), bool), *hseed(k))
             np.asarray(first)
         # prefix pool: compile every pool-insert and (split, tail
         # bucket) extend variant against page 0 junk
@@ -1204,10 +1353,16 @@ class Engine:
                 np.ones((1,), np.float32), np.zeros((1, 2), np.uint32),
                 np.full((1,), _NO_EOS, np.int32),
                 np.zeros((1,), np.int32), np.zeros((1,), bool),
-                np.ones((1, self.cfg.vocab_size), bool), np.int32(0))
+                np.ones((1, self.cfg.vocab_size), bool), np.int32(0),
+                *hseed(1))
             np.asarray(first)
         handle = self.step_async()
         handle.fetch()
+        if self._spec:
+            # the speculative chunk variant compiles here too, so the
+            # scheduler's payoff gate can flip spec/plain per dispatch
+            # under an armed recompile guard
+            self.step_async(spec=True).fetch()
         self.state = self._retire(self.state, np.int32(0))
         # drop the warmup junk: a fresh init (compiled at construction)
         # frees every slot again
@@ -1250,8 +1405,10 @@ class Engine:
         size_of = lambda fn: (fn._cache_size()
                               if callable(getattr(fn, "_cache_size", None))
                               else None)
+        names = ("init", "step", "retire") + (
+            ("step_spec",) if self._spec else ())
         out = {name: size_of(getattr(self, f"_{name}"))
-               for name in ("init", "step", "retire")}
+               for name in names}
         admit_sizes = []
         for (bucket, k), fn in sorted(self._admits.items()):
             s = size_of(fn)
@@ -1291,7 +1448,9 @@ class Engine:
             from apex_tpu.telemetry.recompile import RecompileSentinel
 
             sentinel = RecompileSentinel(registry=registry).install()
-            for name in ("init", "step", "retire"):
+            names = ("init", "step", "retire") + (
+                ("step_spec",) if self._spec else ())
+            for name in names:
                 sentinel.track(name, getattr(self, f"_{name}"))
             for (bucket, k), fn in sorted(self._admits.items()):
                 sentinel.track(self._admit_variant_name(bucket, k), fn)

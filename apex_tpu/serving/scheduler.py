@@ -65,6 +65,7 @@ tokens — it never occupies a slot (admitting it would burn
 from __future__ import annotations
 
 import collections
+import dataclasses
 import time
 from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
@@ -98,6 +99,141 @@ FAULT_CAUSES = ("admit", "dispatch", "fetch", "retire", "invalid_token")
 
 #: shed reasons (label values of ``serving_requests_shed_total``)
 SHED_REASONS = ("queue_full", "deadline")
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecGateConfig:
+    """Policy knobs of the speculative-decoding payoff gate (only
+    meaningful on an engine with ``EngineConfig.spec_k > 0``).
+
+    The gate is the docs/DESIGN.md "Serving round 3" lesson applied to
+    speculation: a speculative chunk only pays when the drafts it
+    verifies actually land, so the scheduler measures BOTH compiled
+    variants' chunk wall times and an acceptance EWMA, and dispatches
+    the spec variant only while::
+
+        EWMA(tokens emitted per wave)  >  wall_spec / wall_plain
+
+    (the break-even: a spec wave costs ``wall_spec / decode_chunk``
+    and emits ``tpw`` tokens; a plain step costs ``wall_plain /
+    decode_chunk`` per token — spec wins iff tpw clears the wall
+    ratio). Both variants are pre-warmed, so switching never
+    recompiles."""
+
+    #: weight of the newest acceptance sample in the EWMA
+    ewma_alpha: float = 0.3
+    #: a CLOSED gate reopens only when the EWMA clears break-even by
+    #: this factor (hysteresis — an open gate closes at 1.0x)
+    margin: float = 1.05
+    #: re-probe cadence, symmetric in both directions: a CLOSED gate
+    #: sends one speculative chunk per this many plain chunks (a
+    #: workload that turns repetitive reopens the gate), and an OPEN
+    #: gate sends one plain chunk per this many speculative chunks so
+    #: ``wall_plain`` tracks the growing attention cost instead of
+    #: freezing at short-context values (a stale baseline inflates the
+    #: break-even and flaps the gate closed on exactly the
+    #: long-generation workloads speculation targets)
+    probe_every: int = 40
+    #: speculative chunks to measure before the gate decides at all
+    min_probe_chunks: int = 2
+
+
+#: ``serving_spec_gate_state`` gauge values
+GATE_CLOSED, GATE_MEASURING, GATE_OPEN = 0.0, 1.0, 2.0
+
+
+class _SpecGate:
+    """The live payoff-gate state machine behind
+    :class:`SpecGateConfig` — wall-time EWMAs for both chunk variants,
+    the acceptance (tokens-per-wave) EWMA, and the open/closed/probe
+    decision. Pure host arithmetic; the decision only picks which
+    pre-warmed compiled variant the next dispatch uses."""
+
+    __slots__ = ("cfg", "spec_k", "accept_ewma", "wall_spec",
+                 "wall_plain", "spec_chunks", "plain_since_probe",
+                 "spec_since_plain", "_open")
+
+    def __init__(self, cfg: SpecGateConfig, spec_k: int):
+        self.cfg = cfg
+        self.spec_k = spec_k
+        self.accept_ewma = 0.0      # tokens per wave (1 .. spec_k + 1)
+        self.wall_spec = 0.0
+        self.wall_plain = 0.0
+        self.spec_chunks = 0
+        self.plain_since_probe = 0
+        self.spec_since_plain = 0
+        self._open = True           # optimistic until measured
+
+    def _ewma(self, prev: float, sample: float) -> float:
+        a = self.cfg.ewma_alpha
+        return sample if prev == 0.0 else (1 - a) * prev + a * sample
+
+    def break_even(self) -> float:
+        """Tokens per wave a spec chunk must emit to match the plain
+        variant's cost — ``wall_spec / wall_plain`` (0.0 until both
+        are measured)."""
+        if self.wall_spec <= 0.0 or self.wall_plain <= 0.0:
+            return 0.0
+        return self.wall_spec / self.wall_plain
+
+    def want_spec(self, spec_inflight: int = 0) -> bool:
+        """Which variant the NEXT chunk should use. ``spec_inflight``
+        is the count of speculative chunks dispatched but not yet
+        fetched: the fetch-side counters reset only when a probe LANDS,
+        so until the gate has measured its way open, probes are
+        serialized — at most one speculative chunk in flight — lest a
+        pipelined scheduler multiply the documented one-chunk probe
+        overhead by its depth."""
+        if self.wall_plain == 0.0:
+            return False            # measure the plain baseline first
+        measuring = self.spec_chunks < self.cfg.min_probe_chunks
+        if (measuring or not self._open) and spec_inflight > 0:
+            return False            # one probe at a time
+        if measuring:
+            return True             # measuring the spec side
+        if self._open:
+            # plain-refresh probe: once per probe_every spec chunks the
+            # open gate re-measures wall_plain (see SpecGateConfig)
+            return self.spec_since_plain < self.cfg.probe_every
+        return self.plain_since_probe >= self.cfg.probe_every
+
+    def observe_plain(self, wall: float) -> None:
+        self.wall_plain = self._ewma(self.wall_plain, wall)
+        self.plain_since_probe += 1
+        self.spec_since_plain = 0
+
+    def observe_spec(self, wall: float,
+                     tokens_per_wave: Optional[float]) -> None:
+        self.wall_spec = self._ewma(self.wall_spec, wall)
+        self.spec_chunks += 1
+        self.plain_since_probe = 0
+        self.spec_since_plain += 1
+        if tokens_per_wave is not None:
+            self.accept_ewma = self._ewma(self.accept_ewma,
+                                          tokens_per_wave)
+        if self.accept_ewma == 0.0:
+            # no acceptance sample has EVER landed (every probe chunk's
+            # rows were retired mid-flight) — deciding now would close
+            # the gate on zero data; keep measuring instead. A real
+            # sample can never be 0.0 (a live wave always emits >= 1
+            # token), so this is an unambiguous never-measured sentinel
+            return
+        be = self.break_even()
+        if be <= 0.0 or self.spec_chunks < self.cfg.min_probe_chunks:
+            return
+        if self._open:
+            self._open = self.accept_ewma > be
+        else:
+            # hysteresis: reopening needs the margin
+            self._open = self.accept_ewma > be * self.cfg.margin
+
+    def state(self) -> float:
+        """Gauge value: 2 open, 1 measuring, 0 closed."""
+        if (self.wall_plain == 0.0
+                or self.spec_chunks < self.cfg.min_probe_chunks
+                or self.accept_ewma == 0.0):
+            return GATE_MEASURING
+        return GATE_OPEN if self._open else GATE_CLOSED
 
 
 class QueueFull(RuntimeError):
@@ -213,6 +349,22 @@ class _RegistryMetrics:
             "serving_prefix_misses_total",
             "submitted requests that missed the prefix pool (cold "
             "prefill at the full prompt bucket)")
+        # -- speculative decoding (EngineConfig.spec_k) -------------------
+        self.spec_drafted = registry.counter(
+            "serving_spec_drafted_total",
+            "draft tokens proposed to the speculative verify forward")
+        self.spec_accepted = registry.counter(
+            "serving_spec_accepted_total",
+            "draft tokens the target's verification accepted (emitted "
+            "beyond the one-per-wave baseline)")
+        self.spec_gate = registry.gauge(
+            "serving_spec_gate_state",
+            "speculation payoff gate: 2 open, 1 measuring, 0 closed")
+        self.spec_accept_ewma = registry.gauge(
+            "serving_spec_acceptance_ewma",
+            "EWMA of tokens emitted per speculative wave (the gate "
+            "compares it to the measured wall_spec/wall_plain "
+            "break-even)")
 
 
 class _Active:
@@ -283,7 +435,8 @@ class Scheduler:
                  sleep: Callable[[float], None] = time.sleep,
                  pipeline_depth: int = 1,
                  max_admit_batch: Optional[int] = None,
-                 resilience: Optional[ResilienceConfig] = None):
+                 resilience: Optional[ResilienceConfig] = None,
+                 spec_gate: Optional[SpecGateConfig] = None):
         if pipeline_depth < 1:
             raise ValueError(
                 f"pipeline_depth {pipeline_depth} must be >= 1 (1 = the "
@@ -350,6 +503,21 @@ class Scheduler:
         #: estimator behind deadline shedding and the QueueFull
         #: retry-after hint
         self._chunk_ewma = 0.0
+        #: speculative-decoding payoff gate (None unless the engine
+        #: carries a spec_k > 0 step variant): decides per dispatch
+        #: which pre-warmed chunk variant to run — see SpecGateConfig
+        if engine.engine_cfg.spec_k > 0:
+            self._gate: Optional[_SpecGate] = _SpecGate(
+                spec_gate or SpecGateConfig(), engine.engine_cfg.spec_k)
+        else:
+            if spec_gate is not None:
+                raise ValueError(
+                    "spec_gate given but the engine has spec_k == 0 — "
+                    "speculation needs EngineConfig.spec_k > 0")
+            self._gate = None
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_chunks = 0
         self._alarms_seen = self._guard_alarm_count()
         self._started: Optional[float] = None
         # steady-decode split: wall time attributable to decode chunks
@@ -596,15 +764,38 @@ class Scheduler:
             return False
         if not self._inflight:
             return True
+        # price each in-flight chunk at its max emission — decode_chunk
+        # for plain chunks, decode_chunk*(spec_k+1) for speculative
+        # ones (conservative: a spec chunk may emit fewer, in which
+        # case the next tick's fetch corrects the estimate)
         cols: Dict[int, int] = {}
-        chunk = self.engine.engine_cfg.decode_chunk
-        for _, snapshot, _, _ in self._inflight:
+        for handle, snapshot, _, _ in self._inflight:
             for slot, act in snapshot.items():
                 if self.active.get(slot) is act:
-                    cols[slot] = cols.get(slot, 0) + chunk
+                    cols[slot] = cols.get(slot, 0) + handle.ncols
         return any(
             len(act.tokens) + cols.get(slot, 0) < act.request.max_tokens
             for slot, act in self.active.items())
+
+    def _use_spec(self) -> bool:
+        """Whether the next chunk dispatches the speculative variant:
+        the payoff gate must want it, no constrained request may be
+        active (its vocab mask advances per token — the decode_chunk==1
+        serialization from the constrained path extends to forcing
+        plain chunks), and no fault replay may be in flight (replay
+        exactness is simplest to audit on the plain path; streams are
+        bit-identical either way, this keeps the replay invariant
+        independent of gate state)."""
+        g = self._gate
+        if g is None:
+            return False
+        for act in self.active.values():
+            if act.request.constraint is not None:
+                return False
+            if len(act.tokens) < act.suppress:
+                return False        # replaying a pre-fault stream
+        return g.want_spec(spec_inflight=sum(
+            1 for entry in self._inflight if entry[0].spec))
 
     def _dispatch_chunk(self) -> bool:
         """Dispatch the next decode chunk if it can pay for itself;
@@ -615,7 +806,7 @@ class Scheduler:
             return False
         t0 = self.clock()
         try:
-            handle = self.engine.step_async()
+            handle = self.engine.step_async(spec=self._use_spec())
         except Exception as e:  # device error escaping the dispatch
             self._recover(self.clock(), cause="dispatch", detail=str(e),
                           affected=[a.request for _, a in
@@ -704,10 +895,60 @@ class Scheduler:
                 "(NaN-poisoned step)", affected=bad)
             return
         n_cols = tokens.shape[1]
+        valid = handle.valid    # spec chunks: which columns are real
+        # speculative accounting + payoff gate: tokens-per-wave over
+        # the still-live snapshot rows (a live wave always emits its
+        # column-0 token, so live waves = True column-0 flags), and the
+        # chunk-wall EWMAs per variant the break-even compares. A
+        # watchdog-tripped chunk is excluded exactly like the overload
+        # EWMA above.
+        # still-live snapshot rows — THE liveness condition for both
+        # the gate's tokens-per-wave denominator and the latency
+        # denominator below (computed once so they can never disagree)
+        live_rows = [s for s, a in snapshot.items()
+                     if self.active.get(s) is a]
+        g = self._gate
+        if g is not None and chunk_wall <= \
+                self.resilience.watchdog_timeout_s:
+            sample = chunk_wall / max(depth_at_dispatch, 1)
+            if handle.spec:
+                self._spec_chunks += 1
+                tpw = None
+                rows = live_rows
+                if rows and valid is not None:
+                    v = valid[rows]
+                    live_waves = int(v[:, ::handle.spec_k + 1].sum())
+                    emitted = int(v.sum())
+                    if live_waves:
+                        tpw = emitted / live_waves
+                        drafted = handle.spec_k * live_waves
+                        self._spec_drafted += drafted
+                        self._spec_accepted += emitted - live_waves
+                        if tele is not None:
+                            tele.spec_drafted.inc(drafted)
+                            tele.spec_accepted.inc(emitted - live_waves)
+                g.observe_spec(sample, tpw)
+                if self.spans is not None:
+                    # the verify forward's host window: dispatch to
+                    # value of the speculative chunk
+                    self.spans.section_at("engine.verify", t_dispatch,
+                                          now)
+            else:
+                g.observe_plain(sample)
+            if tele is not None:
+                tele.spec_gate.set(g.state())
+                tele.spec_accept_ewma.set(g.accept_ewma)
         # in-flight latency of this chunk (dispatch -> value); the
         # decode-time split dedups the overlap so pipelined chunks
-        # don't double-count wall time
-        per_tok = max(now - t_dispatch, 0.0) / n_cols
+        # don't double-count wall time. Spec chunks price latency per
+        # REAL emitted token (pad lanes are not tokens).
+        if valid is None:
+            per_tok = max(now - t_dispatch, 0.0) / n_cols
+        else:
+            mean_emitted = (valid[live_rows].sum() / len(live_rows)
+                            if live_rows else 0.0)
+            per_tok = (max(now - t_dispatch, 0.0)
+                       / max(mean_emitted, 1.0))
         self._decode_time += now - max(self._decode_mark, t_dispatch)
         self._decode_mark = now
         for j in range(n_cols):
@@ -716,8 +957,14 @@ class Scheduler:
                 # finish, a host-side stop, or a deadline retire
                 # landing mid-flight) is skipped: the device emits pad
                 # for done lanes, and a retired request's in-flight
-                # tokens belong to a completion that already closed
+                # tokens belong to a completion that already closed.
+                # Spec chunks additionally skip non-valid columns —
+                # rejected draft lanes emit pad without being tokens
+                # (the StopMatcher and constraint DFA see the accepted
+                # prefix only).
                 if self.active.get(slot) is not act:
+                    continue
+                if valid is not None and not valid[slot, j]:
                     continue
                 tok = int(tokens[slot, j])
                 done = bool(finished[slot, j])
@@ -1242,6 +1489,17 @@ class Scheduler:
             "prefix_hits": float(self._prefix_hit_count),
             "prefix_misses": float(self._prefix_miss_count),
         }
+        if self._gate is not None:
+            # speculative decoding: per-wave accounting + gate state
+            out["spec_chunks"] = float(self._spec_chunks)
+            out["spec_drafted"] = float(self._spec_drafted)
+            out["spec_accepted"] = float(self._spec_accepted)
+            out["spec_accept_rate"] = (
+                self._spec_accepted / self._spec_drafted
+                if self._spec_drafted else 0.0)
+            out["spec_gate_state"] = self._gate.state()
+            out["spec_acceptance_ewma"] = self._gate.accept_ewma
+            out["spec_break_even"] = self._gate.break_even()
         if elapsed:
             out["tokens_per_sec"] = self._tokens_emitted / elapsed
         if self._decode_time > 0:

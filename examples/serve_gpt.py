@@ -160,6 +160,14 @@ def main():
                     "seams: 'random:SEED[:N]' or a comma list of "
                     "point:index:kind[:arg] (see "
                     "apex_tpu.serving.resilience.parse_fault_plan)")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="speculative decoding: draft this many tokens "
+                    "per wave from a device-side n-gram drafter and "
+                    "verify them in one batched target forward "
+                    "(gpt.decode_steps_spec); the scheduler's "
+                    "acceptance-EWMA payoff gate flips between the "
+                    "spec and plain compiled variants, and token "
+                    "streams are bit-identical either way (0 = off)")
     ap.add_argument("--kv-cache-dtype", default="auto",
                     choices=("auto", "bf16", "int8", "fp8"),
                     help="KV-cache storage: int8/fp8 store quantized "
@@ -204,7 +212,7 @@ def main():
     engine = Engine(cfg, params, mesh, EngineConfig(
         slots=args.slots, max_prompt_len=args.max_prompt_len,
         max_seq_len=args.max_seq_len, decode_chunk=args.decode_chunk,
-        prefix_pool_slots=len(templates)),
+        prefix_pool_slots=len(templates), spec_k=args.spec_k),
         fault_plan=fault_plan)
     # compile every program (init/step/retire + each (bucket, k)
     # admission variant + prefix pool inserts/extends) before the first

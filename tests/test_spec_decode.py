@@ -1,0 +1,483 @@
+"""Speculative decoding: draft-k-verify inside the compiled chunk loop.
+
+The contract under test (``gpt.decode_steps_spec`` + the engine's
+``spec_k`` step variant + the scheduler's payoff gate): speculation is
+a pure PERF knob — verification is token-matching against the target's
+own draws at the plain path's key fold points, so emitted streams are
+bit-identical to the plain engine (and to solo ``gpt.generate``) for
+greedy AND sampled requests, across tp shardings, quantized KV caches,
+fault replay, and any gate flip pattern. Drafts only decide how many
+tokens each wave yields.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import mesh as mx
+from apex_tpu.kernels.decode_attention import (
+    cache_write_columns,
+    cache_write_columns_xla,
+)
+from apex_tpu.models import gpt
+from apex_tpu.serving import Request, SamplingParams
+from apex_tpu.serving.engine import Engine, EngineConfig
+from apex_tpu.serving.resilience import FaultPlan, FaultSpec, ResilienceConfig
+from apex_tpu.serving.scheduler import (
+    GATE_CLOSED,
+    GATE_OPEN,
+    Scheduler,
+    SpecGateConfig,
+    _SpecGate,
+)
+from apex_tpu.transformer.testing import standalone_gpt_config
+
+VOCAB = 96
+
+
+def _cfg(**overrides):
+    base = dict(vocab_size=VOCAB, seq_len=96)
+    base.update(overrides)
+    return standalone_gpt_config(**base)
+
+
+def _solo_generate(cfg, params, mesh, prompt, n_new, sp: SamplingParams,
+                   eos_token_id=None):
+    pspecs = gpt.param_specs(cfg)
+    key = (jax.random.PRNGKey(sp.seed)
+           if sp.temperature > 0 and sp.seed is not None else None)
+    out = jax.jit(jax.shard_map(
+        lambda p, t: gpt.generate(
+            cfg, p, t, n_new, temperature=sp.temperature, top_k=sp.top_k,
+            top_p=sp.top_p, key=key, eos_token_id=eos_token_id,
+            pad_token_id=0),
+        mesh=mesh, in_specs=(pspecs, P(None, None)),
+        out_specs=P(None, None), check_vma=False))(
+            params, jnp.asarray([prompt], jnp.int32))
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def _requests(n, max_prompt_len, *, sampled_every=3, max_tokens=10):
+    reqs = []
+    for i in range(n):
+        p_len = 1 + (7 * i + 3) % max_prompt_len
+        prompt = [int(t) for t in jax.random.randint(
+            jax.random.PRNGKey(500 + i), (p_len,), 0, VOCAB)]
+        sp = (SamplingParams(temperature=0.9, top_k=7, seed=17 + i)
+              if i % sampled_every == 1 else SamplingParams())
+        reqs.append(Request(f"r{i}", prompt, max_tokens=max_tokens,
+                            sampling=sp))
+    return reqs
+
+
+def _run(engine, reqs, **kw):
+    sched = Scheduler(engine, **kw)
+    for r in reqs:
+        sched.submit(r)
+    sched.run_until_idle()
+    return sched
+
+
+# --- drafter + kernel write units -------------------------------------------
+
+
+def test_ngram_drafter_replays_cycles():
+    """The device-side drafter replays a remembered cycle: with history
+    ``... a b c a b`` and current token ``c``, the 2-gram match must
+    draft ``a b c a ...``; an empty history falls back to repeating the
+    current token; sentinels never match."""
+    hist = jnp.asarray([
+        [-1, -1, 7, 8, 9, 7, 8],     # cycle (7 8 9), current 9
+        [-1, -1, -1, -1, -1, -1, -1],  # no history
+    ], jnp.int32)
+    tok = jnp.asarray([9, 5], jnp.int32)
+    drafts = np.asarray(gpt.ngram_drafts(hist, tok, 4))
+    assert drafts[0].tolist() == [7, 8, 9, 7]
+    assert drafts[1].tolist() == [5, 5, 5, 5]
+
+
+def test_cache_write_columns_kernel_matches_xla():
+    """The Pallas multi-column write (interpret mode off-TPU) lands the
+    same bytes as the XLA one-hot reference for in-horizon lanes; lanes
+    clamped at the horizon only ever touch the last column."""
+    rng = np.random.RandomState(0)
+    b, h, s, d, t = 3, 2, 16, 8, 3
+    k_cache = rng.randn(b, h, s, d).astype(np.float32)
+    v_cache = rng.randn(b, h, s, d).astype(np.float32)
+    k_new = rng.randn(b, h, t, d).astype(np.float32)
+    v_new = rng.randn(b, h, t, d).astype(np.float32)
+    pos = np.asarray([0, 5, 13], np.int32)  # row 2 overruns at lane 2
+    kk, vk = cache_write_columns(
+        jnp.asarray(k_new), jnp.asarray(v_new), jnp.asarray(k_cache),
+        jnp.asarray(v_cache), jnp.asarray(pos))
+    kx = cache_write_columns_xla(jnp.asarray(k_cache),
+                                    jnp.asarray(k_new), jnp.asarray(pos))
+    vx = cache_write_columns_xla(jnp.asarray(v_cache),
+                                    jnp.asarray(v_new), jnp.asarray(pos))
+    kk, vk, kx, vx = map(np.asarray, (kk, vk, kx, vx))
+    for r in range(b):
+        last_real = min(pos[r] + t, s) - (0 if pos[r] + t <= s else 1)
+        np.testing.assert_array_equal(kk[r, :, :last_real],
+                                      kx[r, :, :last_real])
+        np.testing.assert_array_equal(vk[r, :, :last_real],
+                                      vx[r, :, :last_real])
+    # the clamped row: only column s-1 may differ from the XLA drop
+    assert (kk[2, :, :s - 1] == kx[2, :, :s - 1]).all()
+    # scale-plane (rank 3) spelling of the XLA write
+    sc = rng.randn(b, h, s).astype(np.float32)
+    new_sc = rng.randn(b, h, t).astype(np.float32)
+    out = np.asarray(cache_write_columns_xla(
+        jnp.asarray(sc), jnp.asarray(new_sc), jnp.asarray(pos)))
+    for r in range(b):
+        for j in range(t):
+            if pos[r] + j < s:
+                np.testing.assert_array_equal(out[r, :, pos[r] + j],
+                                              new_sc[r, :, j])
+
+
+# --- bit-parity oracles ------------------------------------------------------
+
+
+def test_spec_greedy_and_sampled_match_solo_generate(devices8):
+    """THE spec oracle: a spec_k engine's completions (greedy and
+    seeded-sampled lanes) are token-identical to solo ``gpt.generate``
+    — accept-prefix under token-matching verification reproduces the
+    plain stream exactly. (Rerun determinism is pinned by the
+    replay-after-fault test, which compares two independent runs.)"""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    eng = Engine(cfg, params, mesh, EngineConfig(
+        slots=2, max_prompt_len=10, max_seq_len=32, decode_chunk=2,
+        spec_k=3, spec_hist=12)).warmup()
+    reqs = _requests(4, 10)
+    sched = _run(eng, reqs)
+    eng.close()
+    for r in reqs:
+        comp = sched.completions[r.request_id]
+        solo = _solo_generate(cfg, params, mesh, list(r.prompt),
+                              r.max_tokens, r.sampling)
+        assert comp.tokens == solo, (
+            f"{r.request_id}: spec {comp.tokens} != solo {solo}")
+
+
+def test_spec_logprobs_and_stop_sequences(devices8):
+    """Spec streams carry per-token logprobs (free from the verify
+    forward, ulp-equal to the plain path's), and stop sequences see the
+    accepted prefix only — a stop match mid-wave trims exactly like the
+    plain path (the pad lanes past the accepted prefix are not
+    tokens)."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    prompt = [int(t) for t in jax.random.randint(
+        jax.random.PRNGKey(3), (6,), 0, VOCAB)]
+    # sampled stream (greedy collapses to a constant): the stop pair is
+    # two consecutive mid-stream tokens, so the match lands mid-wave
+    sp = SamplingParams(temperature=0.9, top_k=7, seed=23)
+    base = _solo_generate(cfg, params, mesh, prompt, 10, sp)
+    stop = [base[4], base[5]]
+
+    # independent reference: base fed through a fresh StopMatcher
+    from apex_tpu.serving.request import StopMatcher
+    ref = StopMatcher([stop])
+    want = []
+    for t in base:
+        flushed, matched = ref.push(t)
+        want += [tok for tok, _ in flushed]
+        if matched:
+            break
+
+    def run_k(spec_k):
+        eng = Engine(cfg, params, mesh, EngineConfig(
+            slots=1, max_prompt_len=8, max_seq_len=32, decode_chunk=2,
+            spec_k=spec_k)).warmup()
+        sched = _run(eng, [Request("s", prompt, max_tokens=10,
+                                   sampling=sp, stop=[stop])])
+        eng.close()
+        return sched.completions["s"]
+
+    spec, plain = run_k(3), run_k(0)
+    assert spec.finish_reason == plain.finish_reason == "stop"
+    assert spec.tokens == plain.tokens == want  # trimmed emission
+    assert len(spec.logprobs) == len(spec.tokens)
+    np.testing.assert_allclose(spec.logprobs, plain.logprobs,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_spec_tp2_matches_tp1(devices8):
+    """Spec decode under tp=2 sharding emits the same streams as
+    tp=1."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    reqs = _requests(3, 8, max_tokens=8)
+
+    def run_tp(tp):
+        mesh = mx.build_mesh(tp=tp, devices=devices8[:tp])
+        eng = Engine(cfg, params, mesh, EngineConfig(
+            slots=2, max_prompt_len=8, max_seq_len=24, decode_chunk=2,
+            spec_k=2)).warmup()
+        sched = _run(eng, reqs)
+        eng.close()
+        return {k: c.tokens for k, c in sched.completions.items()}
+
+    assert run_tp(1) == run_tp(2)
+
+
+def test_spec_int8_kv_parity(devices8):
+    """Under an int8 KV cache, spec and plain engines still emit
+    bit-identical streams to each other: the verify forward quantizes
+    through the same deterministic quantizer as the plain write, so
+    both paths hold the same cache bytes."""
+    cfg = _cfg(kv_cache_dtype="int8")
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    reqs = _requests(3, 8, max_tokens=8)
+
+    def run_k(spec_k):
+        eng = Engine(cfg, params, mesh, EngineConfig(
+            slots=2, max_prompt_len=8, max_seq_len=24, decode_chunk=2,
+            spec_k=spec_k)).warmup()
+        sched = _run(eng, reqs)
+        eng.close()
+        return {k: c.tokens for k, c in sched.completions.items()}
+
+    assert run_k(2) == run_k(0)
+
+
+# --- resilience + trace stability -------------------------------------------
+
+
+def test_spec_replay_after_fault_exact(devices8):
+    """A fault mid-spec-run replays interrupted requests bit-exactly:
+    the chaotic run's non-error completions equal a fault-free run's
+    (replay is forced onto the plain path while re-deriving, which must
+    not change a single token)."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    reqs = _requests(4, 8, max_tokens=10)
+
+    def run_plan(plan):
+        eng = Engine(cfg, params, mesh, EngineConfig(
+            slots=2, max_prompt_len=8, max_seq_len=32, decode_chunk=2,
+            spec_k=3), fault_plan=plan).warmup()
+        sched = _run(eng, reqs, resilience=ResilienceConfig(
+            backoff_base_s=0.001))
+        eng.close()
+        return sched
+
+    chaotic = run_plan(FaultPlan([FaultSpec("fetch", 2, "error")]))
+    clean = run_plan(None)
+    assert set(chaotic.completions) == set(clean.completions)
+    for rid, comp in chaotic.completions.items():
+        if comp.finish_reason == "error":
+            continue
+        assert comp.tokens == clean.completions[rid].tokens, rid
+    assert chaotic.summary()["rebuilds"] >= 1.0
+
+
+def test_spec_recompile_guard_flat_across_switching(devices8):
+    """Gate-driven spec/plain switching (probe cadence forced to
+    alternate), fault replay, and admission waves never recompile:
+    every program cache stays at 1 after warmup, step_spec included."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    eng = Engine(cfg, params, mesh, EngineConfig(
+        slots=2, max_prompt_len=8, max_seq_len=32, decode_chunk=2,
+        spec_k=3)).warmup()
+    reqs = _requests(6, 8, max_tokens=8)  # host jax draws pre-guard
+    with eng.recompile_guard():
+        sched = _run(eng, reqs,
+                     spec_gate=SpecGateConfig(probe_every=1,
+                                              min_probe_chunks=1))
+    sizes = eng.compiled_cache_sizes()
+    for name in ("init", "step", "step_spec", "retire", "admit"):
+        assert sizes[name] in (1, None), (name, sizes)
+    assert sched.summary()["spec_chunks"] >= 1.0
+    eng.close()
+
+
+# --- the payoff gate ---------------------------------------------------------
+
+
+def test_spec_gate_open_close_probe_cycle():
+    """The gate state machine under injected acceptance traces: it
+    measures plain first, probes spec, stays open while the acceptance
+    EWMA clears the measured break-even, closes when acceptance
+    collapses, and re-probes on the configured cadence (reopening only
+    with the hysteresis margin)."""
+    g = _SpecGate(SpecGateConfig(ewma_alpha=0.5, margin=1.05,
+                                 probe_every=3, min_probe_chunks=2),
+                  spec_k=3)
+    assert not g.want_spec()            # no plain baseline yet
+    g.observe_plain(0.010)
+    assert g.want_spec()                # measuring the spec side
+    g.observe_spec(0.015, 4.0)          # high acceptance, cheap verify
+    g.observe_spec(0.015, 4.0)
+    assert g.state() == GATE_OPEN and g.want_spec()
+    # acceptance collapses: 1 token/wave < break-even 1.5 → the EWMA
+    # (alpha 0.5: 4.0 → 2.5 → 1.75 → 1.375) closes on the third sample
+    g.observe_spec(0.015, 1.0)
+    g.observe_spec(0.015, 1.0)
+    g.observe_spec(0.015, 1.0)
+    assert g.state() == GATE_CLOSED
+    # closed: plain chunks until the probe cadence fires
+    for i in range(2):
+        g.observe_plain(0.010)
+        assert not g.want_spec()
+    g.observe_plain(0.010)
+    assert g.want_spec()                # probe_every=3 reached
+    # a probe at recovered acceptance must clear margin × break-even
+    g.observe_spec(0.015, 4.0)
+    g.observe_spec(0.015, 4.0)
+    assert g.state() == GATE_OPEN
+
+
+def test_spec_gate_serialized_probes_and_plain_refresh():
+    """The two pipelining hazards of fetch-side gate bookkeeping:
+    (a) until the gate has measured its way open, ``want_spec`` with a
+    speculative chunk already in flight must say plain — otherwise a
+    depth-d pipeline dispatches d consecutive probe chunks per cadence,
+    paying d× the documented probe overhead on 0%-acceptance traces;
+    (b) an OPEN gate must emit one plain chunk per ``probe_every`` spec
+    chunks to re-measure ``wall_plain`` — a frozen short-context
+    baseline inflates the break-even as sequences grow and flaps the
+    gate closed on exactly the workloads speculation pays for."""
+    g = _SpecGate(SpecGateConfig(ewma_alpha=0.5, margin=1.05,
+                                 probe_every=3, min_probe_chunks=2),
+                  spec_k=3)
+    g.observe_plain(0.010)
+    # (a) measuring phase: one probe at a time
+    assert g.want_spec() and not g.want_spec(spec_inflight=1)
+    g.observe_spec(0.015, 4.0)
+    g.observe_spec(0.015, 4.0)
+    assert g.state() == GATE_OPEN
+    # open gate: pipelined spec dispatches are NOT serialized
+    assert g.want_spec(spec_inflight=2)
+    # (b) probe_every spec chunks without a plain sample → refresh
+    g.observe_spec(0.015, 4.0)          # spec_since_plain hits 3
+    assert not g.want_spec()
+    g.observe_plain(0.010)              # baseline refreshed
+    assert g.want_spec() and g.state() == GATE_OPEN
+    # (a) closed gate: the cadence probe is serialized too
+    for _ in range(3):
+        g.observe_spec(0.015, 1.0)      # acceptance collapses → closed
+    assert g.state() == GATE_CLOSED
+    for _ in range(3):
+        g.observe_plain(0.010)
+    assert g.want_spec() and not g.want_spec(spec_inflight=1)
+
+
+def test_spec_gate_e2e_high_vs_adversarial(devices8):
+    """End-to-end gate behaviour: a repetitive greedy trace holds the
+    gate open with high draft acceptance; an adversarial
+    high-temperature trace collapses acceptance and ends with the gate
+    closed — with streams bit-identical to the plain engine either
+    way. The scheduler runs on an INJECTED ticking clock, so the
+    measured chunk walls (and with them the gate's break-even = 1.0)
+    are deterministic — the terminal gate state depends only on
+    acceptance, never on host load."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+
+    def run_trace(spec_k, sampled):
+        reqs = []
+        for i in range(3):
+            prompt = [int(t) for t in jax.random.randint(
+                jax.random.PRNGKey(50 + i), (4,), 0, VOCAB)]
+            sp = (SamplingParams(temperature=1.5, seed=i) if sampled
+                  else SamplingParams())
+            reqs.append(Request(f"r{i}", prompt, max_tokens=16,
+                                sampling=sp))
+        eng = Engine(cfg, params, mesh, EngineConfig(
+            slots=4, max_prompt_len=8, max_seq_len=32, decode_chunk=2,
+            spec_k=spec_k)).warmup()
+        tick = [0.0]
+
+        def clock():
+            tick[0] += 1e-3
+            return tick[0]
+
+        sched = _run(eng, reqs, clock=clock, sleep=lambda s: None,
+                     spec_gate=(SpecGateConfig(probe_every=1000)
+                                if spec_k else None))
+        eng.close()
+        return ({k: c.tokens for k, c in sched.completions.items()},
+                sched.summary())
+
+    hi_toks, hi = run_trace(3, sampled=False)
+    hi_plain, _ = run_trace(0, sampled=False)
+    assert hi_toks == hi_plain
+    assert hi["spec_accept_rate"] > 0.5, hi
+    # ~4 tokens/wave against the deterministic break-even of 1.0: open
+    assert hi["spec_gate_state"] == GATE_OPEN, hi
+    adv_toks, adv = run_trace(3, sampled=True)
+    adv_plain, _ = run_trace(0, sampled=True)
+    assert adv_toks == adv_plain
+    assert adv["spec_accept_rate"] < 0.3, adv
+    # 1 token/wave cannot clear the break-even: closed after probing
+    assert adv["spec_gate_state"] == GATE_CLOSED, adv
+
+
+def test_spec_constrained_requests_force_plain(devices8):
+    """A schema-constrained request (decode_chunk == 1, per-token mask
+    advance) must never ride a speculative chunk — the gate is forced
+    to the plain variant while one is active."""
+
+    class WhitelistConstraint:
+        """Minimal Request.constraint protocol: always allows the
+        full vocab, never completes (the decode runs to budget)."""
+
+        done = False
+
+        def reset(self):
+            pass
+
+        def allowed_tokens(self):
+            return list(range(VOCAB))
+
+        def advance(self, tok):
+            pass
+
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    eng = Engine(cfg, params, mesh, EngineConfig(
+        slots=2, max_prompt_len=8, max_seq_len=24, decode_chunk=1,
+        spec_k=2)).warmup()
+    prompt = [int(t) for t in jax.random.randint(
+        jax.random.PRNGKey(9), (4,), 0, VOCAB)]
+    sched = _run(eng, [Request("c", prompt, max_tokens=6,
+                               constraint=WhitelistConstraint())])
+    assert sched.completions["c"].tokens  # decoded through plain chunks
+    assert sched.summary()["spec_chunks"] == 0.0
+    eng.close()
+
+
+def test_spec_config_validation(devices8):
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    with pytest.raises(ValueError, match="spec_k"):
+        Engine(cfg, params, mesh, EngineConfig(
+            slots=1, max_prompt_len=8, max_seq_len=16, spec_k=-1))
+    with pytest.raises(ValueError, match="spec_hist"):
+        Engine(cfg, params, mesh, EngineConfig(
+            slots=1, max_prompt_len=8, max_seq_len=16, spec_k=2,
+            spec_hist=1))
+    eng = Engine(cfg, params, mesh, EngineConfig(
+        slots=1, max_prompt_len=8, max_seq_len=16))
+    with pytest.raises(ValueError, match="spec_k"):
+        eng.step_async(spec=True)
+    with pytest.raises(ValueError, match="spec_gate"):
+        Scheduler(eng, spec_gate=SpecGateConfig())
+    with pytest.raises(ValueError, match="spec_k"):
+        gpt.decode_steps_spec(
+            dataclasses.replace(cfg), None, None, {}, 1, spec_k=0)
