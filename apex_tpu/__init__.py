@@ -35,8 +35,15 @@ documented in SURVEY.md (the reference mount was empty at survey time).
 
 __version__ = "0.1.0"
 
-from apex_tpu import _compat  # noqa: F401  (jax.shard_map shim)
-from apex_tpu import mesh  # noqa: F401
+try:
+    from apex_tpu import _compat  # noqa: F401  (jax.shard_map shim)
+    from apex_tpu import mesh  # noqa: F401
+except ImportError:
+    # No working jax (lint-only CI, a tree too broken to import): the
+    # stdlib-only corners (apex_tpu.analysis) stay usable; every
+    # jax-backed subpackage raises with the cause on first access via
+    # __getattr__ below.
+    pass
 
 __all__ = [
     "mesh",
@@ -80,7 +87,11 @@ def __getattr__(name):
         try:
             return importlib.import_module(f"apex_tpu.{name}")
         except ModuleNotFoundError as e:
-            raise AttributeError(
-                f"module 'apex_tpu' has no attribute {name!r} ({e})"
-            ) from e
+            if e.name == f"apex_tpu.{name}":
+                raise AttributeError(
+                    f"module 'apex_tpu' has no attribute {name!r} ({e})"
+                ) from e
+            # the subpackage exists but a dependency (jax) does not —
+            # report the real missing module, not a fake attribute
+            raise
     raise AttributeError(f"module 'apex_tpu' has no attribute {name!r}")

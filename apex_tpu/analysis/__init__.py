@@ -1,0 +1,64 @@
+"""Static trace-safety, donation, and recompile-hazard linter.
+
+The compiled serving/training stack hangs on invariants that are only
+policed at runtime — the RecompileGuard fires *after* a recompile, the
+ABI-drift test *after* a forgotten bump, a use-after-donate *after* a
+chip run returns garbage. This package is their static counterpart: a
+dependency-free (stdlib ``ast``) rule engine that catches the bug
+classes at lint time, before a chip or a tier-1 run ever sees them.
+
+Usage::
+
+    python -m apex_tpu.analysis                  # apex_tpu bench.py examples
+    python -m apex_tpu.analysis --changed        # git-diff mode (pre-commit)
+    python -m apex_tpu.analysis --json path ...  # machine-readable summary
+    python -m apex_tpu.analysis --list-rules
+
+Per-line suppression requires a justification (the bare form is itself
+a finding, and a suppression that no longer matches anything is too —
+the allowlist cannot rot)::
+
+    x = int(pos)  # apex: noqa[TRACER-LEAK]: host-side replay path, never traced
+
+Rule battery (see ``docs/API.md`` for the full table):
+
+=================  =====================================================
+TRACER-LEAK        int()/float()/bool()/.item()/np.* coercions and
+                   Python if/while on values reachable from tracer
+                   arguments of jit-reachable functions
+USE-AFTER-DONATE   reads of a donated cache/state binding after the
+                   dispatch that consumed it; dispatches that drop a
+                   donated buffer without rebinding it
+RECOMPILE-HAZARD   per-call-fresh values (f-strings, dict/list/set
+                   displays, comprehensions) flowing into compiled
+                   entry points; len() into static argnums
+WARMUP-COVERAGE    every compiled program tracked by
+                   compiled_cache_sizes()/the sentinel must be
+                   reachable from warmup()
+ABI-LOCKSTEP       csrc kAbiVersion == _native._ABI_VERSION
+METRIC-DRIFT       metric/span names in docs vs. names registered in
+                   telemetry/serving, both directions
+CITATION           docstring upstream citations carry the
+                   ``apex/<path> (U)`` marker (CLAUDE.md convention)
+TIER1-COST         tests that call Engine.warmup() carry the ``slow``
+                   marker or a justified suppression
+NOQA-BARE          a suppression comment without justification text
+NOQA-UNUSED        a suppression whose rule no longer fires there
+=================  =====================================================
+
+This module must stay importable without jax/numpy (the tier-1 test
+runs it in a bare subprocess), so it lives outside ``apex_tpu``'s
+import graph — import it as ``apex_tpu.analysis`` only.
+"""
+
+from apex_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Project,
+    Suppression,
+    run_analysis,
+    summary_dict,
+)
+from apex_tpu.analysis.rules import ALL_RULES, rule_by_id  # noqa: F401
+from apex_tpu.analysis.rules.abi_lockstep import (  # noqa: F401
+    parse_abi_versions,
+)

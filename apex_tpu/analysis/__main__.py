@@ -1,0 +1,79 @@
+"""CLI: ``python -m apex_tpu.analysis [paths...] [options]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from apex_tpu.analysis.core import (
+    render_json,
+    render_text,
+    run_analysis,
+)
+from apex_tpu.analysis.rules import ALL_RULES
+
+_DEFAULT_TARGETS = ["apex_tpu", "bench.py", "examples"]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m apex_tpu.analysis",
+        description="Static trace-safety / donation / recompile-hazard "
+                    "linter for the compiled stack.")
+    parser.add_argument(
+        "paths", nargs="*", default=None,
+        help="files or directories to lint (default: "
+             + " ".join(_DEFAULT_TARGETS) + ")")
+    parser.add_argument(
+        "--changed", action="store_true",
+        help="lint only files changed vs git HEAD (worktree + staged "
+             "+ untracked) — the pre-commit mode; global rules run "
+             "only when their trigger files changed")
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable summary (findings, counts, active "
+             "suppression count) instead of text")
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids to run (default: all); NOQA "
+             "hygiene always runs, scoped to the enabled ids")
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root override (default: walked up from the first "
+             "target to pyproject.toml/.git)")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule battery and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id:18s} {rule.summary}")
+        print(f"{'NOQA-BARE':18s} (always-on hygiene, not a --rules id) "
+              f"a suppression comment without justification text")
+        print(f"{'NOQA-UNUSED':18s} (always-on hygiene, not a --rules id) "
+              f"a suppression whose rule no longer fires on that line")
+        print(f"{'NOQA-UNKNOWN':18s} (full-battery hygiene, not a --rules "
+              f"id) a suppression naming a rule id that does not exist")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    try:
+        result = run_analysis(
+            args.paths or _DEFAULT_TARGETS, rules=rules, root=args.root,
+            changed_only=args.changed)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(render_json(result) if args.as_json else render_text(result))
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

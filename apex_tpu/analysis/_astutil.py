@@ -1,0 +1,74 @@
+"""Small shared AST helpers for the rule battery."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``jax.jit`` for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def const_int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """A literal tuple/int of ints (``donate_argnums=(1, 2)`` /
+    ``static_argnums=0``); None when not statically known."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def keyword_arg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def walk_stmts(body: Iterable[ast.stmt]) -> Iterable[ast.stmt]:
+    """Every statement, recursively, in source order (control flow
+    flattened — the linter's straight-line approximation)."""
+    for stmt in body:
+        yield stmt
+        for field in ("body", "orelse", "finalbody"):
+            yield from walk_stmts(getattr(stmt, field, []) or [])
+        for handler in getattr(stmt, "handlers", []) or []:
+            yield from walk_stmts(handler.body)
+
+
+def string_constants(node: ast.AST) -> List[str]:
+    return [n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+def attr_reads(node: ast.AST, base: str = "self") -> List[str]:
+    """Names of ``<base>.X`` attribute accesses anywhere under node."""
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and \
+                isinstance(n.value, ast.Name) and n.value.id == base:
+            out.append(n.attr)
+    return out

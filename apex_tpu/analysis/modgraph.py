@@ -1,0 +1,325 @@
+"""Module/function index and jit-entry discovery for the call walk.
+
+The tracer-leak rule needs three things no single AST pass gives:
+
+1. a per-module function table with lexical scoping (nested defs,
+   methods, factory functions returning nested defs — the engine's
+   ``make_admit(bucket)`` pattern);
+2. import resolution so ``gpt.decode_steps(...)`` inside
+   ``serving/engine.py`` lands on the ``decode_steps`` FunctionDef in
+   ``models/gpt.py``;
+3. the jit entry points: ``@jax.jit`` decorators, ``jax.jit(f)`` /
+   ``jax.jit(jax.shard_map(f, ...))`` call sites, and local jit-wrapper
+   lambdas (``sm = lambda f, ...: jax.jit(jax.shard_map(f, ...), ...)``
+   — every compiled program in the engine is built through one).
+
+Everything here is best-effort: an unresolvable callee is silently
+skipped (a linter must underapproximate, never crash), and the walk
+only ever marks *more* parameters traced, so precision losses surface
+as findings a human reviews, not as silent passes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from apex_tpu.analysis._astutil import (
+    const_int_tuple,
+    const_str,
+    dotted,
+    keyword_arg,
+)
+from apex_tpu.analysis.core import FileCtx, Project
+from apex_tpu.analysis.rules.compiled import (
+    jit_call_names,
+    jit_wrapper_names,
+)
+
+_SHARD_WRAPPERS = {"jax.shard_map", "shard_map",
+                   "jax.experimental.shard_map.shard_map"}
+_PARTIAL = {"functools.partial", "partial"}
+
+
+class FuncInfo:
+    """One function/lambda definition with its lexical scope."""
+
+    __slots__ = ("node", "qualname", "module", "parent", "local_defs",
+                 "local_assigns")
+
+    def __init__(self, node, qualname: str, module: "ModuleInfo",
+                 parent: Optional["FuncInfo"]):
+        self.node = node
+        self.qualname = qualname
+        self.module = module
+        self.parent = parent
+        #: name -> FuncInfo for defs directly inside this function
+        self.local_defs: Dict[str, FuncInfo] = {}
+        #: name -> value expr for simple local `name = <expr>` assigns
+        #: (one level — enough to see through `fn = make_admit(b)`)
+        self.local_assigns: Dict[str, ast.AST] = {}
+
+    @property
+    def params(self) -> List[str]:
+        a = self.node.args
+        names = [p.arg for p in
+                 getattr(a, "posonlyargs", []) + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+    def positional_params(self) -> List[str]:
+        a = self.node.args
+        return [p.arg for p in getattr(a, "posonlyargs", []) + a.args]
+
+    def returned_local_def(self) -> Optional["FuncInfo"]:
+        """The nested def this function returns, if its return is a
+        bare local function name (the factory pattern)."""
+        for stmt in ast.walk(self.node):
+            if isinstance(stmt, ast.Return) and \
+                    isinstance(stmt.value, ast.Name):
+                fi = self.local_defs.get(stmt.value.id)
+                if fi is not None:
+                    return fi
+        return None
+
+
+class ModuleInfo:
+    def __init__(self, ctx: FileCtx):
+        self.ctx = ctx
+        #: local name -> dotted import target ("np" -> "numpy")
+        self.imports: Dict[str, str] = {}
+        self.top: Dict[str, FuncInfo] = {}
+        self.by_node: Dict[int, FuncInfo] = {}
+        if ctx.tree is not None:
+            self._collect_imports(ctx.tree)
+            self._index(ctx.tree, None, "")
+
+    def _collect_imports(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.imports[alias.asname or
+                                 alias.name.split(".")[0]] = alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for alias in node.names:
+                    self.imports[alias.asname or alias.name] = \
+                        f"{node.module}.{alias.name}"
+
+    def _index(self, node: ast.AST, parent: Optional[FuncInfo],
+               prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                fi = FuncInfo(child, qn, self, parent)
+                self.by_node[id(child)] = fi
+                if parent is not None:
+                    parent.local_defs[child.name] = fi
+                else:
+                    self.top.setdefault(child.name, fi)
+                self._index(child, fi, f"{qn}.")
+            elif isinstance(child, ast.ClassDef):
+                self._index(child, parent, f"{prefix}{child.name}.")
+            elif isinstance(child, ast.Assign) and parent is not None \
+                    and len(child.targets) == 1 \
+                    and isinstance(child.targets[0], ast.Name):
+                parent.local_assigns[child.targets[0].id] = child.value
+                self._index(child, parent, prefix)
+            else:
+                self._index(child, parent, prefix)
+
+    def import_root(self, name: str) -> Optional[str]:
+        """The dotted import target a bare name is bound to."""
+        return self.imports.get(name)
+
+
+class Graph:
+    """Project-wide view: modules, cross-module resolution, jit roots."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        project.ensure_package_index()
+        self.modules: Dict[str, ModuleInfo] = {}
+        for name, ctx in project.index.items():
+            self.modules[name] = ModuleInfo(ctx)
+
+    # -- resolution --------------------------------------------------------
+
+    def resolve_dotted(self, target: str) -> Optional[FuncInfo]:
+        """``apex_tpu.models.gpt.decode_steps`` -> its FuncInfo."""
+        parts = target.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = self.modules.get(".".join(parts[:cut]))
+            if mod is not None:
+                rest = parts[cut:]
+                if len(rest) == 1:
+                    return mod.top.get(rest[0])
+                return None
+        return None
+
+    def resolve_call(self, mod: ModuleInfo, scope: Optional[FuncInfo],
+                     func: ast.AST) -> Optional[FuncInfo]:
+        """The FuncInfo a call expression lands on, or None."""
+        if isinstance(func, ast.Name):
+            s = scope
+            while s is not None:
+                if func.id in s.local_defs:
+                    return s.local_defs[func.id]
+                v = s.local_assigns.get(func.id)
+                if v is not None:
+                    got = self._resolve_value(mod, s, v)
+                    if got is not None:
+                        return got
+                s = s.parent
+            if func.id in mod.top:
+                return mod.top[func.id]
+            target = mod.import_root(func.id)
+            if target:
+                return self.resolve_dotted(target)
+            return None
+        if isinstance(func, ast.Attribute):
+            d = dotted(func)
+            if d is None:
+                return None
+            base, rest = d.split(".", 1)
+            target = mod.import_root(base)
+            if target:
+                return self.resolve_dotted(f"{target}.{rest}")
+        return None
+
+    def _resolve_value(self, mod: ModuleInfo, scope: FuncInfo,
+                       value: ast.AST) -> Optional[FuncInfo]:
+        """See through ``fn = make_admit(bucket)`` — a local bound to a
+        factory call resolves to the factory's returned nested def."""
+        if isinstance(value, ast.Call):
+            factory = self.resolve_call(mod, scope, value.func)
+            if factory is not None:
+                return factory.returned_local_def()
+        elif isinstance(value, (ast.Lambda,)):
+            fi = FuncInfo(value, "<lambda>", mod, scope)
+            mod.by_node.setdefault(id(value), fi)
+            return mod.by_node[id(value)]
+        return None
+
+    # -- jit entry discovery -----------------------------------------------
+
+    def _is_jit_call(self, call: ast.Call, mod: ModuleInfo) -> bool:
+        # ONE definition of "a jax.jit spelling" for the whole battery
+        # (handles `from jax import jit as J` and `import jax as X`)
+        return dotted(call.func) in jit_call_names(mod.ctx)
+
+    def _static_params(self, call: ast.Call, fi: FuncInfo) -> Set[str]:
+        """Parameter names excluded from tracing by static_argnums /
+        static_argnames on the jit call."""
+        out: Set[str] = set()
+        pos = fi.positional_params()
+        nums = keyword_arg(call, "static_argnums")
+        if nums is not None:
+            idxs = const_int_tuple(nums)
+            for i in idxs or ():
+                if 0 <= i < len(pos):
+                    out.add(pos[i])
+        names = keyword_arg(call, "static_argnames")
+        if names is not None:
+            s = const_str(names)
+            vals = [s] if s is not None else [
+                v for v in (const_str(e) for e in
+                            getattr(names, "elts", [])) if v]
+            out.update(vals)
+        return out
+
+    def _unwrap_jitted(self, mod: ModuleInfo, scope: Optional[FuncInfo],
+                       expr: ast.AST) -> Optional[FuncInfo]:
+        """The function object a jit argument denotes: through
+        shard_map / partial wrappers, names, factory results, lambdas."""
+        while isinstance(expr, ast.Call):
+            d = dotted(expr.func)
+            if d in _SHARD_WRAPPERS or d in _PARTIAL:
+                if not expr.args:
+                    return None
+                expr = expr.args[0]
+                continue
+            got = self.resolve_call(mod, scope, expr.func)
+            if got is not None:
+                return got.returned_local_def()
+            return None
+        if isinstance(expr, ast.Lambda):
+            fi = FuncInfo(expr, "<lambda>", mod, scope)
+            mod.by_node.setdefault(id(expr), fi)
+            return mod.by_node[id(expr)]
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            return self.resolve_call(mod, scope, expr)
+        return None
+
+    def jit_roots(self) -> List[Tuple[FuncInfo, Set[str]]]:
+        """Every statically-discoverable jit entry point with the set
+        of parameter names that are TRACED (params minus static ones).
+        """
+        roots: List[Tuple[FuncInfo, Set[str]]] = []
+        seen: Set[int] = set()
+
+        def add(fi: Optional[FuncInfo], static: Set[str]) -> None:
+            if fi is None or id(fi.node) in seen:
+                return
+            seen.add(id(fi.node))
+            traced = set(fi.params) - static
+            if traced:
+                roots.append((fi, traced))
+
+        for mod in self.modules.values():
+            if mod.ctx.tree is None:
+                continue
+            jit_names = jit_call_names(mod.ctx)
+            wrapper_names = jit_wrapper_names(mod.ctx)
+            # decorators
+            for node in ast.walk(mod.ctx.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        call = dec if isinstance(dec, ast.Call) else None
+                        d = dotted(call.func if call else dec)
+                        if d in jit_names:
+                            fi = mod.by_node.get(id(node))
+                            static = (self._static_params(call, fi)
+                                      if call and fi else set())
+                            add(fi, static)
+                        elif call is not None and d in _PARTIAL \
+                                and call.args \
+                                and dotted(call.args[0]) in jit_names:
+                            fi = mod.by_node.get(id(node))
+                            add(fi, self._static_params(call, fi)
+                                if fi else set())
+                # jit() call sites + wrapper-lambda call sites
+                # (_enclosing is a linear scan — only pay for it on the
+                # handful of nodes that actually build a program)
+                if isinstance(node, ast.Call):
+                    if self._is_jit_call(node, mod) and node.args:
+                        scope = self._enclosing(mod, node)
+                        fi = self._unwrap_jitted(mod, scope, node.args[0])
+                        add(fi, self._static_params(node, fi)
+                            if fi else set())
+                    elif isinstance(node.func, ast.Name) and \
+                            node.func.id in wrapper_names and node.args:
+                        scope = self._enclosing(mod, node)
+                        fi = self._unwrap_jitted(mod, scope, node.args[0])
+                        add(fi, set())
+        return roots
+
+    def _enclosing(self, mod: ModuleInfo,
+                   node: ast.AST) -> Optional[FuncInfo]:
+        """The innermost FuncInfo whose body contains ``node`` (by line
+        span — cheap and good enough for scope lookups)."""
+        best: Optional[FuncInfo] = None
+        lineno = getattr(node, "lineno", None)
+        if lineno is None:
+            return None
+        for fi in mod.by_node.values():
+            n = fi.node
+            end = getattr(n, "end_lineno", None)
+            if n.lineno <= lineno and (end is None or lineno <= end):
+                if best is None or n.lineno > best.node.lineno:
+                    best = fi
+        return best

@@ -1,0 +1,28 @@
+"""The rule battery. Import order = report order in --list-rules."""
+
+from apex_tpu.analysis.rules.tracer_leak import TracerLeakRule
+from apex_tpu.analysis.rules.donation import UseAfterDonateRule
+from apex_tpu.analysis.rules.recompile_hazard import RecompileHazardRule
+from apex_tpu.analysis.rules.warmup_coverage import WarmupCoverageRule
+from apex_tpu.analysis.rules.abi_lockstep import AbiLockstepRule
+from apex_tpu.analysis.rules.metric_drift import MetricDriftRule
+from apex_tpu.analysis.rules.citation import CitationRule
+from apex_tpu.analysis.rules.tier1_cost import Tier1CostRule
+
+ALL_RULES = [
+    TracerLeakRule(),
+    UseAfterDonateRule(),
+    RecompileHazardRule(),
+    WarmupCoverageRule(),
+    AbiLockstepRule(),
+    MetricDriftRule(),
+    CitationRule(),
+    Tier1CostRule(),
+]
+
+
+def rule_by_id(rule_id: str):
+    for r in ALL_RULES:
+        if r.id == rule_id:
+            return r
+    raise KeyError(rule_id)

@@ -1,0 +1,76 @@
+"""ABI-LOCKSTEP: kAbiVersion (csrc) == _ABI_VERSION (python), parsed.
+
+The runtime rejects a stale prebuilt ``.so``, but a *forgotten bump on
+one side* ships silently until something crosses the C ABI. CLAUDE.md's
+convention says the two constants move together; this rule is the
+static twin of the runtime drift test (which now wraps
+:func:`parse_abi_versions` so the parsing lives in exactly one place).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Iterable, List, Optional, Tuple
+
+from apex_tpu.analysis.core import Finding, Project
+
+CPP_REL = "csrc/host_runtime.cpp"
+PY_REL = "apex_tpu/_native/__init__.py"
+
+_CPP_RE = re.compile(
+    r"^static const int32_t kAbiVersion\s*=\s*(\d+)\s*;", re.MULTILINE)
+_PY_RE = re.compile(r"^_ABI_VERSION\s*=\s*(\d+)\s*$", re.MULTILINE)
+
+
+def parse_abi_versions(root: str) -> Tuple[Optional[int], Optional[int]]:
+    """(kAbiVersion from csrc, _ABI_VERSION from _native) under
+    ``root``; None for a side whose declaration cannot be found. THE
+    parser — the runtime test and the lint rule both call it."""
+    cpp = py = None
+    try:
+        with open(os.path.join(root, CPP_REL), encoding="utf-8") as f:
+            m = _CPP_RE.search(f.read())
+            cpp = int(m.group(1)) if m else None
+    except OSError:
+        pass
+    try:
+        with open(os.path.join(root, PY_REL), encoding="utf-8") as f:
+            m = _PY_RE.search(f.read())
+            py = int(m.group(1)) if m else None
+    except OSError:
+        pass
+    return cpp, py
+
+
+class AbiLockstepRule:
+    id = "ABI-LOCKSTEP"
+    summary = ("csrc kAbiVersion and _native._ABI_VERSION must agree "
+               "(bump both together on any C-ABI change)")
+    #: --changed mode runs this rule when either side moved
+    triggers: Tuple[str, ...] = (CPP_REL, PY_REL)
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        has_cpp = os.path.exists(os.path.join(project.root, CPP_REL))
+        has_py = os.path.exists(os.path.join(project.root, PY_REL))
+        if not (has_cpp and has_py):
+            return findings  # not this repo shape (synthetic tree)
+        cpp, py = parse_abi_versions(project.root)
+        if cpp is None:
+            findings.append(Finding(
+                self.id, CPP_REL, 1,
+                "kAbiVersion declaration not found (expected "
+                "`static const int32_t kAbiVersion = N;`)"))
+        if py is None:
+            findings.append(Finding(
+                self.id, PY_REL, 1,
+                "_ABI_VERSION assignment not found (expected "
+                "`_ABI_VERSION = N` at column 0)"))
+        if cpp is not None and py is not None and cpp != py:
+            findings.append(Finding(
+                self.id, PY_REL, 1,
+                f"ABI drift: csrc kAbiVersion={cpp} != _native "
+                f"_ABI_VERSION={py} — bump both together (CLAUDE.md "
+                f"'Native lib')"))
+        return findings

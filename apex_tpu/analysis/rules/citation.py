@@ -1,0 +1,89 @@
+"""CITATION: upstream-path docstring citations carry the (U) marker.
+
+CLAUDE.md's convention: ``apex/<path> (U)`` means an upstream-layout
+path that was never verified against the reference mount (which was
+empty at survey time — SURVEY.md header). A citation without the
+marker silently claims a verified path; readers chase files that may
+not exist under that name. The rule scans every docstring, joins
+wrapped lines (citations routinely break across the 72-col fill), and
+requires ``(U)`` within a short window after any ``apex/...`` path
+that ends in a source extension. Bare directory references
+(``apex/amp/*``, ``apex.optimizers`` module spellings) are out of
+scope — only concrete file citations assert enough to need the tag.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Tuple
+
+from apex_tpu.analysis.core import Finding, Project
+
+#: a concrete upstream file citation: path chars (incl. {a,b} brace
+#: groups once whitespace is collapsed) ending in a source extension
+_CITE = re.compile(
+    r"apex/[A-Za-z0-9_./*{},+-]*\.(?:py|cpp|cu|cuh|h|c)\b")
+#: the marker must appear within this many characters after the path
+#: (allows a closing paren, a comma-joined second path, or ``+``)
+_WINDOW = 48
+_MARKER = "(U)"
+
+
+def _docstrings(tree: ast.AST) -> Iterable[Tuple[int, str]]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            doc = ast.get_docstring(node, clean=False)
+            if doc:
+                body = node.body[0]
+                yield body.lineno, doc
+
+
+class CitationRule:
+    id = "CITATION"
+    summary = ("docstring citations of upstream files must use the "
+               "`apex/<path> (U)` form (CLAUDE.md convention)")
+    triggers: Tuple[str, ...] = ()
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for ctx in project.targets:
+            if ctx.tree is None:
+                continue
+            for start_line, doc in _docstrings(ctx.tree):
+                # collapse the wrap: join continuation whitespace so a
+                # path split across lines matches as one token, but
+                # remember which original line each collapsed offset
+                # came from for the finding anchor
+                collapsed: List[str] = []
+                offsets: List[int] = []  # collapsed index -> line delta
+                line_delta = 0
+                prev_ws = False
+                for ch in doc:
+                    if ch == "\n":
+                        line_delta += 1
+                        ch = " "
+                    if ch in " \t":
+                        if prev_ws:
+                            continue
+                        prev_ws = True
+                    else:
+                        prev_ws = False
+                    collapsed.append(ch)
+                    offsets.append(line_delta)
+                text = "".join(collapsed)
+                for m in _CITE.finditer(text):
+                    window = text[m.end():m.end() + _WINDOW]
+                    # a second path in the same parenthetical citation
+                    # shares the trailing marker: look ahead past it
+                    if _MARKER in window:
+                        continue
+                    lineno = start_line + offsets[m.start()]
+                    findings.append(Finding(
+                        self.id, ctx.rel, lineno,
+                        f"upstream citation {m.group(0)!r} lacks the "
+                        f"(U) marker — write `apex/<path> (U)` "
+                        f"(CLAUDE.md: upstream-layout path, unverified "
+                        f"against the mount)"))
+        return findings

@@ -1,0 +1,158 @@
+"""Shared discovery of compiled-program attributes on a class.
+
+The engine builds its programs as ``self._step = sm(step_local, ...,
+donate=(1, 2))`` / ``self._admits[(bucket, k)] = sm(...)`` where ``sm``
+is a local lambda over ``jax.jit(jax.shard_map(...))``. Three rules
+need that registry: USE-AFTER-DONATE (which argument positions are
+donated), RECOMPILE-HAZARD (which calls dispatch compiled programs),
+and WARMUP-COVERAGE (which programs exist at all).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from apex_tpu.analysis._astutil import const_int_tuple, dotted
+from apex_tpu.analysis.core import FileCtx
+
+_JIT_NAMES = {"jax.jit", "jit"}
+
+
+def jit_call_names(ctx: FileCtx) -> set:
+    """Dotted names that denote ``jax.jit`` in this module: the
+    literals plus ``from jax import jit as J`` / ``import jax as X``
+    aliases — keeps this discovery consistent with modgraph's
+    import-aware ``_is_jit_call``. Memoized on the FileCtx."""
+    cached = getattr(ctx, "_jit_call_names", None)
+    if cached is not None:
+        return cached
+    out = set(_JIT_NAMES)
+    if ctx.tree is not None:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.module == "jax":
+                    for a in node.names:
+                        if a.name == "jit" and a.asname:
+                            out.add(a.asname)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax" and a.asname:
+                        out.add(f"{a.asname}.jit")
+    ctx._jit_call_names = out
+    return out
+
+
+@dataclasses.dataclass
+class Program:
+    attr: str          # the self attribute (or dict attribute) name
+    is_dict: bool      # True for `self._admits[key] = ...` families
+    donate: Tuple[int, ...]
+    line: int
+
+
+@dataclasses.dataclass
+class ClassPrograms:
+    node: ast.ClassDef
+    ctx: FileCtx
+    programs: Dict[str, Program]
+
+    def methods(self) -> Iterable[ast.FunctionDef]:
+        for stmt in self.node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield stmt
+
+
+def jit_wrapper_names(ctx: FileCtx) -> set:
+    """Names bound to lambdas whose body contains a jax.jit call —
+    memoized on the FileCtx (three rules ask per file; the answer only
+    depends on the parsed tree)."""
+    cached = getattr(ctx, "_jit_wrappers", None)
+    if cached is None:
+        cached = _jit_wrapper_names(ctx) if ctx.tree else set()
+        ctx._jit_wrappers = cached
+    return cached
+
+
+def _jit_wrapper_names(ctx: FileCtx) -> set:
+    jit_names = jit_call_names(ctx)
+    out = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Lambda):
+            for inner in ast.walk(node.value):
+                if isinstance(inner, ast.Call) and \
+                        dotted(inner.func) in jit_names:
+                    out.add(node.targets[0].id)
+                    break
+    return out
+
+
+def _program_call_donate(call: ast.Call, wrappers: set,
+                         jit_names: set) -> Optional[Tuple[int, ...]]:
+    """Donate positions if ``call`` builds a compiled program (a
+    ``jax.jit(...)`` call or a jit-wrapper-lambda call); None when the
+    call is not a program builder at all."""
+    d = dotted(call.func)
+    is_builder = d in jit_names or (
+        isinstance(call.func, ast.Name) and call.func.id in wrappers)
+    if not is_builder:
+        return None
+    for kw in call.keywords:
+        if kw.arg and "donate" in kw.arg:
+            t = const_int_tuple(kw.value)
+            if t:
+                return t
+    return ()
+
+
+def collect_class_programs(ctx: FileCtx) -> List[ClassPrograms]:
+    """Every class in ``ctx`` that assigns at least one compiled
+    program to a ``self`` attribute (directly or into a dict).
+    Memoized on the FileCtx — three rules ask per file, and the full
+    module walk is the battery's single biggest cost."""
+    cached = getattr(ctx, "_class_programs", None)
+    if cached is not None:
+        return cached
+    if ctx.tree is None:
+        ctx._class_programs = []
+        return []
+    wrappers = jit_wrapper_names(ctx)
+    jit_names = jit_call_names(ctx)
+    out: List[ClassPrograms] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        programs: Dict[str, Program] = {}
+        for stmt in ast.walk(node):
+            if not (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.value, ast.Call)):
+                continue
+            donate = _program_call_donate(stmt.value, wrappers, jit_names)
+            if donate is None:
+                continue
+            target = stmt.targets[0]
+            if isinstance(target, ast.Attribute) and \
+                    isinstance(target.value, ast.Name) and \
+                    target.value.id == "self":
+                prev = programs.get(target.attr)
+                programs[target.attr] = Program(
+                    target.attr, False,
+                    donate or (prev.donate if prev else ()),
+                    stmt.lineno)
+            elif isinstance(target, ast.Subscript) and \
+                    isinstance(target.value, ast.Attribute) and \
+                    isinstance(target.value.value, ast.Name) and \
+                    target.value.value.id == "self":
+                attr = target.value.attr
+                prev = programs.get(attr)
+                programs[attr] = Program(
+                    attr, True, donate or (prev.donate if prev else ()),
+                    stmt.lineno)
+        if programs:
+            out.append(ClassPrograms(node, ctx, programs))
+    ctx._class_programs = out
+    return out

@@ -1,0 +1,227 @@
+"""USE-AFTER-DONATE: reads of a donated device binding after dispatch.
+
+The engine's step/admit/retire programs donate the cache/state buffers
+(``donate_argnums``): after a dispatch the old arrays are dead, and the
+PR-4 protocol is *rebind at dispatch* — ``self.cache, self.state, ... =
+fn(self._params, self.cache, self.state, ...)`` in one statement. This
+rule replays that protocol statically inside every method of a class
+that owns compiled programs:
+
+- an argument at a donated position that is a ``self.X`` attribute
+  marks ``X`` consumed by that statement;
+- a statement that *reads* a consumed attribute before something
+  rebinds it is a finding (the runtime symptom is garbage tokens or a
+  deleted-buffer error, typically only on a real chip where donation
+  actually aliases);
+- a dispatch whose statement does not rebind the consumed attribute at
+  all is a finding too (the binding is dead the moment the call
+  returns, whether or not anyone reads it later).
+
+Branches are merged conservatively (a buffer consumed on either arm
+stays consumed after the join); ``except`` bodies start from the
+pre-``try`` state unioned with the body's (the fault path of
+``register_prefix``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from apex_tpu.analysis.core import Finding, Project
+from apex_tpu.analysis.rules.compiled import (
+    ClassPrograms,
+    Program,
+    collect_class_programs,
+)
+
+
+class UseAfterDonateRule:
+    id = "USE-AFTER-DONATE"
+    summary = ("reads of a donated cache/state binding after the "
+               "dispatch that consumed it; donated dispatches that "
+               "never rebind the buffer")
+    triggers: Tuple[str, ...] = ()
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for ctx in project.targets:
+            for cp in collect_class_programs(ctx):
+                for method in cp.methods():
+                    findings.extend(_MethodScan(cp, method).scan())
+        return findings
+
+
+class _MethodScan:
+    def __init__(self, cp: ClassPrograms, method: ast.FunctionDef):
+        self.cp = cp
+        self.method = method
+        self.findings: List[Finding] = []
+        self.aliases: Dict[str, Program] = {}
+
+    # -- program identification -------------------------------------------
+
+    def _self_attr(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self":
+            return node.attr
+        return None
+
+    def _expr_program(self, value: ast.AST) -> Optional[Program]:
+        """`self._step` / `self._admits[key]` as a program value."""
+        attr = self._self_attr(value)
+        if attr is not None:
+            p = self.cp.programs.get(attr)
+            return p if p is not None and not p.is_dict else None
+        if isinstance(value, ast.Subscript):
+            attr = self._self_attr(value.value)
+            if attr is not None:
+                p = self.cp.programs.get(attr)
+                return p if p is not None and p.is_dict else None
+        return None
+
+    def _call_program(self, call: ast.Call) -> Optional[Program]:
+        p = self._expr_program(call.func)
+        if p is not None:
+            return p
+        if isinstance(call.func, ast.Name):
+            return self.aliases.get(call.func.id)
+        return None
+
+    # -- findings ----------------------------------------------------------
+
+    def _emit(self, node: ast.AST, message: str) -> None:
+        self.findings.append(Finding(
+            UseAfterDonateRule.id, self.cp.ctx.rel, node.lineno,
+            message, col=node.col_offset))
+
+    def _check_reads(self, node: ast.AST, consumed: Set[str]) -> None:
+        if not consumed:
+            return
+        for n in ast.walk(node):
+            attr = self._self_attr(n)
+            if attr is not None and attr in consumed and \
+                    isinstance(n.ctx, ast.Load):
+                self._emit(
+                    n, f"self.{attr} was donated to an earlier dispatch "
+                       f"in this method and read before being rebound — "
+                       f"the buffer is dead after donation")
+
+    # -- statement processing ---------------------------------------------
+
+    def _track_aliases(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            p = self._expr_program(stmt.value)
+            if p is not None:
+                self.aliases[stmt.targets[0].id] = p
+        if isinstance(stmt, ast.For) and \
+                isinstance(stmt.target, ast.Tuple) and stmt.target.elts \
+                and isinstance(stmt.target.elts[-1], ast.Name):
+            # `for (key, k), fn in sorted(self._admits.items()):`
+            for n in ast.walk(stmt.iter):
+                attr = self._self_attr(n)
+                if attr is not None:
+                    p = self.cp.programs.get(attr)
+                    if p is not None and p.is_dict:
+                        self.aliases[stmt.target.elts[-1].id] = p
+                        return
+
+    def _donated_attrs(self, call: ast.Call, p: Program) -> Set[str]:
+        out: Set[str] = set()
+        for i in p.donate:
+            if i < len(call.args):
+                attr = self._self_attr(call.args[i])
+                if attr is not None:
+                    out.add(attr)
+        return out
+
+    def _store_attrs(self, stmt: ast.stmt) -> Set[str]:
+        out: Set[str] = set()
+        for n in ast.walk(stmt):
+            attr = self._self_attr(n)
+            if attr is not None and isinstance(n.ctx, ast.Store):
+                out.add(attr)
+        return out
+
+    def _process_simple(self, stmt: ast.stmt,
+                        consumed: Set[str]) -> Set[str]:
+        """One non-compound statement: check reads of already-consumed
+        attrs, then apply this statement's dispatches and rebinds."""
+        self._check_reads(stmt, consumed)
+        self._track_aliases(stmt)
+        rebound = self._store_attrs(stmt)
+        newly: Set[str] = set()
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Call):
+                p = self._call_program(n)
+                if p is not None and p.donate:
+                    attrs = self._donated_attrs(n, p)
+                    newly |= attrs
+                    for a in sorted(attrs - rebound):
+                        self._emit(
+                            n, f"dispatch donates self.{a} but the "
+                               f"statement does not rebind it — rebind "
+                               f"at dispatch (`self.{a}, ... = fn(...)`)"
+                               f" or the binding is dead")
+        return (consumed | newly) - rebound
+
+    def _process_header(self, exprs: List[ast.expr],
+                        consumed: Set[str]) -> Set[str]:
+        for e in exprs:
+            self._check_reads(e, consumed)
+            for n in ast.walk(e):
+                if isinstance(n, ast.Call):
+                    p = self._call_program(n)
+                    if p is not None and p.donate:
+                        attrs = self._donated_attrs(n, p)
+                        for a in sorted(attrs):
+                            self._emit(
+                                n, f"dispatch donates self.{a} in an "
+                                   f"expression position that cannot "
+                                   f"rebind it — the binding is dead")
+                        consumed = consumed | attrs
+        return consumed
+
+    def _process_block(self, body: List[ast.stmt],
+                       consumed: Set[str]) -> Set[str]:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.If):
+                consumed = self._process_header([stmt.test], consumed)
+                a = self._process_block(stmt.body, set(consumed))
+                b = self._process_block(stmt.orelse, set(consumed))
+                consumed = a | b
+            elif isinstance(stmt, ast.While):
+                consumed = self._process_header([stmt.test], consumed)
+                a = self._process_block(stmt.body, set(consumed))
+                b = self._process_block(stmt.orelse, set(consumed))
+                consumed = consumed | a | b
+            elif isinstance(stmt, ast.For):
+                self._track_aliases(stmt)
+                consumed = self._process_header([stmt.iter], consumed)
+                a = self._process_block(stmt.body, set(consumed))
+                b = self._process_block(stmt.orelse, set(consumed))
+                consumed = consumed | a | b
+            elif isinstance(stmt, ast.Try):
+                body_out = self._process_block(stmt.body, set(consumed))
+                handler_in = consumed | body_out
+                out = set(body_out)
+                for h in stmt.handlers:
+                    out |= self._process_block(h.body, set(handler_in))
+                out = self._process_block(stmt.orelse, out)
+                consumed = self._process_block(stmt.finalbody, out)
+            elif isinstance(stmt, ast.With):
+                consumed = self._process_header(
+                    [i.context_expr for i in stmt.items], consumed)
+                consumed = self._process_block(stmt.body, consumed)
+            else:
+                consumed = self._process_simple(stmt, consumed)
+        return consumed
+
+    def scan(self) -> List[Finding]:
+        self._process_block(self.method.body, set())
+        return self.findings

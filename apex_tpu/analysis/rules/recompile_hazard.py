@@ -1,0 +1,178 @@
+"""RECOMPILE-HAZARD: per-call-fresh values into compiled entry points.
+
+A compiled program recompiles whenever a static (hashed) input fails
+the cache lookup. Values that are *fresh every call* — f-strings,
+dict/list/set displays built inline, comprehensions — either vary per
+call (shape/hash miss → silent recompile, the exact thing ROADMAP's
+"never recompile after warmup" forbids) or are unhashable outright.
+``len()`` of a runtime collection in a ``static_argnums`` position is
+the classic shape-ladder bug: every new queue depth compiles a new
+program.
+
+Scope (documented, deliberately narrow — this rule must never drown
+the battery in style noise): direct argument expressions at call sites
+of known compiled entry points — the class-held programs from
+``rules.compiled`` (``self._step(...)``, ``self._admits[k](...)``,
+aliases) and module-level ``jax.jit`` results — plus ``len(...)``
+specifically in declared static positions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from apex_tpu.analysis._astutil import const_int_tuple, dotted, keyword_arg
+from apex_tpu.analysis.core import Finding, Project
+from apex_tpu.analysis.rules.compiled import (
+    collect_class_programs,
+    jit_call_names,
+    jit_wrapper_names,
+)
+
+_FRESH = {
+    ast.JoinedStr: "an f-string (fresh per call — hash-misses the "
+                   "compile cache every dispatch)",
+    ast.Dict: "a dict display (fresh per call; unhashable as a static)",
+    ast.Set: "a set display (fresh per call; unhashable as a static)",
+    ast.List: "a list display (unhashable as a static argument)",
+    ast.ListComp: "a comprehension (fresh per call)",
+    ast.SetComp: "a comprehension (fresh per call)",
+    ast.DictComp: "a comprehension (fresh per call)",
+    ast.GeneratorExp: "a generator expression (fresh per call)",
+}
+
+
+class RecompileHazardRule:
+    id = "RECOMPILE-HAZARD"
+    summary = ("per-call-fresh values (f-strings, displays, "
+               "comprehensions) at compiled entry points; len() into "
+               "static argnums")
+    triggers: Tuple[str, ...] = ()
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for ctx in project.targets:
+            if ctx.tree is None:
+                continue
+            findings.extend(self._scan_file(ctx))
+        return findings
+
+    def _scan_file(self, ctx) -> List[Finding]:
+        findings: List[Finding] = []
+        tree = ctx.tree
+        wrappers = jit_wrapper_names(ctx)
+
+        # compiled entry points held on classes
+        program_attrs: Dict[str, bool] = {}  # attr -> is_dict
+        for cp in collect_class_programs(ctx):
+            for p in cp.programs.values():
+                program_attrs[p.attr] = p.is_dict
+
+        # module/function-local `name = jax.jit(...)` results, with
+        # their static positions
+        jit_names: Dict[str, Tuple[Set[int], Set[str]]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                call = node.value
+                d = dotted(call.func)
+                if d in jit_call_names(ctx) or (
+                        isinstance(call.func, ast.Name)
+                        and call.func.id in wrappers):
+                    nums = keyword_arg(call, "static_argnums")
+                    names = keyword_arg(call, "static_argnames")
+                    static_idx: Set[int] = set(
+                        const_int_tuple(nums) or ()) if nums is not None \
+                        else set()
+                    static_names: Set[str] = set()
+                    if names is not None:
+                        for n in ast.walk(names):
+                            if isinstance(n, ast.Constant) and \
+                                    isinstance(n.value, str):
+                                static_names.add(n.value)
+                    jit_names[node.targets[0].id] = (static_idx,
+                                                     static_names)
+
+        def is_entry(call: ast.Call):
+            """(is_compiled_entry, static_idx, static_names)"""
+            f = call.func
+            if isinstance(f, ast.Name):
+                if f.id in jit_names:
+                    return True, jit_names[f.id][0], jit_names[f.id][1]
+                return False, set(), set()
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and f.value.id == "self" \
+                    and f.attr in program_attrs \
+                    and not program_attrs[f.attr]:
+                return True, set(), set()
+            if isinstance(f, ast.Subscript) and \
+                    isinstance(f.value, ast.Attribute) and \
+                    isinstance(f.value.value, ast.Name) and \
+                    f.value.value.id == "self" and \
+                    f.value.attr in program_attrs and \
+                    program_attrs[f.value.attr]:
+                return True, set(), set()
+            return False, set(), set()
+
+        # local aliases `fn = self._admits[...]`
+        alias_names: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                v = node.value
+                if isinstance(v, ast.Subscript) and \
+                        isinstance(v.value, ast.Attribute) and \
+                        isinstance(v.value.value, ast.Name) and \
+                        v.value.value.id == "self" and \
+                        v.value.attr in program_attrs:
+                    alias_names.add(node.targets[0].id)
+                elif isinstance(v, ast.Attribute) and \
+                        isinstance(v.value, ast.Name) and \
+                        v.value.id == "self" and v.attr in program_attrs:
+                    alias_names.add(node.targets[0].id)
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            entry, static_idx, static_names = is_entry(node)
+            if not entry and isinstance(node.func, ast.Name) and \
+                    node.func.id in alias_names:
+                entry = True
+            if not entry:
+                continue
+            for i, a in enumerate(node.args):
+                msg = _FRESH.get(type(a))
+                if msg is not None:
+                    findings.append(Finding(
+                        self.id, ctx.rel, a.lineno,
+                        f"argument {i} of a compiled entry point is "
+                        f"{msg}", col=a.col_offset))
+                elif i in static_idx and isinstance(a, ast.Call) and \
+                        isinstance(a.func, ast.Name) and \
+                        a.func.id == "len":
+                    findings.append(Finding(
+                        self.id, ctx.rel, a.lineno,
+                        f"len(...) flows into static argument {i} of a "
+                        f"compiled entry point — every new length "
+                        f"compiles a new program; use a static ladder "
+                        f"(bucket the value) instead", col=a.col_offset))
+            for kw in node.keywords:
+                msg = _FRESH.get(type(kw.value))
+                if msg is not None:
+                    findings.append(Finding(
+                        self.id, ctx.rel, kw.value.lineno,
+                        f"keyword argument {kw.arg!r} of a compiled "
+                        f"entry point is {msg}", col=kw.value.col_offset))
+                elif kw.arg in static_names and \
+                        isinstance(kw.value, ast.Call) and \
+                        isinstance(kw.value.func, ast.Name) and \
+                        kw.value.func.id == "len":
+                    findings.append(Finding(
+                        self.id, ctx.rel, kw.value.lineno,
+                        f"len(...) flows into static argument "
+                        f"{kw.arg!r} of a compiled entry point — every "
+                        f"new length compiles a new program",
+                        col=kw.value.col_offset))
+        return findings

@@ -1,0 +1,127 @@
+"""TIER1-COST: the marker audit's static sibling for test sources.
+
+The runtime marker audit (tests/conftest.py) fails any tier-1 test
+that *measures* over ~60 s without the ``slow`` marker — but only
+after the budget is already spent. The expensive pattern is known in
+advance: ``Engine.warmup()`` compiles every (bucket, k) admission
+variant plus step/spec/prefix programs, which is exactly the compile
+bill the budget exists to police. So statically: a function in a test
+file that calls ``.warmup()`` must either carry ``@pytest.mark.slow``
+(directly or via a module/class-level ``pytestmark``) or justify the
+cost with ``# apex: noqa[TIER1-COST]: <why>`` (on the call line or on
+the enclosing ``def`` line — one justification on a shared helper
+covers every test riding it).
+
+This rule only fires in files named ``test_*.py`` or ``conftest.py``
+under a ``tests`` directory, so the default battery over ``apex_tpu``
+never sees it; the tier-1 analysis test runs it over ``tests/``
+explicitly.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from apex_tpu.analysis._astutil import dotted
+from apex_tpu.analysis.core import Finding, Project
+
+
+def _is_test_file(rel: str) -> bool:
+    parts = rel.split("/")
+    name = parts[-1]
+    return "tests" in parts[:-1] and (
+        name.startswith("test_") or name == "conftest.py")
+
+
+_SLOW_MARKS = ("pytest.mark.slow", "mark.slow")
+
+
+def _has_slow_marker(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        d = dotted(dec if not isinstance(dec, ast.Call) else dec.func)
+        if d in _SLOW_MARKS:
+            return True
+    return False
+
+
+def _pytestmark_slow(body: List[ast.stmt]) -> bool:
+    """``pytestmark = pytest.mark.slow`` (or a list containing it) at
+    module or class level — the standard whole-scope spelling."""
+    for stmt in body:
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "pytestmark"):
+            continue
+        val = stmt.value
+        elts = val.elts if isinstance(val, (ast.List, ast.Tuple)) else [val]
+        for e in elts:
+            d = dotted(e if not isinstance(e, ast.Call) else e.func)
+            if d in _SLOW_MARKS:
+                return True
+    return False
+
+
+def _walk_own(fn: ast.FunctionDef) -> Iterable[ast.AST]:
+    """Walk a function's own body, not its nested defs' (a warmup call
+    in a nested helper is attributed to the helper alone). Lambdas ARE
+    walked: a lambda is never scanned as a function of its own, so a
+    warmup tucked into one must be charged to the enclosing def or it
+    escapes the rule entirely."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+class Tier1CostRule:
+    id = "TIER1-COST"
+    summary = ("test functions that call Engine.warmup() must carry "
+               "@pytest.mark.slow or a justified suppression — warmup "
+               "compiles every engine variant, the tier-1 budget's "
+               "biggest single line item")
+    triggers: Tuple[str, ...] = ()
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for ctx in project.targets:
+            if ctx.tree is None or not _is_test_file(ctx.rel):
+                continue
+            if _pytestmark_slow(ctx.tree.body):
+                continue  # whole module is slow-marked
+            class_slow = set()
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.ClassDef) and \
+                        _pytestmark_slow(node.body):
+                    for sub in ast.walk(node):
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            class_slow.add(id(sub))
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if id(node) in class_slow or _has_slow_marker(node):
+                    continue
+                for call in _walk_own(node):
+                    if isinstance(call, ast.Call) and \
+                            isinstance(call.func, ast.Attribute) and \
+                            call.func.attr == "warmup":
+                        # anchor at the `.warmup` line (a chained
+                        # multiline `Engine(...).warmup()` starts lines
+                        # earlier), so the suppression comment sits on
+                        # the call it justifies
+                        line = getattr(call.func, "end_lineno",
+                                       None) or call.lineno
+                        findings.append(Finding(
+                            self.id, ctx.rel, line,
+                            f"{node.name}() calls .warmup() — it "
+                            f"compiles every engine program variant; "
+                            f"mark the test slow or justify the tier-1 "
+                            f"cost with `# apex: noqa[TIER1-COST]: "
+                            f"<why>`",
+                            col=call.col_offset,
+                            extra_suppress_lines=(node.lineno,)))
+        return findings

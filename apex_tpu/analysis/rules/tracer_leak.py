@@ -1,0 +1,306 @@
+"""TRACER-LEAK: host coercions / Python control flow on traced values.
+
+Inside a jit-compiled function every argument-derived value is a
+tracer: ``int(x)``, ``float(x)``, ``bool(x)``, ``x.item()``, any
+``np.*`` call, and Python ``if``/``while`` on it all force a concrete
+value — a ``ConcretizationTypeError`` at best, a silent per-value
+recompile at worst (the exact class the RecompileGuard exists to catch
+at runtime). The rule seeds from the statically-discoverable jit entry
+points (``modgraph.Graph.jit_roots``), taints their traced parameters,
+and walks the value flow through intra- and cross-module calls
+(``gpt.decode_steps`` called from the engine's jitted locals is
+analyzed with exactly the parameters that receive traced arguments —
+``cfg``-style static params stay clean, so ``if cfg.num_experts:`` is
+not a finding).
+
+Statically-known escapes stop the taint: ``.shape``/``.dtype``/
+``.ndim``/``.size``, ``len()``, and ``x is None`` checks (argument
+*structure* is static under jit).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from apex_tpu.analysis._astutil import dotted
+from apex_tpu.analysis.core import Finding, Project
+from apex_tpu.analysis.modgraph import FuncInfo, Graph, ModuleInfo
+
+#: attribute reads that yield static (host) values off a tracer
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "nbytes", "itemsize",
+                 "aval", "sharding", "weak_type"}
+#: builtins whose result is static and whose use is trace-legal
+_NEUTRAL_FUNCS = {"len", "isinstance", "type", "hasattr", "getattr",
+                  "repr", "str", "format", "id", "callable"}
+_COERCIONS = {"int", "float", "bool", "complex"}
+_ITEM_METHODS = {"item", "tolist", "__index__", "__float__", "__int__"}
+#: jax higher-order entry points whose function-valued arguments run
+#: traced (their params carry tracers even though no direct call
+#: appears) — matched on the final attribute of a jax-rooted call
+_TRACED_HOFS = {"scan", "cond", "while_loop", "fori_loop", "switch",
+                "map", "associative_scan", "vmap", "pmap", "checkpoint",
+                "remat", "custom_vjp", "custom_jvp", "grad",
+                "value_and_grad"}
+
+
+class _FuncState:
+    __slots__ = ("params", "closure")
+
+    def __init__(self) -> None:
+        self.params: Set[str] = set()
+        self.closure: Set[str] = set()
+
+
+class TracerLeakRule:
+    id = "TRACER-LEAK"
+    summary = ("int()/float()/bool()/.item()/np.* coercions and Python "
+               "if/while on values reachable from tracer arguments of "
+               "jit-reachable functions")
+    triggers: Tuple[str, ...] = ()
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        graph = Graph(project)
+        states: Dict[int, _FuncState] = {}
+        pending: List[FuncInfo] = []
+        findings: Dict[Tuple[str, int, int, str], Finding] = {}
+
+        def state_of(fi: FuncInfo) -> _FuncState:
+            return states.setdefault(id(fi.node), _FuncState())
+
+        def schedule(fi: FuncInfo, params: Set[str],
+                     closure: Set[str]) -> None:
+            st = state_of(fi)
+            before = (len(st.params), len(st.closure))
+            st.params |= params & set(fi.params)
+            st.closure |= closure
+            if (len(st.params), len(st.closure)) != before:
+                pending.append(fi)
+
+        for fi, traced in graph.jit_roots():
+            st = state_of(fi)
+            st.params |= traced
+            pending.append(fi)
+
+        seen_rounds: Dict[int, Tuple[int, int]] = {}
+        while pending:
+            fi = pending.pop()
+            st = state_of(fi)
+            key = (len(st.params), len(st.closure))
+            if seen_rounds.get(id(fi.node)) == key:
+                continue
+            seen_rounds[id(fi.node)] = key
+            self._scan_function(graph, fi, st, schedule, findings)
+
+        return sorted(findings.values(),
+                      key=lambda f: (f.path, f.line, f.col))
+
+    # -- per-function scan -------------------------------------------------
+
+    def _scan_function(self, graph: Graph, fi: FuncInfo, st: _FuncState,
+                       schedule, findings) -> None:
+        mod = fi.module
+        # closure taint must not shadow the function's own (clean)
+        # parameters of the same name
+        env: Set[str] = set(st.params) | (st.closure - set(fi.params))
+        # names bound locally (params or any assignment) shadow module
+        # functions of the same name — `logits, cache = decode_step(...)`
+        # must not resolve a later bare `logits` to the module-level
+        # logits() function
+        local_names: Set[str] = set(fi.params)
+        for n in ast.walk(fi.node):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                local_names.add(n.id)
+        report = mod.ctx.rel in graph.project.target_rels
+
+        def emit(node: ast.AST, message: str) -> None:
+            if not report:
+                return
+            key = (mod.ctx.rel, node.lineno, node.col_offset, message)
+            findings.setdefault(key, Finding(
+                self.id, mod.ctx.rel, node.lineno, message,
+                col=node.col_offset))
+
+        def is_numpy_call(func: ast.AST) -> bool:
+            d = dotted(func)
+            if not d or "." not in d:
+                return False
+            target = mod.import_root(d.split(".", 1)[0])
+            return bool(target) and (target == "numpy"
+                                     or target.startswith("numpy."))
+
+        def tainted(e: ast.AST) -> bool:
+            if isinstance(e, ast.Name):
+                return e.id in env
+            if isinstance(e, ast.Constant):
+                return False
+            if isinstance(e, ast.Attribute):
+                if e.attr in _STATIC_ATTRS:
+                    return False
+                return tainted(e.value)
+            if isinstance(e, ast.Compare):
+                if all(isinstance(op, (ast.Is, ast.IsNot))
+                       for op in e.ops):
+                    return False  # structural check — static under jit
+                if all(isinstance(op, (ast.In, ast.NotIn))
+                       for op in e.ops) and \
+                        isinstance(e.left, ast.Constant) and \
+                        isinstance(e.left.value, str):
+                    # `"hist" in state` — pytree KEY membership is
+                    # structure, not data; static under jit
+                    return False
+                return tainted(e.left) or any(
+                    tainted(c) for c in e.comparators)
+            if isinstance(e, ast.Call):
+                d = dotted(e.func)
+                if isinstance(e.func, ast.Name) and \
+                        e.func.id in (_NEUTRAL_FUNCS | _COERCIONS):
+                    return False  # result is a host value
+                if d and is_numpy_call(e.func):
+                    return False  # flagged as a violation, result host
+                if isinstance(e.func, ast.Attribute) and \
+                        e.func.attr in _ITEM_METHODS:
+                    return False  # flagged as a violation, result host
+                return any(tainted(a) for a in e.args) or any(
+                    tainted(kw.value) for kw in e.keywords) or (
+                    isinstance(e.func, ast.Attribute)
+                    and tainted(e.func.value))
+            if isinstance(e, ast.Lambda):
+                return False
+            return any(tainted(c) for c in ast.iter_child_nodes(e)
+                       if isinstance(c, ast.expr))
+
+        def mark_traced_helper(target: ast.AST) -> None:
+            helper: Optional[FuncInfo] = None
+            if isinstance(target, ast.Name):
+                if target.id in local_names:
+                    return  # a local value, not a function reference
+                helper = graph.resolve_call(mod, fi, target)
+            elif isinstance(target, ast.Lambda):
+                helper = mod.by_node.get(id(target))
+                if helper is None:
+                    helper = FuncInfo(target, "<lambda>", mod, fi)
+                    mod.by_node[id(target)] = helper
+            if helper is not None and helper.module is mod:
+                schedule(helper, set(helper.params), set(env))
+
+        def check_call(call: ast.Call) -> None:
+            func = call.func
+            all_args = list(call.args) + [kw.value for kw in call.keywords]
+            any_tainted = any(tainted(a) for a in all_args)
+            if isinstance(func, ast.Name) and func.id in _COERCIONS \
+                    and any_tainted:
+                emit(call, f"{func.id}() coerces a traced value to a "
+                           f"host scalar inside a jit-reachable "
+                           f"function — use jnp/lax instead")
+            elif isinstance(func, ast.Attribute) and \
+                    func.attr in _ITEM_METHODS and tainted(func.value):
+                emit(call, f".{func.attr}() forces a traced value to "
+                           f"the host inside a jit-reachable function")
+            elif is_numpy_call(func) and any_tainted:
+                emit(call, f"numpy call {dotted(func)}(...) on a traced "
+                           f"value inside a jit-reachable function — "
+                           f"numpy cannot trace; use jnp")
+            # propagation: project-resolvable callee
+            callee = graph.resolve_call(mod, fi, func)
+            if callee is not None and not isinstance(
+                    callee.node, ast.Lambda):
+                formals = callee.positional_params()
+                taints: Set[str] = set()
+                for i, a in enumerate(call.args):
+                    if isinstance(a, ast.Starred):
+                        continue
+                    if i < len(formals) and tainted(a):
+                        taints.add(formals[i])
+                for kw in call.keywords:
+                    if kw.arg and kw.arg in callee.params \
+                            and tainted(kw.value):
+                        taints.add(kw.arg)
+                if taints:
+                    closure = set(env) if callee.module is mod \
+                        and callee.parent is not None else set()
+                    schedule(callee, taints, closure)
+            # function-valued args of jax higher-order calls
+            # (lax.scan / lax.cond / vmap bodies run traced)
+            d = dotted(func)
+            if d and d.rsplit(".", 1)[-1] in _TRACED_HOFS:
+                base = d.split(".", 1)[0]
+                target = mod.import_root(base) or base
+                if target == "jax" or target.startswith("jax."):
+                    for a in all_args:
+                        if isinstance(a, (ast.Name, ast.Lambda)):
+                            mark_traced_helper(a)
+
+        def check_expr(e: ast.AST) -> None:
+            for node in ast.walk(e):
+                if isinstance(node, ast.Call):
+                    check_call(node)
+
+        def assign_targets(target: ast.AST, taint: bool) -> None:
+            if isinstance(target, ast.Name):
+                if taint:
+                    env.add(target.id)
+                else:
+                    env.discard(target.id)
+            elif isinstance(target, (ast.Tuple, ast.List)):
+                for elt in target.elts:
+                    assign_targets(elt, taint)
+            elif isinstance(target, ast.Starred):
+                assign_targets(target.value, taint)
+
+        def scan_body(body: List[ast.stmt]) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue  # analyzed when referenced
+                if isinstance(stmt, ast.Assign):
+                    check_expr(stmt.value)
+                    t = tainted(stmt.value)
+                    for target in stmt.targets:
+                        assign_targets(target, t)
+                elif isinstance(stmt, ast.AnnAssign):
+                    if stmt.value is not None:
+                        check_expr(stmt.value)
+                        assign_targets(stmt.target, tainted(stmt.value))
+                elif isinstance(stmt, ast.AugAssign):
+                    check_expr(stmt.value)
+                    if tainted(stmt.value):
+                        assign_targets(stmt.target, True)
+                elif isinstance(stmt, (ast.If, ast.While)):
+                    check_expr(stmt.test)
+                    if tainted(stmt.test):
+                        kw = "if" if isinstance(stmt, ast.If) else "while"
+                        emit(stmt, f"Python `{kw}` on a traced value "
+                                   f"inside a jit-reachable function — "
+                                   f"use lax.cond/select/while_loop")
+                    scan_body(stmt.body)
+                    scan_body(stmt.orelse)
+                elif isinstance(stmt, ast.For):
+                    check_expr(stmt.iter)
+                    assign_targets(stmt.target, tainted(stmt.iter))
+                    scan_body(stmt.body)
+                    scan_body(stmt.orelse)
+                elif isinstance(stmt, ast.With):
+                    for item in stmt.items:
+                        check_expr(item.context_expr)
+                        if item.optional_vars is not None:
+                            assign_targets(item.optional_vars,
+                                           tainted(item.context_expr))
+                    scan_body(stmt.body)
+                elif isinstance(stmt, ast.Try):
+                    scan_body(stmt.body)
+                    for h in stmt.handlers:
+                        scan_body(h.body)
+                    scan_body(stmt.orelse)
+                    scan_body(stmt.finalbody)
+                else:
+                    for node in ast.iter_child_nodes(stmt):
+                        if isinstance(node, ast.expr):
+                            check_expr(node)
+
+        node = fi.node
+        body = node.body if isinstance(node.body, list) else None
+        if body is None:  # Lambda
+            check_expr(node.body)
+        else:
+            scan_body(body)

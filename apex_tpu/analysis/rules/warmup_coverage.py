@@ -1,0 +1,95 @@
+"""WARMUP-COVERAGE: every compiled program must warm AND be tracked.
+
+The serving invariant is "never recompile after warmup" — which only
+holds if ``Engine.warmup()`` actually compiles *every* program variant,
+and only stays observable if ``compiled_cache_sizes()`` / the recompile
+sentinel track every program. A new compiled program added to
+``_build`` but forgotten in either place is invisible until a chip
+stalls mid-serve; this rule closes the loop at lint time.
+
+Mechanics: for each class that both owns compiled programs
+(``rules.compiled``) and defines a ``warmup`` method, every program
+attribute must be *referenced* from the intra-class call closure of
+(a) ``warmup`` and (b) ``compiled_cache_sizes``/``recompile_sentinel``
+(when defined). A reference is a direct ``self._X`` read, or — for the
+``getattr(self, f"_{name}")`` indirection the cache-size probe uses —
+the bare program name appearing as a string constant in the closure.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from apex_tpu.analysis._astutil import attr_reads, string_constants
+from apex_tpu.analysis.core import Finding, Project
+from apex_tpu.analysis.rules.compiled import collect_class_programs
+
+
+class WarmupCoverageRule:
+    id = "WARMUP-COVERAGE"
+    summary = ("every compiled program variant must be reachable from "
+               "warmup() and tracked by compiled_cache_sizes()/the "
+               "recompile sentinel")
+    triggers: Tuple[str, ...] = ()
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for ctx in project.targets:
+            for cp in collect_class_programs(ctx):
+                methods: Dict[str, ast.FunctionDef] = {
+                    m.name: m for m in cp.methods()}
+                if "warmup" not in methods:
+                    continue
+                refs_warm = self._closure_refs(methods, "warmup")
+                trackers = [n for n in ("compiled_cache_sizes",
+                                        "recompile_sentinel")
+                            if n in methods]
+                refs_track: Set[str] = set()
+                for t in trackers:
+                    refs_track |= self._closure_refs(methods, t)
+                for name, p in sorted(cp.programs.items()):
+                    if not self._covered(name, refs_warm):
+                        findings.append(Finding(
+                            self.id, cp.ctx.rel, p.line,
+                            f"compiled program self.{name} is never "
+                            f"referenced from warmup()'s call closure — "
+                            f"it will compile lazily on first dispatch, "
+                            f"tripping the armed recompile guard"))
+                    if trackers and not self._covered(name, refs_track):
+                        findings.append(Finding(
+                            self.id, cp.ctx.rel, p.line,
+                            f"compiled program self.{name} is not "
+                            f"tracked by compiled_cache_sizes()/"
+                            f"recompile_sentinel() — its recompiles "
+                            f"would be invisible to the guard"))
+        return findings
+
+    @staticmethod
+    def _covered(attr: str, refs: Set[str]) -> bool:
+        # direct `self._X` read, or the getattr-by-name indirection
+        # (`getattr(self, f"_{name}")` over string constants)
+        return attr in refs or attr.lstrip("_") in refs
+
+    def _closure_refs(self, methods: Dict[str, ast.FunctionDef],
+                      start: str) -> Set[str]:
+        """self-attribute reads + string constants across the
+        intra-class call closure of ``start`` (self.foo() edges)."""
+        seen: Set[str] = set()
+        stack = [start]
+        refs: Set[str] = set()
+        while stack:
+            name = stack.pop()
+            if name in seen or name not in methods:
+                continue
+            seen.add(name)
+            node = methods[name]
+            refs.update(attr_reads(node))
+            refs.update(string_constants(node))
+            for n in ast.walk(node):
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        isinstance(n.func.value, ast.Name) and \
+                        n.func.value.id == "self":
+                    stack.append(n.func.attr)
+        return refs

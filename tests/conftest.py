@@ -65,6 +65,13 @@ def devices8():
 # cache — the suite is ~25 min cold vs ~10 min warm) would spuriously
 # fail compile-heavy tests that are well inside budget warm. An
 # explicit APEX_TPU_TIER1_BUDGET_S overrides the heuristic either way.
+#
+# Static sibling: the TIER1-COST lint rule (apex_tpu.analysis) flags
+# the known expensive *pattern* — a test calling Engine.warmup()
+# without the slow marker — before the budget is ever spent; this hook
+# stays as the backstop for everything the pattern can't see. The pair
+# is kept honest by tests/test_static_analysis.py (lint battery over
+# tests/, allowlist pinned) and test_marker_audit.py (this predicate).
 
 
 def _compile_cache_warm(min_entries: int = 500) -> bool:

@@ -118,7 +118,7 @@ def served(devices8):
     engine = Engine(cfg, params, mesh, EngineConfig(
         slots=2, max_prompt_len=48, max_seq_len=128, decode_chunk=1,
         prompt_buckets=(16, 48), admit_batch_sizes=(1, 2)))
-    engine.warmup()
+    engine.warmup()  # apex: noqa[TIER1-COST]: shared server helper: one warm-cache warmup (~s) serves every live-API test
     registry = Registry()
     sched = Scheduler(engine, registry=registry, pipeline_depth=2)
     tok = ByteTokenizer(cfg.vocab_size)
@@ -139,7 +139,7 @@ def _tiny_engine(devices8, fault_plan=None):
         slots=2, max_prompt_len=8, max_seq_len=32, decode_chunk=1,
         prompt_buckets=(8,), admit_batch_sizes=(1,)),
         fault_plan=fault_plan)
-    engine.warmup()
+    engine.warmup()  # apex: noqa[TIER1-COST]: scheduler-level helper on the tiny 1L engine; warm-cache warmup is seconds
     return cfg, params, mesh, engine
 
 
@@ -584,7 +584,7 @@ def test_scheduler_stop_across_chunk_boundary(devices8):
     engine = Engine(cfg, params, mesh, EngineConfig(
         slots=2, max_prompt_len=8, max_seq_len=32, decode_chunk=4,
         prompt_buckets=(8,), admit_batch_sizes=(1,)))
-    engine.warmup()
+    engine.warmup()  # apex: noqa[TIER1-COST]: tiny 1L engine; warm-cache warmup is seconds and the stop oracle needs warmed variants
     try:
         sched = Scheduler(engine, pipeline_depth=2)
         sched.submit(Request("r0", [3, 4, 5], max_tokens=12,
@@ -613,7 +613,7 @@ def test_scheduler_constraint_forces_token_sequence(devices8):
     engine = Engine(cfg, params, mesh, EngineConfig(
         slots=2, max_prompt_len=8, max_seq_len=32, decode_chunk=1,
         prompt_buckets=(8,), admit_batch_sizes=(1,)))
-    engine.warmup()
+    engine.warmup()  # apex: noqa[TIER1-COST]: tiny 1L engine; constraint oracle needs warmed chunk=1 variants
     try:
         sched = Scheduler(engine)
         forced = list(b'"ab"')
@@ -628,7 +628,7 @@ def test_scheduler_constraint_forces_token_sequence(devices8):
         engine8 = Engine(cfg, params, mesh, EngineConfig(
             slots=2, max_prompt_len=8, max_seq_len=32, decode_chunk=2,
             prompt_buckets=(8,), admit_batch_sizes=(1,)))
-        engine8.warmup()
+        engine8.warmup()  # apex: noqa[TIER1-COST]: second tiny engine for the chunk>1 rejection arm; warm-cache warmup is seconds
         try:
             with pytest.raises(ValueError, match="decode_chunk"):
                 Scheduler(engine8).submit(Request(

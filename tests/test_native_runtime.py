@@ -259,24 +259,23 @@ def test_orbax_namedtuple_roundtrip(tmp_path):
 def test_abi_version_sources_agree():
     """ABI-drift guard: CLAUDE.md's convention says kAbiVersion
     (csrc/host_runtime.cpp) and _ABI_VERSION (_native/__init__.py) bump
-    together on any C-ABI change — parse both sources and refuse the
-    drift nothing else checks (a stale prebuilt .so is rejected at
-    runtime, but a forgotten bump on one side would ship silently)."""
-    import re
+    together on any C-ABI change — refuse the drift nothing else checks
+    (a stale prebuilt .so is rejected at runtime, but a forgotten bump
+    on one side would ship silently). The version parsing lives in ONE
+    place — the ABI-LOCKSTEP lint rule — and this runtime test is a
+    thin wrapper over it, plus the one thing lint cannot see: the
+    LOADED module (whichever backend built) agrees with the sources."""
+    from apex_tpu.analysis import parse_abi_versions
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    cpp = open(os.path.join(root, "csrc", "host_runtime.cpp")).read()
-    py = open(os.path.join(
-        root, "apex_tpu", "_native", "__init__.py")).read()
-    m_cpp = re.search(
-        r"^static const int32_t kAbiVersion\s*=\s*(\d+)\s*;", cpp,
-        re.MULTILINE)
-    m_py = re.search(r"^_ABI_VERSION\s*=\s*(\d+)\s*$", py, re.MULTILINE)
-    assert m_cpp, "kAbiVersion declaration not found in host_runtime.cpp"
-    assert m_py, "_ABI_VERSION assignment not found in _native/__init__.py"
-    assert m_cpp.group(1) == m_py.group(1), (
-        f"ABI drift: csrc kAbiVersion={m_cpp.group(1)} != "
-        f"_native _ABI_VERSION={m_py.group(1)} — bump both together "
+    cpp, py = parse_abi_versions(root)
+    assert cpp is not None, \
+        "kAbiVersion declaration not found in host_runtime.cpp"
+    assert py is not None, \
+        "_ABI_VERSION assignment not found in _native/__init__.py"
+    assert cpp == py, (
+        f"ABI drift: csrc kAbiVersion={cpp} != "
+        f"_native _ABI_VERSION={py} — bump both together "
         f"(CLAUDE.md 'Native lib')")
     # and the loaded module (whichever backend built) agrees with them
-    assert nat._ABI_VERSION == int(m_py.group(1))
+    assert nat._ABI_VERSION == py

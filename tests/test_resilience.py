@@ -188,7 +188,7 @@ def test_admit_error_recovers_with_parity(model):
     cfg, params, mesh = model
     plan = FaultPlan([FaultSpec("admit", 0, "error")])
     eng = _mk_engine(cfg, params, mesh, plan)
-    eng.warmup()
+    eng.warmup()  # apex: noqa[TIER1-COST]: chaos recovery parity needs a warmed engine so the guard stays armed through rebuild
     sizes0 = eng.compiled_cache_sizes()
     rcfg = ResilienceConfig(backoff_base_s=0.005)
     sched = Scheduler(eng, resilience=rcfg)

@@ -1,0 +1,802 @@
+"""apex_tpu.analysis — the static linter's own battery.
+
+Three layers:
+
+1. the merge gates: the full-tree run (``apex_tpu bench.py examples``,
+   every rule) and the tests-tree TIER1-COST run are clean, fast
+   (<15 s — pure-Python AST, no compile), and the active-suppression
+   count is pinned so it can only go down;
+2. per-rule positive/negative pairs over synthetic trees — every rule
+   must FIRE on its synthetic violation and stay SILENT on the clean
+   twin (a linter that cannot fire is indistinguishable from one that
+   works);
+3. the suppression mechanism itself: justified noqa silences and is
+   counted, bare noqa is a finding, unused noqa is a finding, and a
+   disabled rule's suppressions are out of scope for the run.
+
+No jax/numpy anywhere in the analyzer (pinned by the purged-import
+subprocess test at the bottom, same pattern as serving.api's).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from apex_tpu.analysis import parse_abi_versions
+from apex_tpu.analysis.core import run_analysis, summary_dict
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the allowlist pin (satellite contract: this number may only go
+#: DOWN; new suppressions need to displace an old one or justify a
+#: bump here with the review that approved it)
+MAX_ACTIVE_SUPPRESSIONS = 25
+
+
+def _rules_of(result):
+    return sorted({f.rule for f in result.findings})
+
+
+def _synth(tmp_path, files, targets=None, rules=None):
+    (tmp_path / "pyproject.toml").write_text("[project]\nname='synth'\n")
+    for rel, body in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+    targets = targets or sorted({r.split("/")[0] for r in files})
+    targets = [str(tmp_path / t) for t in targets]
+    return run_analysis(targets, root=str(tmp_path), rules=rules)
+
+
+# --------------------------------------------------------------------------
+# merge gates
+# --------------------------------------------------------------------------
+
+
+def test_full_tree_clean_and_fast():
+    t0 = time.monotonic()
+    res = run_analysis(
+        [os.path.join(REPO, "apex_tpu"), os.path.join(REPO, "bench.py"),
+         os.path.join(REPO, "examples")], root=REPO)
+    elapsed = time.monotonic() - t0
+    assert not res.findings, "\n".join(f.render() for f in res.findings)
+    assert res.exit_code == 0
+    # pure-Python AST over ~16k lines; a budget blowout means someone
+    # added quadratic work, not that the tree got bigger
+    assert elapsed < 15.0, f"analysis took {elapsed:.1f}s (budget 15s)"
+    s = summary_dict(res)
+    assert s["exit_code"] == 0 and s["counts"] == {}
+
+
+def test_tests_tree_tier1_battery_clean_and_pinned():
+    res = run_analysis([os.path.join(REPO, "tests")], root=REPO,
+                       rules=["TIER1-COST"])
+    assert not res.findings, "\n".join(f.render() for f in res.findings)
+    active = len(res.suppressions_used)
+    # upper bound only: reaching zero (every warmup test slow-marked or
+    # restructured) is the contract's ideal end state, not a failure
+    assert active <= MAX_ACTIVE_SUPPRESSIONS, (
+        f"{active} active TIER1-COST suppressions vs pin "
+        f"{MAX_ACTIVE_SUPPRESSIONS} — the allowlist only shrinks; "
+        f"mark new warmup tests slow or displace an old suppression")
+
+
+def test_changed_mode_git_failure_is_a_usage_error(tmp_path):
+    # a failed git query must not read as "nothing changed" — that
+    # would let the pre-commit gate pass without linting anything
+    (tmp_path / "pyproject.toml").write_text("[project]\nname='x'\n")
+    (tmp_path / "mod.py").write_text("X = 1\n")
+    with pytest.raises(ValueError, match="--changed"):
+        run_analysis([str(tmp_path / "mod.py")], root=str(tmp_path),
+                     changed_only=True)
+
+
+def test_suppression_in_bench_visible_to_partial_runs(tmp_path):
+    # METRIC-DRIFT anchors doc-side findings in bench.py; a justified
+    # suppression there must silence them even when bench.py is not a
+    # target of the (--changed-style) partial run
+    files = {
+        "apex_tpu/__init__.py": "",
+        "apex_tpu/serving/__init__.py": "",
+        "apex_tpu/serving/sched.py": '''
+            def wire(registry):
+                registry.counter("serving_ok_total", "")
+        ''',
+        "bench.py":
+            'K = "serving_ghost_total"  # apex: noqa[METRIC-DRIFT]: trajectory key, deliberately unregistered\n',
+        "docs/API.md": "`serving_ok_total`\n",
+    }
+    res = _synth(tmp_path, files, targets=["apex_tpu"])
+    assert not res.findings, "\n".join(f.render() for f in res.findings)
+
+
+def test_overlapping_targets_analyze_each_file_once(tmp_path):
+    # `analysis pkg pkg/mod.py` must not load mod.py twice — that would
+    # double every per-target finding and the pinned suppressions.active
+    # count (the shrink-only contract number)
+    (tmp_path / "pyproject.toml").write_text("[project]\nname='x'\n")
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(
+        'def f():\n'
+        '    """See apex/amp/scaler.py."""  # apex: noqa[CITATION]: synthetic\n')
+    res = run_analysis([str(pkg), str(pkg / "mod.py")],
+                       root=str(tmp_path))
+    assert res.files == 2, res.files
+    assert len(res.suppressions_used) == 1
+    assert not res.findings, "\n".join(f.render() for f in res.findings)
+    # a stale noqa must surface exactly once, not once per duplicate
+    (pkg / "mod.py").write_text(
+        'X = 1  # apex: noqa[CITATION]: synthetic stale\n')
+    res = run_analysis([str(pkg), str(pkg / "mod.py")],
+                       root=str(tmp_path))
+    assert [f.rule for f in res.findings] == ["NOQA-UNUSED"], \
+        "\n".join(f.render() for f in res.findings)
+
+
+def test_missing_target_is_a_usage_error(tmp_path):
+    # a nonexistent target must be exit 2, not a 0-files "clean" exit 0
+    # from the merge gate itself (the CLI's relative default targets run
+    # from the wrong cwd are exactly this shape)
+    with pytest.raises(ValueError, match="does not exist"):
+        run_analysis([str(tmp_path / "nope")], root=str(tmp_path))
+    from apex_tpu.analysis.__main__ import main
+    assert main([str(tmp_path / "nope")]) == 2
+
+
+def test_repo_abi_versions_parse_and_agree():
+    cpp, py = parse_abi_versions(REPO)
+    assert cpp is not None and py is not None and cpp == py
+
+
+# --------------------------------------------------------------------------
+# TRACER-LEAK
+# --------------------------------------------------------------------------
+
+
+_TRACER_BAD = '''
+    import jax
+    import numpy as np
+
+    def leaky(x, n):
+        if x > 0:            # if on tracer
+            return int(x)    # coercion
+        y = np.asarray(x)    # numpy on tracer
+        return x.item() + n  # .item on tracer
+
+    j = jax.jit(leaky, static_argnums=(1,))
+'''
+
+_TRACER_CLEAN = '''
+    import jax
+    import jax.numpy as jnp
+
+    def fine(cfg, x, masks=None):
+        if cfg:                      # static (untainted at call sites)
+            x = x + 1
+        if masks is not None:        # structural — is-None is static
+            x = jnp.where(masks, x, 0)
+        if "k" in {"k": 1}:          # key membership is structure
+            pass
+        b = x.shape[0]               # shape access is static
+        if b > 2:
+            x = x * 2
+        return jnp.sum(x)
+
+    wrap = lambda f: jax.jit(jax.shard_map(f))
+    g = wrap(lambda x: fine(3, x))
+'''
+
+
+def test_tracer_leak_fires_on_synthetic_violations(tmp_path):
+    res = _synth(tmp_path, {"pkg/mod.py": _TRACER_BAD,
+                            "pkg/__init__.py": ""})
+    leaks = [f for f in res.findings if f.rule == "TRACER-LEAK"]
+    msgs = " | ".join(f.message for f in leaks)
+    assert len(leaks) == 4, msgs
+    assert "int()" in msgs and ".item()" in msgs \
+        and "np.asarray" in msgs and "`if`" in msgs
+
+
+def test_tracer_leak_static_escapes_stay_clean(tmp_path):
+    res = _synth(tmp_path, {"pkg/mod.py": _TRACER_CLEAN,
+                            "pkg/__init__.py": ""})
+    assert "TRACER-LEAK" not in _rules_of(res), \
+        "\n".join(f.render() for f in res.findings)
+
+
+def test_tracer_leak_walks_cross_module_calls(tmp_path):
+    # the jit site lives in a.py; the leak lives in the apex_tpu
+    # package module it calls — the walk must cross the import
+    res = _synth(tmp_path, {
+        "apex_tpu/__init__.py": "",
+        "apex_tpu/helper.py": '''
+            def inner(cfg, v):
+                if cfg:
+                    return v          # cfg stays static
+                return float(v)       # v is traced -> leak
+        ''',
+        "pkg/__init__.py": "",
+        "pkg/a.py": '''
+            import jax
+            from apex_tpu import helper
+
+            def entry(v):
+                return helper.inner(False, v)
+
+            j = jax.jit(entry)
+        ''',
+    }, targets=None)
+    leaks = [f for f in res.findings if f.rule == "TRACER-LEAK"]
+    assert [f.path for f in leaks] == ["apex_tpu/helper.py"], \
+        "\n".join(f.render() for f in res.findings)
+    assert "float()" in leaks[0].message
+
+
+def test_tracer_leak_sees_aliased_jit_spellings(tmp_path):
+    # `import jax as j` call sites and `from jax import jit as J`
+    # decorators are the same entry point as the literal `jax.jit` —
+    # modgraph shares rules/compiled.py's alias-aware jit_call_names,
+    # so the two discoveries cannot drift apart again
+    res = _synth(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/via_module_alias.py": '''
+            import jax as j
+
+            def f(x):
+                return int(x)      # leak under j.jit
+
+            g = j.jit(f)
+        ''',
+        "pkg/via_decorator_alias.py": '''
+            from jax import jit as J
+
+            @J
+            def h(x):
+                return float(x)    # leak under aliased decorator
+        ''',
+    })
+    leaks = sorted(f.path for f in res.findings
+                   if f.rule == "TRACER-LEAK")
+    assert leaks == ["pkg/via_decorator_alias.py",
+                     "pkg/via_module_alias.py"], \
+        "\n".join(f.render() for f in res.findings)
+
+
+# --------------------------------------------------------------------------
+# USE-AFTER-DONATE
+# --------------------------------------------------------------------------
+
+
+_DONATE_BAD = '''
+    import jax
+
+    class Eng:
+        def __init__(self):
+            self._step = jax.jit(lambda c, s: (c, s),
+                                 donate_argnums=(0, 1))
+
+        def bad_read(self):
+            out = self._step(self.cache, self.state)   # no rebind
+            return self.cache                          # read-after
+'''
+
+_DONATE_CLEAN = '''
+    import jax
+
+    class Eng:
+        def __init__(self):
+            self._step = jax.jit(lambda p, c, s: (c, s),
+                                 donate_argnums=(1, 2))
+
+        def good(self):
+            self.cache, self.state = self._step(
+                self.params, self.cache, self.state)   # rebind-at-dispatch
+            return self.cache                          # rebound: fine
+'''
+
+
+def test_use_after_donate_sees_jit_import_alias(tmp_path):
+    # `from jax import jit as J` must be the same entry point as
+    # `jax.jit` — kept consistent with modgraph's import-aware matcher
+    res = _synth(tmp_path, {"pkg/mod.py": '''
+        from jax import jit as J
+
+        class Eng:
+            def __init__(self):
+                self._step = J(lambda c: c, donate_argnums=(0,))
+
+            def bad(self):
+                out = self._step(self.cache)   # no rebind
+                return self.cache
+    ''', "pkg/__init__.py": ""})
+    hits = [f for f in res.findings if f.rule == "USE-AFTER-DONATE"]
+    assert len(hits) == 2, "\n".join(f.render() for f in res.findings)
+
+
+def test_use_after_donate_fires(tmp_path):
+    res = _synth(tmp_path, {"pkg/mod.py": _DONATE_BAD,
+                            "pkg/__init__.py": ""})
+    hits = [f for f in res.findings if f.rule == "USE-AFTER-DONATE"]
+    msgs = " ".join(f.message for f in hits)
+    # 2 unrebound donations (cache, state) + 1 read-after-donate
+    assert len(hits) == 3, "\n".join(f.render() for f in hits)
+    assert "does not rebind" in msgs and "read before being rebound" in msgs
+
+
+def test_rebind_at_dispatch_is_clean(tmp_path):
+    res = _synth(tmp_path, {"pkg/mod.py": _DONATE_CLEAN,
+                            "pkg/__init__.py": ""})
+    assert "USE-AFTER-DONATE" not in _rules_of(res), \
+        "\n".join(f.render() for f in res.findings)
+
+
+# --------------------------------------------------------------------------
+# RECOMPILE-HAZARD
+# --------------------------------------------------------------------------
+
+
+_HAZARD_BAD = '''
+    import jax
+
+    def f(x, n):
+        return x
+
+    g = jax.jit(f, static_argnums=(1,))
+
+    def call(xs):
+        return g(f"{xs}", len(xs))
+'''
+
+
+def test_recompile_hazard_fires(tmp_path):
+    res = _synth(tmp_path, {"pkg/mod.py": _HAZARD_BAD,
+                            "pkg/__init__.py": ""})
+    hits = [f for f in res.findings if f.rule == "RECOMPILE-HAZARD"]
+    msgs = " ".join(f.message for f in hits)
+    assert len(hits) == 2, "\n".join(f.render() for f in hits)
+    assert "f-string" in msgs and "len(...)" in msgs
+
+
+def test_recompile_hazard_named_args_clean(tmp_path):
+    res = _synth(tmp_path, {"pkg/mod.py": '''
+        import jax
+
+        def f(x, n):
+            return x
+
+        g = jax.jit(f, static_argnums=(1,))
+
+        def call(xs, k):
+            return g(xs, k)     # names, not per-call-fresh displays
+    ''', "pkg/__init__.py": ""})
+    assert "RECOMPILE-HAZARD" not in _rules_of(res)
+
+
+# --------------------------------------------------------------------------
+# WARMUP-COVERAGE
+# --------------------------------------------------------------------------
+
+
+_WARMUP_BAD = '''
+    import jax
+
+    class Eng:
+        def __init__(self):
+            self._step = jax.jit(lambda c: c)
+            self._extra = jax.jit(lambda c: c)    # never warmed/tracked
+
+        def warmup(self):
+            self._step(0)
+
+        def compiled_cache_sizes(self):
+            return {"step": self._step._cache_size()}
+'''
+
+
+def test_warmup_coverage_fires_on_forgotten_variant(tmp_path):
+    res = _synth(tmp_path, {"pkg/mod.py": _WARMUP_BAD,
+                            "pkg/__init__.py": ""})
+    hits = [f for f in res.findings if f.rule == "WARMUP-COVERAGE"]
+    assert len(hits) == 2, "\n".join(f.render() for f in hits)
+    assert all("_extra" in f.message for f in hits)
+
+
+def test_warmup_coverage_clean_via_direct_and_getattr_refs(tmp_path):
+    res = _synth(tmp_path, {"pkg/mod.py": '''
+        import jax
+
+        class Eng:
+            def __init__(self):
+                self._step = jax.jit(lambda c: c)
+                self._admits = {}
+                self._admits[(8, 1)] = jax.jit(lambda c: c)
+
+            def warmup(self):
+                self._helper()
+                for k, fn in sorted(self._admits.items()):
+                    fn(0)
+
+            def _helper(self):
+                self._step(0)
+
+            def compiled_cache_sizes(self):
+                out = {n: getattr(self, f"_{n}")._cache_size()
+                       for n in ("step",)}
+                out["admit"] = len(self._admits)
+                return out
+    ''', "pkg/__init__.py": ""})
+    assert "WARMUP-COVERAGE" not in _rules_of(res), \
+        "\n".join(f.render() for f in res.findings)
+
+
+# --------------------------------------------------------------------------
+# ABI-LOCKSTEP
+# --------------------------------------------------------------------------
+
+
+def _abi_tree(version_py):
+    return {
+        "csrc/host_runtime.cpp":
+            "static const int32_t kAbiVersion = 3;\n",
+        "apex_tpu/__init__.py": "",
+        "apex_tpu/_native/__init__.py":
+            f"_ABI_VERSION = {version_py}\n",
+    }
+
+
+def test_abi_lockstep_fires_on_drift(tmp_path):
+    res = _synth(tmp_path, _abi_tree(2), targets=["apex_tpu"])
+    hits = [f for f in res.findings if f.rule == "ABI-LOCKSTEP"]
+    assert len(hits) == 1 and "kAbiVersion=3" in hits[0].message \
+        and "_ABI_VERSION=2" in hits[0].message
+
+
+def test_abi_lockstep_clean_in_lockstep(tmp_path):
+    res = _synth(tmp_path, _abi_tree(3), targets=["apex_tpu"])
+    assert "ABI-LOCKSTEP" not in _rules_of(res)
+
+
+# --------------------------------------------------------------------------
+# METRIC-DRIFT
+# --------------------------------------------------------------------------
+
+
+_METRIC_SRC = '''
+    def wire(registry):
+        registry.counter("serving_good_total", "documented")
+        registry.gauge("serving_orphan_total", "not in the doc")
+'''
+
+
+def test_metric_drift_both_directions(tmp_path):
+    res = _synth(tmp_path, {
+        "apex_tpu/__init__.py": "",
+        "apex_tpu/serving/__init__.py": "",
+        "apex_tpu/serving/sched.py": _METRIC_SRC,
+        "docs/API.md":
+            "`serving_good_total` and `serving_ghost_total` exist.\n",
+    }, targets=["apex_tpu"])
+    hits = [f for f in res.findings if f.rule == "METRIC-DRIFT"]
+    msgs = "\n".join(f.render() for f in hits)
+    assert len(hits) == 2, msgs
+    assert any("serving_ghost_total" in f.message
+               and f.path == "docs/API.md" for f in hits), msgs
+    assert any("serving_orphan_total" in f.message
+               and f.path == "apex_tpu/serving/sched.py"
+               for f in hits), msgs
+
+
+def test_metric_drift_span_colliding_with_engine_api(tmp_path):
+    # `fetch` is both an Engine method and a span-section name; a BARE
+    # doc mention (`engine.fetch`) is a span claim and must be backed
+    # by a registration — only the call spelling (`engine.fetch()`) is
+    # excused as an API reference
+    res = _synth(tmp_path, {
+        "apex_tpu/__init__.py": "",
+        "apex_tpu/serving/__init__.py": "",
+        "apex_tpu/serving/engine.py": '''
+            class Engine:
+                def fetch(self):
+                    pass
+        ''',
+        "apex_tpu/serving/sched.py": '''
+            def wire(registry, spans):
+                registry.counter("serving_ok_total", "")
+                spans.section("engine.dispatch", 0.0, 0.0)
+        ''',
+        "docs/API.md": "`serving_ok_total`; `engine.dispatch` and "
+                       "`engine.fetch` spans; call `engine.fetch()` "
+                       "to sync.\n",
+    }, targets=["apex_tpu"])
+    hits = [f for f in res.findings if f.rule == "METRIC-DRIFT"]
+    assert len(hits) == 1 and "engine.fetch" in hits[0].message, \
+        "\n".join(f.render() for f in res.findings)
+
+
+def test_metric_drift_label_and_alternation_tokens(tmp_path):
+    res = _synth(tmp_path, {
+        "apex_tpu/__init__.py": "",
+        "apex_tpu/serving/__init__.py": "",
+        "apex_tpu/serving/sched.py": '''
+            def wire(registry):
+                registry.counter("serving_spec_drafted_total", "")
+                registry.counter("serving_spec_accepted_total", "")
+                registry.counter("serving_shed_total", "", labels=("r",))
+        ''',
+        "docs/API.md": "`serving_spec_{drafted,accepted}_total` and "
+                       '`serving_shed_total{r="x"}` are exported.\n',
+    }, targets=["apex_tpu"])
+    assert "METRIC-DRIFT" not in _rules_of(res), \
+        "\n".join(f.render() for f in res.findings)
+
+
+# --------------------------------------------------------------------------
+# CITATION
+# --------------------------------------------------------------------------
+
+
+_CITE_SRC = '''
+    """Module header.
+
+    Good: apex/amp/scaler.py (U). Wrapped but tagged:
+    apex/fp16_utils/{fp16util,
+    loss_scaler}.py (U). Bad, untagged: apex/contrib/foo/bar.py is
+    the reference.
+    """
+'''
+
+
+def test_citation_rule_requires_marker(tmp_path):
+    res = _synth(tmp_path, {"pkg/mod.py": _CITE_SRC,
+                            "pkg/__init__.py": ""})
+    hits = [f for f in res.findings if f.rule == "CITATION"]
+    assert len(hits) == 1, "\n".join(f.render() for f in hits)
+    assert "apex/contrib/foo/bar.py" in hits[0].message
+
+
+# --------------------------------------------------------------------------
+# TIER1-COST
+# --------------------------------------------------------------------------
+
+
+_TIER1_SRC = '''
+    import pytest
+
+    def test_unmarked(engine):
+        engine.warmup()          # should fire
+
+    @pytest.mark.slow
+    def test_marked(engine):
+        engine.warmup()          # slow-marked: exempt
+
+    def helper(engine):          # apex: noqa on the def line covers it
+        engine.warmup()
+'''
+
+
+def test_tier1_cost_rule(tmp_path):
+    src = _TIER1_SRC.replace(
+        "def helper(engine):          # apex: noqa on the def line",
+        "def helper(engine):  # apex: noqa[TIER1-COST]: shared helper")
+    res = _synth(tmp_path, {"tests/test_x.py": src},
+                 targets=["tests"], rules=["TIER1-COST"])
+    hits = [f for f in res.findings if f.rule == "TIER1-COST"]
+    assert len(hits) == 1 and "test_unmarked" in hits[0].message, \
+        "\n".join(f.render() for f in res.findings)
+    assert len(res.suppressions_used) == 1  # the def-line noqa
+
+
+def test_tier1_cost_sees_through_lambdas(tmp_path):
+    # a lambda is never scanned as a function of its own, so a warmup
+    # tucked into one is charged to the enclosing def — otherwise the
+    # `mk = lambda: engine.warmup()` spelling escapes the allowlist
+    res = _synth(tmp_path, {"tests/test_x.py": '''
+        def test_lam(engine):
+            mk = lambda: engine.warmup()
+            mk()
+    '''}, targets=["tests"], rules=["TIER1-COST"])
+    hits = [f for f in res.findings if f.rule == "TIER1-COST"]
+    assert len(hits) == 1 and "test_lam" in hits[0].message, \
+        "\n".join(f.render() for f in res.findings)
+
+
+def test_tier1_cost_only_sees_test_files(tmp_path):
+    res = _synth(tmp_path, {"pkg/mod.py": '''
+        def run(engine):
+            engine.warmup()
+    ''', "pkg/__init__.py": ""}, rules=["TIER1-COST"])
+    assert not res.findings
+
+
+# --------------------------------------------------------------------------
+# the suppression mechanism itself
+# --------------------------------------------------------------------------
+
+
+def test_justified_suppression_silences_and_counts(tmp_path):
+    res = _synth(tmp_path, {"pkg/mod.py": '''
+        import jax
+
+        def f(x):
+            return int(x)  # apex: noqa[TRACER-LEAK]: synthetic pin
+
+        j = jax.jit(f)
+    ''', "pkg/__init__.py": ""})
+    assert not res.findings, "\n".join(f.render() for f in res.findings)
+    assert len(res.suppressions_used) == 1
+    s = summary_dict(res)
+    assert s["suppressions"]["active"] == 1
+    assert s["suppressions"]["by_rule"] == {"TRACER-LEAK": 1}
+
+
+def test_bare_suppression_is_a_finding(tmp_path):
+    res = _synth(tmp_path, {"pkg/mod.py": '''
+        import jax
+
+        def f(x):
+            return int(x)  # apex: noqa[TRACER-LEAK]
+
+        j = jax.jit(f)
+    ''', "pkg/__init__.py": ""})
+    assert _rules_of(res) == ["NOQA-BARE"], \
+        "\n".join(f.render() for f in res.findings)
+    assert res.exit_code == 1
+
+
+def test_unused_suppression_is_a_finding(tmp_path):
+    res = _synth(tmp_path, {"pkg/mod.py": '''
+        def f(x):
+            return x + 1  # apex: noqa[TRACER-LEAK]: nothing fires here
+    ''', "pkg/__init__.py": ""})
+    assert _rules_of(res) == ["NOQA-UNUSED"], \
+        "\n".join(f.render() for f in res.findings)
+
+
+def test_suppression_outside_targets_still_matches(tmp_path):
+    # a global rule (METRIC-DRIFT) anchors findings at package files a
+    # partial/--changed run never targeted; a justified suppression at
+    # the registration site must silence them there too, or the
+    # documented pre-commit hook exits 1 spuriously
+    files = {
+        "apex_tpu/__init__.py": "",
+        "apex_tpu/other.py": "X = 1\n",
+        "apex_tpu/serving/__init__.py": "",
+        "apex_tpu/serving/sched.py": '''
+            def wire(registry):
+                registry.gauge("serving_internal_state", "")  # apex: noqa[METRIC-DRIFT]: internal-only, deliberately undocumented
+        ''',
+        "docs/API.md": "no metrics documented\n",
+    }
+    res = _synth(tmp_path, files, targets=["apex_tpu/other.py"])
+    assert not res.findings, "\n".join(f.render() for f in res.findings)
+    # the same run WITH the registration file targeted counts it active
+    res2 = _synth(tmp_path, files,
+                  targets=["apex_tpu/serving/sched.py"])
+    assert not res2.findings, \
+        "\n".join(f.render() for f in res2.findings)
+    assert len(res2.suppressions_used) == 1
+
+
+def test_disabled_rules_suppressions_out_of_scope(tmp_path):
+    # a TIER1-COST noqa in a test file is not "unused" to a run that
+    # never enabled TIER1-COST — each battery polices its own rules
+    res = _synth(tmp_path, {"tests/test_x.py": '''
+        def helper(engine):  # apex: noqa[TIER1-COST]: other battery
+            engine.warmup()
+    '''}, targets=["tests"], rules=["CITATION"])
+    assert not res.findings, "\n".join(f.render() for f in res.findings)
+
+
+def test_unknown_rule_suppression_is_a_finding(tmp_path):
+    # a typo'd (or renamed-rule) id must not become a permanently dead
+    # annotation no run ever flags — the full battery reports it; a
+    # partial --rules run stays silent (it cannot tell another
+    # battery's id from no such id)
+    files = {"pkg/mod.py":
+             "X = 1  # apex: noqa[TRACERLEAK]: typo'd id\n",
+             "pkg/__init__.py": ""}
+    res = _synth(tmp_path, files)
+    assert _rules_of(res) == ["NOQA-UNKNOWN"], \
+        "\n".join(f.render() for f in res.findings)
+    assert "TRACERLEAK" in res.findings[0].message
+    res2 = _synth(tmp_path, files, rules=["CITATION"])
+    assert not res2.findings, \
+        "\n".join(f.render() for f in res2.findings)
+
+
+def test_tier1_cost_respects_pytestmark(tmp_path):
+    # `pytestmark = pytest.mark.slow` at module or class level is the
+    # standard whole-scope slow spelling — it must exempt exactly like
+    # the per-function decorator, or authors get restyled by the linter
+    res = _synth(tmp_path, {
+        "tests/test_mod.py": '''
+            import pytest
+
+            pytestmark = pytest.mark.slow
+
+            def test_soak(engine):
+                engine.warmup()
+        ''',
+        "tests/test_cls.py": '''
+            import pytest
+
+            class TestSoak:
+                pytestmark = [pytest.mark.slow]
+
+                def test_inner(self, engine):
+                    engine.warmup()
+
+            def test_outside(engine):
+                engine.warmup()   # not under the marked class: fires
+        ''',
+    }, targets=["tests"], rules=["TIER1-COST"])
+    hits = [f for f in res.findings if f.rule == "TIER1-COST"]
+    assert len(hits) == 1 and "test_outside" in hits[0].message, \
+        "\n".join(f.render() for f in res.findings)
+
+
+def test_docstring_noqa_examples_are_not_suppressions(tmp_path):
+    res = _synth(tmp_path, {"pkg/mod.py": '''
+        """Docs may show `# apex: noqa[TRACER-LEAK]: why` verbatim."""
+    ''', "pkg/__init__.py": ""})
+    assert not res.findings, "\n".join(f.render() for f in res.findings)
+
+
+# --------------------------------------------------------------------------
+# dependency hygiene
+# --------------------------------------------------------------------------
+
+
+def test_analysis_imports_stdlib_only(tmp_path):
+    """The linter must stay importable and runnable with jax/numpy
+    purged and blocked (it lints the tree BEFORE a broken change could
+    even import) — same harness as serving.api's purged-import test."""
+    script = tmp_path / "probe.py"
+    script.write_text(textwrap.dedent(f'''
+        import sys
+
+        BLOCKED = ("jax", "jaxlib", "numpy", "scipy", "torch")
+
+        class _Blocker:
+            def find_spec(self, name, path=None, target=None):
+                if name.split(".")[0] in BLOCKED:
+                    raise ImportError(f"blocked import: {{name}}")
+
+        # blocked BEFORE apex_tpu itself loads: the claim is that the
+        # linter runs on a machine where jax cannot import at all (the
+        # parent package degrades to its stdlib-only corners)
+        sys.meta_path.insert(0, _Blocker())
+
+        import apex_tpu
+        # degradation shape: a jax-backed subpackage must surface the
+        # REAL missing module, not a fake "no attribute" error...
+        try:
+            apex_tpu.mesh
+        except ImportError as e:
+            assert "jax" in str(e), e
+        else:
+            raise AssertionError("apex_tpu.mesh imported without jax?")
+        # ...while a genuinely absent attribute stays an AttributeError
+        try:
+            apex_tpu.not_a_subpackage
+        except AttributeError:
+            pass
+        from apex_tpu.analysis.core import run_analysis
+        res = run_analysis(
+            [{os.path.join(REPO, "apex_tpu", "analysis")!r}],
+            root={REPO!r})
+        print("FINDINGS", len(res.findings))
+    '''))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, str(script)],
+                       capture_output=True, text=True, timeout=120,
+                       cwd=REPO, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "FINDINGS 0" in r.stdout, r.stdout

@@ -324,7 +324,7 @@ def served_engine(devices8):
                               decode_chunk=8))
     registry = Registry()
     eng.recompile_sentinel(registry=registry)
-    eng.warmup()
+    eng.warmup()  # apex: noqa[TIER1-COST]: shared warmed engine for the live /metrics e2e scrapes; warm-cache ~s
     yield cfg, params, mesh, eng, registry
     eng.close()  # release the process-wide monitoring listener
 
