@@ -1,0 +1,146 @@
+"""Cost of the flight recorder (PR 10): in-process interleaved A/B of
+the NEW scheduler — recorder OFF (always-on request-record bookkeeping
+only) and recorder ON (full event log) — vs the PRE-PR scheduler
+loaded verbatim from git HEAD, over ONE shared warm engine per shape,
+same burst trace, best-of-N with sides interleaved so host drift hits
+all alike. Token parity asserted between every pair of sides.
+
+Run (CPU mesh):
+  git show <pre-PR-rev>:apex_tpu/serving/scheduler.py > /tmp/pre_scheduler_pr10.py
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  PYTHONPATH=/root/repo python .scratch/flightrec_ab.py
+
+Also microbenches the hot-path unit costs directly: one
+FlightRecorder.record() append (the per-decision price) and one
+_record_request + completion-graduation pair (the per-request price) —
+the direct bound on added host work, independent of the noisy
+end-to-end ratio.
+"""
+
+import importlib.util
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import mesh as mx
+from apex_tpu.models import gpt
+from apex_tpu.serving import Request, SamplingParams
+from apex_tpu.serving.engine import Engine, EngineConfig
+from apex_tpu.serving.scheduler import Scheduler as NewScheduler
+from apex_tpu.telemetry.flightrec import FlightRecorder
+
+spec = importlib.util.spec_from_file_location(
+    "pre_scheduler_pr10", "/tmp/pre_scheduler_pr10.py")
+pre_mod = importlib.util.module_from_spec(spec)
+# dataclasses resolves cls.__module__ through sys.modules at class
+# creation — register before exec
+sys.modules["pre_scheduler_pr10"] = pre_mod
+spec.loader.exec_module(pre_mod)
+PreScheduler = pre_mod.Scheduler
+
+mesh = mx.build_mesh(tp=1, devices=jax.devices()[:1])
+
+SHAPES = {
+    # the dispatch-dominated probe (worst case for per-chunk host
+    # overhead: chunks are fast, so fixed host work per chunk is the
+    # largest relative slice)
+    "probe_1l32h": (
+        gpt.GPTConfig(vocab_size=256, hidden_size=32, num_layers=1,
+                      num_heads=2, seq_len=128, remat=False,
+                      compute_dtype=jnp.float32),
+        EngineConfig(slots=4, max_prompt_len=32, max_seq_len=96,
+                     decode_chunk=8), 24, 16),
+    # the compute-bound smoke shape
+    "smoke_4l256h": (
+        gpt.GPTConfig(vocab_size=1024, hidden_size=256, num_layers=4,
+                      num_heads=8, seq_len=256, remat=False,
+                      compute_dtype=jnp.float32),
+        EngineConfig(slots=4, max_prompt_len=16, max_seq_len=48,
+                     decode_chunk=8), 12, 24),
+}
+
+
+def trace(cfg, ecfg, n, mt):
+    reqs = []
+    for i in range(n):
+        p_len = 1 + (11 * i + 5) % ecfg.max_prompt_len
+        prompt = [int(t) for t in jax.random.randint(
+            jax.random.PRNGKey(900 + i), (p_len,), 0, cfg.vocab_size)]
+        sp = (SamplingParams(temperature=0.9, top_k=20, seed=i)
+              if i % 2 else SamplingParams())
+        reqs.append(Request(f"r{i}", prompt, max_tokens=mt, sampling=sp))
+    return reqs
+
+
+SIDES = (
+    ("pre", lambda eng: PreScheduler(eng, pipeline_depth=2)),
+    ("off", lambda eng: NewScheduler(eng, pipeline_depth=2)),
+    ("on", lambda eng: NewScheduler(eng, pipeline_depth=2,
+                                    recorder=FlightRecorder())),
+)
+
+out = {}
+for name, (cfg, ecfg, n_reqs, mt) in SHAPES.items():
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    engine = Engine(cfg, params, mesh, ecfg).warmup()
+    best = {s: 0.0 for s, _ in SIDES}
+    ratios = {"off": [], "on": []}
+    toks = {}
+    events = 0
+    for _ in range(7):
+        per_round = {}
+        for side, make in SIDES:
+            sched = make(engine)
+            for r in trace(cfg, ecfg, n_reqs, mt):
+                sched.submit(r)
+            sched.run_until_idle()
+            t = {rid: c.tokens for rid, c in sched.completions.items()}
+            toks.setdefault(side, t)
+            assert toks[side] == t, f"{name}/{side} rerun drift"
+            s = sched.summary()
+            per_round[side] = s["tokens_per_sec"]
+            best[side] = max(best[side], s["tokens_per_sec"])
+            if side == "on":
+                events = sched.recorder.summary()["events_total"]
+        for side in ("off", "on"):
+            ratios[side].append(per_round[side] / per_round["pre"])
+    assert toks["pre"] == toks["off"] == toks["on"], \
+        f"{name} token drift across sides"
+    ratios = {s: sorted(r) for s, r in ratios.items()}
+    out[name] = {
+        "pre_tokens_per_sec": round(best["pre"], 1),
+        "off_tokens_per_sec": round(best["off"], 1),
+        "on_tokens_per_sec": round(best["on"], 1),
+        "off_over_pre_best": round(best["off"] / best["pre"], 4),
+        "on_over_pre_best": round(best["on"] / best["pre"], 4),
+        "off_over_pre_median": round(ratios["off"][3], 4),
+        "on_over_pre_median": round(ratios["on"][3], 4),
+        "events_per_run": events,
+    }
+
+# direct unit costs of the added hot-path work
+rec = FlightRecorder()
+N = 200_000
+t0 = time.perf_counter()
+for i in range(N):
+    rec.record("dispatch", False, 8, 1, 4)
+record_ns = (time.perf_counter() - t0) / N * 1e9
+
+sched = NewScheduler(engine)
+req = trace(cfg, ecfg, 1, 4)[0]
+M = 20_000
+t0 = time.perf_counter()
+for i in range(M):
+    sched._record_request(req, 0.0)
+    sched._req_records.pop(req.request_id)
+req_record_us = (time.perf_counter() - t0) / M * 1e6
+
+out["unit_costs"] = {
+    "record_ns_per_event": round(record_ns, 1),
+    "request_record_us_per_request": round(req_record_us, 2),
+}
+print(json.dumps(out, indent=1))

@@ -6,6 +6,7 @@ from apex_tpu.analysis.rules.recompile_hazard import RecompileHazardRule
 from apex_tpu.analysis.rules.warmup_coverage import WarmupCoverageRule
 from apex_tpu.analysis.rules.abi_lockstep import AbiLockstepRule
 from apex_tpu.analysis.rules.metric_drift import MetricDriftRule
+from apex_tpu.analysis.rules.event_drift import EventDriftRule
 from apex_tpu.analysis.rules.citation import CitationRule
 from apex_tpu.analysis.rules.tier1_cost import Tier1CostRule
 
@@ -16,6 +17,7 @@ ALL_RULES = [
     WarmupCoverageRule(),
     AbiLockstepRule(),
     MetricDriftRule(),
+    EventDriftRule(),
     CitationRule(),
     Tier1CostRule(),
 ]

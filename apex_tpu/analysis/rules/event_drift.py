@@ -1,0 +1,143 @@
+"""EVENT-DRIFT: recorded flight-recorder event names vs the registry
+and the docs/API.md event table, in every direction.
+
+The flight recorder's hot path (``recorder.record("name", *args)``)
+deliberately skips vocabulary validation — an O(1) append must not pay
+a lookup — so nothing at runtime stops a call site from recording a
+name the export table (``telemetry.flightrec.EVENT_FIELDS``) does not
+know. Such an event still lands in bundles (under a raw ``args`` list),
+but every post-mortem tool, dashboard, and runbook written against the
+docs/API.md event table silently misses it: METRIC-DRIFT's failure
+mode, one layer down. Three invariants, each checked both ways:
+
+- every ``record()``-ed name is registered in ``EVENT_FIELDS``
+  (anchored at the call site) and every registered name is recorded
+  somewhere (a dead vocabulary entry documents an event that can never
+  appear);
+- every registered name appears in docs/API.md's flight-recorder event
+  table, and every table row names a registered event.
+
+``record()`` receivers are matched by the recorder naming convention
+(a terminal name containing ``rec``), so unrelated ``.record()``
+methods elsewhere stay out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Tuple
+
+from apex_tpu.analysis._astutil import const_str
+from apex_tpu.analysis.core import Finding, Project
+
+#: where the vocabulary lives
+_VOCAB_FILE = "apex_tpu/telemetry/flightrec.py"
+_VOCAB_NAME = "EVENT_FIELDS"
+#: where record() call sites are collected from
+_RECORD_SUBTREES = ("apex_tpu/serving/", "apex_tpu/telemetry/")
+_DOC_FILE = "docs/API.md"
+#: the API.md section heading the event table lives under
+_TABLE_HEADING = re.compile(r"flight[- ]recorder event", re.IGNORECASE)
+#: a table row whose first cell is a backticked event name
+_TABLE_ROW = re.compile(r"^\|\s*`([a-z_]+)`\s*\|")
+
+
+def _receiver_is_recorder(func: ast.Attribute) -> bool:
+    v = func.value
+    name = v.id if isinstance(v, ast.Name) else (
+        v.attr if isinstance(v, ast.Attribute) else "")
+    return "rec" in name
+
+
+class EventDriftRule:
+    id = "EVENT-DRIFT"
+    summary = ("flight-recorder event names must agree across record() "
+               "call sites, flightrec.EVENT_FIELDS, and the docs/"
+               "API.md event table (all directions)")
+    triggers: Tuple[str, ...] = (_DOC_FILE, _VOCAB_FILE,
+                                 "apex_tpu/serving/",
+                                 "apex_tpu/telemetry/")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        project.ensure_package_index()
+        vocab_ctx = project.by_rel.get(_VOCAB_FILE)
+        if vocab_ctx is None or vocab_ctx.tree is None:
+            return []  # not this repo shape (synthetic tree)
+        vocab: Dict[str, int] = {}
+        vocab_line = 1
+        for node in ast.walk(vocab_ctx.tree):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == _VOCAB_NAME
+                    for t in node.targets) and \
+                    isinstance(node.value, ast.Dict):
+                vocab_line = node.lineno
+                for k in node.value.keys:
+                    name = const_str(k)
+                    if name is not None:
+                        vocab[name] = k.lineno
+        if not vocab:
+            return []
+
+        recorded: Dict[str, Tuple[str, int]] = {}
+        for ctx in project.by_rel.values():
+            if ctx.tree is None or not any(
+                    ctx.rel.startswith(p) for p in _RECORD_SUBTREES):
+                continue
+            for node in ast.walk(ctx.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "record"
+                        and node.args
+                        and _receiver_is_recorder(node.func)):
+                    continue
+                name = const_str(node.args[0])
+                if name is not None:
+                    recorded.setdefault(name, (ctx.rel, node.lineno))
+
+        documented: Dict[str, int] = {}
+        doc_text = project.read_text(_DOC_FILE)
+        if doc_text is not None:
+            in_section = False
+            for lineno, line in enumerate(doc_text.splitlines(),
+                                          start=1):
+                if line.lstrip().startswith("#"):
+                    in_section = bool(_TABLE_HEADING.search(line))
+                    continue
+                if not in_section:
+                    continue
+                m = _TABLE_ROW.match(line.strip())
+                if m:
+                    documented.setdefault(m.group(1), lineno)
+
+        findings: List[Finding] = []
+        for name, (rel, lineno) in sorted(recorded.items()):
+            if name not in vocab:
+                findings.append(Finding(
+                    self.id, rel, lineno,
+                    f"event {name!r} is recorded here but missing from "
+                    f"flightrec.EVENT_FIELDS — bundles will carry it "
+                    f"as raw args and exports cannot name its fields"))
+        for name, lineno in sorted(vocab.items()):
+            if name not in recorded:
+                findings.append(Finding(
+                    self.id, _VOCAB_FILE, lineno,
+                    f"event {name!r} is registered in EVENT_FIELDS but "
+                    f"no record() call ever emits it — dead vocabulary "
+                    f"(renamed or removed call site)"))
+            if doc_text is not None and name not in documented:
+                findings.append(Finding(
+                    self.id, _VOCAB_FILE, lineno,
+                    f"event {name!r} is registered in EVENT_FIELDS but "
+                    f"missing from the docs/API.md flight-recorder "
+                    f"event table — post-mortem runbooks are written "
+                    f"against the doc"))
+        for name, lineno in sorted(documented.items()):
+            if name not in vocab:
+                findings.append(Finding(
+                    self.id, _DOC_FILE, lineno,
+                    f"event {name!r} is documented in the flight-"
+                    f"recorder event table but not registered in "
+                    f"EVENT_FIELDS — renamed or removed without "
+                    f"updating the doc"))
+        return findings

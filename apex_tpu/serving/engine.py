@@ -866,6 +866,35 @@ class Engine:
                 return hit[0], split
         return None
 
+    def describe(self) -> Dict[str, Any]:
+        """JSON-safe snapshot of everything needed to REBUILD this
+        engine elsewhere — the post-mortem bundle's ``config.json``
+        (``apex_tpu.telemetry.replay`` reconstructs the GPTConfig /
+        EngineConfig / prefix templates from it). Dtypes serialise by
+        numpy name (``compute_dtype: "float32"``); anything else
+        non-primitive falls back to ``str`` (reported, not
+        replayable)."""
+        model: Dict[str, Any] = {}
+        for f in dataclasses.fields(self.cfg):
+            v = getattr(self.cfg, f.name)
+            if not isinstance(v, (int, float, str, bool, type(None))):
+                try:  # dtype-valued fields (compute_dtype, param_dtype)
+                    v = np.dtype(v).name
+                except TypeError:
+                    v = str(v)
+            model[f.name] = v
+        return {
+            "model": model,
+            "engine": dataclasses.asdict(self.engine_cfg),
+            "tp": int(self._mesh.shape.get("tp", 1)),
+            "prompt_buckets": list(self._buckets),
+            "admit_batch_sizes": list(self._batch_sizes),
+            "prefix_templates": [list(self._prefix_tokens[p])
+                                 for p in sorted(self._prefix_tokens)],
+            "warmed": self._warmed,
+            "poisoned": self._poisoned,
+        }
+
     def cache_bytes(self) -> int:
         """Device bytes held by the slot KV cache — under a quantized
         ``kv_cache_dtype`` the int8/fp8 data plane plus the fp32 scale
@@ -1480,9 +1509,13 @@ class Engine:
         sentinel's ``jax.monitoring`` listener stays registered for
         process lifetime otherwise, so engines created in a loop (the
         bench's chunk sweep, a service rebuilding on config reload)
-        must close the old one. Idempotent; the engine itself remains
-        usable, and a later :meth:`recompile_sentinel` call reinstalls
-        a fresh sentinel."""
-        if self._sentinel is not None:
-            self._sentinel.uninstall()
-            self._sentinel = None
+        must close the old one. Idempotent AND re-entrant: the sentinel
+        reference is detached BEFORE the listener is released, so a
+        second ``close()`` — or one racing a bundle-triggered dump that
+        reads the sentinel — can never double-release (a double
+        unregister-by-callback could detach a listener a NEWER sentinel
+        just registered). The engine itself remains usable, and a later
+        :meth:`recompile_sentinel` call reinstalls a fresh sentinel."""
+        sentinel, self._sentinel = self._sentinel, None
+        if sentinel is not None:
+            sentinel.uninstall()

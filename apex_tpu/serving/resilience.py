@@ -138,6 +138,10 @@ class FaultPlan:
         #: specs that actually fired, in firing order — the replay
         #: record chaos tests reconcile counters against
         self.injected: List[FaultSpec] = []
+        #: optional observer called with each FaultSpec the moment it
+        #: fires (the scheduler wires the flight recorder here, so a
+        #: post-mortem bundle shows injections next to detections)
+        self.on_inject: Optional[Callable[[FaultSpec], None]] = None
 
     @classmethod
     def random(cls, seed: int, n_faults: int = 3, *,
@@ -178,6 +182,8 @@ class FaultPlan:
         spec = self._by_point.get(point, {}).get(i)
         if spec is not None:
             self.injected.append(spec)
+            if self.on_inject is not None:
+                self.on_inject(spec)
         return spec
 
     def counts(self) -> Dict[str, int]:
@@ -277,13 +283,19 @@ class HealthMonitor:
     traffic should keep flowing (ok/degraded), 503 when it should stop
     (draining/failed), body = the state name."""
 
-    def __init__(self, *, registry=None, recovery_chunks: int = 2):
+    def __init__(self, *, registry=None, recovery_chunks: int = 2,
+                 on_transition: Optional[
+                     Callable[[str, str, Optional[str]], None]] = None):
         if recovery_chunks < 1:
             raise ValueError(
                 f"recovery_chunks {recovery_chunks} must be >= 1")
         self.state = HEALTH_OK
         self.recovery_chunks = recovery_chunks
         self.last_cause: Optional[str] = None
+        #: optional observer called AFTER each state change with
+        #: ``(old, new, last_cause)`` — the scheduler wires the flight
+        #: recorder + auto bundle dump here
+        self.on_transition = on_transition
         self._resume = HEALTH_OK  # state a drain returns to
         self._streak = 0          # consecutive healthy chunks
         self._gauge = self._transitions = None
@@ -301,10 +313,12 @@ class HealthMonitor:
     def _set(self, state: str) -> None:
         if state == self.state:
             return
-        self.state = state
+        old, self.state = self.state, state
         if self._gauge is not None:
             self._gauge.set(HEALTH_STATES.index(state))
             self._transitions[state].inc()
+        if self.on_transition is not None:
+            self.on_transition(old, state, self.last_cause)
 
     # -- inputs -------------------------------------------------------------
 

@@ -56,6 +56,17 @@ chunks → retired, plus ``error`` marks) and ``engine.dispatch`` /
 Both are pre-bound at construction so the per-token hot path pays an
 attribute access and an add, nothing more.
 
+Black box (``apex_tpu.telemetry.flightrec``): pass ``recorder`` to log
+every load-bearing host decision (submits/sheds, admit dispatches,
+chunk dispatch/fetch, spec-gate flips, fault injection/detection,
+rebuild/replay brackets, watchdog and guard alarms, health
+transitions) into a bounded ring of O(1) tuple appends, and
+``bundle_dir`` to auto-dump an atomic self-contained post-mortem
+bundle on any fault detection, guard alarm, watchdog trip, or terminal
+failure — ``python -m apex_tpu.telemetry.replay <bundle>`` rebuilds
+the run from it and checks the replayed streams bit-identical, and
+``--report`` renders the incident timeline with no jax installed.
+
 The boundary fix the engine relies on: a request whose prompt already
 ends in its eos token completes at ``submit`` time with zero generated
 tokens — it never occupies a slot (admitting it would burn
@@ -66,6 +77,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import os
 import time
 from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
@@ -90,7 +102,9 @@ from apex_tpu.serving.resilience import (
     HealthMonitor,
     ResilienceConfig,
 )
+from apex_tpu.telemetry import flightrec as flightrec_mod
 from apex_tpu.telemetry import spans as spans_mod
+from apex_tpu.telemetry.ring import Ring
 
 #: fault causes the scheduler can detect (label values of
 #: ``serving_faults_detected_total``, pre-created so scrapes show
@@ -426,6 +440,18 @@ class Scheduler:
     all that fit the free slots; 1 = serial single admits, the A/B
     baseline). ``resilience`` tunes recovery/overload policy
     (defaults: :class:`~apex_tpu.serving.resilience.ResilienceConfig`).
+
+    Black box (``apex_tpu.telemetry.flightrec``): pass ``recorder`` (a
+    :class:`~apex_tpu.telemetry.flightrec.FlightRecorder`) to log every
+    load-bearing decision as O(1) event appends, and ``bundle_dir`` to
+    auto-dump a self-contained post-mortem bundle on any fault
+    detection, guard alarm, watchdog trip, or terminal failure
+    (:meth:`dump_bundle` triggers one on demand;
+    ``python -m apex_tpu.telemetry.replay <bundle>`` re-runs it and
+    checks the replayed streams bit-identical). Per-request replay
+    records (prompt/sampling/emitted prefix) are kept regardless —
+    live requests exactly, completed ones in a ``request_log``-bounded
+    ring.
     """
 
     def __init__(self, engine: Engine, *, max_queue: int = 256,
@@ -436,7 +462,11 @@ class Scheduler:
                  pipeline_depth: int = 1,
                  max_admit_batch: Optional[int] = None,
                  resilience: Optional[ResilienceConfig] = None,
-                 spec_gate: Optional[SpecGateConfig] = None):
+                 spec_gate: Optional[SpecGateConfig] = None,
+                 recorder=None, bundle_dir: Optional[str] = None,
+                 bundle_meta: Optional[Dict] = None,
+                 max_auto_bundles: int = 4,
+                 request_log: int = 4096):
         if pipeline_depth < 1:
             raise ValueError(
                 f"pipeline_depth {pipeline_depth} must be >= 1 (1 = the "
@@ -462,11 +492,56 @@ class Scheduler:
         self.spans = spans
         if spans is not None:
             spans.clock = self.clock
+        self._registry = registry
+        #: flight recorder (telemetry.flightrec.FlightRecorder) — the
+        #: always-on black box: every load-bearing host decision is one
+        #: O(1) event append. Its clock is slaved to the scheduler's,
+        #: like the span recorder's, so injected test clocks produce
+        #: deterministic timelines; fault-plan injections are observed
+        #: through FaultPlan.on_inject so a bundle shows injections
+        #: next to detections.
+        self.recorder = recorder
+        if recorder is not None:
+            recorder.clock = self.clock
+        if engine.fault_plan is not None:
+            # the NEWEST scheduler owns the observer either way: a
+            # recorder-less scheduler over a shared engine (the bench's
+            # on/off A/B, a service rebuilding on config reload) must
+            # clear a dead predecessor's wiring, not inherit it
+            engine.fault_plan.on_inject = (
+                None if recorder is None else
+                lambda spec: recorder.record(
+                    "inject", spec.point, spec.index, spec.kind))
+        #: post-mortem bundles: ``bundle_dir`` is where auto-dumps land
+        #: (fault detection / watchdog trip / guard alarm / terminal
+        #: failure — at most ``max_auto_bundles``, one per trigger
+        #: wave; None disables auto-dump, :meth:`dump_bundle` with an
+        #: explicit dir still works). ``bundle_meta`` is carried
+        #: verbatim into the manifest — put params provenance there
+        #: (``{"params": {"init_seed": 0}}``) so
+        #: ``python -m apex_tpu.telemetry.replay`` can rebuild the
+        #: model.
+        self.bundle_dir = bundle_dir
+        self.bundle_meta = dict(bundle_meta or {})
+        self.max_auto_bundles = max_auto_bundles
+        #: bundle paths written so far (auto + manual), oldest first
+        self.bundles_written: List[str] = []
+        self._auto_bundles = 0
+        self._bundle_counter = 0
+        self._dump_token = 0        # one auto-dump per trigger wave
+        self._last_dump_token = -1
+        #: replayable per-request records — live (queued/active) by id,
+        #: completed in a bounded ring; the bundle's requests.jsonl
+        self._req_records: Dict[str, Dict] = {}
+        self._req_done = Ring(request_log)
+        self._submit_seq = 0
+        self._gate_state_seen: Optional[float] = None
         #: the ok → degraded → draining → failed state machine; wire
         #: ``MetricsServer(health=sched.health.healthz)`` to serve it
         self.health = HealthMonitor(
             registry=registry,
-            recovery_chunks=self.resilience.recovery_chunks)
+            recovery_chunks=self.resilience.recovery_chunks,
+            on_transition=self._on_health_transition)
         self.queue: Deque[Request] = collections.deque()
         self.active: Dict[int, _Active] = {}
         self.completions: Dict[str, Completion] = {}
@@ -582,10 +657,15 @@ class Scheduler:
                 f"{ecfg.decode_chunk}")
         now = self.clock()
         request.arrival_time = now
+        self._dump_token += 1
+        rec = self.recorder
         if (request.eos_token_id is not None
                 and prompt[-1] == request.eos_token_id):
             if self.telemetry is not None:
                 self.telemetry.submitted.inc()
+            self._record_request(request, now)
+            if rec is not None:
+                rec.record("submit_terminal", request.request_id)
             self._complete(request, [], FINISH_EOS, ttft=None, now=now)
             return
         plan = self.engine.fault_plan
@@ -595,7 +675,11 @@ class Scheduler:
             depth = self.max_queue if flooded else len(self.queue)
             hint = depth * self._chunk_ewma
             self._shed += 1
+            if rec is not None:
+                rec.record("queue_full", request.request_id, depth,
+                           flooded)
             self.health.record_fault("queue_full")
+            self._maybe_dump("queue_full")
             if self.telemetry is not None:
                 self.telemetry.shed["queue_full"].inc()
             raise QueueFull(
@@ -612,7 +696,11 @@ class Scheduler:
             if self.telemetry is not None:
                 (self.telemetry.prefix_hits if hit is not None
                  else self.telemetry.prefix_misses).inc()
+        self._record_request(request, now)
         self.queue.append(request)
+        if rec is not None:
+            rec.record("submit", request.request_id, len(prompt),
+                       request.max_tokens, len(self.queue))
         if self.telemetry is not None:
             self.telemetry.submitted.inc()
             self.telemetry.queue_depth.set(len(self.queue))
@@ -634,6 +722,7 @@ class Scheduler:
         triggers quarantine + rebuild + replay instead of escaping
         (see module docstring); once the health machine is terminal
         the tick is a no-op."""
+        self._dump_token += 1
         if self.health.state == HEALTH_FAILED:
             return
         now = self.clock()
@@ -724,7 +813,10 @@ class Scheduler:
         v = self._guard_alarm_count()
         if v > self._alarms_seen:
             self._alarms_seen = v
+            if self.recorder is not None:
+                self.recorder.record("guard_alarm", v)
             self.health.record_fault("recompile_alarm")
+            self._maybe_dump("guard_alarm")
 
     def _backoff_wait_s(self) -> Optional[float]:
         """Seconds until the earliest retry-backoff gate opens, when
@@ -823,6 +915,9 @@ class Scheduler:
         # deadline retire) and their columns must be dropped
         self._inflight.append((handle, dict(self.active), t0,
                                len(self._inflight) + 1))
+        if self.recorder is not None:
+            self.recorder.record("dispatch", handle.spec, handle.ncols,
+                                 len(self._inflight), len(self.active))
         if self.telemetry is not None:
             self.telemetry.inflight.set(len(self._inflight))
         return True
@@ -863,10 +958,23 @@ class Scheduler:
         # in-flight chunks, and pricing the queue with the un-divided
         # wall would overstate slot turnover ~d× and shed requests
         # that would have met their deadlines
+        # still-live snapshot rows — THE liveness condition shared by
+        # the fetch event, the gate's tokens-per-wave denominator, and
+        # the latency denominator below (computed once so they can
+        # never disagree)
+        live_rows = [s for s, a in snapshot.items()
+                     if self.active.get(s) is a]
         chunk_wall = max(now - t_dispatch, 0.0)
+        rec = self.recorder
+        if rec is not None:
+            rec.record("fetch", handle.spec, handle.ncols, chunk_wall,
+                       len(live_rows))
         if chunk_wall > self.resilience.watchdog_timeout_s:
             self._watchdog_trips += 1
+            if rec is not None:
+                rec.record("watchdog", chunk_wall)
             self.health.record_fault("watchdog")
+            self._maybe_dump("watchdog")
             if tele is not None:
                 tele.watchdog.inc()
         else:
@@ -902,11 +1010,6 @@ class Scheduler:
         # chunk-wall EWMAs per variant the break-even compares. A
         # watchdog-tripped chunk is excluded exactly like the overload
         # EWMA above.
-        # still-live snapshot rows — THE liveness condition for both
-        # the gate's tokens-per-wave denominator and the latency
-        # denominator below (computed once so they can never disagree)
-        live_rows = [s for s, a in snapshot.items()
-                     if self.active.get(s) is a]
         g = self._gate
         if g is not None and chunk_wall <= \
                 self.resilience.watchdog_timeout_s:
@@ -935,8 +1038,16 @@ class Scheduler:
                                           now)
             else:
                 g.observe_plain(sample)
+            st = g.state()
+            if st != self._gate_state_seen:
+                # a payoff-gate transition is a scheduling decision —
+                # log it once per flip, not per chunk
+                self._gate_state_seen = st
+                if rec is not None:
+                    rec.record("spec_gate", st, g.accept_ewma,
+                               g.break_even())
             if tele is not None:
-                tele.spec_gate.set(g.state())
+                tele.spec_gate.set(st)
                 tele.spec_accept_ewma.set(g.accept_ewma)
         # in-flight latency of this chunk (dispatch -> value); the
         # decode-time split dedups the overlap so pipelined chunks
@@ -1129,7 +1240,10 @@ class Scheduler:
         stream is bit-identical and the already-streamed prefix
         (tracked per request in ``_replay``) is re-derived silently."""
         tele = self.telemetry
+        rec = self.recorder
         rcfg = self.resilience
+        if rec is not None:
+            rec.record("fault", cause, detail, len(affected))
         self.health.record_fault(cause)
         if tele is not None and cause in tele.faults:
             tele.faults[cause].inc()
@@ -1159,6 +1273,10 @@ class Scheduler:
         # failing call and cannot be trusted
         self.engine.rebuild_slots()
         self._rebuilds += 1
+        if rec is not None:
+            rec.record("rebuild", cause,
+                       max(self.clock() - now, 0.0),
+                       self._consecutive_rebuilds)
         if tele is not None:
             tele.rebuilds.inc()
             tele.active_slots.set(0)
@@ -1180,9 +1298,14 @@ class Scheduler:
                 # re-derives (and re-holds) them deterministically
                 st.tokens = list(act.tokens)
                 st.logprobs = list(act.logprobs)
+            if rec is not None:
+                rec.record("replay", r.request_id, len(st.tokens))
             if r.request_id in affected_ids:
                 st.attempts += 1
                 if st.attempts > rcfg.max_retries:
+                    if rec is not None:
+                        rec.record("retry_exhausted", r.request_id,
+                                   st.attempts)
                     self.health.record_fault("retry_exhausted")
                     self._abort(r, FINISH_ERROR, now, act=act,
                                 error=f"{cause}: {detail}; "
@@ -1190,6 +1313,8 @@ class Scheduler:
                     continue
                 st.not_before = now + rcfg.backoff_s(st.attempts)
                 self._retries += 1
+                if rec is not None:
+                    rec.record("retry", r.request_id, st.attempts)
                 if tele is not None:
                     tele.retries.inc()
                 self.events.append(StreamEvent(
@@ -1203,12 +1328,19 @@ class Scheduler:
         self.queue.extendleft(reversed(front))
         if tele is not None:
             tele.queue_depth.set(len(self.queue))
+        # the post-mortem bundle lands AFTER the recovery bracket, so
+        # it carries the fault AND its rebuild/replay/retry events
+        self._maybe_dump(f"fault-{cause}")
 
     def _fail_all(self, cause: str, now: float) -> None:
         """Terminal: abort every queued/active request with an
         ``error`` outcome (partial streams preserved) and mark the
         health machine failed. The process survives — callers see
-        completions, not a crash."""
+        completions, not a crash. The terminal bundle dumps FIRST,
+        while the queue/slot state it should explain still exists."""
+        if self.recorder is not None:
+            self.recorder.record("failed", cause)
+        self._maybe_dump("failed")
         self.health.fail(cause)
         for slot, act in sorted(self.active.items()):
             self._abort(act.request, FINISH_ERROR, now, act=act,
@@ -1224,6 +1356,186 @@ class Scheduler:
             self.telemetry.queue_depth.set(0)
             self.telemetry.active_slots.set(0)
             self.telemetry.inflight.set(0)
+
+    # -- flight recorder + post-mortem bundles -------------------------------
+
+    def _record_request(self, request: Request, now: float) -> None:
+        """Start the replayable record of one accepted request — the
+        bundle's ``requests.jsonl`` row (prompt/sampling/seed; the
+        emitted prefix attaches at completion or dump time). Kept even
+        without a recorder: dumps are most wanted for runs nobody
+        thought to instrument."""
+        sp = request.sampling
+        self._req_records[request.request_id] = {
+            "order": self._submit_seq,
+            "request_id": request.request_id,
+            "prompt": [int(t) for t in request.prompt],
+            "max_tokens": request.max_tokens,
+            "temperature": sp.temperature,
+            "top_k": sp.top_k,
+            "top_p": sp.top_p,
+            "seed": sp.seed,
+            "eos_token_id": request.eos_token_id,
+            "stop": ([[int(t) for t in s] for s in request.stop]
+                     if request.stop else None),
+            "constrained": request.constraint is not None,
+            "deadline": request.deadline,
+            "arrival": now,
+        }
+        self._submit_seq += 1
+
+    def _on_health_transition(self, old: str, new: str,
+                              cause: Optional[str]) -> None:
+        if self.recorder is not None:
+            self.recorder.record("health", old, new, cause)
+
+    def _maybe_dump(self, cause: str) -> None:
+        """Auto-dump gate: a bundle per trigger WAVE (faults, their
+        health transitions, and their retries land in one tick — one
+        bundle explains them all), bounded by ``max_auto_bundles`` so a
+        fault storm cannot fill the disk with near-identical evidence.
+        Disk errors are swallowed — losing a bundle must never take
+        down the serving loop that survived the fault itself."""
+        if self.bundle_dir is None \
+                or self._auto_bundles >= self.max_auto_bundles \
+                or self._last_dump_token == self._dump_token:
+            return
+        self._last_dump_token = self._dump_token
+        self._auto_bundles += 1
+        try:
+            self.dump_bundle(cause)
+        except OSError:
+            pass
+
+    def dump_bundle(self, cause: str = "manual",
+                    bundle_dir: Optional[str] = None) -> str:
+        """Write a self-contained post-mortem bundle directory and
+        return its path: manifest (cause, health, ``summary()``,
+        versions, caller ``bundle_meta``), flight-recorder event log
+        (``events.jsonl``), engine/scheduler config (``config.json``
+        — everything ``apex_tpu.telemetry.replay`` needs to rebuild
+        the run), per-request replay records (``requests.jsonl``),
+        plus registry snapshot / Chrome-trace spans / fault-plan
+        record when those exist. Atomic (same-dir tmp +
+        ``os.replace``): a reader sees a complete bundle or none.
+
+        Safe to call from another thread (the ``/debug/bundle``
+        trigger, a SIGUSR handler): the payload walk takes C-level
+        (GIL-atomic) snapshots of the mutable maps, and the build is
+        retried if the serving loop still manages to mutate a
+        structure mid-iteration — the bundle is a best-effort snapshot
+        of a moving system, but it is always internally well-formed."""
+        base = bundle_dir or self.bundle_dir
+        if base is None:
+            raise ValueError(
+                "no bundle directory: pass bundle_dir here or "
+                "Scheduler(bundle_dir=...)")
+        for attempt in range(3):
+            try:
+                files = self._bundle_payload(cause)
+                break
+            except RuntimeError:  # dict/set mutated during iteration
+                if attempt == 2:
+                    raise
+        slug = "".join(c if c.isalnum() else "-" for c in cause)[:40]
+        while True:
+            name = f"bundle-{self._bundle_counter:04d}-{slug}"
+            path = os.path.join(base, name)
+            self._bundle_counter += 1
+            if not os.path.exists(path):
+                break
+        path = flightrec_mod.write_bundle(path, files)
+        self.bundles_written.append(path)
+        if self.recorder is not None:
+            self.recorder.record("bundle", cause,
+                                 os.path.basename(path))
+        return path
+
+    def _bundle_payload(self, cause: str) -> Dict[str, object]:
+        engine = self.engine
+        rec = self.recorder
+        # completed records first, then live (queued/active) ones with
+        # the client-visible stream they have so far — the longest of
+        # the live slot's tokens and the replay snapshot (mid-replay
+        # the snapshot is what the client actually saw)
+        # list()/dict() of a dict are single C calls — GIL-atomic
+        # snapshots, so a cross-thread dump never iterates a map the
+        # serving loop is mutating (the comprehensions below run over
+        # the snapshots, not the live structures)
+        requests = [dict(r) for r in self._req_done.values()]
+        by_id = {a.request.request_id: a
+                 for a in list(self.active.values())}
+        for rid, row in list(self._req_records.items()):
+            row = dict(row)
+            act = by_id.get(rid)
+            toks = list(act.tokens) if act is not None else []
+            st = self._replay.get(rid)
+            if st is not None and len(st.tokens) > len(toks):
+                toks = list(st.tokens)
+            row["emitted"] = toks
+            row["status"] = "active" if act is not None else "queued"
+            requests.append(row)
+        requests.sort(key=lambda r: r["order"])
+        manifest: Dict[str, object] = {
+            "bundle_version": 1,
+            "cause": cause,
+            "wall_time": time.time(),
+            "clock": self.clock(),
+            "health": {"state": self.health.state,
+                       "last_cause": self.health.last_cause},
+            "summary": self.summary(),
+            "flightrec": rec.summary() if rec is not None else None,
+            "compiled": engine.compiled_cache_sizes(),
+            "versions": flightrec_mod.versions(),
+            "meta": self.bundle_meta,
+        }
+        sentinel = getattr(engine, "_sentinel", None)
+        if sentinel is not None:
+            manifest["recompile"] = sentinel.compiles_total()
+        config: Dict[str, object] = {
+            "engine": engine.describe(),
+            "scheduler": {
+                "max_queue": self.max_queue,
+                "pipeline_depth": self.pipeline_depth,
+                "max_admit_batch": self.max_admit_batch,
+                "resilience": dataclasses.asdict(self.resilience),
+                "spec_gate": (dataclasses.asdict(self._gate.cfg)
+                              if self._gate is not None else None),
+            },
+        }
+        files: Dict[str, object] = {
+            "manifest.json": manifest,
+            "config.json": config,
+            "events.jsonl": (rec.to_dicts(rec.events())
+                             if rec is not None else []),
+            "requests.jsonl": requests,
+        }
+        if self._registry is not None:
+            files["registry.json"] = self._registry.to_dict()
+        if self.spans is not None:
+            files["spans_trace.json"] = self.spans.to_chrome_trace()
+            # raw span rows keep ABSOLUTE scheduler-clock times (the
+            # Chrome trace rebases to its own t0), so the replay
+            # report can merge spans and flight events on one axis
+            raw = []
+            for e in self.spans.events():
+                if e[0] == spans_mod._MARK:
+                    raw.append({"kind": "mark", "t": e[1],
+                                "request_id": e[2], "phase": e[3],
+                                "note": e[4]})
+                else:
+                    raw.append({"kind": "section", "t": e[1],
+                                "name": e[2], "t_end": e[3]})
+            files["spans_raw.jsonl"] = raw
+        plan = engine.fault_plan
+        if plan is not None:
+            files["fault_plan.json"] = {
+                "specs": [dataclasses.asdict(s) for s in plan.specs],
+                "injected": [dataclasses.asdict(s)
+                             for s in plan.injected],
+                "counts": plan.counts(),
+            }
+        return files
 
     # -- deadlines + overload protection ------------------------------------
 
@@ -1246,6 +1558,9 @@ class Scheduler:
                     and self._chunk_ewma > 0.0 and pos >= n_free
                     and now + wave * self._chunk_ewma > r.deadline):
                 self._shed += 1
+                if self.recorder is not None:
+                    self.recorder.record("shed", r.request_id,
+                                         "deadline")
                 if self.telemetry is not None:
                     self.telemetry.shed["deadline"].inc()
                 self._abort(r, FINISH_TIMEOUT, now)
@@ -1282,6 +1597,8 @@ class Scheduler:
         dl = request.deadline
         if dl is None or now < dl:
             return False
+        if self.recorder is not None:
+            self.recorder.record("queue_expired", request.request_id)
         if self.telemetry is not None:
             self.telemetry.queue_expired.inc()
         self._abort(request, FINISH_TIMEOUT, now)
@@ -1373,12 +1690,18 @@ class Scheduler:
                 tele.admit_dispatches.inc(n_groups)
                 tele.queue_depth.set(len(self.queue))
             rows = list(zip(reqs, slots, results))
+            rec = self.recorder
             for idx, (r, slot, res) in enumerate(rows):
                 st = self._replay.get(r.request_id)
                 act = _Active(r)
                 act.suppress = 0 if st is None else len(st.tokens)
                 act.first_token_time = t_first
                 self.active[slot] = act
+                if rec is not None:
+                    hit = self._prefix_hits.get(r.request_id)
+                    rec.record("admit", r.request_id, slot, res.bucket,
+                               res.batch_size, res.group,
+                               0 if hit is None else hit[1])
                 if tele is not None:
                     tele.admitted.inc()
                     tele.admit_batch[res.batch_size].inc()
@@ -1436,6 +1759,17 @@ class Scheduler:
                           ttft=ttft, latency=now - arrival,
                           logprobs=list(logprobs or []))
         self.completions[request.request_id] = comp
+        if self.recorder is not None:
+            self.recorder.record("finish", request.request_id, reason,
+                                 len(tokens))
+        rrec = self._req_records.pop(request.request_id, None)
+        if rrec is not None:
+            # the replayable record graduates to the bounded
+            # completed-request ring with its final client stream
+            rrec["status"] = "completed"
+            rrec["finish_reason"] = reason
+            rrec["emitted"] = list(tokens)
+            self._req_done.append(rrec)
         if reason == FINISH_EOS and not tokens:
             # eos-terminal prompt: completes at submit, emits only the
             # finished event (no token)
@@ -1483,6 +1817,8 @@ class Scheduler:
             "shed": float(self._shed),
             "watchdog_trips": float(self._watchdog_trips),
             "health_state": float(self.health.code),
+            # black box: post-mortem bundles written (auto + manual)
+            "bundles_written": float(len(self.bundles_written)),
             # KV-cache capacity: slot-cache device bytes (quantized
             # data + scales) and the prefix pool's admission savings
             "cache_bytes": float(self.engine.cache_bytes()),

@@ -20,7 +20,15 @@ layer funnels through:
   the serving engine's never-recompile invariant is a runtime
   guarantee, not a code-review note,
 - :mod:`apex_tpu.telemetry.http`      — ``/metrics`` (Prometheus),
-  ``/healthz``, ``/vars`` from a stdlib daemon-thread server.
+  ``/healthz``, ``/vars``, ``/debug/events``, ``/debug/bundle`` from a
+  stdlib daemon-thread server,
+- :mod:`apex_tpu.telemetry.flightrec` — the always-on flight recorder
+  (bounded structured event log of every load-bearing host decision)
+  plus the atomic post-mortem bundle writer,
+- :mod:`apex_tpu.telemetry.replay`    — ``python -m
+  apex_tpu.telemetry.replay <bundle>`` deterministic incident replay
+  (bit-identical stream check) and the stdlib-only ``--report``
+  timeline.
 
 Dependency-free by contract: no torch, no tensorboard (a tier-1 test
 imports every module here with both purged); ``recompile`` is the only
@@ -31,10 +39,12 @@ module that imports jax. Submodules load lazily (PEP 562) so
 from __future__ import annotations
 
 __all__ = [
-    "ring", "registry", "spans", "recompile", "http",
+    "ring", "registry", "spans", "recompile", "http", "flightrec",
+    "replay",
     "Ring", "Registry", "DEFAULT_BUCKETS", "parse_prometheus_text",
     "SpanRecorder", "RecompileSentinel", "RecompileGuard",
     "RecompileError", "MetricsServer", "start_metrics_server",
+    "FlightRecorder", "EVENT_FIELDS",
 ]
 
 _LAZY = {
@@ -43,6 +53,10 @@ _LAZY = {
     "spans": "apex_tpu.telemetry.spans",
     "recompile": "apex_tpu.telemetry.recompile",
     "http": "apex_tpu.telemetry.http",
+    "flightrec": "apex_tpu.telemetry.flightrec",
+    "replay": "apex_tpu.telemetry.replay",
+    "FlightRecorder": "apex_tpu.telemetry.flightrec",
+    "EVENT_FIELDS": "apex_tpu.telemetry.flightrec",
     "Ring": "apex_tpu.telemetry.ring",
     "Registry": "apex_tpu.telemetry.registry",
     "DEFAULT_BUCKETS": "apex_tpu.telemetry.registry",

@@ -1,0 +1,256 @@
+"""Flight recorder + post-mortem bundles — the serving black box.
+
+The resilience layer (PR 5) *survives* faults and the registry (PR 3)
+*counts* them, but when a watchdog trip or chaos fault fires mid-soak
+the state that explains it — scheduler decisions, spec-gate flips,
+fault-plan indices, slot snapshots — is gone by the time anyone looks.
+Upstream apex solved exactly this for amp: the dynamic loss scaler
+records its overflow history so a run is *explainable* after the fact
+(``apex/amp/scaler.py`` (U)). This module is that idea grown to the
+whole serving stack:
+
+- :class:`FlightRecorder` — an always-on bounded structured event log:
+  every load-bearing host-side decision (submit/shed, admit dispatch,
+  chunk dispatch/fetch, spec-gate and health transitions, fault
+  injection/detection, rebuild/replay brackets, watchdog and guard
+  alarms) is ONE O(1) tuple append on the hot path — no device calls,
+  no dict-per-event, no formatting until export. Events carry a
+  monotonic sequence number (ring wraparound never reorders or hides a
+  gap) and an injectable clock (the scheduler slaves it to its own, so
+  fake-clock tests produce deterministic timelines).
+- :data:`EVENT_FIELDS` — the event vocabulary: name → positional field
+  names. Export zips the hot-path tuples against it; the static
+  analyzer's EVENT-DRIFT rule pins it against both the ``record()``
+  call sites and the docs/API.md event table, in both directions.
+- :func:`write_bundle` — the atomic post-mortem bundle writer: a
+  self-contained directory (event log JSONL, registry snapshot,
+  Chrome-trace spans, configs, fault plan, per-request records,
+  versions) materialised via same-dir tmp + ``os.replace`` — the
+  PR-5 checkpoint pattern, so a crash mid-dump never leaves a
+  half-written bundle where a post-mortem tool will read it.
+
+The scheduler owns the *content* of a bundle
+(:meth:`apex_tpu.serving.scheduler.Scheduler.dump_bundle`); this
+module owns the mechanics and stays stdlib-only by the telemetry
+contract, so ``python -m apex_tpu.telemetry.replay <bundle> --report``
+can render an incident timeline on a laptop with no jax installed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from apex_tpu.telemetry.ring import Ring
+
+#: the event vocabulary: name → positional field names of the args
+#: tuple a ``record(name, *args)`` call carries. Every recorded name
+#: must appear here AND in the docs/API.md flight-recorder event table
+#: (the EVENT-DRIFT lint rule checks both directions) — an event only
+#: one side knows about is a silent observability outage, exactly like
+#: a renamed metric.
+EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
+    # -- intake ------------------------------------------------------------
+    "submit": ("request_id", "prompt_len", "max_tokens", "queue_depth"),
+    "submit_terminal": ("request_id",),
+    "queue_full": ("request_id", "queue_depth", "injected"),
+    "shed": ("request_id", "reason"),
+    "queue_expired": ("request_id",),
+    # -- admission ---------------------------------------------------------
+    "admit": ("request_id", "slot", "bucket", "batch_size", "group",
+              "prefix_split"),
+    # -- the decode loop ---------------------------------------------------
+    "dispatch": ("spec", "ncols", "inflight", "active_slots"),
+    "fetch": ("spec", "ncols", "wall_s", "live_rows"),
+    "watchdog": ("wall_s",),
+    "spec_gate": ("state", "accept_ewma", "break_even"),
+    # -- faults + recovery -------------------------------------------------
+    "inject": ("point", "index", "kind"),
+    "fault": ("cause", "detail", "affected"),
+    "rebuild": ("cause", "wall_s", "consecutive"),
+    "replay": ("request_id", "suppress"),
+    "retry": ("request_id", "attempts"),
+    "retry_exhausted": ("request_id", "attempts"),
+    "guard_alarm": ("alarms_total",),
+    "health": ("from", "to", "cause"),
+    "failed": ("cause",),
+    # -- outcomes ----------------------------------------------------------
+    "finish": ("request_id", "reason", "n_tokens"),
+    "bundle": ("cause", "path"),
+}
+
+
+class FlightRecorder:
+    """Bounded always-on structured event log.
+
+    >>> rec = FlightRecorder()
+    >>> sched = Scheduler(engine, recorder=rec, bundle_dir="incidents")
+    >>> rec.tail(3)     # the last three decisions, as dicts
+
+    ``capacity`` bounds host memory (the ring keeps the newest events;
+    ``summary()`` reports how many were dropped so a truncated log is
+    never mistaken for a complete one). ``clock`` must be monotonic
+    seconds; the scheduler slaves it to its own clock at construction,
+    exactly like the span recorder, so injected test clocks yield
+    deterministic timelines. ``record`` is the hot path: one tuple
+    allocation + one ring append, nothing else — field names are only
+    zipped in at export time (:meth:`tail` / :meth:`to_dicts`).
+    """
+
+    __slots__ = ("_events", "clock", "_seq")
+
+    def __init__(self, capacity: int = 65536,
+                 clock=time.monotonic):
+        self._events = Ring(capacity)
+        self.clock = clock
+        self._seq = 0
+
+    # -- recording (hot path) ----------------------------------------------
+
+    def record(self, name: str, *args: Any) -> None:
+        """O(1): stamp one event. ``args`` are positional per
+        :data:`EVENT_FIELDS` (unvalidated here — the hot path pays no
+        lookup; tests and the EVENT-DRIFT rule police the vocabulary)."""
+        self._seq += 1
+        self._events.append((self._seq, self.clock(), name, args))
+
+    # -- export -------------------------------------------------------------
+
+    @property
+    def seq(self) -> int:
+        """Sequence number of the newest event (0 = none yet)."""
+        return self._seq
+
+    def events(self) -> List[tuple]:
+        """Retained ``(seq, t, name, args)`` tuples, oldest first."""
+        return self._events.values()
+
+    @staticmethod
+    def to_dicts(events) -> List[Dict[str, Any]]:
+        """Zip raw event tuples against :data:`EVENT_FIELDS`. Unknown
+        names (a vocabulary drift the lint rule would flag) keep their
+        args under ``"args"`` instead of being dropped — a post-mortem
+        must never lose data to a rename."""
+        out = []
+        for seq, t, name, args in events:
+            d: Dict[str, Any] = {"seq": seq, "t": t, "event": name}
+            fields = EVENT_FIELDS.get(name)
+            if fields is None or len(fields) < len(args):
+                d["args"] = list(args)
+            else:
+                d.update(zip(fields, args))
+            out.append(d)
+        return out
+
+    def tail(self, n: int = 256) -> List[Dict[str, Any]]:
+        """The newest ``n`` events as dicts, oldest first — the
+        ``/debug/events`` payload."""
+        evs = self._events.values()
+        if n < len(evs):
+            evs = evs[len(evs) - max(n, 0):]
+        return self.to_dicts(evs)
+
+    def summary(self) -> Dict[str, Any]:
+        """Depth/drop accounting — the ``/vars`` block."""
+        return {
+            "events": len(self._events),
+            "events_total": self._events.total,
+            "events_dropped": self._events.dropped,
+            "capacity": self._events.capacity,
+            "last_seq": self._seq,
+        }
+
+    def clear(self) -> None:
+        self._events.clear()
+        self._seq = 0
+
+
+# -- bundle mechanics --------------------------------------------------------
+
+
+def _jsonl(rows) -> str:
+    return "".join(json.dumps(r, sort_keys=True, default=str) + "\n"
+                   for r in rows)
+
+
+def write_bundle(path: str, files: Dict[str, Any]) -> str:
+    """Atomically materialise a post-mortem bundle directory at
+    ``path``: each ``files`` entry becomes one file (``.jsonl`` values
+    are lists of dicts written one JSON object per line, everything
+    else is JSON), written into a same-filesystem temp directory and
+    ``os.replace``d into place — the checkpoint-write pattern, so a
+    reader either sees the complete bundle or no bundle. Raises if
+    ``path`` already exists (bundles are immutable evidence; the
+    caller picks a fresh name)."""
+    path = os.path.abspath(path)
+    if os.path.exists(path):
+        raise FileExistsError(f"bundle {path} already exists — bundles "
+                              f"are immutable; pick a fresh name")
+    parent = os.path.dirname(path)
+    os.makedirs(parent, exist_ok=True)
+    tmp = f"{path}.tmp{os.getpid()}"
+    os.makedirs(tmp)
+    try:
+        for name, content in files.items():
+            with open(os.path.join(tmp, name), "w",
+                      encoding="utf-8") as f:
+                if name.endswith(".jsonl"):
+                    f.write(_jsonl(content))
+                else:
+                    json.dump(content, f, indent=1, sort_keys=True,
+                              default=str)
+                    f.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        # never leave temp droppings next to real bundles
+        for root, dirs, names in os.walk(tmp, topdown=False):
+            for n in names:
+                os.unlink(os.path.join(root, n))
+            for d in dirs:
+                os.rmdir(os.path.join(root, d))
+        if os.path.isdir(tmp):
+            os.rmdir(tmp)
+        raise
+    return path
+
+
+def read_bundle(path: str) -> Dict[str, Any]:
+    """Load every file of a bundle directory back into memory:
+    ``{filename: parsed}`` — ``.jsonl`` files as lists of dicts, JSON
+    files as their value. Stdlib-only (the ``--report`` path)."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"no bundle directory at {path}")
+    out: Dict[str, Any] = {}
+    for name in sorted(os.listdir(path)):
+        full = os.path.join(path, name)
+        if not os.path.isfile(full):
+            continue
+        with open(full, "r", encoding="utf-8") as f:
+            if name.endswith(".jsonl"):
+                out[name] = [json.loads(line)
+                             for line in f if line.strip()]
+            else:
+                out[name] = json.load(f)
+    if "manifest.json" not in out:
+        raise ValueError(
+            f"{path} is not a post-mortem bundle (no manifest.json)")
+    return out
+
+
+def versions() -> Dict[str, Optional[str]]:
+    """Toolchain provenance for the manifest — best-effort, never
+    imports anything heavy that is not already loaded."""
+    import platform
+    import sys
+
+    out: Dict[str, Optional[str]] = {
+        "python": platform.python_version(),
+        "platform": platform.platform(),
+    }
+    for mod in ("apex_tpu", "jax", "jaxlib", "numpy"):
+        m = sys.modules.get(mod)
+        out[mod] = getattr(m, "__version__", None) if m else None
+    return out

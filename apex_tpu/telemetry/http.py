@@ -13,8 +13,15 @@ dependencies (stdlib ``http.server`` on a daemon thread):
   state machine (or any user probe) drives the answer a load balancer
   sees,
 - ``/vars``     — one JSON snapshot: registry dict + span-recorder
-  summary + recompile-sentinel counters + any caller extras (the
-  human-curl endpoint).
+  summary + recompile-sentinel counters + flight-recorder depth/drop
+  counters + any caller extras (the human-curl endpoint),
+- ``/debug/events?n=K`` — JSON tail of the flight recorder (the last
+  K structured events, default 256) when ``recorder=`` is given —
+  "what was it doing right before" without waiting for a bundle,
+- ``/debug/bundle`` — trigger a post-mortem bundle on demand when
+  ``bundle_trigger=`` is given (e.g. ``sched.dump_bundle``); answers
+  the written path. Both answer 404 when unwired, so the no-recorder
+  server behaves exactly as before.
 
 ``port=0`` binds an ephemeral port (tests; ``server.port`` tells you
 what you got). The handler only reads snapshot methods that take their
@@ -25,6 +32,7 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -43,7 +51,9 @@ class MetricsServer:
     def __init__(self, registry, *, host: str = "127.0.0.1",
                  port: int = 0, spans=None, sentinel=None,
                  extra_vars: Optional[Callable[[], Dict[str, Any]]] = None,
-                 health: Optional[Callable[[], Tuple[int, str]]] = None):
+                 health: Optional[Callable[[], Tuple[int, str]]] = None,
+                 recorder=None,
+                 bundle_trigger: Optional[Callable[[], str]] = None):
         self.registry = registry
         self.spans = spans
         self.sentinel = sentinel
@@ -51,6 +61,13 @@ class MetricsServer:
         #: optional ``/healthz`` callback returning (status code,
         #: body); None keeps the historical unconditional ``ok`` + 200
         self.health = health
+        #: optional flight recorder (telemetry.flightrec) behind
+        #: ``/debug/events`` and the ``/vars`` depth/drop counters
+        self.recorder = recorder
+        #: optional ``/debug/bundle`` callback returning the written
+        #: bundle path (wire ``sched.dump_bundle`` — or a lambda
+        #: tagging the cause)
+        self.bundle_trigger = bundle_trigger
         self._host = host
         self._requested_port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -68,7 +85,7 @@ class MetricsServer:
                 pass
 
             def do_GET(self):
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 status = 200
                 if path == "/metrics":
                     body = server.registry.to_prometheus_text() \
@@ -85,8 +102,31 @@ class MetricsServer:
                     body = json.dumps(server.vars(), indent=1,
                                       sort_keys=True).encode("utf-8")
                     ctype = "application/json"
+                elif path == "/debug/events" \
+                        and server.recorder is not None:
+                    q = urllib.parse.parse_qs(query)
+                    try:
+                        n = int(q.get("n", ["256"])[0])
+                    except ValueError:
+                        self.send_error(400, "n must be an integer")
+                        return
+                    body = json.dumps(
+                        server.recorder.tail(n), indent=1,
+                        sort_keys=True, default=str).encode("utf-8")
+                    ctype = "application/json"
+                elif path == "/debug/bundle" \
+                        and server.bundle_trigger is not None:
+                    try:
+                        out = server.bundle_trigger()
+                    except Exception as e:  # surfaced, not swallowed
+                        self.send_error(
+                            500, f"bundle dump failed: {e}")
+                        return
+                    body = json.dumps({"bundle": out}).encode("utf-8")
+                    ctype = "application/json"
                 else:
-                    self.send_error(404, "try /metrics /healthz /vars")
+                    self.send_error(404, "try /metrics /healthz /vars "
+                                    "/debug/events /debug/bundle")
                     return
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
@@ -128,6 +168,8 @@ class MetricsServer:
             out["spans"] = self.spans.summary()
         if self.sentinel is not None:
             out["recompile"] = self.sentinel.compiles_total()
+        if self.recorder is not None:
+            out["flightrec"] = self.recorder.summary()
         if self.health is not None:
             status, body = self.health()
             out["health"] = {"status": status, "body": body.strip()}
@@ -138,7 +180,8 @@ class MetricsServer:
 
 def start_metrics_server(registry, *, host: str = "127.0.0.1",
                          port: int = 0, spans=None, sentinel=None,
-                         extra_vars=None, health=None) -> MetricsServer:
+                         extra_vars=None, health=None, recorder=None,
+                         bundle_trigger=None) -> MetricsServer:
     """Construct AND start a :class:`MetricsServer` in one call — the
     one-liner for scripts::
 
@@ -147,4 +190,5 @@ def start_metrics_server(registry, *, host: str = "127.0.0.1",
     """
     return MetricsServer(registry, host=host, port=port, spans=spans,
                          sentinel=sentinel, extra_vars=extra_vars,
-                         health=health).start()
+                         health=health, recorder=recorder,
+                         bundle_trigger=bundle_trigger).start()

@@ -107,11 +107,14 @@ class RecompileSentinel:
         return self
 
     def uninstall(self) -> None:
-        if self._unregister is not None:
-            self._unregister()
-            self._unregister = None
+        """Release the process-wide listeners (idempotent; the handle
+        is detached BEFORE the unregister call so a re-entrant or
+        repeated uninstall can never double-release it)."""
+        unregister, self._unregister = self._unregister, None
         self._installed = False
         self.monitoring_available = False
+        if unregister is not None:
+            unregister()
 
     def _on_event(self, name: str, **kw) -> None:
         if name == CACHE_HIT_EVENT:

@@ -1,0 +1,327 @@
+"""Deterministic incident replay + stdlib-only post-mortem reports.
+
+``python -m apex_tpu.telemetry.replay <bundle>`` rebuilds the exact
+run a post-mortem bundle (:mod:`apex_tpu.telemetry.flightrec`,
+:meth:`~apex_tpu.serving.scheduler.Scheduler.dump_bundle`) came from —
+GPTConfig / EngineConfig / scheduler knobs / fault plan / request
+trace, all reconstructed from the bundle — re-runs it, and checks that
+every replayed stream reproduces the recorded emitted prefix
+BIT-IDENTICALLY (per-request determinism from the resilience layer
+makes this exact: a request's tokens are a function of its prompt +
+sampling seed only, whatever faults interleave). A completed
+eos/length/stop request must match exactly; an interrupted (active /
+queued / timed-out) one must extend its recorded prefix. That turns
+"the soak tripped at 3am" from archaeology into a command.
+
+``--report`` renders the bundle as a human-readable incident timeline
+— flight-recorder events, host span sections, health transitions, and
+per-request outcomes merged on one clock — with NO jax installed
+(stdlib-only, like ``serving.api``): the module imports jax lazily and
+only on the replay path, so the report runs on a laptop that has never
+seen the toolchain.
+
+Replay caveats (recorded in the output, not silently ignored):
+requests carrying a schema constraint are skipped (the DFA object is
+not serialisable); recorded deadlines are dropped (absolute clock
+times from a dead process); the fault plan is re-armed by seam INDEX,
+so faults may land on slightly different calls than the original run
+— which is exactly the point of the bit-identical contract: streams
+must not depend on where faults land. ``--no-faults`` replays clean.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from apex_tpu.telemetry.flightrec import read_bundle
+
+#: finish reasons whose recorded stream is complete and deterministic —
+#: replay must reproduce them exactly; anything else (timeout shed by a
+#: wall clock, fault-errored) is prefix-checked only
+_EXACT_REASONS = ("eos", "length", "stop")
+
+
+# -- the stdlib-only report --------------------------------------------------
+
+
+def _fmt_fields(row: Dict[str, Any], skip=("seq", "t", "event")) -> str:
+    parts = []
+    for k, v in row.items():
+        if k in skip:
+            continue
+        if isinstance(v, float):
+            v = f"{v:.6g}"
+        parts.append(f"{k}={v}")
+    return " ".join(parts)
+
+
+def render_report(bundle: Dict[str, Any]) -> str:
+    """The incident timeline: manifest header, fault plan, merged
+    events + span sections (one clock — spans come from the raw rows,
+    not the rebased Chrome trace), and per-request outcomes."""
+    man = bundle["manifest.json"]
+    out: List[str] = []
+    health = man.get("health") or {}
+    out.append(f"post-mortem bundle: cause={man.get('cause')}  "
+               f"health={health.get('state')}"
+               + (f" ({health.get('last_cause')})"
+                  if health.get("last_cause") else ""))
+    vers = man.get("versions") or {}
+    out.append("versions: " + "  ".join(
+        f"{k}={v}" for k, v in sorted(vers.items()) if v))
+    summ = man.get("summary") or {}
+    keys = ("requests_completed", "tokens_emitted", "rebuilds",
+            "retries", "shed", "watchdog_trips", "bundles_written")
+    out.append("summary: " + "  ".join(
+        f"{k}={summ[k]:g}" for k in keys if k in summ))
+    if man.get("meta"):
+        out.append(f"meta: {json.dumps(man['meta'], sort_keys=True)}")
+
+    plan = bundle.get("fault_plan.json")
+    if plan:
+        out.append("")
+        out.append(f"fault plan ({len(plan.get('injected', []))} of "
+                   f"{len(plan.get('specs', []))} specs fired):")
+        fired = {(s["point"], s["index"])
+                 for s in plan.get("injected", [])}
+        for s in plan.get("specs", []):
+            mark = "FIRED" if (s["point"], s["index"]) in fired else "-"
+            out.append(f"  {mark:5s} {s['kind']}@{s['point']}"
+                       f"[{s['index']}]")
+
+    # merge flight events and span sections on the recorder clock
+    rows: List[tuple] = []
+    for ev in bundle.get("events.jsonl", []):
+        label = ev["event"].upper() if ev["event"] in (
+            "fault", "watchdog", "guard_alarm", "health", "failed",
+            "inject", "rebuild") else ev["event"]
+        rows.append((ev["t"], 0, f"{label:15s} {_fmt_fields(ev)}"))
+    for sp in bundle.get("spans_raw.jsonl", []):
+        if sp["kind"] == "section":
+            dur_ms = (sp["t_end"] - sp["t"]) * 1e3
+            rows.append((sp["t"], 1,
+                         f"[span] {sp['name']} {dur_ms:.3f} ms"))
+    rows.sort(key=lambda r: (r[0], r[1]))
+    out.append("")
+    out.append(f"timeline ({len(rows)} rows):")
+    t0 = rows[0][0] if rows else 0.0
+    for t, _, text in rows:
+        out.append(f"  +{t - t0:10.6f}s  {text}")
+
+    reqs = bundle.get("requests.jsonl", [])
+    out.append("")
+    out.append(f"requests ({len(reqs)}):")
+    for r in reqs:
+        status = r.get("status", "?")
+        reason = r.get("finish_reason")
+        out.append(
+            f"  #{r.get('order'):>3} {r.get('request_id'):<16} "
+            f"{status:<9} "
+            f"{('[' + reason + '] ') if reason else ''}"
+            f"prompt={len(r.get('prompt') or [])}t "
+            f"emitted={len(r.get('emitted') or [])}t"
+            + (" constrained" if r.get("constrained") else ""))
+    return "\n".join(out)
+
+
+# -- deterministic replay (imports jax lazily) -------------------------------
+
+
+def replay_bundle(path: str, *, no_faults: bool = False,
+                  params_init_seed: Optional[int] = None,
+                  verbose: bool = True) -> Dict[str, Any]:
+    """Rebuild the bundle's engine + scheduler + fault plan, re-run the
+    recorded request trace, and compare every replayed stream to the
+    recorded emitted prefix. Returns the machine-readable result (the
+    CLI prints it; ``mismatches`` non-empty = exit 1)."""
+    bundle = read_bundle(path)
+    cfg_d = dict(bundle["config.json"]["engine"]["model"])
+    ecfg_d = dict(bundle["config.json"]["engine"]["engine"])
+    sched_d = bundle["config.json"]["scheduler"]
+    eng_d = bundle["config.json"]["engine"]
+    meta = bundle["manifest.json"].get("meta") or {}
+    params_meta = meta.get("params") or {}
+    seed = (params_init_seed if params_init_seed is not None
+            else params_meta.get("init_seed"))
+    if seed is None:
+        raise SystemExit(
+            "cannot rebuild params: the bundle's meta carries no "
+            "{'params': {'init_seed': N}} (Scheduler bundle_meta) — "
+            "pass --params-init-seed, or replay on the host that owns "
+            f"the checkpoint ({params_meta or 'no provenance recorded'})")
+
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from apex_tpu import mesh as mx
+    from apex_tpu.models import gpt
+    from apex_tpu.serving import Request, SamplingParams
+    from apex_tpu.serving.engine import Engine, EngineConfig
+    from apex_tpu.serving.resilience import (
+        EngineFailed,
+        FaultPlan,
+        FaultSpec,
+        ResilienceConfig,
+    )
+    from apex_tpu.serving.scheduler import (
+        QueueFull,
+        Scheduler,
+        SpecGateConfig,
+    )
+
+    for k in ("compute_dtype", "param_dtype"):
+        # dtype-VALUED fields serialise by numpy name (describe());
+        # semantic string knobs (kv_cache_dtype="int8",
+        # attn_score_dtype="f32") must stay strings, so the conversion
+        # is allowlisted, not suffix-guessed
+        if isinstance(cfg_d.get(k), str):
+            cfg_d[k] = np.dtype(cfg_d[k])
+    cfg_names = {f.name for f in dataclasses.fields(gpt.GPTConfig)}
+    cfg = gpt.GPTConfig(**{k: v for k, v in cfg_d.items()
+                           if k in cfg_names})
+    e_names = {f.name for f in dataclasses.fields(EngineConfig)}
+    e_kwargs = {k: v for k, v in ecfg_d.items() if k in e_names}
+    for k in ("prompt_buckets", "admit_batch_sizes"):
+        if e_kwargs.get(k) is not None:
+            e_kwargs[k] = tuple(e_kwargs[k])
+    ecfg = EngineConfig(**e_kwargs)
+
+    tp = int(eng_d.get("tp", 1))
+    mesh = mx.build_mesh(tp=tp, devices=jax.devices()[:tp])
+    params = gpt.init(cfg, jax.random.PRNGKey(int(seed)))
+
+    plan = None
+    plan_d = bundle.get("fault_plan.json")
+    if plan_d and not no_faults:
+        plan = FaultPlan([FaultSpec(
+            point=s["point"], index=s["index"], kind=s["kind"],
+            slots=tuple(s.get("slots", (0,))),
+            hang_s=s.get("hang_s", 0.0), token=s.get("token", -1))
+            for s in plan_d["specs"]])
+    engine = Engine(cfg, params, mesh, ecfg, fault_plan=plan)
+    engine.warmup()
+    for template in eng_d.get("prefix_templates", []):
+        engine.register_prefix(template)
+    gate_d = sched_d.get("spec_gate")
+    sched = Scheduler(
+        engine,
+        max_queue=sched_d.get("max_queue", 256),
+        pipeline_depth=sched_d.get("pipeline_depth", 1),
+        max_admit_batch=sched_d.get("max_admit_batch"),
+        resilience=ResilienceConfig(**sched_d["resilience"]),
+        spec_gate=(SpecGateConfig(**gate_d)
+                   if gate_d and ecfg.spec_k > 0 else None))
+
+    rows = sorted(bundle.get("requests.jsonl", []),
+                  key=lambda r: r["order"])
+    skipped: List[Dict[str, Any]] = []
+    replayed: List[Dict[str, Any]] = []
+    failed_terminally = False
+    for row in rows:
+        if row.get("constrained"):
+            skipped.append({"request_id": row["request_id"],
+                            "why": "constrained (DFA not serialisable)"})
+            continue
+        req = Request(
+            row["request_id"], list(row["prompt"]),
+            max_tokens=row["max_tokens"],
+            sampling=SamplingParams(
+                temperature=row.get("temperature", 0.0),
+                top_k=row.get("top_k", 0),
+                top_p=row.get("top_p", 1.0),
+                seed=row.get("seed")),
+            eos_token_id=row.get("eos_token_id"),
+            stop=row.get("stop"))
+        while True:
+            try:
+                sched.submit(req)
+                break
+            except QueueFull:
+                sched.step()  # drain; an injected flood also lands here
+            except EngineFailed:
+                failed_terminally = True
+                skipped.append({"request_id": row["request_id"],
+                                "why": "engine failed terminally"})
+                break
+        if failed_terminally:
+            break
+        replayed.append(row)
+    sched.run_until_idle()
+
+    mismatches: List[Dict[str, Any]] = []
+    matched = 0
+    for row in replayed:
+        rid = row["request_id"]
+        comp = sched.completions.get(rid)
+        if comp is None:
+            mismatches.append({"request_id": rid,
+                               "why": "no replayed completion"})
+            continue
+        want = [int(t) for t in row.get("emitted") or []]
+        got = list(comp.tokens)
+        exact = (row.get("status") == "completed"
+                 and row.get("finish_reason") in _EXACT_REASONS)
+        if exact and (got != want
+                      or comp.finish_reason != row["finish_reason"]):
+            mismatches.append({
+                "request_id": rid, "why": "completed stream differs",
+                "recorded": want, "replayed": got,
+                "recorded_reason": row["finish_reason"],
+                "replayed_reason": comp.finish_reason})
+        elif not exact and got[:len(want)] != want:
+            mismatches.append({
+                "request_id": rid,
+                "why": "replayed stream does not extend the recorded "
+                       "emitted prefix",
+                "recorded_prefix": want, "replayed": got})
+        else:
+            matched += 1
+    out = {
+        "bundle": path,
+        "requests": len(rows),
+        "replayed": len(replayed),
+        "matched": matched,
+        "mismatches": mismatches,
+        "skipped": skipped,
+        "faults_reinjected": (len(plan.injected)
+                              if plan is not None else 0),
+        "health": sched.health.state,
+    }
+    if verbose:
+        print(json.dumps(out, sort_keys=True))
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m apex_tpu.telemetry.replay",
+        description="Replay a post-mortem bundle deterministically "
+                    "(bit-identical stream check), or render it as an "
+                    "incident report (stdlib-only; no jax needed).")
+    ap.add_argument("bundle", help="bundle directory "
+                    "(Scheduler.dump_bundle output)")
+    ap.add_argument("--report", action="store_true",
+                    help="print the human-readable incident timeline "
+                    "instead of replaying (never imports jax)")
+    ap.add_argument("--no-faults", action="store_true",
+                    help="replay WITHOUT re-arming the recorded fault "
+                    "plan (clean re-run; streams must still match)")
+    ap.add_argument("--params-init-seed", type=int, default=None,
+                    help="rebuild params as gpt.init(PRNGKey(SEED)) "
+                    "when the bundle's meta carries no provenance")
+    args = ap.parse_args(argv)
+    if args.report:
+        print(render_report(read_bundle(args.bundle)))
+        return 0
+    out = replay_bundle(args.bundle, no_faults=args.no_faults,
+                        params_init_seed=args.params_init_seed)
+    return 1 if out["mismatches"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

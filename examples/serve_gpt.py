@@ -50,6 +50,17 @@ deterministic faults at the engine seams for manual recovery drills —
 ``point:index:kind[:arg]``, e.g. ``"fetch:2:nan:1,dispatch:5:error"``.
 Interrupted requests are replayed/retried; the run prints what fired
 and the final health state.
+
+Black box (``apex_tpu.telemetry.flightrec``): ``--bundle-dir DIR``
+arms the always-on flight recorder and auto-dumps a self-contained
+post-mortem bundle there on any fault detection / watchdog trip /
+guard alarm / terminal failure; ``SIGUSR1`` (and ``GET
+/debug/bundle`` on the metrics port) dump one on demand, and
+``/debug/events?n=K`` tails the live event log. Replay an incident
+exactly — or render its timeline with no jax installed::
+
+  python -m apex_tpu.telemetry.replay incidents/bundle-0000-* \
+      [--report]
 """
 
 import argparse
@@ -155,6 +166,11 @@ def main():
     ap.add_argument("--span-trace", metavar="PATH", default=None,
                     help="write the per-request span timeline as "
                     "Chrome-trace JSON (view in Perfetto)")
+    ap.add_argument("--bundle-dir", metavar="DIR", default=None,
+                    help="arm the flight recorder and auto-dump "
+                    "post-mortem bundles here on fault/watchdog/alarm "
+                    "(SIGUSR1 or GET /debug/bundle dump on demand; "
+                    "python -m apex_tpu.telemetry.replay replays one)")
     ap.add_argument("--fault-plan", metavar="SPEC", default=None,
                     help="inject deterministic faults at the engine "
                     "seams: 'random:SEED[:N]' or a comma list of "
@@ -230,8 +246,9 @@ def main():
     # telemetry: spans whenever a trace is requested; the registry +
     # process-wide recompile sentinel only when there is a /metrics
     # endpoint to export them through (counters nobody can scrape are
-    # pure per-token overhead)
-    registry = spans = server = None
+    # pure per-token overhead); the flight recorder whenever bundles
+    # OR a metrics endpoint exist (the /debug/events tail)
+    registry = spans = server = recorder = None
     if args.span_trace or args.metrics_port is not None:
         from apex_tpu.telemetry import SpanRecorder
 
@@ -241,12 +258,38 @@ def main():
 
         registry = Registry()
         engine.recompile_sentinel(registry=registry)
+    if args.bundle_dir is not None or args.metrics_port is not None:
+        from apex_tpu.telemetry import FlightRecorder
+
+        recorder = FlightRecorder()
 
     # offline batch mode submits everything up front — size the queue to
     # the trace instead of dying on backpressure at the default 256
     sched = Scheduler(engine, max_queue=max(256, len(reqs)),
                       registry=registry, spans=spans,
-                      pipeline_depth=args.pipeline_depth)
+                      pipeline_depth=args.pipeline_depth,
+                      recorder=recorder, bundle_dir=args.bundle_dir,
+                      # params provenance: telemetry.replay rebuilds
+                      # the model from a bundle with this
+                      bundle_meta=({"params": {"ckpt": args.ckpt}}
+                                   if args.ckpt
+                                   else {"params": {"init_seed": 0}}))
+    if args.bundle_dir is not None:
+        import signal
+
+        # SIGUSR-style on-demand dump: kill -USR1 <pid>. A disk error
+        # here must not take down the serving loop the handler
+        # interrupted (same policy as the scheduler's auto-dump path).
+        def _dump_on_signal(*_):
+            try:
+                print(f"bundle: {sched.dump_bundle('sigusr1')}")
+            except OSError as e:
+                print(f"bundle dump failed: {e}")
+
+        if hasattr(signal, "SIGUSR1"):
+            signal.signal(signal.SIGUSR1, _dump_on_signal)
+        print(f"black box armed: bundles -> {args.bundle_dir} "
+              f"(SIGUSR1 dumps on demand)")
     if args.metrics_port is not None:
         from apex_tpu.telemetry import start_metrics_server
 
@@ -255,8 +298,12 @@ def main():
         server = start_metrics_server(
             registry, port=args.metrics_port, spans=spans,
             sentinel=engine.recompile_sentinel(),
-            health=sched.health.healthz)
-        print(f"metrics: {server.url}/metrics  /healthz  /vars")
+            health=sched.health.healthz, recorder=recorder,
+            bundle_trigger=(
+                (lambda: sched.dump_bundle("http"))
+                if args.bundle_dir is not None else None))
+        print(f"metrics: {server.url}/metrics  /healthz  /vars  "
+              f"/debug/events")
     for r in reqs:
         sched.submit(r)
     sched.run_until_idle()
@@ -270,6 +317,9 @@ def main():
         print(f"chaos: {len(fault_plan.injected)} fault(s) fired "
               f"({[s.describe() for s in fault_plan.injected]}), "
               f"health={sched.health.state}")
+    if sched.bundles_written:
+        print(f"post-mortem bundles: {sched.bundles_written} — replay "
+              f"with `python -m apex_tpu.telemetry.replay <bundle>`")
     if args.span_trace:
         with open(args.span_trace, "w") as f:
             json.dump(spans.to_chrome_trace(), f)

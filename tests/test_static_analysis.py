@@ -537,6 +537,97 @@ def test_metric_drift_label_and_alternation_tokens(tmp_path):
 
 
 # --------------------------------------------------------------------------
+# EVENT-DRIFT
+# --------------------------------------------------------------------------
+
+
+_EVENT_VOCAB = '''
+    EVENT_FIELDS = {
+        "good": ("request_id",),
+        "undocumented": ("n",),
+        "never_recorded": ("x",),
+    }
+'''
+
+_EVENT_DOC = ("#### Flight-recorder event names\n"
+              "| event | fields | meaning |\n"
+              "|---|---|---|\n"
+              "| `good` | request_id | fine |\n"
+              "| `never_recorded` | x | vocabulary orphan |\n"
+              "| `phantom` | y | doc orphan |\n")
+
+
+def _event_tree(tmp_path, sched_src, doc=_EVENT_DOC):
+    return _synth(tmp_path, {
+        "apex_tpu/__init__.py": "",
+        "apex_tpu/telemetry/__init__.py": "",
+        "apex_tpu/telemetry/flightrec.py": _EVENT_VOCAB,
+        "apex_tpu/serving/__init__.py": "",
+        "apex_tpu/serving/sched.py": sched_src,
+        "docs/API.md": doc,
+    }, targets=["apex_tpu"], rules=["EVENT-DRIFT"])
+
+
+def test_event_drift_all_directions(tmp_path):
+    res = _event_tree(tmp_path, '''
+        def wire(recorder):
+            recorder.record("good", "r0")
+            recorder.record("undocumented", 3)
+            recorder.record("ghost", 1)
+            db.record("not_an_event")     # non-recorder receiver
+    ''')
+    hits = [f for f in res.findings if f.rule == "EVENT-DRIFT"]
+    msgs = "\n".join(f.render() for f in hits)
+    # ghost: recorded, not in vocabulary (anchored at the call site)
+    assert any("'ghost'" in f.message
+               and f.path == "apex_tpu/serving/sched.py"
+               for f in hits), msgs
+    # undocumented: in vocabulary + recorded, missing from the doc table
+    assert any("'undocumented'" in f.message and "API.md" in f.message
+               and f.path == "apex_tpu/telemetry/flightrec.py"
+               for f in hits), msgs
+    # never_recorded: dead vocabulary (documented but no call site)
+    assert any("'never_recorded'" in f.message
+               and "no record() call" in f.message for f in hits), msgs
+    # phantom: documented, not in the vocabulary (anchored in the doc)
+    assert any("'phantom'" in f.message and f.path == "docs/API.md"
+               for f in hits), msgs
+    # the non-recorder receiver stays out of scope
+    assert not any("not_an_event" in f.message for f in hits), msgs
+    assert len(hits) == 4, msgs
+
+
+def test_event_drift_clean_tree(tmp_path):
+    res = _event_tree(tmp_path, '''
+        def wire(rec):
+            rec.record("good", "r0")
+            rec.record("undocumented", 3)
+            rec.record("never_recorded", 1)
+    ''', doc=("#### Flight-recorder event names\n"
+              "| event | fields | meaning |\n"
+              "|---|---|---|\n"
+              "| `good` | request_id | fine |\n"
+              "| `undocumented` | n | now documented |\n"
+              "| `never_recorded` | x | recorded after all |\n"))
+    assert "EVENT-DRIFT" not in _rules_of(res), \
+        "\n".join(f.render() for f in res.findings)
+
+
+def test_event_drift_absent_on_foreign_trees(tmp_path):
+    # no flightrec.py (or one without the vocabulary) = not this repo
+    # shape; the rule must stay silent instead of flagging everything
+    res = _synth(tmp_path, {
+        "apex_tpu/__init__.py": "",
+        "apex_tpu/serving/__init__.py": "",
+        "apex_tpu/serving/sched.py": 'def f(rec):\n'
+                                     '    rec.record("anything", 1)\n',
+        "docs/API.md": _EVENT_DOC,
+    }, targets=["apex_tpu"], rules=["EVENT-DRIFT"])
+    assert "EVENT-DRIFT" not in _rules_of(res), \
+        "\n".join(f.render() for f in res.findings)
+
+
+# --------------------------------------------------------------------------
 # CITATION
 # --------------------------------------------------------------------------
 

@@ -528,6 +528,8 @@ import apex_tpu.telemetry.registry
 import apex_tpu.telemetry.spans
 import apex_tpu.telemetry.http
 import apex_tpu.telemetry.recompile
+import apex_tpu.telemetry.flightrec
+import apex_tpu.telemetry.replay
 
 r = t.Registry()
 r.counter("x_total").inc()
