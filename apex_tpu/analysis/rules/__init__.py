@@ -3,6 +3,7 @@
 from apex_tpu.analysis.rules.tracer_leak import TracerLeakRule
 from apex_tpu.analysis.rules.donation import UseAfterDonateRule
 from apex_tpu.analysis.rules.recompile_hazard import RecompileHazardRule
+from apex_tpu.analysis.rules.page_table_static import PageTableStaticRule
 from apex_tpu.analysis.rules.warmup_coverage import WarmupCoverageRule
 from apex_tpu.analysis.rules.abi_lockstep import AbiLockstepRule
 from apex_tpu.analysis.rules.metric_drift import MetricDriftRule
@@ -14,6 +15,7 @@ ALL_RULES = [
     TracerLeakRule(),
     UseAfterDonateRule(),
     RecompileHazardRule(),
+    PageTableStaticRule(),
     WarmupCoverageRule(),
     AbiLockstepRule(),
     MetricDriftRule(),

@@ -67,12 +67,22 @@ class EventDriftRule:
         vocab: Dict[str, int] = {}
         vocab_line = 1
         for node in ast.walk(vocab_ctx.tree):
-            if isinstance(node, ast.Assign) and any(
-                    isinstance(t, ast.Name) and t.id == _VOCAB_NAME
-                    for t in node.targets) and \
-                    isinstance(node.value, ast.Dict):
+            # both spellings bind the vocabulary: a plain assignment
+            # and the annotated `EVENT_FIELDS: Dict[...] = {...}` the
+            # real module uses (AnnAssign — missing it made this rule
+            # silently inert against the actual vocabulary)
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            if any(isinstance(t, ast.Name) and t.id == _VOCAB_NAME
+                   for t in targets) and isinstance(value, ast.Dict):
                 vocab_line = node.lineno
-                for k in node.value.keys:
+                for k in value.keys:
                     name = const_str(k)
                     if name is not None:
                         vocab[name] = k.lineno

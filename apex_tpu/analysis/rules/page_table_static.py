@@ -1,0 +1,118 @@
+"""PAGE-TABLE-STATIC: block-table geometry must be config-derived.
+
+The paged KV cache's whole static-shape contract is that block tables
+are DATA — ``[slots, max_pages] int32`` arrays whose *contents* vary
+per request while their *shapes* are constants derived from the engine
+config (``max_pages = ceil(max_seq_len / page_size)``). The recompile
+hazard this feature is most likely to reintroduce is sizing a table
+(or a per-admission page-index array) from a LIVE request — ``len(
+prompt)``, ``prompt.size``, a queue depth — at dispatch time: every new
+length then produces a new array shape into a compiled program, and the
+shape ladder silently recompiles per request (RECOMPILE-HAZARD's
+``len()``-into-static-argnums bug, one layer down: here the length
+poisons a *shape*, which every jit treats as static).
+
+Scope (deliberately narrow, like the rest of the battery): array
+constructor calls (``np/jnp`` ``zeros``/``ones``/``full``/``empty``)
+whose result is bound to a table/page-named target (``*table*``,
+``*pages*`` — the naming convention of every block-table surface in
+the serving stack). Inside the constructor's SHAPE argument, a
+``len(...)`` call or a ``.size``/``.shape`` attribute read is flagged:
+config-derived shapes are spelled from config attributes and
+constants, never from measured lengths. Contents (``row[:len(shared)]
+= ...``) are unconstrained — tables are data.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Tuple
+
+from apex_tpu.analysis._astutil import dotted
+from apex_tpu.analysis.core import Finding, Project
+
+#: table/page-named binding targets — the block-table naming
+#: convention of the serving stack (``_tables``, ``row`` is excluded:
+#: only names that SAY table/pages are held to the shape contract)
+_TABLE_RE = re.compile(r"(?i)(^|_)(tables?|pages?)(_|\d|$)")
+
+#: array constructors whose first argument is a shape
+_CTORS = {"zeros", "ones", "full", "empty"}
+_MODULES = {"np", "numpy", "jnp"}
+
+
+def _target_names(node: ast.Assign) -> List[str]:
+    out: List[str] = []
+    for t in node.targets:
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, ast.Attribute):
+            out.append(t.attr)
+    return out
+
+
+def _shape_arg(call: ast.Call) -> ast.AST:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "shape":
+            return kw.value
+    return call
+
+
+class PageTableStaticRule:
+    id = "PAGE-TABLE-STATIC"
+    summary = ("block-table/page-index array shapes must be "
+               "config-derived constants — len()/.size of live request "
+               "data in a table shape recompiles per request length")
+    triggers: Tuple[str, ...] = ()
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for ctx in project.targets:
+            if ctx.tree is None:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Assign) \
+                        or not isinstance(node.value, ast.Call):
+                    continue
+                call = node.value
+                d = dotted(call.func)
+                if d is None:
+                    continue
+                parts = d.split(".")
+                if len(parts) != 2 or parts[0] not in _MODULES \
+                        or parts[1] not in _CTORS:
+                    continue
+                names = [n for n in _target_names(node)
+                         if _TABLE_RE.search(n)]
+                if not names:
+                    continue
+                findings.extend(self._scan_shape(
+                    ctx, names[0], _shape_arg(call)))
+        return findings
+
+    def _scan_shape(self, ctx, name: str, shape: ast.AST
+                    ) -> List[Finding]:
+        findings: List[Finding] = []
+        for n in ast.walk(shape):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                    and n.func.id == "len":
+                findings.append(Finding(
+                    self.id, ctx.rel, n.lineno,
+                    f"len(...) flows into the shape of table/page "
+                    f"array {name!r} — block-table geometry must be a "
+                    f"config-derived constant (max_pages = "
+                    f"ceil(max_seq_len / page_size)), or every request "
+                    f"length compiles a new program",
+                    col=n.col_offset))
+            elif isinstance(n, ast.Attribute) and n.attr in ("size",
+                                                            "shape"):
+                findings.append(Finding(
+                    self.id, ctx.rel, n.lineno,
+                    f".{n.attr} of a runtime array flows into the "
+                    f"shape of table/page array {name!r} — derive the "
+                    f"shape from engine config, not from live data",
+                    col=n.col_offset))
+        return findings

@@ -20,6 +20,14 @@ from apex_tpu.kernels.decode_attention import (
     decode_attention,
     decode_attention_quantized,
     kv_storage_dtype,
+    paged_attention,
+    paged_attention_quantized,
+    paged_gather_xla,
+    paged_write_column,
+    paged_write_column_quant,
+    paged_write_columns,
+    paged_write_columns_quant,
+    paged_write_columns_xla,
     quantize_kv_rows,
 )
 from apex_tpu.kernels.flash_attention import (
@@ -52,6 +60,14 @@ __all__ = [
     "decode_attention",
     "decode_attention_quantized",
     "kv_storage_dtype",
+    "paged_attention",
+    "paged_attention_quantized",
+    "paged_gather_xla",
+    "paged_write_column",
+    "paged_write_column_quant",
+    "paged_write_columns",
+    "paged_write_columns_quant",
+    "paged_write_columns_xla",
     "quantize_kv_rows",
     "flash_attention",
     "flash_attention_bsh",

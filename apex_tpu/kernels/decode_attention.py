@@ -575,6 +575,516 @@ def _run_attn_quant(q, k_q, k_s, v_q, v_s, pos, scale, h, block_k):
     return out[:, 0]
 
 
+# ---------------------------------------------------------------------------
+# paged cache layout: a global page pool + per-row block tables
+#
+# The contiguous layout above stores one [S]-horizon stripe per batch
+# row; the paged layout stores a GLOBAL pool of fixed-size pages
+# ``[num_pages, h, P, d]`` plus a per-row block table ``[b, max_pages]
+# int32`` mapping each row's logical chunk j of the horizon onto a
+# physical page. The split-K sweep already walks the horizon in
+# ``block_k`` chunks through a scalar-prefetched index map — a page is
+# nothing but a SECOND indirection on that chunk index (``block_k`` ==
+# the page size, and the chunk's block index is ``table[b, j]`` instead
+# of ``j``), so the read kernel is the same online-softmax merge with a
+# remapped prefetch. Writes land at ``(table[b, pos // P], pos % P)``.
+# Everything stays static-shaped: tables are DATA (never shapes), and
+# a row's effective horizon is ``max_pages * P`` with the same
+# ``col <= pos`` masking contract as the contiguous kernels. The XLA
+# fallbacks (`paged_gather_xla` / `paged_write_columns_xla`) give the
+# CPU tier-1 suite bit-exact oracle semantics: a gather of the same
+# cache bytes into the contiguous shape, followed by the SAME
+# materialised-scores expressions.
+# ---------------------------------------------------------------------------
+
+
+def paged_gather_xla(plane, table):
+    """Gather a row-contiguous view of a paged cache plane: ``plane
+    [num_pages, h, P(, d)]`` indexed by ``table [b, max_pages]`` →
+    ``[b, h, max_pages * P(, d)]``. THE paged read fallback: the
+    gathered array holds exactly the bytes a contiguous cache would,
+    so feeding it to the contiguous score expressions keeps paged
+    decode bit-identical to contiguous decode (the paged == contiguous
+    stream oracle stands on this)."""
+    g = jnp.take(plane, jnp.asarray(table, jnp.int32), axis=0)
+    if plane.ndim == 4:
+        b, mp, h, p, d = g.shape
+        return jnp.transpose(g, (0, 2, 1, 3, 4)).reshape(b, h, mp * p, d)
+    if plane.ndim == 3:
+        b, mp, h, p = g.shape
+        return jnp.transpose(g, (0, 2, 1, 3)).reshape(b, h, mp * p)
+    raise ValueError(
+        f"paged plane must be [num_pages, h, P(, d)], got rank "
+        f"{plane.ndim}")
+
+
+def paged_write_columns_xla(plane, new, table, pos):
+    """Write ``new [b, h, T(, d)]`` into logical columns ``pos[b] + j``
+    of a paged cache plane ``plane [num_pages, h, P(, d)]`` under
+    ``table [b, max_pages]`` — the paged spelling of
+    :func:`cache_write_columns_xla`. Columns at or past the row's
+    ``max_pages * P`` horizon are DROPPED (the same over-horizon write
+    guard). Rows must target distinct physical (page, offset) cells
+    except inside a shared garbage/sink page, where a collision writes
+    an arbitrary colliding row's value — the sink holds garbage by
+    contract (done rows redirected there never have their lanes read).
+    """
+    p = plane.shape[2]
+    n_pages = plane.shape[0]
+    mp = table.shape[1]
+    smax = mp * p
+    t = new.shape[2]
+    pos = jnp.asarray(pos, jnp.int32)
+    cols = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None]   # [b, T]
+    inb = cols < smax
+    colc = jnp.clip(cols, 0, smax - 1)
+    pages = jnp.take_along_axis(jnp.asarray(table, jnp.int32),
+                                colc // p, axis=1)               # [b, T]
+    flat = pages * p + colc % p                                  # [b, T]
+    s_total = n_pages * p
+    onehot = ((jnp.arange(s_total, dtype=jnp.int32)[None, None]
+               == flat[:, :, None]) & inb[:, :, None])           # [b,T,S]
+    oh = onehot.reshape(-1, s_total)                             # [bT, S]
+    hit = oh.any(axis=0)                                         # [S]
+    # per-cell source row: argmax picks the first hitter (selection,
+    # not arithmetic — an int8 einsum accumulation could overflow)
+    src = jnp.argmax(oh, axis=0)                                 # [S]
+    if plane.ndim == 4:
+        new_flat = jnp.transpose(new, (0, 2, 1, 3)).reshape(
+            -1, new.shape[1], new.shape[3])                      # [bT,h,d]
+        taken = jnp.take(new_flat, src, axis=0)                  # [S,h,d]
+        flat_plane = jnp.transpose(plane, (0, 2, 1, 3)).reshape(
+            s_total, plane.shape[1], plane.shape[3])
+        out = jnp.where(hit[:, None, None], taken.astype(plane.dtype),
+                        flat_plane)
+        return jnp.transpose(
+            out.reshape(n_pages, p, plane.shape[1], plane.shape[3]),
+            (0, 2, 1, 3))
+    if plane.ndim == 3:
+        new_flat = jnp.transpose(new, (0, 2, 1)).reshape(
+            -1, new.shape[1])                                    # [bT, h]
+        taken = jnp.take(new_flat, src, axis=0)                  # [S, h]
+        flat_plane = jnp.transpose(plane, (0, 2, 1)).reshape(
+            s_total, plane.shape[1])
+        out = jnp.where(hit[:, None], taken.astype(plane.dtype),
+                        flat_plane)
+        return jnp.transpose(out.reshape(n_pages, p, plane.shape[1]),
+                             (0, 2, 1))
+    raise ValueError(
+        f"paged plane must be [num_pages, h, P(, d)], got rank "
+        f"{plane.ndim}")
+
+
+def _paged_write_kernel(pos_ref, tbl_ref, kn_ref, vn_ref, ki_ref,
+                        vi_ref, ko_ref, vo_ref):
+    del pos_ref, tbl_ref, ki_ref, vi_ref  # scalars drive the index map
+    ko_ref[...] = kn_ref[...][:, :, None]
+    vo_ref[...] = vn_ref[...][:, :, None]
+
+
+def paged_write_column(k_new, v_new, k_pool, v_pool, table, pos):
+    """Write ``k_new/v_new [b, h, d]`` into logical column ``pos[b]``
+    of the paged pools ``[num_pages, h, P, d]`` under ``table [b,
+    max_pages]`` — the paged :func:`_write_column`: the output block
+    index is ``(table[b, pos // P], pos % P)``, both pools aliased
+    input→output so only the b touched cells move."""
+    n_pages, h, p, d = k_pool.shape
+    mp = table.shape[1]
+    new_spec = pl.BlockSpec((1, h, d), lambda i, pos_ref, tbl_ref: (i, 0, 0))
+    col_spec = pl.BlockSpec(
+        (1, h, 1, d),
+        lambda i, pos_ref, tbl_ref: (
+            tbl_ref[i * mp + lax.div(pos_ref[i], p)], 0,
+            lax.rem(pos_ref[i], p), 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(k_new.shape[0],),
+        in_specs=[new_spec, new_spec,
+                  pl.BlockSpec(memory_space=pltpu.ANY),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=[col_spec, col_spec],
+    )
+    return pl.pallas_call(
+        _paged_write_kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+                   jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype)],
+        # operand order: (pos, table, k_new, v_new, k_pool, v_pool)
+        input_output_aliases={4: 0, 5: 1},
+        interpret=use_interpret(),
+    )(jnp.asarray(pos, jnp.int32),
+      jnp.asarray(table, jnp.int32).reshape(-1),
+      k_new.astype(k_pool.dtype), v_new.astype(v_pool.dtype),
+      k_pool, v_pool)
+
+
+def _paged_write_kernel_quant(pos_ref, tbl_ref, kn_ref, vn_ref, kqi_ref,
+                              ksi_ref, vqi_ref, vsi_ref, kq_ref, ks_ref,
+                              vq_ref, vs_ref, *, kind):
+    del pos_ref, tbl_ref, kqi_ref, ksi_ref, vqi_ref, vsi_ref
+    kq, ks = quantize_kv_rows(kn_ref[...], kind)      # (1, h, d)/(1, h)
+    vq, vs = quantize_kv_rows(vn_ref[...], kind)
+    kq_ref[...] = kq[:, :, None]
+    ks_ref[...] = ks[:, :, None]
+    vq_ref[...] = vq[:, :, None]
+    vs_ref[...] = vs[:, :, None]
+
+
+def paged_write_column_quant(k_new, v_new, k_q, k_s, v_q, v_s, table,
+                             pos, kind):
+    """:func:`paged_write_column` over the quantized pool layout
+    (``[num_pages, h, P, d]`` storage + ``[num_pages, h, P]`` fp32
+    scales): the incoming rows are quantized IN-KERNEL
+    (:func:`quantize_kv_rows` — the one deterministic quantizer) and
+    land one quantized + one scale cell at ``(table[b, pos // P],
+    pos % P)`` across all four planes."""
+    k_new, _ = widen_f16(k_new)
+    v_new, _ = widen_f16(v_new)
+    n_pages, h, p, d = k_q.shape
+    mp = table.shape[1]
+    new_spec = pl.BlockSpec((1, h, d), lambda i, pos_ref, tbl_ref: (i, 0, 0))
+    col = lambda i, pos_ref, tbl_ref: (
+        tbl_ref[i * mp + lax.div(pos_ref[i], p)], 0,
+        lax.rem(pos_ref[i], p), 0)
+    scol = lambda i, pos_ref, tbl_ref: (
+        tbl_ref[i * mp + lax.div(pos_ref[i], p)], 0,
+        lax.rem(pos_ref[i], p))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(k_new.shape[0],),
+        in_specs=[new_spec, new_spec]
+        + [pl.BlockSpec(memory_space=pltpu.ANY)] * 4,
+        out_specs=[pl.BlockSpec((1, h, 1, d), col),
+                   pl.BlockSpec((1, h, 1), scol),
+                   pl.BlockSpec((1, h, 1, d), col),
+                   pl.BlockSpec((1, h, 1), scol)],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_write_kernel_quant, kind=kind),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(k_q.shape, k_q.dtype),
+                   jax.ShapeDtypeStruct(k_s.shape, k_s.dtype),
+                   jax.ShapeDtypeStruct(v_q.shape, v_q.dtype),
+                   jax.ShapeDtypeStruct(v_s.shape, v_s.dtype)],
+        # operand order: (pos, table, k_new, v_new, k_q, k_s, v_q, v_s)
+        input_output_aliases={4: 0, 5: 1, 6: 2, 7: 3},
+        interpret=use_interpret(),
+    )(jnp.asarray(pos, jnp.int32),
+      jnp.asarray(table, jnp.int32).reshape(-1), k_new, v_new,
+      k_q, k_s, v_q, v_s)
+
+
+def _paged_write_cols_kernel(pos_ref, tbl_ref, kn_ref, vn_ref, ki_ref,
+                             vi_ref, ko_ref, vo_ref):
+    del pos_ref, tbl_ref, ki_ref, vi_ref
+    ko_ref[...] = kn_ref[...]    # blocks are (1, h, 1, d) on both sides
+    vo_ref[...] = vn_ref[...]
+
+
+def paged_write_columns(k_new, v_new, k_pool, v_pool, table, pos):
+    """Write ``k_new/v_new [b, h, T, d]`` into logical columns
+    ``pos[b] .. pos[b] + T - 1`` of the paged pools — the paged
+    :func:`cache_write_columns` (the speculative verify forward's cache
+    landing). Over-horizon lanes CLAMP onto the row's last logical
+    column ``max_pages * P - 1`` (the contiguous kernel's contract —
+    that cell is only ever read by discarded lanes)."""
+    n_pages, h, p, d = k_pool.shape
+    mp = table.shape[1]
+    smax = mp * p
+    t = k_new.shape[2]
+    new_spec = pl.BlockSpec((1, h, 1, d),
+                            lambda i, j, pos_ref, tbl_ref: (i, 0, j, 0))
+
+    def col(i, j, pos_ref, tbl_ref):
+        c = jnp.minimum(pos_ref[i] + j, smax - 1)
+        return (tbl_ref[i * mp + lax.div(c, p)], 0, lax.rem(c, p), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(k_new.shape[0], t),
+        in_specs=[new_spec, new_spec,
+                  pl.BlockSpec(memory_space=pltpu.ANY),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=[pl.BlockSpec((1, h, 1, d), col),
+                   pl.BlockSpec((1, h, 1, d), col)],
+    )
+    return pl.pallas_call(
+        _paged_write_cols_kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+                   jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype)],
+        # operand order: (pos, table, k_new, v_new, k_pool, v_pool)
+        input_output_aliases={4: 0, 5: 1},
+        interpret=use_interpret(),
+    )(jnp.asarray(pos, jnp.int32),
+      jnp.asarray(table, jnp.int32).reshape(-1),
+      k_new.astype(k_pool.dtype), v_new.astype(v_pool.dtype),
+      k_pool, v_pool)
+
+
+def _paged_write_cols_kernel_quant(pos_ref, tbl_ref, kn_ref, vn_ref,
+                                   kqi_ref, ksi_ref, vqi_ref, vsi_ref,
+                                   kq_ref, ks_ref, vq_ref, vs_ref, *,
+                                   kind):
+    del pos_ref, tbl_ref, kqi_ref, ksi_ref, vqi_ref, vsi_ref
+    kq, ks = quantize_kv_rows(kn_ref[:, :, 0], kind)     # (1, h, d)/(1, h)
+    vq, vs = quantize_kv_rows(vn_ref[:, :, 0], kind)
+    kq_ref[...] = kq[:, :, None]
+    ks_ref[...] = ks[:, :, None]
+    vq_ref[...] = vq[:, :, None]
+    vs_ref[...] = vs[:, :, None]
+
+
+def paged_write_columns_quant(k_new, v_new, k_q, k_s, v_q, v_s, table,
+                              pos, kind):
+    """:func:`paged_write_columns` over the quantized pool layout:
+    each incoming row is quantized IN-KERNEL and lands one quantized +
+    one scale cell per lane; same clamped over-horizon contract."""
+    k_new, _ = widen_f16(k_new)
+    v_new, _ = widen_f16(v_new)
+    n_pages, h, p, d = k_q.shape
+    mp = table.shape[1]
+    smax = mp * p
+    t = k_new.shape[2]
+    new_spec = pl.BlockSpec((1, h, 1, d),
+                            lambda i, j, pos_ref, tbl_ref: (i, 0, j, 0))
+
+    def col(i, j, pos_ref, tbl_ref):
+        c = jnp.minimum(pos_ref[i] + j, smax - 1)
+        return (tbl_ref[i * mp + lax.div(c, p)], 0, lax.rem(c, p), 0)
+
+    def scol(i, j, pos_ref, tbl_ref):
+        c = jnp.minimum(pos_ref[i] + j, smax - 1)
+        return (tbl_ref[i * mp + lax.div(c, p)], 0, lax.rem(c, p))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(k_new.shape[0], t),
+        in_specs=[new_spec, new_spec]
+        + [pl.BlockSpec(memory_space=pltpu.ANY)] * 4,
+        out_specs=[pl.BlockSpec((1, h, 1, d), col),
+                   pl.BlockSpec((1, h, 1), scol),
+                   pl.BlockSpec((1, h, 1, d), col),
+                   pl.BlockSpec((1, h, 1), scol)],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_write_cols_kernel_quant, kind=kind),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(k_q.shape, k_q.dtype),
+                   jax.ShapeDtypeStruct(k_s.shape, k_s.dtype),
+                   jax.ShapeDtypeStruct(v_q.shape, v_q.dtype),
+                   jax.ShapeDtypeStruct(v_s.shape, v_s.dtype)],
+        # operand order: (pos, table, k_new, v_new, k_q, k_s, v_q, v_s)
+        input_output_aliases={4: 0, 5: 1, 6: 2, 7: 3},
+        interpret=use_interpret(),
+    )(jnp.asarray(pos, jnp.int32),
+      jnp.asarray(table, jnp.int32).reshape(-1), k_new, v_new,
+      k_q, k_s, v_q, v_s)
+
+
+def _paged_attn_kernel(pos_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
+                       acc_ref, m_ref, l_ref, *, scale, p, smax, h):
+    r = pl.program_id(0)        # (batch, head) row
+    j = pl.program_id(1)        # logical page index of the horizon
+    nk = pl.num_programs(1)
+    pos = pos_ref[lax.div(r, h)]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # pages entirely past the row's position contribute nothing — the
+    # same block skip as the contiguous sweep, over remapped chunks
+    @pl.when(j * p <= pos)
+    def _block():
+        q = q_ref[0]                                      # (1, d)
+        k = k_ref[0, 0]                                   # (p, d)
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (1, p)
+        col = lax.broadcasted_iota(jnp.int32, (1, p), 1) + j * p
+        valid = (col <= pos) & (col < smax)
+        s = jnp.where(valid, s, _NEG)
+        v = jnp.where(jnp.transpose(valid), v, 0.0).astype(v.dtype)
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        pw = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        l_ref[:] = jnp.broadcast_to(
+            corr * l_ref[:, :1] + jnp.sum(pw, axis=-1, keepdims=True),
+            l_ref.shape)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            pw.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pool, v_pool, table, pos, *,
+                    scale: Optional[float] = None):
+    """Split-K flash-decode over the paged pool: ``q [b, h, d]``
+    against ``k_pool/v_pool [num_pages, h, P, d]`` under ``table [b,
+    max_pages]`` and per-row ``pos [b]`` — chunk ``j`` of row ``b``'s
+    sweep streams page ``table[b, j]`` (the scalar-prefetched remap of
+    the contiguous chunk index). Returns ``out [b, h, d]`` attending
+    columns ``0..pos[b]`` with the contiguous kernel's exact masking
+    contract; the write is separate (:func:`paged_write_column`) so
+    the engine can schedule it against the same dispatch."""
+    b, h, d = q.shape
+    n_pages, _, p, _ = k_pool.shape
+    mp = table.shape[1]
+    smax = mp * p
+    s = float(scale) if scale is not None else 1.0 / d ** 0.5
+    q, was16 = widen_f16(q)
+    k_pool, _ = widen_f16(k_pool)
+    v_pool, _ = widen_f16(v_pool)
+    pos = jnp.asarray(pos, jnp.int32)
+    tbl = jnp.asarray(table, jnp.int32).reshape(-1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b * h, mp),
+        in_specs=[
+            pl.BlockSpec((1, 1, d),
+                         lambda r, j, pos_ref, tbl_ref: (r, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, p, d),
+                lambda r, j, pos_ref, tbl_ref: (
+                    tbl_ref[lax.div(r, h) * mp + j], lax.rem(r, h), 0,
+                    0)),
+            pl.BlockSpec(
+                (1, 1, p, d),
+                lambda r, j, pos_ref, tbl_ref: (
+                    tbl_ref[lax.div(r, h) * mp + j], lax.rem(r, h), 0,
+                    0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, d), lambda r, j, pos_ref, tbl_ref: (r, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, d), jnp.float32),
+            pltpu.VMEM((1, _LANES), jnp.float32),
+            pltpu.VMEM((1, _LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_attn_kernel, scale=s, p=p, smax=smax,
+                          h=h),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, 1, d), q.dtype),
+        interpret=use_interpret(),
+    )(pos, tbl, q.reshape(b * h, 1, d), k_pool, v_pool)
+    out = out.reshape(b, h, d)
+    if was16:
+        out = out.astype(jnp.float16)
+    return out
+
+
+def _paged_attn_kernel_quant(pos_ref, tbl_ref, q_ref, k_ref, ks_ref,
+                             v_ref, vs_ref, o_ref, acc_ref, m_ref,
+                             l_ref, *, scale, p, smax, h):
+    r = pl.program_id(0)
+    j = pl.program_id(1)
+    nk = pl.num_programs(1)
+    pos = pos_ref[lax.div(r, h)]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * p <= pos)
+    def _block():
+        q = q_ref[0].astype(jnp.float32)              # (1, d)
+        col = lax.broadcasted_iota(jnp.int32, (1, p), 1) + j * p
+        valid = (col <= pos) & (col < smax)
+        kq = k_ref[0, 0].astype(jnp.float32)          # (p, d)
+        s = jax.lax.dot_general(
+            q, kq, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        s = s * ks_ref[0, 0][None, :] * scale         # (1, p)
+        s = jnp.where(valid, s, _NEG)
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        pw = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        l_ref[:] = jnp.broadcast_to(
+            corr * l_ref[:, :1] + jnp.sum(pw, axis=-1, keepdims=True),
+            l_ref.shape)
+        vq = v_ref[0, 0].astype(jnp.float32)
+        vq = jnp.where(jnp.transpose(valid), vq, 0.0)
+        vs = jnp.where(valid[0], vs_ref[0, 0], 0.0)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            pw * vs[None, :], vq, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
+def paged_attention_quantized(q, k_q, k_s, v_q, v_s, table, pos, *,
+                              kind: str = "int8",
+                              scale: Optional[float] = None):
+    """:func:`paged_attention` over the quantized pool layout: int8/fp8
+    ``[num_pages, h, P, d]`` storage with fp32 ``[num_pages, h, P]``
+    scales, scales folded into the fp32 scores/probabilities per page
+    exactly like the contiguous quantized sweep."""
+    if kind not in KV_QMAX:
+        raise ValueError(f"unknown quantized-KV kind {kind!r}")
+    b, h, d = q.shape
+    n_pages, _, p, _ = k_q.shape
+    mp = table.shape[1]
+    smax = mp * p
+    s = float(scale) if scale is not None else 1.0 / d ** 0.5
+    q, was16 = widen_f16(q)
+    pos = jnp.asarray(pos, jnp.int32)
+    tbl = jnp.asarray(table, jnp.int32).reshape(-1)
+    page_spec = pl.BlockSpec(
+        (1, 1, p, d),
+        lambda r, j, pos_ref, tbl_ref: (
+            tbl_ref[lax.div(r, h) * mp + j], lax.rem(r, h), 0, 0))
+    scale_spec = pl.BlockSpec(
+        (1, 1, p),
+        lambda r, j, pos_ref, tbl_ref: (
+            tbl_ref[lax.div(r, h) * mp + j], lax.rem(r, h), 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b * h, mp),
+        in_specs=[
+            pl.BlockSpec((1, 1, d),
+                         lambda r, j, pos_ref, tbl_ref: (r, 0, 0)),
+            page_spec, scale_spec, page_spec, scale_spec,
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, d), lambda r, j, pos_ref, tbl_ref: (r, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, d), jnp.float32),
+            pltpu.VMEM((1, _LANES), jnp.float32),
+            pltpu.VMEM((1, _LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_attn_kernel_quant, scale=s, p=p,
+                          smax=smax, h=h),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, 1, d), q.dtype),
+        interpret=use_interpret(),
+    )(pos, tbl, q.reshape(b * h, 1, d), k_q, k_s, v_q, v_s)
+    out = out.reshape(b, h, d)
+    if was16:
+        out = out.astype(jnp.float16)
+    return out
+
+
 def decode_attention_quantized(q, k_new, v_new, k_q, k_scale, v_q,
                                v_scale, pos, *, kind: str = "int8",
                                scale: Optional[float] = None,

@@ -51,6 +51,14 @@ from apex_tpu.kernels.decode_attention import (
     cache_write_columns_quant as _cache_write_columns_quant,
     cache_write_columns_xla as _cache_write_columns_xla,
     kv_storage_dtype as _kv_storage_dtype,
+    paged_attention as _paged_attention,
+    paged_attention_quantized as _paged_attention_quantized,
+    paged_gather_xla as _paged_gather_xla,
+    paged_write_column as _paged_write_column,
+    paged_write_column_quant as _paged_write_column_quant,
+    paged_write_columns as _paged_write_columns,
+    paged_write_columns_quant as _paged_write_columns_quant,
+    paged_write_columns_xla as _paged_write_columns_xla,
     quantize_kv_rows as _quantize_kv_rows_impl,
 )
 from apex_tpu.kernels.blockwise_attention import blockwise_attention
@@ -1179,9 +1187,81 @@ def _decode_attend(cfg: GPTConfig, q, k_new, v_new, kv, pos):
     return jnp.einsum("bhs,bhsd->bhd", p_attn, v_cache), new_kv
 
 
-def _decode_layer(cfg: GPTConfig, p, x, kv, pos):
+def _paged_attend(cfg: GPTConfig, q, k_new, v_new, kv, pos, table):
+    """:func:`_decode_attend` over the PAGED cache layout: ``kv`` is
+    the per-layer page-pool slice (``[2, num_pages, hl, P, d]`` array,
+    or the quantized ``{"kv", "scale"}`` pytree of the same family)
+    and ``table [b, max_pages] int32`` maps each row's logical horizon
+    chunk onto a physical page. The write lands at ``(table[b, pos //
+    P], pos % P)``; the read sweeps the remapped pages. Under the
+    kernel impl both ride scalar-prefetched index maps
+    (:func:`apex_tpu.kernels.paged_attention`); the XLA fallback
+    writes through the one-hot page scatter and GATHERS the row-
+    contiguous view, then applies the EXACT contiguous score
+    expression — same bytes, same einsum shapes, so a paged row's
+    logits are bit-identical to the contiguous cache's (the paged ==
+    contiguous stream oracle)."""
+    b, heads, d = q.shape
+    kind = _kv_cache_dtype(cfg)
+    quant = kind != "compute"
+    kvq = kv["kv"] if quant else kv        # [2, num_pages, hl, P, d]
+    p_sz = kvq.shape[3]
+    s_max = table.shape[1] * p_sz
+    posv = (jnp.full((b,), pos, jnp.int32) if pos.ndim == 0 else pos)
+    if _decode_attn_impl(cfg, s_max) == "kernel":
+        if quant:
+            kq, ks, vq, vs = _paged_write_column_quant(
+                k_new, v_new, kvq[0], kv["scale"][0], kvq[1],
+                kv["scale"][1], table, posv, kind)
+            ctx = _paged_attention_quantized(
+                q, kq, ks, vq, vs, table, posv, kind=kind,
+                scale=1.0 / np.sqrt(d))
+            return ctx, {"kv": jnp.stack([kq, vq]),
+                         "scale": jnp.stack([ks, vs])}
+        kp, vp = _paged_write_column(k_new, v_new, kvq[0], kvq[1],
+                                     table, posv)
+        ctx = _paged_attention(q, kp, vp, table, posv,
+                               scale=1.0 / np.sqrt(d))
+        return ctx, jnp.stack([kp, vp])
+    if quant:
+        k_new, k_s = quantize_kv_rows(k_new, kind)
+        v_new, v_s = quantize_kv_rows(v_new, kind)
+    kp = _paged_write_columns_xla(kvq[0], k_new[:, :, None], table,
+                                  posv)
+    vp = _paged_write_columns_xla(kvq[1], v_new[:, :, None], table,
+                                  posv)
+    if quant:
+        ksp = _paged_write_columns_xla(kv["scale"][0],
+                                       k_s[:, :, None], table, posv)
+        vsp = _paged_write_columns_xla(kv["scale"][1],
+                                       v_s[:, :, None], table, posv)
+        new_kv = {"kv": jnp.stack([kp, vp]),
+                  "scale": jnp.stack([ksp, vsp])}
+        k_cache = dequantize_kv(_paged_gather_xla(kp, table),
+                                _paged_gather_xla(ksp, table),
+                                cfg.compute_dtype)
+        v_cache = dequantize_kv(_paged_gather_xla(vp, table),
+                                _paged_gather_xla(vsp, table),
+                                cfg.compute_dtype)
+    else:
+        new_kv = jnp.stack([kp, vp])
+        k_cache = _paged_gather_xla(kp, table)
+        v_cache = _paged_gather_xla(vp, table)
+    valid = (jnp.arange(s_max)[None] <= posv[:, None])[:, None]
+    # the contiguous XLA branch's expressions VERBATIM (bit-parity)
+    q = q * jnp.asarray(1.0 / np.sqrt(d), q.dtype)
+    scores = jnp.einsum(
+        "bhd,bhsd->bhs", q, k_cache).astype(jnp.float32)
+    scores = jnp.where(valid, scores, -1e30)
+    p_attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhs,bhsd->bhd", p_attn, v_cache), new_kv
+
+
+def _decode_layer(cfg: GPTConfig, p, x, kv, pos, table=None):
     """One layer for one token: x [b, hidden], kv [2, b, hl, S, d] (or
-    the quantized ``{"kv", "scale"}`` pytree of the same shape family).
+    the quantized ``{"kv", "scale"}`` pytree of the same shape family;
+    under a paged cache — ``table`` given — the per-layer page-pool
+    slice ``[2, num_pages, hl, P, d]``).
 
     ``pos`` is the write/attend position — a scalar (whole batch at one
     position: generate/beam) or a ``[b]`` vector (per-slot positions:
@@ -1200,7 +1280,11 @@ def _decode_layer(cfg: GPTConfig, p, x, kv, pos):
     q, k_new, v_new = (
         t.reshape(b, hl // d, d)
         for t in _qkv_project(cfg, p["attn"]["qkv"], xa))
-    ctx, new_kv = _decode_attend(cfg, q, k_new, v_new, kv, pos)
+    if table is None:
+        ctx, new_kv = _decode_attend(cfg, q, k_new, v_new, kv, pos)
+    else:
+        ctx, new_kv = _paged_attend(cfg, q, k_new, v_new, kv, pos,
+                                    table)
     out = ctx.reshape(b, hl)
     attn = row_parallel_linear(
         out, p["attn"]["proj"]["kernel"], p["attn"]["proj"]["bias"],
@@ -1227,9 +1311,16 @@ def _lm_head(cfg: GPTConfig, params, h):
     return lg.astype(jnp.float32)
 
 
-def decode_step(cfg: GPTConfig, params, cache, token, pos):
+def decode_step(cfg: GPTConfig, params, cache, token, pos, table=None):
     """One decoding step: ``token [b] int32`` at position ``pos`` →
     (full-vocab fp32 logits ``[b, vocab]``, updated cache).
+
+    ``table`` (optional ``[b, max_pages] int32``) switches the cache to
+    the PAGED layout: ``cache`` is then the page pool from
+    :func:`init_cache` called with ``batch=num_pages, max_len=
+    page_size`` (same pytree family — layer/plane dims line up), and
+    each row's horizon is its block-table row. Tables are DATA, never
+    shapes: one compiled program serves every table content.
 
     ``pos`` is a scalar (the whole batch decodes in lockstep —
     generate/beam) or a ``[b] int32`` vector of per-row positions (the
@@ -1248,8 +1339,8 @@ def decode_step(cfg: GPTConfig, params, cache, token, pos):
     if cfg.sequence_parallel:
         cfg = dataclasses.replace(cfg, sequence_parallel=False)
     pos = jnp.asarray(pos, jnp.int32)
-    table = params["embedding"]["word"]["table"].astype(cfg.compute_dtype)
-    emb = vocab_parallel_embedding(token[:, None], table, axis=cfg.axis)
+    emb_t = params["embedding"]["word"]["table"].astype(cfg.compute_dtype)
+    emb = vocab_parallel_embedding(token[:, None], emb_t, axis=cfg.axis)
     if pos.ndim == 0:
         pos_e = lax.dynamic_index_in_dim(
             params["embedding"]["position"], pos, 0, keepdims=False)
@@ -1260,7 +1351,8 @@ def decode_step(cfg: GPTConfig, params, cache, token, pos):
 
     def body(carry, inp):
         layer_p, kv = inp
-        y, kv = _decode_layer(cfg, _cast_layer(cfg, layer_p), carry, kv, pos)
+        y, kv = _decode_layer(cfg, _cast_layer(cfg, layer_p), carry, kv,
+                              pos, table)
         return y, kv
 
     x, new_cache = lax.scan(body, x, (params["layers"], cache))
@@ -1273,7 +1365,8 @@ _NO_EOS_SENTINEL = -1
 
 
 def decode_steps(cfg: GPTConfig, params, cache, state, n: int, *,
-                 pad_token_id: int = 0, draw_fn=None, masks=None):
+                 pad_token_id: int = 0, draw_fn=None, masks=None,
+                 table=None):
     """``n`` fused decode steps as ONE compiled ``lax.scan`` — the
     chunked device-side decode loop. Each step is a
     :func:`decode_step` + on-device sampling + per-slot eos/budget
@@ -1317,7 +1410,7 @@ def decode_steps(cfg: GPTConfig, params, cache, state, n: int, *,
     def body(carry, _):
         cache, st = carry
         logits, cache = decode_step(
-            cfg, params, cache, st["tok"], st["pos"])
+            cfg, params, cache, st["tok"], st["pos"], table)
         if draw_fn is None:
             nxt = _sampling.draw_slots(
                 logits, st["key"], st["pos"], st["temp"], st["top_k"],
@@ -1410,6 +1503,78 @@ def ngram_drafts(hist, tok, k: int):
     return jnp.stack(out, axis=1)
 
 
+def _paged_attend_multi(cfg: GPTConfig, q, k_new, v_new, kv, pos,
+                        table):
+    """:func:`_decode_attend_multi` over the paged layout: all T K/V
+    columns land through the paged multi-column write (Pallas
+    scalar-prefetch remap under the kernel impl, one-hot page scatter
+    under XLA — over-horizon lanes clamp/drop into masked-garbage
+    cells exactly like the contiguous pair), then the T query rows
+    attend the GATHERED row-contiguous view with the contiguous verify
+    path's exact materialised-scores expression — the paged spec ==
+    contiguous spec parity stands on the gathered bytes being
+    identical."""
+    b, heads, t, d = q.shape
+    kind = _kv_cache_dtype(cfg)
+    quant = kind != "compute"
+    kvq = kv["kv"] if quant else kv
+    p_sz = kvq.shape[3]
+    s_max = table.shape[1] * p_sz
+    use_kernel = _decode_attn_impl(cfg, s_max) == "kernel"
+    if use_kernel:
+        if quant:
+            kq, ks, vq, vs = _paged_write_columns_quant(
+                k_new, v_new, kvq[0], kv["scale"][0], kvq[1],
+                kv["scale"][1], table, pos, kind)
+            new_kv = {"kv": jnp.stack([kq, vq]),
+                      "scale": jnp.stack([ks, vs])}
+            k_cache = dequantize_kv(_paged_gather_xla(kq, table),
+                                    _paged_gather_xla(ks, table),
+                                    cfg.compute_dtype)
+            v_cache = dequantize_kv(_paged_gather_xla(vq, table),
+                                    _paged_gather_xla(vs, table),
+                                    cfg.compute_dtype)
+        else:
+            kp, vp = _paged_write_columns(k_new, v_new, kvq[0],
+                                          kvq[1], table, pos)
+            new_kv = jnp.stack([kp, vp])
+            k_cache = _paged_gather_xla(kp, table)
+            v_cache = _paged_gather_xla(vp, table)
+    else:
+        if quant:
+            k_new, k_s = quantize_kv_rows(k_new, kind)
+            v_new, v_s = quantize_kv_rows(v_new, kind)
+        kp = _paged_write_columns_xla(kvq[0], k_new, table, pos)
+        vp = _paged_write_columns_xla(kvq[1], v_new, table, pos)
+        if quant:
+            ksp = _paged_write_columns_xla(kv["scale"][0], k_s, table,
+                                           pos)
+            vsp = _paged_write_columns_xla(kv["scale"][1], v_s, table,
+                                           pos)
+            new_kv = {"kv": jnp.stack([kp, vp]),
+                      "scale": jnp.stack([ksp, vsp])}
+            k_cache = dequantize_kv(_paged_gather_xla(kp, table),
+                                    _paged_gather_xla(ksp, table),
+                                    cfg.compute_dtype)
+            v_cache = dequantize_kv(_paged_gather_xla(vp, table),
+                                    _paged_gather_xla(vsp, table),
+                                    cfg.compute_dtype)
+        else:
+            new_kv = jnp.stack([kp, vp])
+            k_cache = _paged_gather_xla(kp, table)
+            v_cache = _paged_gather_xla(vp, table)
+    # the contiguous _decode_attend_multi read expressions VERBATIM
+    valid = (jnp.arange(s_max)[None, None]
+             <= (pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None])
+             [:, :, None])                        # [b, T, S]
+    q = q * jnp.asarray(1.0 / np.sqrt(d), q.dtype)
+    scores = jnp.einsum(
+        "bhtd,bhsd->bhts", q, k_cache).astype(jnp.float32)
+    scores = jnp.where(valid[:, None], scores, -1e30)
+    p_attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bhsd->bhtd", p_attn, v_cache), new_kv
+
+
 def _decode_attend_multi(cfg: GPTConfig, q, k_new, v_new, kv, pos):
     """:func:`_decode_attend` for ``T`` tokens per row at positions
     ``pos[b] .. pos[b] + T - 1`` — the speculative verify forward's
@@ -1485,11 +1650,12 @@ def _decode_attend_multi(cfg: GPTConfig, q, k_new, v_new, kv, pos):
     return jnp.einsum("bhts,bhsd->bhtd", p_attn, v_cache), new_kv
 
 
-def _verify_layer(cfg: GPTConfig, p, x, kv, pos):
+def _verify_layer(cfg: GPTConfig, p, x, kv, pos, table=None):
     """:func:`_decode_layer` for ``T`` tokens per row: ``x [b, T,
     hidden]`` at positions ``pos[b] + t``. Projections/LN/MLP are
     per-position (row-independent matmuls — the :func:`prefill_extend`
-    argument), attention via :func:`_decode_attend_multi`."""
+    argument), attention via :func:`_decode_attend_multi` (or its
+    paged sibling when ``table`` is given)."""
     xa = _layer_norm(cfg, x, p["ln1"]["scale"], p["ln1"]["bias"])
     d = cfg.head_dim
     b, t, _ = xa.shape
@@ -1497,7 +1663,12 @@ def _verify_layer(cfg: GPTConfig, p, x, kv, pos):
     q, k_new, v_new = (
         jnp.transpose(z.reshape(b, t, hl // d, d), (0, 2, 1, 3))
         for z in _qkv_project(cfg, p["attn"]["qkv"], xa))
-    ctx, new_kv = _decode_attend_multi(cfg, q, k_new, v_new, kv, pos)
+    if table is None:
+        ctx, new_kv = _decode_attend_multi(cfg, q, k_new, v_new, kv,
+                                           pos)
+    else:
+        ctx, new_kv = _paged_attend_multi(cfg, q, k_new, v_new, kv,
+                                          pos, table)
     out = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(b, t, hl)
     attn = row_parallel_linear(
         out, p["attn"]["proj"]["kernel"], p["attn"]["proj"]["bias"],
@@ -1507,7 +1678,8 @@ def _verify_layer(cfg: GPTConfig, p, x, kv, pos):
     return x + _mlp(cfg, p["mlp"], xb), new_kv
 
 
-def decode_verify(cfg: GPTConfig, params, cache, tokens, pos):
+def decode_verify(cfg: GPTConfig, params, cache, tokens, pos,
+                  table=None):
     """The speculative verify forward: feed ``tokens [b, T] int32``
     (this step's input token followed by T-1 drafted candidates) at
     per-row positions ``pos[b] .. pos[b] + T - 1`` through ONE batched
@@ -1544,8 +1716,8 @@ def decode_verify(cfg: GPTConfig, params, cache, tokens, pos):
             cfg, sequence_parallel=False, context_parallel=False)
     pos = jnp.asarray(pos, jnp.int32)
     b, t = tokens.shape
-    table = params["embedding"]["word"]["table"].astype(cfg.compute_dtype)
-    emb = vocab_parallel_embedding(tokens.astype(jnp.int32), table,
+    emb_t = params["embedding"]["word"]["table"].astype(cfg.compute_dtype)
+    emb = vocab_parallel_embedding(tokens.astype(jnp.int32), emb_t,
                                    axis=cfg.axis)
     # over-horizon lanes (a near-budget row drafting past its last
     # position) clamp their position-embedding index — their logits
@@ -1559,7 +1731,7 @@ def decode_verify(cfg: GPTConfig, params, cache, tokens, pos):
     def body(carry, inp):
         layer_p, kv = inp
         y, kv = _verify_layer(cfg, _cast_layer(cfg, layer_p), carry, kv,
-                              pos)
+                              pos, table)
         return y, kv
 
     x, new_cache = lax.scan(body, x, (params["layers"], cache))
@@ -1569,7 +1741,7 @@ def decode_verify(cfg: GPTConfig, params, cache, tokens, pos):
 
 def decode_steps_spec(cfg: GPTConfig, params, cache, state, n: int, *,
                       spec_k: int, pad_token_id: int = 0, draw_fn=None,
-                      draft_fn=None, masks=None):
+                      draft_fn=None, masks=None, table=None):
     """:func:`decode_steps` with draft-k-verify speculation: ``n``
     scan iterations (waves), each drafting ``spec_k`` candidate tokens
     from the slot's token history (:func:`ngram_drafts`, or the
@@ -1621,7 +1793,7 @@ def decode_steps_spec(cfg: GPTConfig, params, cache, state, n: int, *,
                           cfg.vocab_size - 1)
         tokens_in = jnp.concatenate([tok[:, None], drafts], axis=1)
         logits_all, cache = decode_verify(cfg, params, cache, tokens_in,
-                                          pos)
+                                          pos, table)
         live0 = ~st["done"]
         rem = st["remaining"]
         done = st["done"]
@@ -1929,6 +2101,34 @@ def cache_insert_slots(cache, blocks, slots):
         cache = cache_insert_slot(
             cache, jax.tree.map(lambda x: x[:, :, i:i + 1], blocks),
             slots[i])
+    return cache
+
+
+def cache_insert_pages(cache, blocks, pages, *, page_size: int):
+    """Scatter prefilled cache blocks into a PAGED pool: ``blocks
+    [l, 2, k, hl, span, d]`` (or the quantized pytree; ``span`` a
+    multiple of ``page_size``) land in the pool ``[l, 2, num_pages,
+    hl, P, d]`` at page indices ``pages [k, span // P]`` (traced; must
+    be distinct across the whole call except inside a shared
+    garbage/sink page). Row ``i``'s columns ``[j·P, (j+1)·P)`` fill
+    page ``pages[i, j]`` — ``k`` and ``span`` are static, so this
+    unrolls into ``k · span/P`` one-page ``dynamic_update_slice``
+    writes, each touching only its own page (the paged sibling of
+    :func:`cache_insert_slots`; the page dim IS the slot dim, so the
+    same insert primitive serves both layouts)."""
+    span = jax.tree.leaves(blocks)[0].shape[4]
+    if span % page_size:
+        raise ValueError(
+            f"block span {span} not a multiple of page_size "
+            f"{page_size}")
+    k = jax.tree.leaves(blocks)[0].shape[2]
+    for i in range(k):
+        for j in range(span // page_size):
+            sub = jax.tree.map(
+                lambda x: lax.slice_in_dim(
+                    x[:, :, i:i + 1], j * page_size,
+                    (j + 1) * page_size, axis=4), blocks)
+            cache = cache_insert_slot(cache, sub, pages[i, j])
     return cache
 
 

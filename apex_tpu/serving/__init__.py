@@ -44,10 +44,12 @@ from apex_tpu.serving.request import (  # noqa: F401
 
 __all__ = [
     "request", "sampling", "engine", "scheduler", "resilience", "api",
+    "pages",
     "Request", "SamplingParams", "Completion", "StreamEvent",
     "StopMatcher",
     "Engine", "EngineConfig", "Scheduler", "QueueFull",
     "SpecGateConfig", "Admission", "AdmitResult", "StepHandle",
+    "ChunkedAdmission", "PageAllocator", "PagesExhausted",
     "FaultPlan", "FaultSpec", "ResilienceConfig", "HealthMonitor",
     "EngineFault", "InjectedFault", "EngineFailed",
 ]
@@ -62,11 +64,15 @@ _LAZY = {
     "engine": "apex_tpu.serving.engine",
     "scheduler": "apex_tpu.serving.scheduler",
     "resilience": "apex_tpu.serving.resilience",
+    "pages": "apex_tpu.serving.pages",
     "Engine": "apex_tpu.serving.engine",
     "EngineConfig": "apex_tpu.serving.engine",
     "Admission": "apex_tpu.serving.engine",
     "AdmitResult": "apex_tpu.serving.engine",
+    "ChunkedAdmission": "apex_tpu.serving.engine",
     "StepHandle": "apex_tpu.serving.engine",
+    "PageAllocator": "apex_tpu.serving.pages",
+    "PagesExhausted": "apex_tpu.serving.pages",
     "Scheduler": "apex_tpu.serving.scheduler",
     "QueueFull": "apex_tpu.serving.scheduler",
     "SpecGateConfig": "apex_tpu.serving.scheduler",

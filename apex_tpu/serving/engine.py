@@ -49,10 +49,12 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.models import gpt
 from apex_tpu.serving import sampling
+from apex_tpu.serving.pages import SINK, PageAllocator, PagesExhausted
 from apex_tpu.serving.resilience import (
     KIND_ERROR,
     KIND_HANG,
@@ -144,6 +146,45 @@ class EngineConfig:
     #: bucket) pair plus one pool-insert per prefix bucket, all
     #: compiled by :meth:`Engine.warmup`.
     prefix_pool_slots: int = 0
+    #: paged KV cache: > 0 switches the slot cache from one contiguous
+    #: ``[max_seq_len]``-horizon stripe per slot to a GLOBAL pool of
+    #: ``page_size``-token pages plus one ``[max_pages] int32`` block
+    #: table row per slot (``max_pages = ceil(max_seq_len /
+    #: page_size)`` — config-derived, never request-derived: tables
+    #: are DATA in the compiled programs, so one program serves every
+    #: table content). A 12-token request then pins
+    #: ``ceil((12 + max_tokens) / page_size)`` pages instead of a full
+    #: ``max_seq_len`` stripe — the fragmentation-free capacity play —
+    #: and prefix-pool hits share the prefix's pages copy-on-write
+    #: (refcounted; the prefix region is read-only by construction, so
+    #: the "first write" that would allocate is the admission's own
+    #: private tail/decode pages). 0 = the historical contiguous
+    #: layout. Emitted streams are bit-identical either way (the paged
+    #: == contiguous oracle pins it).
+    page_size: int = 0
+    #: pages in the global pool (paged mode only). 0 = auto-size to
+    #: ``slots * max_pages + 1`` — every slot can hold a worst-case
+    #: request, plus the reserved sink page 0 (freed slots' table rows
+    #: redirect there so their frozen decode lanes write garbage into
+    #: garbage). Set lower to oversubscribe HBM against a mixed-length
+    #: workload; admission then backpressures through
+    #: :class:`~apex_tpu.serving.pages.PagesExhausted` when the pool
+    #: runs dry (the scheduler keeps the queue and sheds per policy).
+    num_pages: int = 0
+    #: chunked prefill: > 0 admits prompts LONGER than this in
+    #: ``prefill_chunk``-token slices — chunk 0 through a bucket-sized
+    #: cold prefill, later chunks through ``gpt.prefill_extend`` over
+    #: the already-ingested prefix — with the scheduler free to run
+    #: decode waves between chunk dispatches, so a long-prompt
+    #: admission no longer stalls every other stream's TTFT for one
+    #: monolithic forward. Must be a prompt bucket dividing
+    #: ``max_prompt_len``. One compiled extend variant per chunk index
+    #: (``max_prompt_len / prefill_chunk - 1`` of them), all warmed.
+    #: Streams are bit-identical to a monolithic admission whenever
+    #: cold prefill runs the materialised-scores attention (every
+    #: off-TPU config — the ``gpt.prefill_extend`` parity contract).
+    #: 0 disables.
+    prefill_chunk: int = 0
 
 
 #: eos sentinel in the per-slot eos vector: no stop token for this slot
@@ -203,6 +244,53 @@ class AdmitResult:
     batch_size: int
     group: int
     logprob: float = 0.0
+
+
+def _pad_span(block, span: int):
+    """Zero-pad a cache block pytree (``[l, 2, k, hl, T(, d)]`` leaves)
+    to ``span`` columns on the horizon dim (4) — the paged insert's
+    page-alignment shim: ``gpt.cache_insert_pages`` writes whole pages,
+    and the pad columns land either in the slot's own not-yet-decoded
+    cells or in the sink page (masked garbage both ways)."""
+    def f(x):
+        pad = span - x.shape[4]
+        if pad <= 0:
+            return x
+        w = [(0, 0)] * x.ndim
+        w[4] = (0, pad)
+        return jnp.pad(x, w)
+
+    return jax.tree.map(f, block)
+
+
+class ChunkedAdmission:
+    """Host progress of one chunked-prefill admission
+    (``EngineConfig.prefill_chunk``): created by
+    :meth:`Engine.admit_chunked_start` (which dispatches chunk 0),
+    advanced one chunk-forward per :meth:`Engine.admit_chunked_step`
+    call — the scheduler interleaves decode waves between calls — and
+    finished by the same method returning the :class:`AdmitResult`.
+    ``chunks_total`` counts the prefill forwards (the admission's
+    device dispatches are ``chunks_total + 1`` including the finish)."""
+
+    __slots__ = ("admission", "prompt", "p_len", "chunks_total",
+                 "next_chunk", "slot", "_logits")
+
+    def __init__(self, admission: Admission, prompt: np.ndarray,
+                 p_len: int, chunks_total: int):
+        self.admission = admission
+        self.prompt = prompt
+        self.p_len = p_len
+        self.chunks_total = chunks_total
+        self.next_chunk = 1          # chunk 0 dispatched at start
+        self.slot = admission.slot
+        self._logits = None          # the final chunk's device logits
+
+    @property
+    def done_prefilling(self) -> bool:
+        """True once every prefill chunk is dispatched (the next
+        :meth:`Engine.admit_chunked_step` call runs the finish)."""
+        return self.next_chunk >= self.chunks_total
 
 
 def _threefry_key_data(seed: int) -> np.ndarray:
@@ -362,6 +450,70 @@ class Engine:
                 "diverge (see gpt.prefill_extend)")
         self._prefix_splits, self._extend_variants = \
             self._resolve_prefix_variants(ecfg, self._buckets)
+        # -- paged KV cache geometry (all config-derived constants:
+        # tables are data, never shapes — PAGE-TABLE-STATIC) ------------
+        if ecfg.page_size < 0 or ecfg.num_pages < 0:
+            raise ValueError(
+                f"page_size {ecfg.page_size} / num_pages "
+                f"{ecfg.num_pages} must be >= 0")
+        self._paged = ecfg.page_size > 0
+        if not self._paged and ecfg.num_pages:
+            raise ValueError(
+                "num_pages without page_size — the pool geometry only "
+                "exists in paged mode")
+        self._max_pages = 0
+        self._num_pages = 0
+        if self._paged:
+            self._max_pages = -(-ecfg.max_seq_len // ecfg.page_size)
+            self._num_pages = (ecfg.num_pages
+                               or ecfg.slots * self._max_pages + 1)
+            if self._num_pages < self._max_pages + 1:
+                raise ValueError(
+                    f"num_pages {self._num_pages} cannot hold one "
+                    f"worst-case request ({self._max_pages} pages) "
+                    f"plus the sink page")
+            if self._prefix_splits:
+                # copy-on-write sharing maps whole pages: only
+                # page-aligned split points can share (the tail insert
+                # starts at the split, and a mid-page split would make
+                # a shared page writable)
+                splits = tuple(s for s in self._prefix_splits
+                               if s % ecfg.page_size == 0)
+                if not splits:
+                    raise ValueError(
+                        f"prefix_pool_slots={ecfg.prefix_pool_slots} "
+                        f"with page_size={ecfg.page_size}: no split "
+                        f"point in {self._prefix_splits} is "
+                        f"page-aligned — pick a page_size dividing a "
+                        f"prompt bucket")
+                self._extend_variants = tuple(
+                    (ps, tb) for ps, tb in self._extend_variants
+                    if ps in splits)
+                self._prefix_splits = splits
+        # -- chunked prefill geometry -----------------------------------
+        if ecfg.prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk {ecfg.prefill_chunk} must be >= 0")
+        self._chunk_size = ecfg.prefill_chunk
+        if self._chunk_size:
+            if cfg.num_experts:
+                raise ValueError(
+                    "prefill_chunk > 0 does not compose with "
+                    "num_experts > 0 (chunked admission rides "
+                    "gpt.prefill_extend, which MoE expert capacity "
+                    "breaks — see its docstring)")
+            if self._chunk_size not in self._buckets:
+                raise ValueError(
+                    f"prefill_chunk {self._chunk_size} must be one of "
+                    f"the prompt buckets {self._buckets} (chunk 0 is a "
+                    f"bucket-sized cold prefill)")
+            if self._chunk_size >= ecfg.max_prompt_len \
+                    or ecfg.max_prompt_len % self._chunk_size:
+                raise ValueError(
+                    f"prefill_chunk {self._chunk_size} must divide and "
+                    f"be smaller than max_prompt_len "
+                    f"{ecfg.max_prompt_len} (the chunk ladder is "
+                    f"static)")
         self.cfg = cfg
         self.engine_cfg = ecfg
         self._mesh = mesh
@@ -394,8 +546,28 @@ class Engine:
         self._prefix_tokens: Dict[int, Tuple[int, ...]] = {}
         self._prefix_used = 0
         self.pool: Optional[Any] = None
+        #: paged-mode host state: the page allocator, the [B, max_pages]
+        #: block-table host mirror (device copy cached like the masks —
+        #: re-uploaded only when a row changes), per-slot page
+        #: bookkeeping, and the registered prefixes' pinned cache pages
+        self._page_alloc: Optional[PageAllocator] = None
+        self._tables: Optional[np.ndarray] = None
+        self._tables_dev: Optional[Any] = None
+        self._slot_pages: Dict[int, Tuple[List[int], List[int], int]] = {}
+        self._prefix_pages: Dict[int, List[int]] = {}
+        if self._paged:
+            self._page_alloc = PageAllocator(self._num_pages,
+                                             ecfg.page_size)
+            self._tables = np.full((ecfg.slots, self._max_pages), SINK,
+                                   np.int32)
+        #: the single in-progress chunked-prefill admission (None
+        #: between chunked admissions; the engine serializes them — the
+        #: scratch buffer holds one prompt)
+        self._chunked: Optional[ChunkedAdmission] = None
         self._build()
         self.cache, self.state = self._init(params)
+        if self._chunk_size:
+            self._chunk_scratch = self._chunk_scratch_init(params)
         if self._prefix_splits:
             self.pool = self._pool_init(params)
 
@@ -492,8 +664,18 @@ class Engine:
             state_keys.append("hist")
         state_spec = {k: P() for k in state_keys}
 
+        paged = self._paged
+        p_sz = ecfg.page_size
+
         def init_local(params):
-            cache = gpt.init_cache(cfg, params, B, max_len=ecfg.max_seq_len)
+            if paged:
+                # the paged pool: the page dim rides the slot dim of
+                # the contiguous layout, the horizon dim is one page
+                cache = gpt.init_cache(cfg, params, self._num_pages,
+                                       max_len=p_sz)
+            else:
+                cache = gpt.init_cache(cfg, params, B,
+                                       max_len=ecfg.max_seq_len)
             state = {
                 "tok": jnp.full((B,), pad, jnp.int32),
                 "pos": jnp.zeros((B,), jnp.int32),
@@ -511,17 +693,19 @@ class Engine:
                                          jnp.int32)
             return cache, state
 
-        def step_local(params, cache, state, masks):
+        def step_core(params, cache, state, masks, table):
             # the whole per-token body (decode + per-slot draw +
             # eos/budget masking) lives in gpt.decode_steps — ONE
             # compiled scan of decode_chunk steps per dispatch; masks
             # is the per-slot constrained-decoding vocab whitelist
-            # (all-True rows are bit-identical to no mask)
+            # (all-True rows are bit-identical to no mask); table is
+            # the paged block table (None = contiguous layout)
             hist = state["hist"] if spec else None
             pos0 = state["pos"]
             cache, state, toks, lps, fins = gpt.decode_steps(
                 cfg, params, cache, state, ecfg.decode_chunk,
-                pad_token_id=ecfg.pad_token_id, masks=masks)
+                pad_token_id=ecfg.pad_token_id, masks=masks,
+                table=table)
             if spec:
                 # keep the drafter's history fresh across PLAIN chunks
                 # too (a payoff-gated scheduler flips between the two
@@ -532,7 +716,7 @@ class Engine:
                     hist, toks, state["pos"] - pos0)}
             return cache, state, toks, lps, fins
 
-        def step_spec_local(params, cache, state, masks):
+        def step_spec_core(params, cache, state, masks, table):
             # the speculative chunk: decode_chunk draft-verify-accept
             # waves, emitting up to decode_chunk*(spec_k+1) columns
             # (valid marks the real ones); bit-identical streams to
@@ -540,12 +724,32 @@ class Engine:
             return gpt.decode_steps_spec(
                 cfg, params, cache, state, ecfg.decode_chunk,
                 spec_k=ecfg.spec_k, pad_token_id=ecfg.pad_token_id,
-                masks=masks)
+                masks=masks, table=table)
+
+        if paged:
+            # the cores already take the table last — they ARE the
+            # paged step programs
+            step_local = step_core
+            step_spec_local = step_spec_core
+        else:
+            def step_local(params, cache, state, masks):
+                return step_core(params, cache, state, masks, None)
+
+            def step_spec_local(params, cache, state, masks):
+                return step_spec_core(params, cache, state, masks,
+                                      None)
 
         def make_admit(bucket: int):
+            n_ins = -(-bucket // p_sz) if paged else 0
+
             def admit_local(params, cache, state, slots, prompts, p_lens,
                             max_tokens, temp, top_k, top_p, keys, eos,
-                            req_idx, seeded, masks, hist0=None):
+                            req_idx, seeded, masks, *extra):
+                # extra rides the optional data args in a fixed order:
+                # the paged per-row page indices, then the spec
+                # history seed
+                pages = extra[0] if paged else None
+                hist0 = extra[-1] if spec else None
                 # ONE padded forward admits the whole [k, bucket] batch;
                 # row i's logits/KV are exactly its solo prefill_at's
                 blocks, logits0 = gpt.prefill_many(
@@ -567,7 +771,16 @@ class Engine:
                 first_lp = jnp.take_along_axis(
                     jax.nn.log_softmax(logits0, axis=-1),
                     first[:, None], axis=1)[:, 0]
-                cache = gpt.cache_insert_slots(cache, blocks, slots)
+                if paged:
+                    # the paged scatter: row i's bucket columns land
+                    # in its own allocated pages (pad columns reach
+                    # the sink or the row's not-yet-decoded cells —
+                    # masked garbage either way)
+                    cache = gpt.cache_insert_pages(
+                        cache, _pad_span(blocks, n_ins * p_sz), pages,
+                        page_size=p_sz)
+                else:
+                    cache = gpt.cache_insert_slots(cache, blocks, slots)
                 hit_eos = (eos >= 0) & (first == eos)
                 done0 = hit_eos | (max_tokens <= 1)
                 new_state = {
@@ -606,24 +819,27 @@ class Engine:
                           out_specs=out_specs, check_vma=False),
             donate_argnums=donate)
         scalar = P()
+        n_step_args = 2 if paged else 1  # masks (+ tables)
         self._init = sm(init_local, (pspecs,), (cache_spec, state_spec))
         self._step = sm(
-            step_local, (pspecs, cache_spec, state_spec, scalar),
+            step_local,
+            (pspecs, cache_spec, state_spec) + (scalar,) * n_step_args,
             (cache_spec, state_spec, scalar, scalar, scalar),
             donate=(1, 2))
         self._step_spec = None
         if spec:
             self._step_spec = sm(
-                step_spec_local, (pspecs, cache_spec, state_spec,
-                                  scalar),
+                step_spec_local,
+                (pspecs, cache_spec, state_spec)
+                + (scalar,) * n_step_args,
                 (cache_spec, state_spec, scalar, scalar, scalar,
                  scalar),
                 donate=(1, 2))
         # one admission program per (bucket, k) — the k dim and padded
         # width are static shapes, everything request-scoped is data
-        # (spec engines thread one extra data arg: the host-packed
-        # prompt-tail history seed)
-        n_admit_args = 13 if spec else 12
+        # (paged engines thread the per-row page indices, spec engines
+        # the host-packed prompt-tail history seed)
+        n_admit_args = 12 + int(paged) + int(spec)
         self._admits: Dict[Tuple[int, int], Any] = {}
         for bucket in self._buckets:
             fn = make_admit(bucket)
@@ -637,8 +853,125 @@ class Engine:
         self._retire = sm(retire_local, (state_spec, scalar), state_spec,
                           donate=(0,))
 
+        # -- chunked-prefill programs (prefill_chunk > 0) -----------------
+        # chunk 0 is a bucket-sized cold prefill into the compute-dtype
+        # scratch; chunk i attends the scratch's first i*C columns
+        # through gpt.prefill_extend (the prefix-reuse forward — cost
+        # scales with the chunk, and its hit == cold parity contract
+        # makes chunked streams bit-identical to monolithic admission
+        # off-TPU); the finish draws the first token from the final
+        # chunk's logits and quantizes/inserts the whole prompt block
+        # exactly where a cold admission would
+        self._chunk_exts: Dict[int, Any] = {}
+        if self._chunk_size:
+            chunk_c = self._chunk_size
+            mpl = ecfg.max_prompt_len
+            # the scratch stores COMPUTE-dtype K/V (the pool's
+            # master-copy argument: every later chunk must attend the
+            # exact prefix values a cold prefill would see;
+            # quantization happens once at the finish insert)
+            cfg_ext = dataclasses.replace(cfg, kv_cache_dtype="bf16")
+            scratch_spec = gpt.cache_specs(cfg_ext)
+            n_fin = -(-mpl // p_sz) if paged else 0
+
+            def scratch_init_local(params):
+                return gpt.init_cache(cfg_ext, params, 1, max_len=mpl)
+
+            self._chunk_scratch_init = sm(scratch_init_local, (pspecs,),
+                                          scratch_spec)
+
+            def chunk0_local(params, scratch, tokens):
+                blocks, _ = gpt.prefill_many(
+                    cfg_ext, params, tokens,
+                    jnp.full((1,), chunk_c - 1, jnp.int32),
+                    max_len=chunk_c)
+                return gpt.cache_insert_slot(scratch, blocks,
+                                             jnp.int32(0))
+
+            self._chunk0 = sm(chunk0_local,
+                              (pspecs, scratch_spec, scalar),
+                              scratch_spec, donate=(1,))
+
+            def make_chunk_ext(i: int):
+                pfx = i * chunk_c
+
+                def chunk_ext_local(params, scratch, tail, last):
+                    prefix = jax.tree.map(
+                        lambda x: lax.slice_in_dim(x, 0, pfx, axis=4),
+                        scratch)
+                    tail_kv, logits = gpt.prefill_extend(
+                        cfg, params, prefix, tail, last,
+                        prefix_len=pfx)
+                    return (gpt.cache_insert_slot(
+                        scratch, tail_kv, jnp.int32(0), pos=pfx),
+                        logits)
+
+                return chunk_ext_local
+
+            for i in range(1, mpl // chunk_c):
+                self._chunk_exts[i] = sm(
+                    make_chunk_ext(i),
+                    (pspecs, scratch_spec, scalar, scalar),
+                    (scratch_spec, scalar), donate=(1,))
+
+            def chunk_finish_local(params, cache, state, scratch,
+                                   logits0, slots, p_lens, max_tokens,
+                                   temp, top_k, top_p, keys, eos,
+                                   req_idx, seeded, masks, *extra):
+                pages = extra[0] if paged else None
+                hist0 = extra[-1] if spec else None
+                base = jnp.zeros((2,), jnp.uint32)
+                folded = jax.vmap(
+                    lambda i: jax.random.fold_in(base, i))(req_idx)
+                keys = jnp.where(seeded[:, None], keys, folded)
+                # the fold position is p_len - 1, exactly the cold
+                # admission's — same logits (prefill_extend parity),
+                # same fold, same first draw
+                first = sampling.draw_slots(
+                    logits0, keys, p_lens - 1, temp, top_k, top_p,
+                    masks=masks)
+                first_lp = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits0, axis=-1),
+                    first[:, None], axis=1)[:, 0]
+                blk = gpt.quantize_cache_block(cfg, scratch)
+                if paged:
+                    cache = gpt.cache_insert_pages(
+                        cache, _pad_span(blk, n_fin * p_sz), pages,
+                        page_size=p_sz)
+                else:
+                    cache = gpt.cache_insert_slot(cache, blk, slots[0])
+                hit_eos = (eos >= 0) & (first == eos)
+                done0 = hit_eos | (max_tokens <= 1)
+                new_state = {
+                    "tok": state["tok"].at[slots].set(first),
+                    "pos": state["pos"].at[slots].set(p_lens),
+                    "remaining": state["remaining"].at[slots].set(
+                        max_tokens - 1),
+                    "done": state["done"].at[slots].set(done0),
+                    "temp": state["temp"].at[slots].set(temp),
+                    "top_k": state["top_k"].at[slots].set(top_k),
+                    "top_p": state["top_p"].at[slots].set(top_p),
+                    "key": state["key"].at[slots].set(keys),
+                    "eos": state["eos"].at[slots].set(eos),
+                }
+                if spec:
+                    new_state["hist"] = state["hist"].at[slots].set(
+                        jnp.concatenate([hist0, first[:, None]],
+                                        axis=1))
+                return (cache, new_state, first, first_lp, hit_eos,
+                        done0)
+
+            self._chunk_finish = sm(
+                chunk_finish_local,
+                (pspecs, cache_spec, state_spec, scratch_spec)
+                + (scalar,) * (12 + int(paged) + int(spec)),
+                (cache_spec, state_spec, scalar, scalar, scalar,
+                 scalar),
+                donate=(1, 2))
+
         # -- shared-prefix pool programs (prefix_pool_slots > 0) ----------
         self._pool_inserts: Dict[int, Any] = {}
+        self._pool_pageins: Dict[int, Any] = {}
         self._admit_prefix: Dict[Tuple[int, int], Any] = {}
         if not self._prefix_splits:
             return
@@ -684,11 +1017,38 @@ class Engine:
                 (pspecs, pool_spec, scalar, scalar), pool_spec,
                 donate=(1,))
 
+        if paged:
+            # the copy-on-write page-in: quantize a registered
+            # prefix's compute-dtype pool block ONCE into pinned cache
+            # pages (the same quantizer, same input values as a cold
+            # prefill of those positions — so a page-sharing hit reads
+            # bit-identical cache bytes to a PR-7 pooled-slot copy).
+            # Hits then map these pages read-only; no prefix K/V bytes
+            # move at admission time at all.
+            def make_pool_pagein(pb: int):
+                def pool_pagein_local(cache, pool, page, pages):
+                    block = gpt.cache_gather_page(pool, page, pb)
+                    return gpt.cache_insert_pages(
+                        cache, gpt.quantize_cache_block(cfg, block),
+                        pages, page_size=p_sz)
+
+                return pool_pagein_local
+
+            for pb in self._prefix_splits:
+                self._pool_pageins[pb] = sm(
+                    make_pool_pagein(pb),
+                    (cache_spec, pool_spec, scalar, scalar), cache_spec,
+                    donate=(0,))
+
         def make_admit_prefix(ps: int, tb: int):
+            n_tail = -(-tb // p_sz) if paged else 0
+
             def admit_prefix_local(params, cache, state, pool, slots,
                                    tails, t_lens, max_tokens, temp,
                                    top_k, top_p, keys, eos, req_idx,
-                                   seeded, masks, page, hist0=None):
+                                   seeded, masks, page, *extra):
+                pages = extra[0] if paged else None
+                hist0 = extra[-1] if spec else None
                 # the compiled gather: page -> [l, 2, 1, hl, ps, d]
                 # block of EXACT compute-dtype prefix K/V (the pool's
                 # master copy)
@@ -707,17 +1067,34 @@ class Engine:
                 first_lp = jnp.take_along_axis(
                     jax.nn.log_softmax(logits0, axis=-1),
                     first[:, None], axis=1)[:, 0]
-                # the prefix block quantizes at INSERT (same quantizer,
-                # same exact input values as a cold prefill of those
-                # positions), the tail block appends at offset ps —
-                # together exactly the cache bytes a cold admission of
-                # the full prompt would hold
-                cache = gpt.cache_insert_slot(
-                    cache, gpt.quantize_cache_block(cfg, block),
-                    slots[0])
-                cache = gpt.cache_insert_slot(
-                    cache, gpt.quantize_cache_block(cfg, tail_kv),
-                    slots[0], pos=ps)
+                if paged:
+                    # copy-on-write: the prefix pages are SHARED (the
+                    # host mapped them into this slot's table row and
+                    # pinned their refcounts) — only the TAIL block
+                    # moves, into the slot's private pages at the
+                    # page-aligned split offset. The shared pages
+                    # already hold quantize(prefix) from registration
+                    # page-in, so the slot's gathered cache bytes are
+                    # exactly what the contiguous two-insert spelling
+                    # below produces.
+                    cache = gpt.cache_insert_pages(
+                        cache,
+                        _pad_span(gpt.quantize_cache_block(cfg, tail_kv),
+                                  n_tail * p_sz),
+                        pages, page_size=p_sz)
+                else:
+                    # the prefix block quantizes at INSERT (same
+                    # quantizer, same exact input values as a cold
+                    # prefill of those positions), the tail block
+                    # appends at offset ps — together exactly the
+                    # cache bytes a cold admission of the full prompt
+                    # would hold
+                    cache = gpt.cache_insert_slot(
+                        cache, gpt.quantize_cache_block(cfg, block),
+                        slots[0])
+                    cache = gpt.cache_insert_slot(
+                        cache, gpt.quantize_cache_block(cfg, tail_kv),
+                        slots[0], pos=ps)
                 hit_eos = (eos >= 0) & (first == eos)
                 done0 = hit_eos | (max_tokens <= 1)
                 new_state = {
@@ -745,7 +1122,7 @@ class Engine:
             self._admit_prefix[(ps, tb)] = sm(
                 make_admit_prefix(ps, tb),
                 (pspecs, cache_spec, state_spec, pool_spec)
-                + (scalar,) * (14 if spec else 13),
+                + (scalar,) * (13 + int(paged) + int(spec)),
                 (cache_spec, state_spec, scalar, scalar, scalar,
                  scalar),
                 donate=(1, 2))
@@ -779,6 +1156,102 @@ class Engine:
         """Bucket-aligned split points the prefix pool can reuse at
         (ascending; empty when the pool is disabled)."""
         return self._prefix_splits
+
+    # -- paged KV cache (EngineConfig.page_size > 0) -----------------------
+
+    @property
+    def paged(self) -> bool:
+        """True when the cache runs the paged layout."""
+        return self._paged
+
+    @property
+    def page_allocator(self) -> Optional[PageAllocator]:
+        """The refcounted page allocator (None in contiguous mode) —
+        the scheduler's occupancy/fragmentation gauge source."""
+        return self._page_alloc
+
+    @property
+    def max_pages(self) -> int:
+        """Block-table width per slot (``ceil(max_seq_len /
+        page_size)`` — a config-derived constant; 0 in contiguous
+        mode)."""
+        return self._max_pages
+
+    def pages_needed(self, prompt_len: int, max_tokens: int,
+                     prefix_len: int = 0) -> int:
+        """Private pages one admission pins: the request's token
+        footprint (prompt + budget, minus a shared prefix) in pages.
+        0 in contiguous mode — the scheduler's backpressure check is
+        layout-agnostic."""
+        if not self._paged:
+            return 0
+        p = self.engine_cfg.page_size
+        return -(-(prompt_len + max_tokens) // p) - prefix_len // p
+
+    def can_admit_pages(self, prompt_len: int, max_tokens: int,
+                        prefix_len: int = 0) -> bool:
+        """Whether the pool currently has the private pages this
+        admission needs (always True in contiguous mode)."""
+        if not self._paged:
+            return True
+        return self._page_alloc.can_alloc(
+            self.pages_needed(prompt_len, max_tokens, prefix_len))
+
+    def free_slot(self, slot: int) -> None:
+        """Release ``slot``'s page mapping: private pages return to
+        the free list, shared prefix pages drop one pin, and the
+        slot's table row redirects to the sink page (its frozen decode
+        lane keeps writing every chunk — the sink absorbs that). The
+        scheduler calls this at request release; no-op in contiguous
+        mode (slots there are implicitly recycled by the next
+        admission's overwrite)."""
+        if self._paged:
+            self._free_slot_pages(slot)
+
+    def page_stats(self) -> Optional[Dict[str, float]]:
+        """Allocator occupancy snapshot (None in contiguous mode)."""
+        if self._page_alloc is None:
+            return None
+        return self._page_alloc.stats()
+
+    def _free_slot_pages(self, slot: int) -> None:
+        ent = self._slot_pages.pop(slot, None)
+        if ent is None:
+            return
+        priv, shared, footprint = ent
+        self._page_alloc.free(priv)
+        self._page_alloc.free(shared)
+        self._page_alloc.used_tokens -= footprint
+        self._tables[slot, :] = SINK
+        self._tables_dev = None
+
+    def _alloc_slot_pages(self, slot: int, p_len: int, max_tokens: int,
+                          prefix_page: Optional[int] = None,
+                          prefix_len: int = 0) -> np.ndarray:
+        """Map ``slot``'s table row for one admission: pin the shared
+        prefix pages (copy-on-write — refcount, no bytes move),
+        allocate the private tail/decode pages, sink-fill the rest.
+        Raises :class:`PagesExhausted` (before any state change beyond
+        releasing the slot's stale mapping) when the pool is dry.
+        Returns the row."""
+        self._free_slot_pages(slot)
+        p = self.engine_cfg.page_size
+        shared: List[int] = []
+        if prefix_page is not None:
+            shared = list(
+                self._prefix_pages[prefix_page][:prefix_len // p])
+        need = -(-(p_len + max_tokens) // p) - len(shared)
+        priv = self._page_alloc.alloc(need)
+        self._page_alloc.share(shared)
+        row = np.full((self._max_pages,), SINK, np.int32)
+        row[:len(shared)] = shared
+        row[len(shared):len(shared) + need] = priv
+        self._tables[slot] = row
+        self._tables_dev = None
+        footprint = p_len + max_tokens - prefix_len
+        self._page_alloc.used_tokens += footprint
+        self._slot_pages[slot] = (priv, shared, footprint)
+        return self._tables[slot]
 
     def register_prefix(self, tokens) -> int:
         """Prefill a shared prompt prefix (a system-prompt template)
@@ -835,6 +1308,26 @@ class Engine:
             self._prefix_used = 0
             self.pool = self._pool_init(self._params)
             raise
+        if self._paged:
+            # page-in the quantized prefix ONCE into pinned cache
+            # pages — the copy-on-write master every sharing hit maps
+            # read-only (refcount 1 here = the registration pin, so
+            # the pages survive every hit's release)
+            cache_pages = self._page_alloc.alloc(
+                pb // self.engine_cfg.page_size)
+            try:
+                self.cache = self._pool_pageins[pb](
+                    self.cache, self.pool, np.int32(page),
+                    np.asarray([cache_pages], np.int32))
+            except Exception:
+                # the page-in DONATES the cache — a failure may have
+                # consumed it; poison until rebuild_slots() like every
+                # other cache-donating seam
+                self._page_alloc.free(cache_pages)
+                self._poisoned = True
+                raise
+            self._prefix_pages[page] = cache_pages
+            self._page_alloc.used_tokens += pb
         # page committed only after the insert landed — a failed call
         # must not leak the page
         self._prefix_used += 1
@@ -1053,6 +1546,16 @@ class Engine:
         if len(set(slots_used)) != len(slots_used):
             raise ValueError(
                 f"admit_many slots must be distinct, got {slots_used}")
+        if self._paged:
+            # all-or-nothing: refuse the whole batch BEFORE any
+            # dispatch when the pool cannot cover it (conservative —
+            # stale mappings on the target slots are not counted as
+            # reclaimable; the scheduler releases slots first)
+            total = sum(
+                self.pages_needed(n, a.max_tokens, a.prefix_len)
+                for a, (_, n) in zip(items, validated))
+            if not self._page_alloc.can_alloc(total):
+                raise PagesExhausted(total, self._page_alloc.free_pages)
         pending = []  # (device futures, bucket, k, group) per dispatch
         i, group = 0, 0
         while i < len(items):
@@ -1096,8 +1599,19 @@ class Engine:
             masks = np.stack([self._masks[a.slot] for a in batch])
             arr = lambda vals, dt: np.asarray(vals, dt)
             fn = self._admits[(bucket, k)]
-            extra = ((np.stack([self._hist_seed(p) for p, _ in proms]),)
-                     if self._spec else ())
+            extra: Tuple[Any, ...] = ()
+            if self._paged:
+                # map each row's table BEFORE the dispatch that reads
+                # it; the insert writes the first ceil(bucket/P)
+                # entries of each row (sink-padded past the
+                # allocation)
+                n_ins = -(-bucket // self.engine_cfg.page_size)
+                rows = [self._alloc_slot_pages(a.slot, n, a.max_tokens)
+                        for a, (_, n) in zip(batch, proms)]
+                extra += (np.stack([r[:n_ins] for r in rows]),)
+            if self._spec:
+                extra += (np.stack([self._hist_seed(p)
+                                    for p, _ in proms]),)
             self.cache, self.state, first, first_lp, hit_eos, done = fn(
                 self._params, self.cache, self.state,
                 arr([a.slot for a in batch], np.int32), prompts,
@@ -1152,7 +1666,22 @@ class Engine:
         self.set_slot_mask(a.slot, a.allowed_tokens)
         masks = self._masks[a.slot][None]
         fn = self._admit_prefix[(ps, tb)]
-        extra = ((self._hist_seed(prompt)[None],) if self._spec else ())
+        extra: Tuple[Any, ...] = ()
+        if self._paged:
+            # copy-on-write mapping: shared prefix pages pinned into
+            # the row, private pages allocated for the tail + decode;
+            # the insert gets the row entries from the split onward
+            p_szc = self.engine_cfg.page_size
+            row = self._alloc_slot_pages(
+                a.slot, n, a.max_tokens, prefix_page=a.prefix_page,
+                prefix_len=ps)
+            n_tail = -(-tb // p_szc)
+            pages = np.full((n_tail,), SINK, np.int32)
+            avail = row[ps // p_szc: ps // p_szc + n_tail]
+            pages[:avail.size] = avail
+            extra += (pages[None],)
+        if self._spec:
+            extra += (self._hist_seed(prompt)[None],)
         self.cache, self.state, first, first_lp, hit_eos, done = fn(
             self._params, self.cache, self.state, self.pool,
             np.asarray([a.slot], np.int32), tails,
@@ -1165,6 +1694,129 @@ class Engine:
                         else int(a.eos_token_id)], np.int32),
             req_idx, seeded, masks, np.int32(a.prefix_page), *extra)
         return first, first_lp, hit_eos, done
+
+    # -- chunked prefill (EngineConfig.prefill_chunk > 0) ------------------
+
+    @property
+    def chunked_prefill_enabled(self) -> bool:
+        """True when ``EngineConfig.prefill_chunk > 0``."""
+        return self._chunk_size > 0
+
+    def chunked_for(self, prompt_len: int) -> bool:
+        """Whether a prompt of this length admits through chunked
+        prefill (longer than one chunk) instead of :meth:`admit_many`."""
+        return self._chunk_size > 0 and prompt_len > self._chunk_size
+
+    def admit_chunked_start(self, a: Admission) -> ChunkedAdmission:
+        """Begin a chunked-prefill admission: validate, map the slot's
+        pages (paged mode — :class:`PagesExhausted` backpressure fires
+        HERE, before any device work), and dispatch chunk 0 (the
+        bucket-sized cold prefill into the compute-dtype scratch).
+        Exactly one chunked admission may be in progress (the scratch
+        holds one prompt); the scheduler interleaves decode waves
+        between the subsequent :meth:`admit_chunked_step` calls."""
+        self._check_poisoned()
+        if not self._chunk_size:
+            raise ValueError(
+                "chunked prefill disabled "
+                "(EngineConfig.prefill_chunk == 0)")
+        if self._chunked is not None:
+            raise RuntimeError(
+                "a chunked admission is already in progress — the "
+                "scratch buffer holds one prompt at a time")
+        if a.prefix_page is not None:
+            raise ValueError(
+                "chunked prefill does not compose with prefix-pool "
+                "hits (a hit already skips the prefix forward — "
+                "nothing long is left to chunk)")
+        prompt, n = self._validate_admission(a)
+        if n <= self._chunk_size:
+            raise ValueError(
+                f"prompt of {n} tokens fits one {self._chunk_size}-"
+                f"token chunk — use admit_many")
+        if self._paged:
+            self._alloc_slot_pages(a.slot, n, a.max_tokens)
+        c = self._chunk_size
+        ca = ChunkedAdmission(a, prompt, n, -(-n // c))
+        tok0 = prompt[:c].astype(np.int32)[None]
+        try:
+            self._chunk_scratch = self._chunk0(
+                self._params, self._chunk_scratch, tok0)
+        except Exception:
+            # scratch donated into the failing call
+            self._poisoned = True
+            raise
+        self._chunked = ca
+        return ca
+
+    def admit_chunked_step(self, ca: ChunkedAdmission
+                           ) -> Optional[AdmitResult]:
+        """Advance one chunked admission by ONE device dispatch: the
+        next ``prefill_extend`` chunk while prefilling (returns None),
+        then the finish — first-token draw + whole-prompt cache insert
+        + slot-state scatter — returning the :class:`AdmitResult`.
+        The scheduler runs decode waves between calls; that is the
+        entire stall-free-admission mechanism."""
+        self._check_poisoned()
+        if ca is not self._chunked:
+            raise ValueError(
+                "stale ChunkedAdmission — not the one in progress")
+        c = self._chunk_size
+        a = ca.admission
+        if not ca.done_prefilling:
+            i = ca.next_chunk
+            chunk = ca.prompt[i * c: min((i + 1) * c, ca.p_len)]
+            tail = np.full((1, c), self.engine_cfg.pad_token_id,
+                           np.int32)
+            tail[0, :chunk.size] = chunk
+            try:
+                self._chunk_scratch, ca._logits = self._chunk_exts[i](
+                    self._params, self._chunk_scratch, tail,
+                    np.asarray([chunk.size - 1], np.int32))
+            except Exception:
+                self._poisoned = True
+                self._chunked = None
+                raise
+            ca.next_chunk += 1
+            return None
+        # the finish dispatch — the admission's only cache/state write
+        keys = (_threefry_key_data(a.seed) if a.seed is not None
+                else np.zeros((2,), np.uint32))[None]
+        seeded = np.asarray([a.seed is not None], bool)
+        req_idx = np.asarray([self._req_counter], np.int32)
+        self._req_counter += 1
+        self.set_slot_mask(a.slot, a.allowed_tokens)
+        masks = self._masks[a.slot][None]
+        extra: Tuple[Any, ...] = ()
+        if self._paged:
+            n_fin = -(-self.engine_cfg.max_prompt_len
+                      // self.engine_cfg.page_size)
+            extra += (self._tables[a.slot][:n_fin][None],)
+        if self._spec:
+            extra += (self._hist_seed(ca.prompt)[None],)
+        try:
+            self.cache, self.state, first, first_lp, hit_eos, done = \
+                self._chunk_finish(
+                    self._params, self.cache, self.state,
+                    self._chunk_scratch, ca._logits,
+                    np.asarray([a.slot], np.int32),
+                    np.asarray([ca.p_len], np.int32),
+                    np.asarray([a.max_tokens], np.int32),
+                    np.asarray([a.temperature], np.float32),
+                    np.asarray([a.top_k], np.int32),
+                    np.asarray([a.top_p], np.float32), keys,
+                    np.asarray([_NO_EOS if a.eos_token_id is None
+                                else int(a.eos_token_id)], np.int32),
+                    req_idx, seeded, masks, *extra)
+        except Exception:
+            self._poisoned = True
+            self._chunked = None
+            raise
+        self._chunked = None
+        return AdmitResult(
+            int(np.asarray(first)[0]), bool(np.asarray(hit_eos)[0]),
+            bool(np.asarray(done)[0]), bucket=c, batch_size=1,
+            group=0, logprob=float(np.asarray(first_lp)[0]))
 
     def _hist_seed(self, prompt) -> np.ndarray:
         """The drafter-ring admission seed for one prompt: its last
@@ -1205,18 +1857,27 @@ class Engine:
                 "step_async(spec=True) needs EngineConfig.spec_k > 0")
         if self._masks_dev is None:
             self._masks_dev = jnp.asarray(self._masks)
+        step_extra: Tuple[Any, ...] = ()
+        if self._paged:
+            # the block tables ride every dispatch as DATA (one static
+            # [B, max_pages] int32 argument — same contract as the
+            # masks; the device copy is cached until a row changes)
+            if self._tables_dev is None:
+                self._tables_dev = jnp.asarray(self._tables)
+            step_extra = (self._tables_dev,)
         chunk = self.engine_cfg.decode_chunk
         valid = None
         if spec:
             (self.cache, self.state, emit, logprobs, finished,
              valid) = self._step_spec(
-                self._params, self.cache, self.state, self._masks_dev)
+                self._params, self.cache, self.state, self._masks_dev,
+                *step_extra)
             spec_k = self.engine_cfg.spec_k
             ncols = chunk * (spec_k + 1)
         else:
             self.cache, self.state, emit, logprobs, finished = \
                 self._step(self._params, self.cache, self.state,
-                           self._masks_dev)
+                           self._masks_dev, *step_extra)
             spec_k, ncols = 0, chunk
         plan = None if self._warming else self.fault_plan
         return StepHandle(emit, logprobs, finished, plan=plan,
@@ -1315,7 +1976,25 @@ class Engine:
         never donated to a failing step/admit call, so registered
         templates survive recovery and replayed prefix hits reuse
         them."""
+        if self._paged:
+            # slot mappings die with the slots; registered prefixes
+            # keep their registration pin (the pool block survives,
+            # and the quantized page-in is replayed below into the
+            # fresh cache)
+            for slot in list(self._slot_pages):
+                self._free_slot_pages(slot)
+            self._tables[:, :] = SINK
+            self._tables_dev = None
+        self._chunked = None
         self.cache, self.state = self._init(self._params)
+        if self._chunk_size:
+            self._chunk_scratch = self._chunk_scratch_init(self._params)
+        if self._paged and self._prefix_pages:
+            for page in sorted(self._prefix_pages):
+                pb = len(self._prefix_tokens[page])
+                self.cache = self._pool_pageins[pb](
+                    self.cache, self.pool, np.int32(page),
+                    np.asarray([self._prefix_pages[page]], np.int32))
         self._masks[:, :] = True
         self._masks_dev = None
         self._poisoned = False
@@ -1345,6 +2024,11 @@ class Engine:
         hseed = lambda k: (
             (np.full((k, ecfg.spec_hist - 1), -1, np.int32),)
             if self._spec else ())
+        # paged warm args: sink-page indices — every warmup insert
+        # lands in the garbage page, so no allocator state is touched
+        wpages = lambda k, span: (
+            (np.full((k, -(-span // ecfg.page_size)), SINK, np.int32),)
+            if self._paged else ())
         for (bucket, k), fn in sorted(self._admits.items()):
             # dummy args exercise shapes only: k pad-token prompts of
             # length 1, budget 1 (done at admission), no sampling
@@ -1358,7 +2042,34 @@ class Engine:
                 np.zeros((k, 2), np.uint32),
                 np.full((k,), _NO_EOS, np.int32),
                 np.zeros((k,), np.int32), np.zeros((k,), bool),
-                np.ones((k, self.cfg.vocab_size), bool), *hseed(k))
+                np.ones((k, self.cfg.vocab_size), bool),
+                *wpages(k, bucket), *hseed(k))
+            np.asarray(first)
+        if self._chunk_size:
+            # the chunked-prefill ladder: chunk 0, every extend
+            # variant, then the finish — junk tokens, logits flow
+            # through so the finish compiles against the real dtypes
+            c = self._chunk_size
+            self._chunk_scratch = self._chunk0(
+                self._params, self._chunk_scratch,
+                np.full((1, c), ecfg.pad_token_id, np.int32))
+            lg = None
+            for i, fn in sorted(self._chunk_exts.items()):
+                self._chunk_scratch, lg = fn(
+                    self._params, self._chunk_scratch,
+                    np.full((1, c), ecfg.pad_token_id, np.int32),
+                    np.zeros((1,), np.int32))
+            self.cache, self.state, first, _, _, _ = self._chunk_finish(
+                self._params, self.cache, self.state,
+                self._chunk_scratch, lg,
+                np.zeros((1,), np.int32),
+                np.full((1,), 2, np.int32), np.ones((1,), np.int32),
+                np.zeros((1,), np.float32), np.zeros((1,), np.int32),
+                np.ones((1,), np.float32), np.zeros((1, 2), np.uint32),
+                np.full((1,), _NO_EOS, np.int32),
+                np.zeros((1,), np.int32), np.zeros((1,), bool),
+                np.ones((1, self.cfg.vocab_size), bool),
+                *wpages(1, ecfg.max_prompt_len), *hseed(1))
             np.asarray(first)
         # prefix pool: compile every pool-insert and (split, tail
         # bucket) extend variant against page 0 junk
@@ -1372,6 +2083,9 @@ class Engine:
             self.pool = fn(self._params, self.pool,
                            np.full((1, pb), ecfg.pad_token_id,
                                    np.int32), np.int32(0))
+        for pb, fn in sorted(self._pool_pageins.items()):
+            self.cache = fn(self.cache, self.pool, np.int32(0),
+                            *wpages(1, pb))
         for (ps, tb), fn in sorted(self._admit_prefix.items()):
             self.cache, self.state, first, _, _, _ = fn(
                 self._params, self.cache, self.state, self.pool,
@@ -1383,7 +2097,7 @@ class Engine:
                 np.full((1,), _NO_EOS, np.int32),
                 np.zeros((1,), np.int32), np.zeros((1,), bool),
                 np.ones((1, self.cfg.vocab_size), bool), np.int32(0),
-                *hseed(1))
+                *wpages(1, tb), *hseed(1))
             np.asarray(first)
         handle = self.step_async()
         handle.fetch()
@@ -1396,6 +2110,17 @@ class Engine:
         # drop the warmup junk: a fresh init (compiled at construction)
         # frees every slot again
         self.cache, self.state = self._init(self._params)
+        if self._chunk_size:
+            self._chunk_scratch = self._chunk_scratch_init(self._params)
+            self._chunked = None
+        if self._paged:
+            # warmup only ever wrote sink pages, but reset the host
+            # mappings anyway so registration starts from a clean pool
+            self._page_alloc.reset()
+            self._tables[:, :] = SINK
+            self._tables_dev = None
+            self._slot_pages.clear()
+            self._prefix_pages.clear()
         if self._prefix_splits:
             # warmup wrote junk into pool page 0 — reset the pool AND
             # the host registry, so templates register on clean pages
@@ -1418,8 +2143,24 @@ class Engine:
             items.append(("pool_init", self._pool_init))
             for pb, fn in sorted(self._pool_inserts.items()):
                 items.append((f"pool_p{pb}", fn))
+            for pb, fn in sorted(self._pool_pageins.items()):
+                items.append((f"pool_pagein_p{pb}", fn))
             for (ps, tb), fn in sorted(self._admit_prefix.items()):
                 items.append((f"admit_prefix_p{ps}_t{tb}", fn))
+        return items
+
+    def _chunk_program_items(self):
+        """(name, compiled fn) for every chunked-prefill program —
+        shared by :meth:`compiled_cache_sizes` and the recompile
+        sentinel, same contract as :meth:`_prefix_program_items`."""
+        items = []
+        if self._chunk_size:
+            items.append(("chunk_scratch_init",
+                          self._chunk_scratch_init))
+            items.append(("chunk0", self._chunk0))
+            for i, fn in sorted(self._chunk_exts.items()):
+                items.append((f"chunk_ext_{i}", fn))
+            items.append(("chunk_finish", self._chunk_finish))
         return items
 
     def compiled_cache_sizes(self) -> Dict[str, Any]:
@@ -1444,7 +2185,8 @@ class Engine:
             out[self._admit_variant_name(bucket, k)] = s
             if s is not None:
                 admit_sizes.append(s)
-        for name, fn in self._prefix_program_items():
+        for name, fn in (self._prefix_program_items()
+                         + self._chunk_program_items()):
             s = size_of(fn)
             out[name] = s
             if s is not None and name.startswith("admit_prefix"):
@@ -1483,7 +2225,8 @@ class Engine:
                 sentinel.track(name, getattr(self, f"_{name}"))
             for (bucket, k), fn in sorted(self._admits.items()):
                 sentinel.track(self._admit_variant_name(bucket, k), fn)
-            for name, fn in self._prefix_program_items():
+            for name, fn in (self._prefix_program_items()
+                             + self._chunk_program_items()):
                 sentinel.track(name, fn)
             self._sentinel = sentinel
         return self._sentinel

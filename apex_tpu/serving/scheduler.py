@@ -82,7 +82,13 @@ import time
 from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from apex_tpu import profiler
-from apex_tpu.serving.engine import Admission, Engine, StepHandle
+from apex_tpu.serving.engine import (
+    Admission,
+    ChunkedAdmission,
+    Engine,
+    StepHandle,
+)
+from apex_tpu.serving.pages import PagesExhausted
 from apex_tpu.serving.request import (
     FINISH_EOS,
     FINISH_ERROR,
@@ -355,6 +361,40 @@ class _RegistryMetrics:
             "device bytes held by the slot KV cache (quantized data + "
             "scale planes under a quantized kv_cache_dtype)"
         ).set(engine.cache_bytes())
+        # -- paged KV cache (EngineConfig.page_size) ----------------------
+        # pre-created even for contiguous engines (explicit zeros in
+        # scrapes, same convention as every ladder counter above)
+        self.pages_in_use = registry.gauge(
+            "serving_pages_in_use",
+            "KV-cache pages currently allocated (paged layout; 0 under "
+            "the contiguous layout)")
+        self.pages_free = registry.gauge(
+            "serving_pages_free",
+            "KV-cache pages on the free list (paged layout)")
+        self.pages_shared = registry.gauge(
+            "serving_pages_shared",
+            "KV-cache pages pinned by more than one holder — "
+            "copy-on-write prefix pages with live sharers")
+        self.page_fragmentation = registry.gauge(
+            "serving_page_fragmentation",
+            "internal fragmentation of the allocated pages: 1 - "
+            "used_tokens / (pages_in_use * page_size)")
+        self.page_share_hits = registry.counter(
+            "serving_page_share_hits_total",
+            "admissions that mapped a registered prefix's pages "
+            "copy-on-write instead of copying prefix K/V bytes")
+        self.pages_exhausted = registry.counter(
+            "serving_pages_exhausted_total",
+            "admission waves deferred because the page pool had fewer "
+            "free pages than the head request needed (backpressure — "
+            "the request stays queued)")
+        self.chunked_chunks = registry.counter(
+            "serving_chunked_prefill_chunks_total",
+            "chunked-prefill chunk forwards dispatched (long-prompt "
+            "admissions interleaved with decode waves)")
+        self.chunked_admissions = registry.counter(
+            "serving_chunked_admissions_total",
+            "requests admitted through the chunked-prefill path")
         self.prefix_hits = registry.counter(
             "serving_prefix_hits_total",
             "submitted requests that matched a pooled shared prefix "
@@ -565,6 +605,20 @@ class Scheduler:
         self._prefix_hits: Dict[str, Tuple[int, int]] = {}
         self._prefix_hit_count = 0
         self._prefix_miss_count = 0
+        #: the in-flight chunked-prefill admission (one at a time —
+        #: the engine's scratch holds one prompt): (progress, request).
+        #: Each tick advances it ONE chunk forward before the decode
+        #: dispatch, so a long prompt's ingestion interleaves with
+        #: everyone else's decode waves instead of stalling them.
+        #: ``_chunked_fresh`` marks the start tick — chunk 0 was this
+        #: tick's one chunk dispatch, so _advance_chunked must not add
+        #: a second
+        self._chunked: Optional[Tuple[ChunkedAdmission, Request]] = None
+        self._chunked_fresh = False
+        self._chunked_admissions = 0
+        self._chunked_chunks = 0
+        self._page_share_hits = 0
+        self._pages_exhausted_waits = 0
         self._steps = 0
         self._tokens_emitted = 0
         self._admitted_requests = 0
@@ -696,6 +750,21 @@ class Scheduler:
             if self.telemetry is not None:
                 (self.telemetry.prefix_hits if hit is not None
                  else self.telemetry.prefix_misses).inc()
+        if self.engine.paged:
+            # a request that could NEVER fit the pool (even with every
+            # other slot free) would wait at the queue head forever —
+            # reject loudly at submit instead; transient exhaustion is
+            # the normal backpressure path. The need is the PRIVATE
+            # footprint — a prefix hit's shared pages are pinned, not
+            # allocated (checked AFTER match_prefix so a CoW-discounted
+            # request that fits is never falsely rejected)
+            needed = self._request_pages_needed(request)
+            if needed > self.engine.page_allocator.capacity:
+                raise ValueError(
+                    f"request needs {needed} pages but the pool only "
+                    f"has {self.engine.page_allocator.capacity} — "
+                    f"raise EngineConfig.num_pages or shrink the "
+                    f"request")
         self._record_request(request, now)
         self.queue.append(request)
         if rec is not None:
@@ -730,7 +799,14 @@ class Scheduler:
             self._started = now
         self._poll_guard_alarms()
         self._expire(now)
+        # admissions FIRST, then one chunk of any in-progress chunked
+        # prefill, then the decode dispatch: a short prompt's
+        # admission never queues behind this tick's chunk forward, so
+        # the long admission inflates nobody's TTFT — the interleave
+        # that keeps a 32k-token admission from stalling every other
+        # stream
         self._admit_queued(now)
+        self._advance_chunked(now)
         dispatched = bool(self.active) and self._dispatch_chunk()
         keep = self.pipeline_depth - 1 if dispatched else 0
         while len(self._inflight) > keep:
@@ -740,6 +816,13 @@ class Scheduler:
             self.telemetry.steps.inc()
             self.telemetry.queue_depth.set(len(self.queue))
             self.telemetry.active_slots.set(len(self.active))
+            if self.engine.paged:
+                ps = self.engine.page_stats()
+                self.telemetry.pages_in_use.set(ps["pages_in_use"])
+                self.telemetry.pages_free.set(ps["pages_free"])
+                self.telemetry.pages_shared.set(ps["pages_shared"])
+                self.telemetry.page_fragmentation.set(
+                    ps["fragmentation"])
         if self.metrics is not None:
             elapsed = max(self.clock() - self._started, 1e-9)
             self.metrics.log(self._steps, {
@@ -768,7 +851,8 @@ class Scheduler:
         backoff and nothing is in flight, waits out the earliest gate
         via ``sleep`` instead of spinning."""
         steps = 0
-        while self.queue or self.active or self._inflight:
+        while (self.queue or self.active or self._inflight
+               or self._chunked is not None):
             self.step()
             steps += 1
             if steps > max_steps:
@@ -787,10 +871,11 @@ class Scheduler:
         return out
 
     def idle(self) -> bool:
-        """True when there is nothing to do — queue, slots, and the
-        pipeline are all empty (the API driver thread sleeps instead of
-        spinning ticks)."""
-        return not (self.queue or self.active or self._inflight)
+        """True when there is nothing to do — queue, slots, pipeline,
+        and any chunked admission are all empty (the API driver thread
+        sleeps instead of spinning ticks)."""
+        return not (self.queue or self.active or self._inflight
+                    or self._chunked is not None)
 
     def overload_hint_s(self) -> float:
         """The queue-drain estimate behind :class:`QueueFull`'s
@@ -821,7 +906,8 @@ class Scheduler:
     def _backoff_wait_s(self) -> Optional[float]:
         """Seconds until the earliest retry-backoff gate opens, when
         that is the ONLY remaining work (else None)."""
-        if self.active or self._inflight or not self.queue:
+        if self.active or self._inflight or self._chunked is not None \
+                or not self.queue:
             return None
         now = self.clock()
         waits = []
@@ -1265,6 +1351,14 @@ class Scheduler:
             (act.request, act)
             for _, act in sorted(self.active.items())]
         interrupted += [(r, None) for r in batch_reqs]
+        if self._chunked is not None:
+            # a mid-chunked fault: the half-ingested prompt replays
+            # from scratch like any other interrupted request
+            ca, cr = self._chunked
+            self._chunked = None
+            if all(r.request_id != cr.request_id
+                   for r, _ in interrupted):
+                interrupted.append((cr, None))
         self.active.clear()
         self._reset_free()
         # always rebuild: even when the fault was detected host-side
@@ -1345,6 +1439,12 @@ class Scheduler:
         for slot, act in sorted(self.active.items()):
             self._abort(act.request, FINISH_ERROR, now, act=act,
                         error=cause)
+            self.engine.free_slot(slot)
+        if self._chunked is not None:
+            ca, cr = self._chunked
+            self._chunked = None
+            self.engine.free_slot(ca.slot)
+            self._abort(cr, FINISH_ERROR, now, error=cause)
         self.active.clear()
         self._reset_free()
         for r in self.queue:
@@ -1606,6 +1706,169 @@ class Scheduler:
 
     # -- admission ----------------------------------------------------------
 
+    def _admission_of(self, r: Request, slot: int) -> Admission:
+        """Build one :class:`Admission` row from a request (shared by
+        the batched, prefix-hit, and chunked admission paths so they
+        can never disagree on the sampling surface)."""
+        hit = self._prefix_hits.get(r.request_id)
+        return Admission(
+            slot=slot, prompt=r.prompt,
+            max_tokens=r.max_tokens,
+            temperature=r.sampling.temperature,
+            top_k=r.sampling.top_k,
+            top_p=r.sampling.top_p,
+            seed=r.sampling.seed,
+            eos_token_id=r.eos_token_id,
+            allowed_tokens=(
+                tuple(r.constraint.allowed_tokens())
+                if r.constraint is not None else None),
+            prefix_page=None if hit is None else hit[0],
+            prefix_len=0 if hit is None else hit[1])
+
+    def _request_pages_needed(self, r: Request) -> int:
+        """One request's PRIVATE page need — copy-on-write prefix
+        pages discounted (they pin, they don't allocate). The one
+        spelling submit's never-fits guard, the admission page gate,
+        and the backpressure telemetry all share."""
+        hit = self._prefix_hits.get(r.request_id)
+        return self.engine.pages_needed(
+            len(r.prompt), r.max_tokens, 0 if hit is None else hit[1])
+
+    def _note_pages_exhausted(self, r: Request, needed: int) -> None:
+        """Backpressure, not a fault: the head request waits queued
+        until releases free enough pages (an ingress layer sees the
+        pressure as queue growth → :class:`QueueFull` 429s)."""
+        self._pages_exhausted_waits += 1
+        if self.recorder is not None:
+            self.recorder.record(
+                "pages_exhausted", r.request_id, needed,
+                self.engine.page_allocator.free_pages)
+        if self.telemetry is not None:
+            self.telemetry.pages_exhausted.inc()
+
+    def _advance_chunked(self, now: float) -> None:
+        """Drive the in-progress chunked-prefill admission one device
+        dispatch forward (one ``prefill_extend`` chunk, or the
+        finish). Decode dispatch follows in the same tick, so chunks
+        and decode waves strictly alternate."""
+        if self._chunked is None:
+            return
+        if self._chunked_fresh:
+            # chunk 0 was dispatched by _start_chunked THIS tick —
+            # one chunk forward per tick, strictly
+            self._chunked_fresh = False
+            return
+        ca, r = self._chunked
+        rec = self.recorder
+        try:
+            res = self.engine.admit_chunked_step(ca)
+        except Exception as e:
+            self._chunked = None
+            self._recover(self.clock(), cause="admit", detail=str(e),
+                          affected=[r], batch_reqs=[r])
+            return
+        if res is None:
+            self._chunked_chunks += 1
+            if rec is not None:
+                rec.record("prefill_chunk", r.request_id,
+                           ca.next_chunk - 1, ca.chunks_total)
+            if self.telemetry is not None:
+                self.telemetry.chunked_chunks.inc()
+            return
+        # the finish landed: the request occupies its slot from here on
+        # — exactly the bookkeeping one _admit_queued row gets
+        self._chunked = None
+        t_first = self.clock()
+        vocab = self.engine.cfg.vocab_size
+        if not 0 <= res.first_token < vocab:
+            self._recover(t_first, cause="invalid_token",
+                          detail="invalid first token from chunked "
+                          "admission (NaN-poisoned prefill)",
+                          affected=[r], batch_reqs=[r])
+            return
+        slot = ca.slot
+        self._chunked_admissions += 1
+        self._admitted_requests += 1
+        self._admit_dispatches += 1
+        st = self._replay.get(r.request_id)
+        act = _Active(r)
+        act.suppress = 0 if st is None else len(st.tokens)
+        act.first_token_time = t_first
+        self.active[slot] = act
+        if rec is not None:
+            rec.record("admit", r.request_id, slot, res.bucket,
+                       res.batch_size, res.group, 0)
+        tele = self.telemetry
+        if tele is not None:
+            tele.admitted.inc()
+            tele.chunked_admissions.inc()
+            tele.admit_dispatches.inc()
+            if res.bucket in tele.bucket:
+                tele.bucket[res.bucket].inc()
+        if act.suppress < 1:
+            self.ttft_stats.add(t_first - r.arrival_time)
+            if self.spans is not None:
+                self.spans.mark(r.request_id,
+                                spans_mod.PHASE_FIRST_TOKEN)
+            if tele is not None:
+                tele.ttft.observe(t_first - r.arrival_time)
+        reason = None
+        if res.finished:
+            reason = FINISH_EOS if res.hit_eos else FINISH_LENGTH
+        self._ingest(slot, act, res.first_token, res.logprob, t_first,
+                     device_done=res.finished, device_reason=reason)
+
+    def _start_chunked(self, now: float) -> None:
+        """Begin a chunked admission for the queue head when it
+        qualifies: chunked prefill enabled, prompt longer than one
+        chunk, no prefix-pool hit (a hit already skips the long
+        forward), none already in progress, and a free slot + pages."""
+        if (self._chunked is not None
+                or not self.engine.chunked_prefill_enabled
+                or not self._free or not self.queue):
+            return
+        r = self.queue[0]
+        if not self.engine.chunked_for(len(r.prompt)) \
+                or r.request_id in self._prefix_hits:
+            return
+        st = self._replay.get(r.request_id)
+        if st is not None and now < st.not_before:
+            return
+        needed = self.engine.pages_needed(len(r.prompt), r.max_tokens)
+        if not self.engine.can_admit_pages(len(r.prompt), r.max_tokens):
+            self._note_pages_exhausted(r, needed)
+            return
+        self.queue.popleft()
+        slot = self._free.pop()
+        if r.constraint is not None:
+            r.constraint.reset()
+        if self.spans is not None:
+            self.spans.mark(r.request_id, spans_mod.PHASE_PREFILL,
+                            note=f"slot {slot} (chunked)")
+        try:
+            ca = self.engine.admit_chunked_start(
+                self._admission_of(r, slot))
+        except PagesExhausted as e:
+            # a stale mapping race — requeue, the slot returns free
+            self._free.append(slot)
+            self.queue.appendleft(r)
+            self._note_pages_exhausted(r, e.requested)
+            return
+        except Exception as e:
+            self._free.append(slot)
+            self._recover(self.clock(), cause="admit", detail=str(e),
+                          affected=[r], batch_reqs=[r])
+            return
+        self._chunked = (ca, r)
+        self._chunked_fresh = True
+        self._chunked_chunks += 1
+        if self.recorder is not None:
+            self.recorder.record("prefill_chunk", r.request_id, 0,
+                                 ca.chunks_total)
+        if self.telemetry is not None:
+            self.telemetry.chunked_chunks.inc()
+            self.telemetry.queue_depth.set(len(self.queue))
+
     def _pop_eligible(self, now: float, n: int) -> List[Request]:
         """Pop up to ``n`` queued requests whose retry-backoff gate
         (if any) has opened, preserving queue order for the rest —
@@ -1617,19 +1880,67 @@ class Scheduler:
             st = self._replay.get(r.request_id)
             if st is not None and now < st.not_before:
                 skipped.append(r)
+            elif self.engine.chunked_for(len(r.prompt)) \
+                    and r.request_id not in self._prefix_hits:
+                # chunked-eligible prompts admit through the chunked
+                # path only (one at a time — _start_chunked); batching
+                # one here would be exactly the monolithic long-prefill
+                # stall chunking exists to remove
+                skipped.append(r)
             else:
                 picked.append(r)
         self.queue.extendleft(reversed(skipped))
         return picked
 
     def _admit_queued(self, now: float) -> None:
-        while self._free and self.queue:
-            n = min(len(self._free), len(self.queue))
+        # batched short admissions first, chunked start last: the wave
+        # of shorts must not queue behind chunk 0's forward (see
+        # step()'s ordering note)
+        self._admit_batches(now)
+        self._start_chunked(now)
+
+    def _chunked_head_pending(self) -> bool:
+        """A chunked-eligible request heads the queue with none in
+        progress — `_admit_batches` keeps one slot free for it (shorts
+        admit first within a tick, but must not STARVE the long under
+        sustained short traffic)."""
+        if self._chunked is not None or not self.queue \
+                or not self.engine.chunked_prefill_enabled:
+            return False
+        head = self.queue[0]
+        return (self.engine.chunked_for(len(head.prompt))
+                and head.request_id not in self._prefix_hits)
+
+    def _admit_batches(self, now: float) -> None:
+        while self.queue:
+            reserve = 1 if self._chunked_head_pending() else 0
+            if len(self._free) <= reserve:
+                return
+            n = min(len(self._free) - reserve, len(self.queue))
             if self.max_admit_batch is not None:
                 n = min(n, self.max_admit_batch)
             reqs = self._pop_eligible(now, n)
             if not reqs:
-                return  # whole queue gated on retry backoff
+                return  # queue gated on backoff / the chunked path
+            if self.engine.paged:
+                # allocator backpressure, FIFO-strict: admit the
+                # prefix of the wave the free pages cover; the first
+                # request that does not fit (and everything behind it)
+                # stays queued until releases free pages
+                free_p = self.engine.page_allocator.free_pages
+                needed, cut, cut_need = 0, len(reqs), 0
+                for idx, r in enumerate(reqs):
+                    need = self._request_pages_needed(r)
+                    if needed + need > free_p:
+                        cut, cut_need = idx, need
+                        break
+                    needed += need
+                if cut < len(reqs):
+                    self.queue.extendleft(reversed(reqs[cut:]))
+                    if cut == 0:
+                        self._note_pages_exhausted(reqs[0], cut_need)
+                        return
+                    reqs = reqs[:cut]
             slots = [self._free.pop() for _ in range(len(reqs))]
             if self.spans is not None:
                 for r, slot in zip(reqs, slots):
@@ -1643,26 +1954,20 @@ class Scheduler:
                     r.constraint.reset()
             t_admit = self.clock()
 
-            def _admission(r: Request, slot: int) -> Admission:
-                hit = self._prefix_hits.get(r.request_id)
-                return Admission(
-                    slot=slot, prompt=r.prompt,
-                    max_tokens=r.max_tokens,
-                    temperature=r.sampling.temperature,
-                    top_k=r.sampling.top_k,
-                    top_p=r.sampling.top_p,
-                    seed=r.sampling.seed,
-                    eos_token_id=r.eos_token_id,
-                    allowed_tokens=(
-                        tuple(r.constraint.allowed_tokens())
-                        if r.constraint is not None else None),
-                    prefix_page=None if hit is None else hit[0],
-                    prefix_len=0 if hit is None else hit[1])
-
             try:
                 results = self.engine.admit_many([
-                    _admission(r, slot)
+                    self._admission_of(r, slot)
                     for r, slot in zip(reqs, slots)])
+            except PagesExhausted:
+                # backpressure raced the pre-flight check (a stale
+                # mapping, a share) — requeue and wait, no fault; the
+                # event records the HEAD's own need (the exception's
+                # `requested` is the whole batch's total)
+                self._free.extend(reversed(slots))
+                self.queue.extendleft(reversed(reqs))
+                self._note_pages_exhausted(
+                    reqs[0], self._request_pages_needed(reqs[0]))
+                return
             except Exception as e:  # device error escaping the admit
                 self._recover(self.clock(), cause="admit", detail=str(e),
                               affected=list(reqs), batch_reqs=list(reqs))
@@ -1697,11 +2002,21 @@ class Scheduler:
                 act.suppress = 0 if st is None else len(st.tokens)
                 act.first_token_time = t_first
                 self.active[slot] = act
+                hit = self._prefix_hits.get(r.request_id)
                 if rec is not None:
-                    hit = self._prefix_hits.get(r.request_id)
                     rec.record("admit", r.request_id, slot, res.bucket,
                                res.batch_size, res.group,
                                0 if hit is None else hit[1])
+                if hit is not None and self.engine.paged:
+                    # the hit mapped the prefix's pages copy-on-write
+                    # — zero prefix bytes moved at admission
+                    self._page_share_hits += 1
+                    if rec is not None:
+                        rec.record(
+                            "page_share", r.request_id,
+                            hit[1] // self.engine.engine_cfg.page_size)
+                    if tele is not None:
+                        tele.page_share_hits.inc()
                 if tele is not None:
                     tele.admitted.inc()
                     tele.admit_batch[res.batch_size].inc()
@@ -1736,6 +2051,10 @@ class Scheduler:
     def _release(self, slot: int, reason: str) -> None:
         act = self.active.pop(slot)
         self._free.append(slot)
+        # paged: the slot's private pages return to the pool and its
+        # table row redirects to the sink — this release is what frees
+        # capacity for the backpressured queue head
+        self.engine.free_slot(slot)
         now = self.clock()
         ttft = (None if act.first_token_time is None
                 else act.first_token_time - act.request.arrival_time)
@@ -1825,6 +2144,20 @@ class Scheduler:
             "prefix_hits": float(self._prefix_hit_count),
             "prefix_misses": float(self._prefix_miss_count),
         }
+        if self.engine.paged:
+            # paged-cache capacity: occupancy, CoW sharing, chunked
+            # admissions, and backpressure waits this run
+            ps = self.engine.page_stats()
+            out["pages_total"] = ps["pages_total"]
+            out["pages_in_use"] = ps["pages_in_use"]
+            out["pages_shared"] = ps["pages_shared"]
+            out["page_fragmentation"] = ps["fragmentation"]
+            out["page_share_hits"] = float(self._page_share_hits)
+            out["pages_exhausted_waits"] = float(
+                self._pages_exhausted_waits)
+        if self.engine.chunked_prefill_enabled:
+            out["chunked_admissions"] = float(self._chunked_admissions)
+            out["chunked_chunks"] = float(self._chunked_chunks)
         if self._gate is not None:
             # speculative decoding: per-wave accounting + gate state
             out["spec_chunks"] = float(self._spec_chunks)

@@ -61,6 +61,10 @@ EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
     # -- admission ---------------------------------------------------------
     "admit": ("request_id", "slot", "bucket", "batch_size", "group",
               "prefix_split"),
+    # -- paged KV cache + chunked prefill ----------------------------------
+    "page_share": ("request_id", "shared_pages"),
+    "pages_exhausted": ("request_id", "needed", "free"),
+    "prefill_chunk": ("request_id", "chunk", "chunks_total"),
     # -- the decode loop ---------------------------------------------------
     "dispatch": ("spec", "ncols", "inflight", "active_slots"),
     "fetch": ("spec", "ncols", "wall_s", "live_rows"),

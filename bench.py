@@ -37,8 +37,8 @@ BASELINE_TOKENS_PER_SEC = 58600.0
 
 #: stable trajectory keys for the BENCH_serve.json series (bumped per
 #: PR so the per-line provenance is plottable without git archaeology)
-BENCH_PR = 10
-BENCH_LABEL = "flight-recorder"
+BENCH_PR = 11
+BENCH_LABEL = "paged-kv-chunked-prefill"
 
 
 def chaos_smoke():
@@ -206,17 +206,39 @@ def _api_wire_load(engine, reqs, inproc_tokens, vocab_size):
     }
 
 
+def _ab_order(rnd, sides):
+    """Paired-A/B side order for round ``rnd``: alternates round to
+    round — a FIXED order lets a systematic first-runner/second-runner
+    effect survive even paired per-round ratios (the PR-10 flightrec
+    1.334 lesson)."""
+    return sides if rnd % 2 == 0 else tuple(reversed(sides))
+
+
+def _median(xs):
+    """The paired-A/B ratio reducer: middle of the sorted per-round
+    ratios (shared by every paired A/B so the convention can never
+    diverge between them)."""
+    return sorted(xs)[len(xs) // 2]
+
+
 def serve(telemetry_out=None, api=False):
     """Serving throughput/latency at a fixed seeded BURST trace (every
     request arrives at t=0 — the admission-pressure regime batched
     admission exists for): one JSON line with tokens/s, the
     TTFT-vs-steady-decode split, a ``decode_chunk`` sweep, a
     pipelined-vs-serial loop A/B, a bucketed-vs-flat admission
-    A/B, and a flight-recorder on/off A/B (the always-on black box
-    must cost nothing: overhead ratio + events/s + atomic
-    bundle-write latency) — with a sweep-WIDE token-drift assert
-    (every configuration must emit bit-identical per-request
-    streams). Every 4th request
+    A/B, a paged-vs-contiguous KV-cache A/B (cache bytes pinned per
+    active token on a mixed-length trace — the fragmentation-free
+    capacity gain — plus steady-decode parity), a chunked-prefill A/B
+    (short-stream TTFT inflation from one long admission, monolithic
+    vs interleaved), and a flight-recorder on/off A/B (the always-on
+    black box must cost nothing: overhead ratio + events/s + atomic
+    bundle-write latency). A/B ratios are PAIRED per interleaved
+    round with the median reported (independent per-side best-of-N
+    let host drift land asymmetrically — the PR-10 flightrec line's
+    1.334 lesson), and a sweep-WIDE token-drift assert pins every
+    configuration to bit-identical per-request streams. Every 4th
+    request
     carries a stop sequence (host-side tail match, trimmed emission),
     so the sweep also pins stop handling chunk/pipeline-invariant.
 
@@ -306,12 +328,13 @@ def serve(telemetry_out=None, api=False):
     tokens_by_cfg = {}
 
     def measure_ab(sides):
-        """Interleave the sides' reps — one rep of each per round, so
-        host-load drift hits every side alike — and return each side's
-        best summary."""
+        """Interleave the sides' reps — one rep of each per round,
+        order ALTERNATING round to round (a fixed order lets a
+        systematic first-runner/second-runner effect survive even
+        paired ratios) — and return each side's best summary."""
         best = {}
-        for _ in range(reps):
-            for name, engine, kw in sides:
+        for rnd in range(reps):
+            for name, engine, kw in _ab_order(rnd, tuple(sides)):
                 toks, s = run(engine, trace(100, n_requests), **kw)
                 if name not in tokens_by_cfg:
                     tokens_by_cfg[name] = toks
@@ -472,17 +495,31 @@ def serve(telemetry_out=None, api=False):
                                 max_tokens=8, sampling=sp))
         return reqs
 
+    # PAIRED measurement: the two sides run back-to-back inside each
+    # round and the ratio is taken PER ROUND, then the median of the
+    # round ratios is reported. Best-of-N per side (the old spelling)
+    # let host drift land asymmetrically across the two best picks —
+    # prefix_ttft_speedup wandered 1.638 → 1.896 → 1.315 over PRs
+    # 7/8/10 on an unchanged admission path (pure measurement jitter);
+    # .scratch/flightrec_ab.py's paired medians sat at 0.977–1.031 on
+    # the same host. Same fix as the flight-recorder A/B below.
     best_pref = {}
     ptoks = {}
-    for _ in range(reps):
-        for name, eng in (("hit", eng_pref), ("cold", eng_cold)):
+    pref_ratios = []
+    pref_sides = (("hit", eng_pref), ("cold", eng_cold))
+    for rnd in range(reps + 3):
+        round_ttft = {}
+        for name, eng in _ab_order(rnd, pref_sides):
             toks, s = run(eng, prefix_trace(), pipeline_depth=2,
                           max_admit_batch=1)
             ptoks.setdefault(name, toks)
             assert ptoks[name] == toks, f"prefix {name} rerun drift"
+            round_ttft[name] = s["ttft_mean_ms"]
             if name not in best_pref or s["ttft_mean_ms"] < \
                     best_pref[name]["ttft_mean_ms"]:
                 best_pref[name] = s
+        pref_ratios.append(round_ttft["cold"]
+                           / max(round_ttft["hit"], 1e-9))
     # bit-parity holds when cold prefill runs the materialised-scores
     # attention (prefill_extend's expression — the CPU mesh and any
     # xla attn_impl config); under flash prefill the two differ at the
@@ -500,14 +537,217 @@ def serve(telemetry_out=None, api=False):
         "cold_bucket": eng_cold.bucket_for(tlen + 1),
         "hit_ttft_mean_ms": round(best_pref["hit"]["ttft_mean_ms"], 2),
         "cold_ttft_mean_ms": round(best_pref["cold"]["ttft_mean_ms"], 2),
-        "ttft_speedup": round(best_pref["cold"]["ttft_mean_ms"]
-                              / max(best_pref["hit"]["ttft_mean_ms"],
-                                    1e-9), 3),
+        "ttft_speedup": round(_median(pref_ratios), 3),
         "hit_rate": round(hit_rate, 3),
         "token_drift": pref_drift,
     }
     eng_pref.close()
     eng_cold.close()
+
+    # KV-cache capacity A/B #3 — paged cache: a global page pool +
+    # per-slot block tables vs the contiguous one-stripe-per-slot
+    # layout, on a MIXED-length trace (short and long prompts, varied
+    # budgets — the workload where contiguous slots strand the most
+    # HBM). The headline is cache bytes PINNED per active token,
+    # time-averaged over the drive loop: the contiguous side pins a
+    # full max_seq_len stripe per busy slot no matter how small the
+    # request; the paged side pins only each request's pages. Streams
+    # must be BIT-identical (paging is a layout play, not a numerics
+    # play), so the paged side joins the capacity A/B's own parity
+    # assert; steady decode rides along and must sit inside the host
+    # noise band.
+    page_sz = 8
+    eng_paged = Engine(cfg, params, mesh, dataclasses.replace(
+        ecfg, decode_chunk=8, page_size=page_sz))
+    eng_paged.warmup()
+
+    def mixed_trace():
+        reqs = []
+        for i in range(n_requests):
+            # half the prompts short (1..6), half long (half..full
+            # bucket), budgets varied small — the fragmentation mix
+            if i % 2:
+                p_len = 1 + (5 * i + 1) % 6
+            else:
+                p_len = ecfg.max_prompt_len // 2 + (7 * i) % (
+                    ecfg.max_prompt_len // 2) + 1
+            prompt = [int(t) for t in jax.random.randint(
+                jax.random.PRNGKey(500 + i), (p_len,), 0,
+                cfg.vocab_size)]
+            sp = (SamplingParams(temperature=0.9, top_k=40, seed=i)
+                  if i % 2 else SamplingParams())
+            reqs.append(Request(f"m{i}", prompt,
+                                max_tokens=1 + i % 6, sampling=sp))
+        return reqs
+
+    def run_tracked(eng, reqs, **kw):
+        """run() with a per-tick occupancy probe: time-summed pinned
+        cache bytes and active-request token footprints (the bytes-
+        per-active-token numerator/denominator), host-side reads
+        only."""
+        sched = Scheduler(eng, **kw)
+        for r in reqs:
+            sched.submit(r)
+        stripe = eng.cache_bytes() / eng.slots
+        page_bytes = (eng.cache_bytes() / eng._num_pages
+                      if eng.paged else 0.0)
+        pinned_sum = tokens_sum = 0.0
+        steps = 0
+        while not sched.idle():
+            sched.step()
+            steps += 1
+            if steps > 100_000:
+                raise RuntimeError("paged A/B drive loop stuck")
+            act_tokens = sum(
+                len(a.request.prompt) + a.request.max_tokens
+                for a in sched.active.values())
+            if not act_tokens:
+                continue
+            if eng.paged:
+                pinned = eng.page_allocator.pages_in_use * page_bytes
+            else:
+                pinned = len(sched.active) * stripe
+            pinned_sum += pinned
+            tokens_sum += act_tokens
+        toks = {rid: c.tokens for rid, c in sched.completions.items()}
+        return toks, sched.summary(), pinned_sum / max(tokens_sum, 1.0)
+
+    best_pg = {}
+    pg_toks = {}
+    bpt = {}
+    pg_ratios = []
+    pg_sides = (("paged", eng_paged), ("contig", engine))
+    for rnd in range(reps + 3):
+        round_dec = {}
+        for name, eng in _ab_order(rnd, pg_sides):
+            toks, s, bytes_per_tok = run_tracked(
+                eng, mixed_trace(), pipeline_depth=2)
+            pg_toks.setdefault(name, toks)
+            assert pg_toks[name] == toks, f"paged ab {name} rerun drift"
+            bpt[name] = bytes_per_tok  # deterministic per side
+            round_dec[name] = s.get("decode_tokens_per_sec", 0.0)
+            if name not in best_pg or s.get(
+                    "decode_tokens_per_sec", 0.0) > best_pg[name].get(
+                    "decode_tokens_per_sec", 0.0):
+                best_pg[name] = s
+        pg_ratios.append(round_dec["paged"]
+                         / max(round_dec["contig"], 1e-9))
+    # paged == contiguous BIT-parity is engineered on the XLA path
+    # (gathered bytes + verbatim score expressions); on chip BOTH
+    # sides take the Pallas kernel path with DIFFERENT split-K block
+    # granularities (one page vs _fit_block_k of the horizon), so the
+    # online-softmax merge order differs at the ulp level and drift is
+    # REPORTED, not asserted — the prefix A/B's flash caveat again
+    pg_drift = sum(1 for k in pg_toks["paged"]
+                   if pg_toks["paged"][k] != pg_toks["contig"][k])
+    if not on_tpu:
+        assert pg_drift == 0, "paged token drift"
+    paged_ab = {
+        "page_size": page_sz,
+        "num_pages": eng_paged._num_pages,
+        "contig_bytes_per_active_token": round(bpt["contig"], 1),
+        "paged_bytes_per_active_token": round(bpt["paged"], 1),
+        # the fragmentation-free capacity headline: how many MORE
+        # active tokens the same HBM holds under paging on this mix
+        "effective_capacity_gain": round(
+            bpt["contig"] / max(bpt["paged"], 1e-9), 3),
+        "contig_decode_tokens_per_sec": round(
+            best_pg["contig"].get("decode_tokens_per_sec", 0.0), 1),
+        "paged_decode_tokens_per_sec": round(
+            best_pg["paged"].get("decode_tokens_per_sec", 0.0), 1),
+        # paired per-round median, like every other ratio here
+        "decode_ratio": round(_median(pg_ratios), 3),
+        "page_fragmentation": round(
+            best_pg["paged"].get("page_fragmentation", 0.0), 3),
+        "token_drift": pg_drift,
+    }
+    eng_paged.close()
+
+    # Chunked-prefill A/B — one long prompt admitted alongside a wave
+    # of short ones (all at t=0, long first): monolithic admission
+    # makes every short stream's TTFT wait out the long prefill
+    # forward; chunked admission interleaves the long prompt's chunk
+    # forwards with the shorts' decode waves. The observable is the
+    # SHORT requests' mean TTFT vs a shorts-only baseline — paired
+    # per-round ratios, median reported; the chunked side's inflation
+    # must sit inside the host noise band. Streams bit-identical
+    # between mono and chunked (prefill_extend parity — CPU mesh).
+    mpl_c = min(4 * ecfg.max_prompt_len, cfg.seq_len // 2)
+    chunk_c = ecfg.max_prompt_len
+    ecfg_ck = dataclasses.replace(
+        ecfg, decode_chunk=8, max_prompt_len=mpl_c,
+        max_seq_len=mpl_c + 32)
+    eng_mono = Engine(cfg, params, mesh, ecfg_ck).warmup()
+    eng_chunk = Engine(cfg, params, mesh, dataclasses.replace(
+        ecfg_ck, prefill_chunk=chunk_c)).warmup()
+    # one admission wave of shorts (slots - 1 of them, so none waits
+    # on slot turnover), serial k=1 admissions on both sides: the
+    # shorts' TTFT then isolates exactly the queue-behind-the-long-
+    # prefill effect the interleave removes, not the k-ladder or slot
+    # recycling
+    n_short = ecfg.slots - 1
+
+    def chunk_trace(with_long):
+        reqs = []
+        if with_long:
+            long_p = [int(t) for t in jax.random.randint(
+                jax.random.PRNGKey(600), (mpl_c,), 0, cfg.vocab_size)]
+            reqs.append(Request("long", long_p, max_tokens=8,
+                                sampling=SamplingParams()))
+        for i in range(n_short):
+            prompt = [int(t) for t in jax.random.randint(
+                jax.random.PRNGKey(610 + i), (1 + i % 8,), 0,
+                cfg.vocab_size)]
+            sp = (SamplingParams(temperature=0.9, top_k=40, seed=i)
+                  if i % 2 else SamplingParams())
+            reqs.append(Request(f"c{i}", prompt, max_tokens=8,
+                                sampling=sp))
+        return reqs
+
+    def short_ttft(engine, with_long):
+        sched = Scheduler(engine, pipeline_depth=2, max_admit_batch=1)
+        for r in chunk_trace(with_long):
+            sched.submit(r)
+        sched.run_until_idle()
+        toks = {rid: c.tokens for rid, c in sched.completions.items()}
+        ttfts = [c.ttft for rid, c in sched.completions.items()
+                 if rid != "long" and c.ttft is not None]
+        return toks, 1e3 * sum(ttfts) / max(len(ttfts), 1)
+
+    ck_toks = {}
+    infl = {"mono": [], "chunked": []}
+    ck_best = {}
+    ck_sides = (("mono", eng_mono), ("chunked", eng_chunk))
+    for rnd in range(reps + 3):
+        _, base_ms = short_ttft(eng_mono, with_long=False)
+        for name, eng in _ab_order(rnd, ck_sides):
+            toks, ms = short_ttft(eng, with_long=True)
+            ck_toks.setdefault(name, toks)
+            assert ck_toks[name] == toks, f"chunked {name} rerun drift"
+            infl[name].append(ms / max(base_ms, 1e-9))
+            ck_best[name] = min(ck_best.get(name, ms), ms)
+    # chunked == monolithic BIT-parity holds under materialised-scores
+    # cold prefill (the prefill_extend contract — every off-TPU
+    # config); under flash cold prefill the two differ at the
+    # reduction-order ulp level, so drift is REPORTED, not asserted
+    # (the prefix A/B's caveat, inherited)
+    ck_drift = sum(1 for k in ck_toks["mono"]
+                   if ck_toks["mono"][k] != ck_toks["chunked"][k])
+    if not on_tpu or cfg.attn_impl == "xla":
+        assert ck_drift == 0, "chunked token drift"
+    chunked_ab = {
+        "long_prompt": mpl_c,
+        "prefill_chunk": chunk_c,
+        "short_ttft_mono_ms": round(ck_best["mono"], 2),
+        "short_ttft_chunked_ms": round(ck_best["chunked"], 2),
+        # short-stream TTFT inflation vs the shorts-only baseline
+        # (paired per-round, median): the stall the interleave removes
+        "ttft_inflation_mono": round(_median(infl["mono"]), 3),
+        "ttft_inflation_chunked": round(_median(infl["chunked"]), 3),
+        "token_drift": ck_drift,
+    }
+    eng_mono.close()
+    eng_chunk.close()
 
     # Speculative-decoding A/B — draft-k-verify inside the compiled
     # chunk loop (gpt.decode_steps_spec), payoff-gated by the
@@ -605,10 +845,18 @@ def serve(telemetry_out=None, api=False):
     import shutil
     import tempfile
 
+    # PAIRED per-round ratios, median reported — the same fix as the
+    # prefix A/B above: independent best-of-N per side let host drift
+    # land asymmetrically (PR 10's trajectory recorded 1.334, outside
+    # the 0.74–1.23 host band, while .scratch/flightrec_ab.py's paired
+    # medians sat at 0.977–1.031 on the same host and the recorder's
+    # unit cost is ~0.9 us/event — the bench was measuring noise)
     rec_events_total = 0
     best_fr = {}
-    for _ in range(reps):
-        for name in ("flightrec", "plain"):
+    fr_ratios = []
+    for rnd in range(reps + 3):
+        round_tps = {}
+        for name in _ab_order(rnd, ("flightrec", "plain")):
             fr = FlightRecorder() if name == "flightrec" else None
             sched = Scheduler(engine, pipeline_depth=2, recorder=fr)
             for r in trace(100, n_requests):
@@ -622,6 +870,7 @@ def serve(telemetry_out=None, api=False):
                 f"flightrec ab {name} token drift"
             s = sched.summary()
             s["_wall"] = wall
+            round_tps[name] = s["tokens_per_sec"]
             if fr is not None:
                 rec_events_total = fr.summary()["events_total"]
                 s["_events_per_sec"] = rec_events_total / max(wall,
@@ -630,6 +879,8 @@ def serve(telemetry_out=None, api=False):
             if name not in best_fr or s["tokens_per_sec"] > \
                     best_fr[name]["tokens_per_sec"]:
                 best_fr[name] = s
+        fr_ratios.append(round_tps["flightrec"]
+                         / max(round_tps["plain"], 1e-9))
     # bundle-write latency: median-of-3 atomic dumps of the freshly
     # soaked scheduler state (events + requests + config + registry)
     tmp = tempfile.mkdtemp(prefix="apex_bundle_ab_")
@@ -644,9 +895,8 @@ def serve(telemetry_out=None, api=False):
             best_fr["flightrec"]["tokens_per_sec"], 1),
         "plain_tokens_per_sec": round(
             best_fr["plain"]["tokens_per_sec"], 1),
-        "overhead_ratio": round(
-            best_fr["flightrec"]["tokens_per_sec"]
-            / max(best_fr["plain"]["tokens_per_sec"], 1e-9), 3),
+        # median of the interleaved per-round paired ratios (see above)
+        "overhead_ratio": round(_median(fr_ratios), 3),
         "events_total": rec_events_total,
         "events_per_sec": round(
             best_fr["flightrec"]["_events_per_sec"], 1),
@@ -701,6 +951,8 @@ def serve(telemetry_out=None, api=False):
         "bucket_ab": bucket_ab,
         "kv_cache_ab": kv_ab,
         "prefix_ab": prefix_ab,
+        "paged_ab": paged_ab,
+        "chunked_ab": chunked_ab,
         "spec_ab": spec_ab,
         "flightrec_ab": flightrec_ab,
     }
@@ -728,6 +980,17 @@ def serve(telemetry_out=None, api=False):
         "kv_int8_bytes_ratio": kv_ab["bytes_ratio"],
         "prefix_hit_rate": prefix_ab["hit_rate"],
         "prefix_ttft_speedup": prefix_ab["ttft_speedup"],
+        # paged-cache successor metrics: bytes pinned per active token
+        # and the fragmentation-free capacity gain on the mixed trace;
+        # chunked prefill's short-stream TTFT inflation (vs 1.0 = no
+        # stall) next to the monolithic baseline's
+        "cache_bytes_per_active_token": paged_ab[
+            "paged_bytes_per_active_token"],
+        "paged_capacity_gain": paged_ab["effective_capacity_gain"],
+        "paged_decode_ratio": paged_ab["decode_ratio"],
+        "chunked_ttft_inflation": chunked_ab["ttft_inflation_chunked"],
+        "chunked_ttft_inflation_mono": chunked_ab[
+            "ttft_inflation_mono"],
         "spec_accept_rate": spec_ab["high_accept_rate"],
         "spec_decode_tokens_per_sec": spec_ab[
             "high_spec_decode_tokens_per_sec"],

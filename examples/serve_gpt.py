@@ -34,6 +34,18 @@ Run (CPU simulation; omit --requests for a synthetic trace):
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python examples/serve_gpt.py --tp 2 --slots 2
 
+Paged KV cache + chunked prefill (``--page-size``/``--max-pages``/
+``--prefill-chunk``): a fixed-size page pool with per-slot block
+tables replaces the one-contiguous-stripe-per-slot layout (short
+requests stop stranding a full horizon; prefix-template hits share
+pages copy-on-write), and prompts longer than one chunk admit in
+chunk-sized slices interleaved with decode waves — the synthetic
+trace gains a long-prompt line so both paths actually run::
+
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python examples/serve_gpt.py --slots 4 --max-prompt-len 32 \
+    --page-size 8 --prefill-chunk 16 --num-requests 8
+
 Observability (``apex_tpu.telemetry``): ``--metrics-port N`` serves
 ``/metrics`` (Prometheus text), ``/healthz`` (live-wired to the
 scheduler's health state machine: 200 ok/degraded, 503
@@ -105,17 +117,26 @@ def load_requests(path, vocab_size):
 
 
 def synthetic_requests(n, prompt_len, max_tokens, vocab_size,
-                       prefix=None):
+                       prefix=None, long_prompt_len=0):
     """Seeded stand-in trace: half greedy, half sampled; every third
     request carries a stop sequence (trimmed emission when it fires).
     With ``prefix`` (a pooled template's token list), every other
     request's prompt starts with it — the many-users-one-template
-    workload prefix reuse exists for."""
+    workload prefix reuse exists for. With ``long_prompt_len > 0``,
+    every fourth request (offset 1, so it never collides with a
+    prefix row) carries a prompt of that length — the long-admission
+    traffic chunked prefill (``--prefill-chunk``) interleaves with
+    decode waves instead of stalling everyone's TTFT on."""
     reqs = []
     for i in range(n):
-        tail = [int(t) for t in jax.random.randint(
-            jax.random.PRNGKey(1000 + i), (1 + (prompt_len + i) %
-                                           prompt_len,), 0, vocab_size)]
+        if long_prompt_len and i % 4 == 1:
+            tail = [int(t) for t in jax.random.randint(
+                jax.random.PRNGKey(2000 + i), (long_prompt_len,), 0,
+                vocab_size)]
+        else:
+            tail = [int(t) for t in jax.random.randint(
+                jax.random.PRNGKey(1000 + i),
+                (1 + (prompt_len + i) % prompt_len,), 0, vocab_size)]
         prompt = (list(prefix) + tail[:2]) if prefix and i % 2 == 0 \
             else tail
         sp = (SamplingParams(temperature=0.9, top_k=20, seed=i)
@@ -196,6 +217,29 @@ def main():
                     "with it admit by pooled-K/V copy + tail-only "
                     "prefill; synthetic traces prepend the first "
                     "template to half the prompts")
+    ap.add_argument("--page-size", type=int, default=0,
+                    help="paged KV cache: tokens per page (0 = the "
+                    "contiguous one-stripe-per-slot layout). A short "
+                    "request then pins only the pages its prompt + "
+                    "budget need instead of a full max-seq-len "
+                    "stripe, and prefix-template hits share the "
+                    "template's pages copy-on-write; token streams "
+                    "are bit-identical either way")
+    ap.add_argument("--max-pages", type=int, default=0,
+                    help="pages in the global pool (paged mode; 0 = "
+                    "auto-size so every slot fits a worst-case "
+                    "request). Set lower to oversubscribe — admission "
+                    "then backpressures when the pool runs dry "
+                    "instead of stranding idle capacity")
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="chunked prefill: prompts longer than this "
+                    "admit in chunk-sized slices interleaved with "
+                    "decode waves, so a long admission stops stalling "
+                    "other streams' TTFT (must be a prompt bucket "
+                    "dividing --max-prompt-len; 0 = monolithic "
+                    "admission). The synthetic trace gains a "
+                    "long-prompt line (every 4th request) to "
+                    "exercise it")
     args = ap.parse_args()
 
     cfg = gpt.GPTConfig(vocab_size=1024, hidden_size=128, num_layers=4,
@@ -228,7 +272,9 @@ def main():
     engine = Engine(cfg, params, mesh, EngineConfig(
         slots=args.slots, max_prompt_len=args.max_prompt_len,
         max_seq_len=args.max_seq_len, decode_chunk=args.decode_chunk,
-        prefix_pool_slots=len(templates), spec_k=args.spec_k),
+        prefix_pool_slots=len(templates), spec_k=args.spec_k,
+        page_size=args.page_size, num_pages=args.max_pages,
+        prefill_chunk=args.prefill_chunk),
         fault_plan=fault_plan)
     # compile every program (init/step/retire + each (bucket, k)
     # admission variant + prefix pool inserts/extends) before the first
@@ -237,11 +283,18 @@ def main():
     engine.warmup()
     for t in templates:  # after warmup (which resets the pool)
         engine.register_prefix(t)
+    long_len = 0
+    if args.prefill_chunk and not args.requests:
+        # a long-prompt line in the synthetic trace: longer than one
+        # chunk (so it actually admits chunked) and capped to the
+        # engine's prompt room
+        long_len = min(args.max_prompt_len, 2 * args.prefill_chunk)
     reqs = (load_requests(args.requests, cfg.vocab_size) if args.requests
             else synthetic_requests(args.num_requests, 8, args.max_tokens,
                                     cfg.vocab_size,
                                     prefix=templates[0] if templates
-                                    else None))
+                                    else None,
+                                    long_prompt_len=long_len))
 
     # telemetry: spans whenever a trace is requested; the registry +
     # process-wide recompile sentinel only when there is a /metrics

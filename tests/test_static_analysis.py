@@ -379,6 +379,52 @@ def test_recompile_hazard_named_args_clean(tmp_path):
 
 
 # --------------------------------------------------------------------------
+# PAGE-TABLE-STATIC
+# --------------------------------------------------------------------------
+
+
+def test_page_table_static_fires_on_request_derived_shape(tmp_path):
+    res = _synth(tmp_path, {"pkg/mod.py": '''
+        import numpy as np
+
+        def admit(self, prompt, max_tokens):
+            # the recompile-hazard class this rule exists for: table
+            # geometry measured from the live request
+            self._tables = np.zeros(
+                (self.slots, len(prompt) // self.page_size), np.int32)
+            pages = np.full((prompt.size // 4,), 0, np.int32)
+            return pages
+    ''', "pkg/__init__.py": ""})
+    hits = [f for f in res.findings if f.rule == "PAGE-TABLE-STATIC"]
+    msgs = "\n".join(f.render() for f in hits)
+    assert len(hits) == 2, msgs
+    assert any("len(...)" in f.message and "_tables" in f.message
+               for f in hits), msgs
+    assert any(".size" in f.message and "pages" in f.message
+               for f in hits), msgs
+
+
+def test_page_table_static_clean_on_config_shapes(tmp_path):
+    res = _synth(tmp_path, {"pkg/mod.py": '''
+        import numpy as np
+
+        def build(self, ecfg):
+            # config-derived constants: the blessed spelling
+            max_pages = -(-ecfg.max_seq_len // ecfg.page_size)
+            self._tables = np.full((ecfg.slots, max_pages), 0, np.int32)
+            row_pages = np.zeros((max_pages,), np.int32)
+            # table CONTENTS from request data are fine — tables are
+            # data; only shapes are constrained
+            row_pages[:len(self.shared)] = self.shared
+            # non-table arrays may size from data (other rules' turf)
+            buf = np.zeros((len(self.queue),), np.int32)
+            return row_pages, buf
+    ''', "pkg/__init__.py": ""})
+    assert "PAGE-TABLE-STATIC" not in _rules_of(res), \
+        "\n".join(f.render() for f in res.findings)
+
+
+# --------------------------------------------------------------------------
 # WARMUP-COVERAGE
 # --------------------------------------------------------------------------
 
@@ -611,6 +657,40 @@ def test_event_drift_clean_tree(tmp_path):
               "| `never_recorded` | x | recorded after all |\n"))
     assert "EVENT-DRIFT" not in _rules_of(res), \
         "\n".join(f.render() for f in res.findings)
+
+
+def test_event_drift_sees_annotated_vocabulary(tmp_path):
+    """The REAL flightrec module binds the vocabulary with a type
+    annotation (`EVENT_FIELDS: Dict[...] = {...}` — ast.AnnAssign);
+    the rule must parse that spelling too, or it is silently inert
+    against the actual repo (the regression this pins: the rule
+    shipped matching plain Assign only and never fired on the tree)."""
+    res = _synth(tmp_path, {
+        "apex_tpu/__init__.py": "",
+        "apex_tpu/telemetry/__init__.py": "",
+        "apex_tpu/telemetry/flightrec.py": '''
+            from typing import Dict, Tuple
+
+            EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
+                "good": ("request_id",),
+                "dead_entry": ("x",),
+            }
+        ''',
+        "apex_tpu/serving/__init__.py": "",
+        "apex_tpu/serving/sched.py": 'def f(recorder):\n'
+                                     '    recorder.record("good", 1)\n',
+        "docs/API.md": ("#### Flight-recorder event names\n"
+                        "| event | fields | meaning |\n"
+                        "|---|---|---|\n"
+                        "| `good` | request_id | fine |\n"),
+    }, targets=["apex_tpu"], rules=["EVENT-DRIFT"])
+    hits = [f for f in res.findings if f.rule == "EVENT-DRIFT"]
+    msgs = "\n".join(f.render() for f in hits)
+    assert any("'dead_entry'" in f.message
+               and "no record() call" in f.message for f in hits), msgs
+    assert any("'dead_entry'" in f.message and "API.md" in f.message
+               for f in hits), msgs
+    assert len(hits) == 2, msgs
 
 
 def test_event_drift_absent_on_foreign_trees(tmp_path):
