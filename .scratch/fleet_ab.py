@@ -1,0 +1,93 @@
+"""Routing-overhead A/B: bare Scheduler vs a 1-replica Router on the
+same trace — what does the fleet layer cost when nothing ever fails?
+
+Method (docs/DESIGN.md conventions, PR-11 methodology): one process,
+two independent warmed engine+scheduler stacks of the same config
+(side A driven directly, side B through a Router), the SAME seeded
+burst trace per round with fresh request ids, paired per-round wall
+ratios with ALTERNATING side order, median reported. Sync is the
+run-to-idle value fetch, never block_until_ready. Token streams
+asserted identical across sides every round.
+
+Run: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     PYTHONPATH=. python .scratch/fleet_ab.py
+"""
+
+import json
+import time
+
+import jax
+
+from apex_tpu import mesh as mx
+from apex_tpu.models import gpt
+from apex_tpu.serving import Request, SamplingParams
+from apex_tpu.serving.engine import Engine, EngineConfig
+from apex_tpu.serving.fleet import Router
+from apex_tpu.serving.scheduler import Scheduler
+
+ROUNDS = 11
+N_REQS = 24
+
+cfg = gpt.GPTConfig(
+    vocab_size=1024, hidden_size=256, num_layers=4, num_heads=8,
+    seq_len=256, remat=False, compute_dtype=jax.numpy.float32)
+ecfg = EngineConfig(slots=4, max_prompt_len=16, max_seq_len=48,
+                    decode_chunk=4)
+mesh = mx.build_mesh(tp=1, devices=jax.devices()[:1])
+params = gpt.init(cfg, jax.random.PRNGKey(0))
+
+
+def trace(rnd, tag):
+    reqs = []
+    for i in range(N_REQS):
+        p_len = 1 + (5 * i + 3) % ecfg.max_prompt_len
+        prompt = [int(t) for t in jax.random.randint(
+            jax.random.PRNGKey(100 + i), (p_len,), 0, cfg.vocab_size)]
+        sp = (SamplingParams(temperature=0.9, top_k=40, seed=i)
+              if i % 2 else SamplingParams())
+        reqs.append(Request(f"{tag}{rnd}_{i}", prompt, max_tokens=16,
+                            sampling=sp))
+    return reqs
+
+
+sched_a = Scheduler(Engine(cfg, params, mesh, ecfg).warmup(),
+                    pipeline_depth=2)
+router = Router([Scheduler(Engine(cfg, params, mesh, ecfg).warmup(),
+                           pipeline_depth=2)])
+
+
+def run(side, rnd):
+    drv = sched_a if side == "bare" else router
+    reqs = trace(rnd, side[0])
+    t0 = time.perf_counter()
+    for r in reqs:
+        drv.submit(r)
+    drv.run_until_idle()
+    wall = time.perf_counter() - t0
+    toks = {r.request_id[1:]: drv.completions[r.request_id].tokens
+            for r in reqs}
+    return wall, toks
+
+
+# warm both sides (round 0 discarded)
+run("bare", 0), run("router", 0)
+ratios = []
+for rnd in range(1, ROUNDS + 1):
+    sides = ("bare", "router") if rnd % 2 else ("router", "bare")
+    walls = {}
+    streams = {}
+    for side in sides:
+        walls[side], streams[side] = run(side, rnd)
+    assert streams["bare"] == streams["router"], "token drift"
+    ratios.append(walls["router"] / walls["bare"])
+
+ratios.sort()
+print(json.dumps({
+    "metric": "fleet_router_overhead_ratio_router_over_bare",
+    "median": round(ratios[len(ratios) // 2], 3),
+    "min": round(ratios[0], 3),
+    "max": round(ratios[-1], 3),
+    "rounds": ROUNDS,
+    "requests_per_round": N_REQS,
+}))

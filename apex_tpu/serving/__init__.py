@@ -44,14 +44,15 @@ from apex_tpu.serving.request import (  # noqa: F401
 
 __all__ = [
     "request", "sampling", "engine", "scheduler", "resilience", "api",
-    "pages",
+    "pages", "fleet",
     "Request", "SamplingParams", "Completion", "StreamEvent",
     "StopMatcher",
     "Engine", "EngineConfig", "Scheduler", "QueueFull",
     "SpecGateConfig", "Admission", "AdmitResult", "StepHandle",
     "ChunkedAdmission", "PageAllocator", "PagesExhausted",
-    "FaultPlan", "FaultSpec", "ResilienceConfig", "HealthMonitor",
-    "EngineFault", "InjectedFault", "EngineFailed",
+    "FaultPlan", "FaultSpec", "FleetFaultPlan", "ResilienceConfig",
+    "HealthMonitor", "EngineFault", "InjectedFault", "EngineFailed",
+    "Router", "FleetConfig", "FleetHealth", "EvictedRequest",
 ]
 
 # ``sampling`` (jax) and ``api`` load lazily alongside engine/scheduler
@@ -76,6 +77,12 @@ _LAZY = {
     "Scheduler": "apex_tpu.serving.scheduler",
     "QueueFull": "apex_tpu.serving.scheduler",
     "SpecGateConfig": "apex_tpu.serving.scheduler",
+    "EvictedRequest": "apex_tpu.serving.scheduler",
+    "fleet": "apex_tpu.serving.fleet",
+    "Router": "apex_tpu.serving.fleet",
+    "FleetConfig": "apex_tpu.serving.fleet",
+    "FleetHealth": "apex_tpu.serving.fleet",
+    "FleetFaultPlan": "apex_tpu.serving.resilience",
     "FaultPlan": "apex_tpu.serving.resilience",
     "FaultSpec": "apex_tpu.serving.resilience",
     "ResilienceConfig": "apex_tpu.serving.resilience",

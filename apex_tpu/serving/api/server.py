@@ -99,7 +99,11 @@ class _Submission:
 
 
 class ApiServer:
-    """Serve the OpenAI surface over a warmed scheduler until
+    """Serve the OpenAI surface over a warmed scheduler — or a
+    :class:`~apex_tpu.serving.fleet.Router` over N replicas (the
+    router duck-types the scheduler surface; 429s then mean "every
+    routable replica is saturated", 503s "no replica left standing",
+    and ``/healthz`` answers from the fleet aggregate) — until
     ``stop()``.
 
     >>> server = ApiServer(sched, ByteTokenizer(cfg.vocab_size),
@@ -158,12 +162,16 @@ class ApiServer:
     def start(self) -> "ApiServer":
         if self._httpd is not None:
             return self
+        # fleet-aware registration: a Router registers the template
+        # into EVERY replica's pool; a plain Scheduler into its engine
+        register = getattr(self.scheduler, "register_prefix",
+                           None) or self.scheduler.engine.register_prefix
         for tpl in self.prefix_templates:
             # BEFORE the driver thread exists — registration is the
             # last main-thread device work (a compiled pool insert)
             toks = (self.tokenizer.encode(tpl) if isinstance(tpl, str)
                     else [int(t) for t in tpl])
-            self.scheduler.engine.register_prefix(toks)
+            register(toks)
         self._running = True
         self._driver = threading.Thread(
             target=self._drive, name="apex-tpu-api-driver", daemon=True)
@@ -258,11 +266,22 @@ class ApiServer:
 
     def _submit(self, sub: _Submission, QueueFull, EngineFailed) -> None:
         sched = self.scheduler
-        # all-or-nothing pre-flight: an n>1 fan must not half-land when
-        # the queue is nearly full
-        if len(sched.queue) + len(sub.requests) > sched.max_queue:
+        # terminal health is a 503, never a capacity 429: a failed
+        # engine — or a fleet with NO surviving replica — is not
+        # "try again later"
+        if getattr(getattr(sched, "health", None), "state", None) \
+                == "failed":
             sub.reply.put(protocol.ApiError(
-                429, f"queue at capacity ({len(sched.queue)})",
+                503, "engine health is failed; not accepting requests",
+                err_type="server_error", code="engine_failed"))
+            return
+        # all-or-nothing pre-flight: an n>1 fan must not half-land when
+        # the queue is nearly full. can_accept is fleet-aware: a
+        # Router answers for the ROUTABLE replicas' combined headroom,
+        # a plain Scheduler for its own queue
+        if not sched.can_accept(len(sub.requests)):
+            sub.reply.put(protocol.ApiError(
+                429, "queue at capacity",
                 err_type="rate_limit_error", code="queue_full",
                 retry_after_s=sched.overload_hint_s()))
             return

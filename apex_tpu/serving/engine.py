@@ -55,6 +55,7 @@ from jax.sharding import PartitionSpec as P
 from apex_tpu.models import gpt
 from apex_tpu.serving import sampling
 from apex_tpu.serving.pages import SINK, PageAllocator, PagesExhausted
+from apex_tpu.telemetry.recompile import expected_compiles
 from apex_tpu.serving.resilience import (
     KIND_ERROR,
     KIND_HANG,
@@ -565,11 +566,16 @@ class Engine:
         #: scratch buffer holds one prompt)
         self._chunked: Optional[ChunkedAdmission] = None
         self._build()
-        self.cache, self.state = self._init(params)
-        if self._chunk_size:
-            self._chunk_scratch = self._chunk_scratch_init(params)
-        if self._prefix_splits:
-            self.pool = self._pool_init(params)
+        with expected_compiles():
+            # construction compiles (the init programs materialise
+            # here) are sanctioned: another live engine's armed
+            # recompile guard must read them as a replica being built,
+            # not as its own trace-stability breach
+            self.cache, self.state = self._init(params)
+            if self._chunk_size:
+                self._chunk_scratch = self._chunk_scratch_init(params)
+            if self._prefix_splits:
+                self.pool = self._pool_init(params)
 
     @staticmethod
     def _resolve_buckets(ecfg: EngineConfig) -> Tuple[int, ...]:
@@ -2013,7 +2019,12 @@ class Engine:
             return self
         self._warming = True  # warmup must not consume fault-plan seams
         try:
-            self._warmup_body()
+            with expected_compiles():
+                # warmup IS the sanctioned compile pass: its events
+                # must never be attributed to another live engine's
+                # armed guard (the fleet router warms replacement
+                # replicas mid-serve)
+                self._warmup_body()
         finally:
             self._warming = False
         self._warmed = True
@@ -2262,3 +2273,13 @@ class Engine:
         sentinel, self._sentinel = self._sentinel, None
         if sentinel is not None:
             sentinel.uninstall()
+
+    def __enter__(self) -> "Engine":
+        """Context-manager form: ``with Engine(...) as eng:`` closes on
+        exit — the ergonomic fix for the "engines created in a loop
+        must call close()" footgun (a leaked sentinel listener outlives
+        the engine otherwise)."""
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()

@@ -228,6 +228,88 @@ def parse_fault_plan(text: str, *,
     return FaultPlan(specs, hang_fn=hang_fn)
 
 
+class FleetFaultPlan:
+    """Per-replica :class:`FaultPlan` schedule for a fleet — the chaos
+    harness lifted to the router level: replica ``i``'s engine is
+    built with ``fleet_plan[i]``, every plan is independently
+    deterministic, and a kill-one-replica-mid-burst soak replays
+    exactly from its seed.
+
+    >>> plans = FleetFaultPlan.kill(1, 2, at=4)   # replica 1 dies
+    >>> engines = [Engine(cfg, params, mesh, ecfg,
+    ...                   fault_plan=plans[i]) for i in range(2)]
+    """
+
+    def __init__(self, plans: Sequence[FaultPlan]):
+        self.plans: Tuple[FaultPlan, ...] = tuple(plans)
+        if not self.plans:
+            raise ValueError("a fleet plan needs at least one replica")
+
+    def __len__(self) -> int:
+        return len(self.plans)
+
+    def __getitem__(self, i: int) -> FaultPlan:
+        return self.plans[i]
+
+    def __iter__(self):
+        return iter(self.plans)
+
+    @classmethod
+    def random(cls, seed: int, n_replicas: int, n_faults: int = 3,
+               **kw) -> "FleetFaultPlan":
+        """A seeded random plan per replica — derived seeds, so the
+        whole fleet soak is bit-reproducible from one ``seed``."""
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas {n_replicas} must be >= 1")
+        return cls([FaultPlan.random(seed * 1_000_003 + i, n_faults,
+                                     **kw)
+                    for i in range(n_replicas)])
+
+    @classmethod
+    def kill(cls, replica: int, n_replicas: int, *, at: int = 4,
+             rebuilds: int = 4) -> "FleetFaultPlan":
+        """Terminally fail ``replica`` at its ``at``-th decode
+        dispatch: ``rebuilds`` consecutive dispatch errors with no
+        healthy chunk between them exhaust the scheduler's
+        ``max_consecutive_rebuilds`` (default 3, so the default
+        ``rebuilds=4`` crosses it) and the health machine goes
+        ``failed`` — deterministically, mid-burst. Every other
+        replica's plan is empty.
+
+        Pair the victim's scheduler with ``ResilienceConfig(
+        max_retries >= rebuilds)``: with the default ``max_retries=2``
+        a router's retry-exhaustion failover can move every live
+        request OFF the replica after the third consecutive fault,
+        leaving no traffic to consume the remaining dispatch indices —
+        the replica then survives degraded instead of failing
+        terminally (fine for the fleet, wrong for a kill drill). On a
+        slow/throttled host, also raise ``watchdog_timeout_s``: two
+        >timeout chunks trip the router's breaker and evict the victim
+        the same way."""
+        if not 0 <= replica < n_replicas:
+            raise ValueError(
+                f"replica {replica} outside fleet [0, {n_replicas})")
+        specs = [FaultSpec("dispatch", at + j, KIND_ERROR)
+                 for j in range(rebuilds)]
+        return cls([FaultPlan(specs if i == replica else ())
+                    for i in range(n_replicas)])
+
+    @property
+    def injected(self) -> List[FaultSpec]:
+        """Every fault that fired, across replicas, in replica order."""
+        return [s for p in self.plans for s in p.injected]
+
+    def describe(self) -> str:
+        return "; ".join(
+            f"r{i}=[{', '.join(s.describe() for s in p.specs)}]"
+            for i, p in enumerate(self.plans) if p.specs) or "no faults"
+
+    def reset(self) -> "FleetFaultPlan":
+        for p in self.plans:
+            p.reset()
+        return self
+
+
 # -- exceptions --------------------------------------------------------------
 
 

@@ -462,6 +462,22 @@ class _ReplayState:
 _LIVE, _RELEASED, _RECOVERED = 0, 1, 2
 
 
+@dataclasses.dataclass
+class EvictedRequest:
+    """One interrupted request handed to a :attr:`Scheduler.on_evict`
+    hook instead of an ``error`` completion: the request itself plus
+    the longest CLIENT-VISIBLE stream it was sent (the grow-only
+    emitted-prefix snapshot fault replay maintains). A fleet router
+    resubmits it to a healthy replica with
+    ``submit(request, replay_prefix=tokens)`` — replay re-derives the
+    prefix silently, so the client stream continues bit-identical with
+    zero duplicate or lost tokens."""
+
+    request: Request
+    tokens: List[int]
+    logprobs: List[float]
+
+
 class Scheduler:
     """Drive an :class:`Engine` over a stream of requests.
 
@@ -506,7 +522,9 @@ class Scheduler:
                  recorder=None, bundle_dir: Optional[str] = None,
                  bundle_meta: Optional[Dict] = None,
                  max_auto_bundles: int = 4,
-                 request_log: int = 4096):
+                 request_log: int = 4096,
+                 on_evict: Optional[
+                     Callable[[List[EvictedRequest], str], None]] = None):
         if pipeline_depth < 1:
             raise ValueError(
                 f"pipeline_depth {pipeline_depth} must be >= 1 (1 = the "
@@ -575,6 +593,16 @@ class Scheduler:
         self._req_records: Dict[str, Dict] = {}
         self._req_done = Ring(request_log)
         self._submit_seq = 0
+        #: router-facing eviction hook (``(evicted, cause) -> None``):
+        #: when set, work this scheduler can no longer serve — every
+        #: queued/active request at terminal failure, or a single
+        #: request whose bounded retries exhausted — is handed over as
+        #: :class:`EvictedRequest` records (emitted prefix attached)
+        #: INSTEAD of being aborted with ``error`` events, so a fleet
+        #: router can fail it over to a healthy replica with the client
+        #: stream intact. None (the default) keeps the single-engine
+        #: abort-with-error semantics unchanged.
+        self.on_evict = on_evict
         self._gate_state_seen: Optional[float] = None
         #: the ok → degraded → draining → failed state machine; wire
         #: ``MetricsServer(health=sched.health.healthz)`` to serve it
@@ -624,9 +652,11 @@ class Scheduler:
         self._admitted_requests = 0
         self._admit_dispatches = 0
         self._retries = 0
+        self._retry_exhausted = 0
         self._rebuilds = 0
         self._shed = 0
         self._watchdog_trips = 0
+        self._evicted_requests = 0
         self._consecutive_rebuilds = 0
         #: EWMA of chunk dispatch→fetch wall time — the overload
         #: estimator behind deadline shedding and the QueueFull
@@ -660,13 +690,22 @@ class Scheduler:
 
     # -- intake ------------------------------------------------------------
 
-    def submit(self, request: Request) -> None:
+    def submit(self, request: Request, *,
+               replay_prefix: Optional[Sequence[int]] = None,
+               replay_logprobs: Optional[Sequence[float]] = None) -> None:
         """Enqueue ``request``; raises :class:`QueueFull` at capacity
         (with queue depth + a retry-after hint attached) and
         :class:`~apex_tpu.serving.resilience.EngineFailed` once the
         health machine is terminal. Prompt-validity errors raise
         immediately; a prompt that already ends in the request's eos
-        token completes here with zero generated tokens."""
+        token completes here with zero generated tokens.
+
+        ``replay_prefix`` (router-facing) primes the grow-only
+        emitted-prefix snapshot with tokens the client ALREADY saw on
+        another replica before a failover: generation re-derives them
+        from the prompt and suppresses the duplicate events, exactly
+        like local fault replay, so the continued stream is
+        bit-identical."""
         if self.health.state == HEALTH_FAILED:
             raise EngineFailed(
                 f"engine health is failed ({self.health.last_cause}); "
@@ -766,6 +805,15 @@ class Scheduler:
                     f"raise EngineConfig.num_pages or shrink the "
                     f"request")
         self._record_request(request, now)
+        if replay_prefix:
+            # failover hand-off: everything another replica streamed
+            # becomes this scheduler's last-known-good snapshot — the
+            # same grow-only record a local fault replay maintains
+            st = self._replay.setdefault(request.request_id,
+                                         _ReplayState())
+            if len(replay_prefix) > len(st.tokens):
+                st.tokens = [int(t) for t in replay_prefix]
+                st.logprobs = list(replay_logprobs or [])
         self.queue.append(request)
         if rec is not None:
             rec.record("submit", request.request_id, len(prompt),
@@ -884,6 +932,23 @@ class Scheduler:
         ``n>1`` fan must not half-land) with the same hint a rejection
         would carry."""
         return len(self.queue) * self._chunk_ewma
+
+    def can_accept(self, n: int = 1) -> bool:
+        """Whether ``n`` more submissions fit the queue right now —
+        the all-or-nothing pre-flight the API front end (and the fleet
+        router, which aggregates it across replicas) checks before
+        fanning a batch that must not half-land. Capacity only:
+        terminal health surfaces as :class:`EngineFailed` from
+        :meth:`submit` (a 503, not a 429)."""
+        return len(self.queue) + n <= self.max_queue
+
+    @property
+    def chunk_latency_ewma_s(self) -> float:
+        """The measured decode-chunk latency EWMA (seconds; 0.0 before
+        any chunk landed) — the overload estimator behind deadline
+        shedding and retry-after hints, exposed so a fleet router can
+        weight replicas by how fast they actually serve."""
+        return self._chunk_ewma
 
     # -- internals ---------------------------------------------------------
 
@@ -1401,6 +1466,20 @@ class Scheduler:
                         rec.record("retry_exhausted", r.request_id,
                                    st.attempts)
                     self.health.record_fault("retry_exhausted")
+                    self._retry_exhausted += 1
+                    if self.on_evict is not None:
+                        # fleet hand-off: this replica gave up on the
+                        # request, but another may serve it — the
+                        # router resubmits with the emitted prefix so
+                        # the client stream continues, not errors
+                        self._evicted_requests += 1
+                        self._replay.pop(r.request_id, None)
+                        self._req_records.pop(r.request_id, None)
+                        self.on_evict(
+                            [EvictedRequest(r, list(st.tokens),
+                                            list(st.logprobs))],
+                            f"retry_exhausted ({cause}: {detail})")
+                        continue
                     self._abort(r, FINISH_ERROR, now, act=act,
                                 error=f"{cause}: {detail}; "
                                 f"{rcfg.max_retries} retries exhausted")
@@ -1431,11 +1510,17 @@ class Scheduler:
         ``error`` outcome (partial streams preserved) and mark the
         health machine failed. The process survives — callers see
         completions, not a crash. The terminal bundle dumps FIRST,
-        while the queue/slot state it should explain still exists."""
+        while the queue/slot state it should explain still exists.
+        With an :attr:`on_evict` hook, interrupted work is handed over
+        as :class:`EvictedRequest` records instead of error outcomes —
+        the fleet failover path."""
         if self.recorder is not None:
             self.recorder.record("failed", cause)
         self._maybe_dump("failed")
         self.health.fail(cause)
+        if self.on_evict is not None:
+            self._evict_all(cause)
+            return
         for slot, act in sorted(self.active.items()):
             self._abort(act.request, FINISH_ERROR, now, act=act,
                         error=cause)
@@ -1456,6 +1541,61 @@ class Scheduler:
             self.telemetry.queue_depth.set(0)
             self.telemetry.active_slots.set(0)
             self.telemetry.inflight.set(0)
+
+    def eject_all(self, cause: str) -> None:
+        """Router-facing: hand EVERY queued/active request to the
+        :attr:`on_evict` hook with its emitted prefix and clear this
+        scheduler's work — the circuit-breaker eviction (the engine
+        stays alive; the caller typically ``rebuild_slots()`` right
+        after, since in-flight chunks are discarded unfetched)."""
+        if self.on_evict is None:
+            raise ValueError(
+                "eject_all needs an on_evict hook — without one the "
+                "evicted requests would simply vanish")
+        self._evict_all(cause)
+
+    def _evict_all(self, cause: str) -> None:
+        """Hand every interrupted request (active slots first — they
+        were admitted earliest — then any chunked admission, then the
+        queue) to :attr:`on_evict` with its longest client-visible
+        stream, clearing this scheduler's work WITHOUT emitting error
+        events or completions: the fleet router owns their fate now.
+        In-flight chunks are discarded unfetched — anything they
+        carried re-derives on the healthy replica."""
+        evicted: List[EvictedRequest] = []
+
+        def take(request: Request, act: Optional[_Active]) -> None:
+            st = self._replay.pop(request.request_id, None)
+            tokens = list(act.tokens) if act is not None else []
+            lps = list(act.logprobs) if act is not None else []
+            if st is not None and len(st.tokens) > len(tokens):
+                # mid-replay: the pre-fault stream is the longest the
+                # client saw — never hand over a shrunk snapshot
+                tokens, lps = list(st.tokens), list(st.logprobs)
+            self._req_records.pop(request.request_id, None)
+            evicted.append(EvictedRequest(request, tokens, lps))
+
+        for slot, act in sorted(self.active.items()):
+            take(act.request, act)
+            self.engine.free_slot(slot)
+        if self._chunked is not None:
+            ca, cr = self._chunked
+            self._chunked = None
+            self.engine.free_slot(ca.slot)
+            take(cr, None)
+        for r in self.queue:
+            take(r, None)
+        self.active.clear()
+        self.queue.clear()
+        self._reset_free()
+        self._replay.clear()
+        self._inflight.clear()
+        self._evicted_requests += len(evicted)
+        if self.telemetry is not None:
+            self.telemetry.queue_depth.set(0)
+            self.telemetry.active_slots.set(0)
+            self.telemetry.inflight.set(0)
+        self.on_evict(evicted, cause)
 
     # -- flight recorder + post-mortem bundles -------------------------------
 
@@ -2132,9 +2272,13 @@ class Scheduler:
             "pipeline_depth": float(self.pipeline_depth),
             # resilience: recoveries + overload actions this run
             "retries": float(self._retries),
+            "retry_exhausted": float(self._retry_exhausted),
             "rebuilds": float(self._rebuilds),
             "shed": float(self._shed),
             "watchdog_trips": float(self._watchdog_trips),
+            # fleet: requests handed to the on_evict hook (0 without a
+            # router)
+            "evicted_requests": float(self._evicted_requests),
             "health_state": float(self.health.code),
             # black box: post-mortem bundles written (auto + manual)
             "bundles_written": float(len(self.bundles_written)),

@@ -83,6 +83,11 @@ EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
     # -- outcomes ----------------------------------------------------------
     "finish": ("request_id", "reason", "n_tokens"),
     "bundle": ("cause", "path"),
+    # -- fleet router (serving.fleet) ---------------------------------------
+    "route": ("request_id", "replica", "health", "est_wait_s"),
+    "failover": ("replica", "cause", "requests"),
+    "drain": ("replica", "phase"),
+    "restart": ("replica", "cause"),
 }
 
 

@@ -37,8 +37,8 @@ BASELINE_TOKENS_PER_SEC = 58600.0
 
 #: stable trajectory keys for the BENCH_serve.json series (bumped per
 #: PR so the per-line provenance is plottable without git archaeology)
-BENCH_PR = 11
-BENCH_LABEL = "paged-kv-chunked-prefill"
+BENCH_PR = 12
+BENCH_LABEL = "fleet-router"
 
 
 def chaos_smoke():
@@ -76,14 +76,16 @@ def chaos_smoke():
         return reqs
 
     def run(plan):
-        eng = Engine(cfg, params, mesh, ecfg, fault_plan=plan)
-        eng.warmup()
-        sched = Scheduler(eng, pipeline_depth=2, resilience=(
-            ResilienceConfig(backoff_base_s=0.002)))
-        for r in trace():
-            sched.submit(r)
-        sched.run_until_idle()
-        return sched
+        # context-managed: chaos engines are created per side — the
+        # close() releases the sentinel listener, host state survives
+        with Engine(cfg, params, mesh, ecfg,
+                    fault_plan=plan).warmup() as eng:
+            sched = Scheduler(eng, pipeline_depth=2, resilience=(
+                ResilienceConfig(backoff_base_s=0.002)))
+            for r in trace():
+                sched.submit(r)
+            sched.run_until_idle()
+            return sched
 
     # one fault at every seam: raised errors at admit + dispatch, a
     # NaN batch + a (0 s) hang at fetch — seeded indices, exact rerun
@@ -115,6 +117,116 @@ def chaos_smoke():
         "token_drift": 0,
         "health_state": s["health_state"],
     }))
+
+
+def fleet_smoke():
+    """``--mode serve --fleet``: the failover A/B — a fleet of 2
+    replicas with a deterministic kill-one-mid-burst drill
+    (``FleetFaultPlan.kill``) vs a clean single replica on the same
+    trace. Asserts the victim fails terminally, its interrupted
+    requests fail over, and EVERY stream is bit-identical to the
+    clean run (zero duplicate, zero lost tokens). Appends a
+    ``pr=12, label=fleet-router`` line to BENCH_serve.json. One JSON
+    line."""
+    import time as _time
+
+    from apex_tpu.serving import Request, SamplingParams
+    from apex_tpu.serving.engine import Engine, EngineConfig
+    from apex_tpu.serving.fleet import Router
+    from apex_tpu.serving.resilience import (
+        FleetFaultPlan, ResilienceConfig)
+    from apex_tpu.serving.scheduler import Scheduler
+
+    cfg = gpt.GPTConfig(
+        vocab_size=1024, hidden_size=256, num_layers=4, num_heads=8,
+        seq_len=256, remat=False, compute_dtype=jnp.float32)
+    ecfg = EngineConfig(slots=4, max_prompt_len=16, max_seq_len=32,
+                        decode_chunk=2)
+    mesh = mx.build_mesh(tp=1, devices=jax.devices()[:1])
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+
+    def trace():
+        reqs = []
+        for i in range(12):
+            p_len = 1 + (5 * i + 3) % ecfg.max_prompt_len
+            prompt = [int(t) for t in jax.random.randint(
+                jax.random.PRNGKey(500 + i), (p_len,), 0,
+                cfg.vocab_size)]
+            sp = (SamplingParams(temperature=0.9, top_k=40, seed=i)
+                  if i % 2 else SamplingParams())
+            reqs.append(Request(f"r{i}", prompt, max_tokens=8,
+                                sampling=sp))
+        return reqs
+
+    # clean single-replica reference
+    with Engine(cfg, params, mesh, ecfg).warmup() as eng:
+        sched = Scheduler(eng, pipeline_depth=2)
+        for r in trace():
+            sched.submit(r)
+        t0 = _time.perf_counter()
+        sched.run_until_idle()
+        single_wall = _time.perf_counter() - t0
+        clean = {rid: c.tokens for rid, c in sched.completions.items()}
+        single_tokens = sched.summary()["tokens_emitted"]
+
+    # fleet of 2, replica 1 killed mid-burst (retry headroom so the
+    # per-request retry bound can't drain the victim before the
+    # rebuild-storm counter crosses terminal — see FleetFaultPlan.kill)
+    plans = FleetFaultPlan.kill(1, 2, at=2)
+    scheds = [Scheduler(
+        Engine(cfg, params, mesh, ecfg, fault_plan=plans[i]).warmup(),
+        pipeline_depth=2,
+        resilience=ResilienceConfig(max_retries=8,
+                                    backoff_base_s=0.002,
+                                    # a throttled host's >30s chunk
+                                    # would breaker-evict the victim
+                                    # before the drill terminates it
+                                    watchdog_timeout_s=600.0))
+        for i in range(2)]
+    with Router(scheds) as router:
+        for r in trace():
+            router.submit(r)
+        t0 = _time.perf_counter()
+        router.run_until_idle()
+        fleet_wall = _time.perf_counter() - t0
+        s = router.summary()
+        assert len(router.completions) == 12, "fleet run lost requests"
+        assert scheds[1].health.state == "failed", \
+            "kill drill did not terminate replica 1"
+        assert s["failed_over_requests"] > 0, "nothing failed over"
+        drift = [rid for rid, c in router.completions.items()
+                 if c.tokens != clean[rid]]
+        assert not drift, f"failover token drift: {drift}"
+        fleet_tokens = s["tokens_emitted"]
+
+    line = {
+        "metric": "gpt_serve_fleet_failover",
+        "value": 1.0,
+        "unit": "pass",
+        "requests": 12,
+        "faults_fired": len(plans.injected),
+        "failover_waves": s["failover_waves"],
+        "failed_over_requests": s["failed_over_requests"],
+        "incidents": s["incidents"],
+        "token_drift": 0,
+        "fleet_tokens_per_sec": round(fleet_tokens / fleet_wall, 1),
+        "single_tokens_per_sec": round(single_tokens / single_wall, 1),
+    }
+    traj = {
+        "pr": BENCH_PR,
+        "label": BENCH_LABEL,
+        "metric": line["metric"],
+        "fleet_tokens_per_sec": line["fleet_tokens_per_sec"],
+        "single_tokens_per_sec": line["single_tokens_per_sec"],
+        "failed_over_requests": s["failed_over_requests"],
+        "token_drift": 0,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_serve.json")
+    with open(path, "a") as f:
+        f.write(json.dumps(traj) + "\n")
+    line["bench_out"] = os.path.basename(path)
+    print(json.dumps(line))
 
 
 def _api_wire_load(engine, reqs, inproc_tokens, vocab_size):
@@ -354,6 +466,8 @@ def serve(telemetry_out=None, api=False):
         engine.warmup()  # compile every (bucket, k) admission variant
         sweep[str(chunk)] = fmt(measure(f"chunk{chunk}", engine,
                                         pipeline_depth=2))
+        if chunk != 8:
+            engine.close()  # the chunk=8 engine rides on below
     head = sweep["8"]
     # the two admission/loop A/Bs ride the warm chunk=8 engine, same
     # burst, sides interleaved: pipelined (depth 2, batched admission)
@@ -383,6 +497,7 @@ def serve(telemetry_out=None, api=False):
         "ttft_speedup": round(s_flat["ttft_mean_ms"]
                               / max(s_pipe["ttft_mean_ms"], 1e-9), 3),
     }
+    flat_eng.close()
     if not on_tpu:
         # the acceptance A/B shape: the dispatch-dominated 1L/32h CPU
         # probe (DESIGN.md "Decode performance") at an admission-heavy
@@ -432,6 +547,8 @@ def serve(telemetry_out=None, api=False):
             "pipelined_ttft_mean_ms": round(
                 best["pipelined"]["ttft_mean_ms"], 2),
         }
+        new_eng.close()
+        old_eng.close()
     # KV-cache capacity A/B #1 — quantized cache: int8 storage vs the
     # compute-dtype cache on the warm chunk=8 trace (interleaved
     # best-of-reps). Cache bytes per slot is the headline (the
@@ -1087,9 +1204,19 @@ if __name__ == "__main__":
                     "server (SSE streaming) — wire-level served tok/s "
                     "+ TTFT, with a zero-token-drift assert against "
                     "the in-process engine")
+    ap.add_argument("--fleet", action="store_true",
+                    help="serve mode: run the fleet failover A/B "
+                    "(fleet-of-2 with a deterministic kill-one-"
+                    "replica-mid-burst drill vs a clean single "
+                    "replica) — asserts recovery + zero token drift "
+                    "and appends a fleet-router BENCH_serve.json line")
     args = ap.parse_args()
     if args.mode == "serve":
-        chaos_smoke() if args.chaos else serve(
-            telemetry_out=args.telemetry_out, api=args.api)
+        if args.chaos:
+            chaos_smoke()
+        elif args.fleet:
+            fleet_smoke()
+        else:
+            serve(telemetry_out=args.telemetry_out, api=args.api)
     else:
         main()

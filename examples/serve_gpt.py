@@ -63,6 +63,23 @@ deterministic faults at the engine seams for manual recovery drills —
 Interrupted requests are replayed/retried; the run prints what fired
 and the final health state.
 
+Fleet (``apex_tpu.serving.fleet``): ``--replicas N`` serves the trace
+through a health-aware Router over N engine replicas — submits placed
+on the best replica, failover + rolling restarts built in. Kill one
+mid-burst and watch every stream complete anyway (the router fails the
+interrupted requests over with their emitted prefixes; streams stay
+bit-identical)::
+
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python examples/serve_gpt.py --replicas 2 --kill-replica 1@4 \
+    --num-requests 8
+
+``--kill-replica i@t`` terminally fails replica ``i`` at its ``t``-th
+decode dispatch (a deterministic ``FleetFaultPlan.kill`` drill); the
+run prints the fleet summary, per-replica health, and any fleet
+incident manifest written next to the replica's own post-mortem
+bundle (``--bundle-dir``).
+
 Black box (``apex_tpu.telemetry.flightrec``): ``--bundle-dir DIR``
 arms the always-on flight recorder and auto-dumps a self-contained
 post-mortem bundle there on any fault detection / watchdog trip /
@@ -196,7 +213,19 @@ def main():
                     help="inject deterministic faults at the engine "
                     "seams: 'random:SEED[:N]' or a comma list of "
                     "point:index:kind[:arg] (see "
-                    "apex_tpu.serving.resilience.parse_fault_plan)")
+                    "apex_tpu.serving.resilience.parse_fault_plan); "
+                    "with --replicas > 1 it applies to replica 0")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="serve through a fleet Router over this many "
+                    "engine replicas (health-weighted routing, "
+                    "deterministic failover, rolling restarts); 1 = "
+                    "the plain single-engine scheduler")
+    ap.add_argument("--kill-replica", metavar="I@T", default=None,
+                    help="fleet chaos drill: terminally fail replica "
+                    "I at its T-th decode dispatch "
+                    "(FleetFaultPlan.kill) and show every stream "
+                    "complete anyway via failover; needs "
+                    "--replicas >= 2")
     ap.add_argument("--spec-k", type=int, default=0,
                     help="speculative decoding: draft this many tokens "
                     "per wave from a device-side n-gram drafter and "
@@ -261,28 +290,50 @@ def main():
     else:
         params = gpt.init(cfg, jax.random.PRNGKey(0))
 
+    if args.replicas < 1:
+        raise SystemExit("--replicas must be >= 1")
+    if args.kill_replica and args.replicas < 2:
+        raise SystemExit("--kill-replica needs --replicas >= 2 (a "
+                         "fleet of one has nowhere to fail over)")
     fault_plan = None
     if args.fault_plan:
         from apex_tpu.serving.resilience import parse_fault_plan
 
         fault_plan = parse_fault_plan(args.fault_plan)
         print(f"fault plan: {[s.describe() for s in fault_plan.specs]}")
+    kill_plan = None
+    if args.kill_replica:
+        from apex_tpu.serving.resilience import FleetFaultPlan
+
+        victim, at = args.kill_replica.split("@")
+        kill_plan = FleetFaultPlan.kill(int(victim), args.replicas,
+                                        at=int(at))
+        print(f"fleet kill drill: {kill_plan.describe()}")
     templates = [[int(t) for t in spec.split(",")]
                  for spec in (args.prefix_template or ())]
-    engine = Engine(cfg, params, mesh, EngineConfig(
+    ecfg = EngineConfig(
         slots=args.slots, max_prompt_len=args.max_prompt_len,
         max_seq_len=args.max_seq_len, decode_chunk=args.decode_chunk,
         prefix_pool_slots=len(templates), spec_k=args.spec_k,
         page_size=args.page_size, num_pages=args.max_pages,
-        prefill_chunk=args.prefill_chunk),
-        fault_plan=fault_plan)
+        prefill_chunk=args.prefill_chunk)
+
+    def replica_plan(i):
+        if kill_plan is not None:
+            return kill_plan[i]
+        return fault_plan if i == 0 else None
+
     # compile every program (init/step/retire + each (bucket, k)
     # admission variant + prefix pool inserts/extends) before the first
     # request — admission never traces mid-serve, and recompile_guard
     # could be armed right here
-    engine.warmup()
-    for t in templates:  # after warmup (which resets the pool)
-        engine.register_prefix(t)
+    engines = []
+    for i in range(args.replicas):
+        e = Engine(cfg, params, mesh, ecfg,
+                   fault_plan=replica_plan(i))
+        e.warmup()
+        engines.append(e)
+    engine = engines[0]
     long_len = 0
     if args.prefill_chunk and not args.requests:
         # a long-prompt line in the synthetic trace: longer than one
@@ -318,15 +369,40 @@ def main():
 
     # offline batch mode submits everything up front — size the queue to
     # the trace instead of dying on backpressure at the default 256
-    sched = Scheduler(engine, max_queue=max(256, len(reqs)),
-                      registry=registry, spans=spans,
+    bundle_meta = ({"params": {"ckpt": args.ckpt}} if args.ckpt
+                   else {"params": {"init_seed": 0}})
+    if args.replicas > 1:
+        from apex_tpu.serving.fleet import Router
+        from apex_tpu.serving.resilience import ResilienceConfig
+
+        # per-engine serving metrics would collide name-for-name in
+        # one registry, so the fleet registry carries the router's
+        # per-replica-labeled serving_fleet_* surface instead; the
+        # shared recorder gives ONE merged incident timeline. The
+        # kill drill needs retry headroom (see FleetFaultPlan.kill).
+        replica_scheds = [
+            Scheduler(e, max_queue=max(256, len(reqs)), spans=spans,
                       pipeline_depth=args.pipeline_depth,
                       recorder=recorder, bundle_dir=args.bundle_dir,
-                      # params provenance: telemetry.replay rebuilds
-                      # the model from a bundle with this
-                      bundle_meta=({"params": {"ckpt": args.ckpt}}
-                                   if args.ckpt
-                                   else {"params": {"init_seed": 0}}))
+                      bundle_meta=bundle_meta,
+                      resilience=ResilienceConfig(max_retries=8))
+            for e in engines]
+        sched = Router(replica_scheds, registry=registry,
+                       recorder=recorder, bundle_dir=args.bundle_dir)
+        for t in templates:  # every replica serves the hit
+            sched.register_prefix(t)
+        bundle_sched = replica_scheds[0]   # SIGUSR1 / /debug/bundle
+    else:
+        sched = Scheduler(engine, max_queue=max(256, len(reqs)),
+                          registry=registry, spans=spans,
+                          pipeline_depth=args.pipeline_depth,
+                          recorder=recorder, bundle_dir=args.bundle_dir,
+                          # params provenance: telemetry.replay rebuilds
+                          # the model from a bundle with this
+                          bundle_meta=bundle_meta)
+        for t in templates:  # after warmup (which resets the pool)
+            engine.register_prefix(t)
+        bundle_sched = sched
     if args.bundle_dir is not None:
         import signal
 
@@ -335,7 +411,7 @@ def main():
         # interrupted (same policy as the scheduler's auto-dump path).
         def _dump_on_signal(*_):
             try:
-                print(f"bundle: {sched.dump_bundle('sigusr1')}")
+                print(f"bundle: {bundle_sched.dump_bundle('sigusr1')}")
             except OSError as e:
                 print(f"bundle dump failed: {e}")
 
@@ -353,7 +429,7 @@ def main():
             sentinel=engine.recompile_sentinel(),
             health=sched.health.healthz, recorder=recorder,
             bundle_trigger=(
-                (lambda: sched.dump_bundle("http"))
+                (lambda: bundle_sched.dump_bundle("http"))
                 if args.bundle_dir is not None else None))
         print(f"metrics: {server.url}/metrics  /healthz  /vars  "
               f"/debug/events")
@@ -370,8 +446,20 @@ def main():
         print(f"chaos: {len(fault_plan.injected)} fault(s) fired "
               f"({[s.describe() for s in fault_plan.injected]}), "
               f"health={sched.health.state}")
-    if sched.bundles_written:
-        print(f"post-mortem bundles: {sched.bundles_written} — replay "
+    if kill_plan is not None:
+        status, body = sched.health.healthz()
+        print(f"fleet after kill drill: {len(kill_plan.injected)} "
+              f"fault(s) fired, /healthz {status} {body.strip()!r}")
+        for rep in sched.replicas:
+            print(f"  replica {rep.index}: state={rep.state} "
+                  f"health={rep.health_state} routed={rep.routed} "
+                  f"bundles={rep.sched.bundles_written}")
+        if sched.incidents_written:
+            print(f"  fleet incident manifests: "
+                  f"{sched.incidents_written}")
+    bundles = getattr(sched, "bundles_written", None)
+    if bundles:
+        print(f"post-mortem bundles: {bundles} — replay "
               f"with `python -m apex_tpu.telemetry.replay <bundle>`")
     if args.span_trace:
         with open(args.span_trace, "w") as f:

@@ -625,16 +625,13 @@ def test_scheduler_constraint_forces_token_sequence(devices8):
         assert comp.tokens == forced
         assert comp.finish_reason == "stop"
         # constrained requests need chunk=1 — enforced at submit
-        engine8 = Engine(cfg, params, mesh, EngineConfig(
-            slots=2, max_prompt_len=8, max_seq_len=32, decode_chunk=2,
-            prompt_buckets=(8,), admit_batch_sizes=(1,)))
-        engine8.warmup()  # apex: noqa[TIER1-COST]: second tiny engine for the chunk>1 rejection arm; warm-cache warmup is seconds
-        try:
+        with Engine(cfg, params, mesh, EngineConfig(
+                slots=2, max_prompt_len=8, max_seq_len=32, decode_chunk=2,
+                prompt_buckets=(8,), admit_batch_sizes=(1,))) as engine8:
+            engine8.warmup()  # apex: noqa[TIER1-COST]: second tiny engine for the chunk>1 rejection arm; warm-cache warmup is seconds
             with pytest.raises(ValueError, match="decode_chunk"):
                 Scheduler(engine8).submit(Request(
                     "r1", [3], max_tokens=4,
                     constraint=JsonSchemaConstraint({"enum": ["a"]})))
-        finally:
-            engine8.close()
     finally:
         engine.close()

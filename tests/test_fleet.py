@@ -1,0 +1,540 @@
+"""apex_tpu.serving.fleet — the fleet-resilience suite.
+
+Headline oracle (the PR-12 acceptance pin): with a seeded per-replica
+fault plan that terminally fails one of two replicas mid-burst, every
+client stream completes BIT-IDENTICAL to a clean single-replica run of
+the same trace (zero duplicate, zero lost tokens — the router fails
+interrupted requests over with their emitted-prefix snapshots and the
+target replica re-derives + suppresses), the fleet ``/healthz`` never
+leaves 200 while at least one replica is ``ok``, a drain → rebuild →
+re-admit rolling-restart cycle completes with zero shed requests, the
+failed replica auto-dumps a post-mortem bundle referenced by the fleet
+incident manifest, and the recompile guard stays flat per replica
+through all of it.
+
+Also here: the multi-engine recompile-sentinel regression (a second
+live engine's compiles must never be attributed to the first engine's
+armed guard — the hard prerequisite the router would otherwise trip)
+and the Engine/Router context-manager contract.
+"""
+
+import collections
+import json
+import os
+
+import jax
+import pytest
+
+from apex_tpu import mesh as mx
+from apex_tpu.models import gpt
+from apex_tpu.serving import Request, SamplingParams
+from apex_tpu.serving.engine import Engine, EngineConfig
+from apex_tpu.serving.fleet import (
+    REPLICA_COOLING,
+    REPLICA_FAILED,
+    REPLICA_LIVE,
+    FleetConfig,
+    Router,
+)
+from apex_tpu.serving.request import FINISH_ERROR
+from apex_tpu.serving.resilience import (
+    EngineFailed,
+    FaultPlan,
+    FaultSpec,
+    FleetFaultPlan,
+    ResilienceConfig,
+)
+from apex_tpu.serving.scheduler import QueueFull, Scheduler
+from apex_tpu.telemetry import FlightRecorder, Registry
+
+VOCAB = 96
+
+
+@pytest.fixture(scope="module")
+def model(devices8):
+    from apex_tpu.transformer.testing import standalone_gpt_config
+
+    cfg = standalone_gpt_config(vocab_size=VOCAB, seq_len=64)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    return cfg, params, mesh
+
+
+def _mk_sched(model, plan=None, *, slots=2, retries=8, **sched_kw):  # apex: noqa[TIER1-COST]: shared tiny-replica builder — one warm-cache warmup per replica serves every fleet test below
+    cfg, params, mesh = model
+    eng = Engine(cfg, params, mesh,
+                 EngineConfig(slots=slots, max_prompt_len=8,
+                              max_seq_len=24, decode_chunk=2),
+                 fault_plan=plan).warmup()
+    # watchdog generous: on a throttled host a >30s chunk would trip
+    # the router's breaker and evict the kill drill's victim before
+    # its dispatch indices are consumed (the fleet SURVIVES either
+    # way, but the drill tests assert the terminal outcome)
+    sched_kw.setdefault("resilience", ResilienceConfig(
+        max_retries=retries, backoff_base_s=0.001,
+        watchdog_timeout_s=600.0))
+    return Scheduler(eng, **sched_kw)
+
+
+def _reqs(n, *, seed0=7000, max_tokens=6):
+    """Deterministic mixed trace (greedy + seeded-sampled) — exactly
+    the per-request determinism failover bit-exactness rests on."""
+    out = []
+    for i in range(n):
+        p_len = 2 + (3 * i) % 6
+        prompt = [int(t) for t in jax.random.randint(
+            jax.random.PRNGKey(seed0 + i), (p_len,), 0, VOCAB)]
+        sp = (SamplingParams(temperature=0.9, top_k=7, seed=seed0 + i)
+              if i % 2 else SamplingParams())
+        out.append(Request(f"f{seed0}_{i}", prompt,
+                           max_tokens=max_tokens, sampling=sp))
+    return out
+
+
+@pytest.fixture(scope="module")
+def ref_sched(model):
+    """ONE clean single-replica scheduler shared by every oracle
+    reference run (request ids are unique per trace seed, so traces
+    stack on it without collision) — a module-level engine instead of
+    one warmup per test."""
+    sched = _mk_sched(model)
+    yield sched
+    sched.engine.close()
+
+
+def _clean_reference(ref, reqs):
+    """The oracle: the same trace through the clean replica."""
+    for r in reqs:
+        ref.submit(r)
+    ref.run_until_idle()
+    return {r.request_id: ref.completions[r.request_id].tokens
+            for r in reqs}
+
+
+def _drive_collecting(router):
+    """Run the fleet to idle, collecting per-request streamed tokens
+    and sampling the fleet healthz every tick."""
+    streamed = collections.defaultdict(list)
+    statuses = []
+    while not router.idle():
+        router.step()
+        statuses.append(router.health.healthz()[0])
+        for ev in router.pop_events():
+            if ev.token is not None:
+                streamed[ev.request_id].append(ev.token)
+        router._maybe_sleep()
+    return streamed, statuses
+
+
+# --- unit coverage (host-only, fast) ----------------------------------------
+
+
+def test_fleet_fault_plan_kill_random_and_validation():
+    plans = FleetFaultPlan.kill(1, 3, at=5, rebuilds=2)
+    assert len(plans) == 3
+    assert not plans[0].specs and not plans[2].specs
+    assert [s.index for s in plans[1].specs] == [5, 6]
+    assert all(s.point == "dispatch" and s.kind == "error"
+               for s in plans[1].specs)
+    assert "r1=" in plans.describe()
+    with pytest.raises(ValueError, match="outside fleet"):
+        FleetFaultPlan.kill(3, 3)
+    with pytest.raises(ValueError, match="at least one replica"):
+        FleetFaultPlan([])
+    # seeded randoms: derived per replica, bit-reproducible
+    a = FleetFaultPlan.random(11, 2, n_faults=2)
+    b = FleetFaultPlan.random(11, 2, n_faults=2)
+    assert [p.specs for p in a] == [p.specs for p in b]
+    assert a[0].specs != a[1].specs
+    a[0].take("admit")
+    a.reset()
+    assert a[0].counts()["admit"] == 0 and not a.injected
+
+
+def test_fleet_config_validation():
+    with pytest.raises(ValueError, match="breaker_guard_alarms"):
+        FleetConfig(breaker_guard_alarms=0)
+    with pytest.raises(ValueError, match="max_failovers"):
+        FleetConfig(max_failovers=0)
+    with pytest.raises(ValueError, match="cooldown"):
+        FleetConfig(breaker_cooldown_steps=0)
+
+
+def test_router_constructor_validation(model):
+    s0 = _mk_sched(model)
+    s1 = _mk_sched(model)
+    try:
+        with pytest.raises(ValueError, match="at least one replica"):
+            Router([])
+        with pytest.raises(ValueError, match="distinct"):
+            Router([s0, s0])
+        r = Router([s0, s1])
+        with pytest.raises(ValueError, match="exactly one router"):
+            Router([s0, s1])  # already owned
+        r.close()
+    finally:
+        s0.engine.close()
+        s1.engine.close()
+
+
+# --- routing + parity -------------------------------------------------------
+
+
+def test_router_routes_and_streams_match_single_replica(model, ref_sched):
+    """Clean-fleet oracle: requests spread over 2 replicas, merged
+    completions + streams bit-identical to the single-replica run,
+    fleet metrics/summary consistent."""
+    reqs = _reqs(8, seed0=7100)
+    want = _clean_reference(ref_sched, reqs)
+    registry = Registry()
+    rec = FlightRecorder()
+    with Router([_mk_sched(model), _mk_sched(model)],
+                registry=registry, recorder=rec) as router:
+        for r in reqs:
+            router.submit(r)
+        streamed, statuses = _drive_collecting(router)
+        assert len(router.completions) == len(reqs)
+        for r in reqs:
+            comp = router.completions[r.request_id]
+            assert comp.tokens == want[r.request_id], r.request_id
+            assert streamed[r.request_id] == comp.tokens
+        assert set(statuses) == {200}
+        s = router.summary()
+        assert s["routed"] == len(reqs)
+        assert s["failover_waves"] == 0 and s["aborted_requests"] == 0
+        # both replicas actually served (health-weighted spreading)
+        assert all(rep.routed > 0 for rep in router.replicas)
+        routed = registry.counter("serving_fleet_routed_total",
+                                  labels=("replica",))
+        assert sum(c.value for c in routed.children()) == len(reqs)
+        assert any(e[2] == "route" for e in rec.events())
+        # duplicate ids rejected fleet-wide
+        with pytest.raises(ValueError, match="duplicate"):
+            router.submit(reqs[0])
+
+
+# --- THE acceptance pin: kill one replica mid-burst -------------------------
+
+
+def test_kill_one_replica_mid_burst_streams_bit_identical(model, ref_sched, tmp_path):
+    """Replica 1 terminally fails mid-burst (seeded FleetFaultPlan):
+    every stream completes bit-identical to the clean run, the fleet
+    /healthz never leaves 200 (replica 0 stays ok), the victim
+    auto-dumps a post-mortem bundle, the fleet incident manifest links
+    it, and both replicas' recompile guards stay flat throughout."""
+    reqs = _reqs(8, seed0=7200)
+    want = _clean_reference(ref_sched, reqs)
+    plans = FleetFaultPlan.kill(1, 2, at=2)
+    rec = FlightRecorder()
+    bundle_dir = str(tmp_path / "incidents")
+    scheds = [_mk_sched(model, plans[i], bundle_dir=bundle_dir,
+                        recorder=rec)
+              for i in range(2)]
+    guards = [s.engine.recompile_guard() for s in scheds]
+    for g in guards:
+        g.__enter__()
+    with Router(scheds, recorder=rec, bundle_dir=bundle_dir) as router:
+        for r in reqs:
+            router.submit(r)
+        streamed, statuses = _drive_collecting(router)
+        # the victim died terminally; the fleet never stopped serving
+        assert scheds[1].health.state == "failed"
+        assert router.replicas[1].state == REPLICA_FAILED
+        assert set(statuses) == {200}, "fleet /healthz left 200"
+        # zero duplicate, zero lost tokens: streams == completions ==
+        # the clean single-replica oracle
+        assert len(router.completions) == len(reqs)
+        for r in reqs:
+            comp = router.completions[r.request_id]
+            assert comp.finish_reason != FINISH_ERROR, r.request_id
+            assert comp.tokens == want[r.request_id], r.request_id
+            assert streamed[r.request_id] == comp.tokens, r.request_id
+        s = router.summary()
+        assert s["failover_waves"] >= 1
+        assert s["failed_over_requests"] >= 1
+        # the victim's own black box fired...
+        victim_bundles = scheds[1].bundles_written
+        assert victim_bundles, "failed replica dumped no bundle"
+        # ...and the fleet incident manifest links it
+        assert len(router.incidents_written) == 1
+        with open(os.path.join(router.incidents_written[0],
+                               "manifest.json")) as f:
+            manifest = json.load(f)
+        assert manifest["replica"] == 1
+        assert manifest["replica_bundles"] == victim_bundles
+        assert set(manifest["evicted_request_ids"]) <= {
+            r.request_id for r in reqs}
+        # the router's flight recorder saw the failover decisions
+        names = [e[2] for e in rec.events()]
+        assert "failover" in names and "route" in names
+    # recompile guard flat per replica: rebuilds, eviction, terminal
+    # failure, and failover replays never compiled anything
+    for g in guards:
+        g.__exit__(None, None, None)
+        assert not g.tripped, g.alarms
+    for sc in scheds:
+        sc.engine.close()
+
+
+def test_retry_exhaustion_fails_over_instead_of_erroring(model, ref_sched):
+    """A request whose bounded retries exhaust on one replica is
+    handed to another replica and COMPLETES with its exact stream —
+    the single-engine error outcome becomes a fleet hand-off."""
+    reqs = _reqs(4, seed0=7300)
+    want = _clean_reference(ref_sched, reqs)
+    # three consecutive dispatch faults: attempts 1..3 > max_retries=2
+    # exhausts on the third, below max_consecutive_rebuilds+1=4 so the
+    # replica survives degraded (no terminal failure)
+    plan = FaultPlan([FaultSpec("dispatch", i, "error")
+                      for i in (1, 2, 3)])
+    scheds = [_mk_sched(model, plan if i == 1 else None, retries=2)
+              for i in range(2)]
+    with Router(scheds) as router:
+        for r in reqs:
+            router.submit(r)
+        router.run_until_idle()
+        assert scheds[1].health.state != "failed"
+        assert len(router.completions) == len(reqs)
+        for r in reqs:
+            comp = router.completions[r.request_id]
+            assert comp.finish_reason != FINISH_ERROR, r.request_id
+            assert comp.tokens == want[r.request_id], r.request_id
+        assert router.summary()["failed_over_requests"] >= 1
+        assert scheds[1].summary()["retry_exhausted"] >= 1
+
+
+def test_breaker_trips_on_watchdog_evicts_and_cools(model, ref_sched):
+    """Watchdog trips cross the breaker threshold: the replica's work
+    fails over, it leaves rotation (cooling), rejoins after the
+    cooldown, and every stream still completes bit-identically."""
+    reqs = _reqs(6, seed0=7400)
+    want = _clean_reference(ref_sched, reqs)
+    # replica 1 flags EVERY chunk as hung (timeout 0) — deterministic
+    scheds = [
+        _mk_sched(model),
+        _mk_sched(model, resilience=ResilienceConfig(
+            max_retries=8, backoff_base_s=0.001,
+            watchdog_timeout_s=0.0)),
+    ]
+    cfg = FleetConfig(breaker_watchdog_trips=2,
+                      breaker_cooldown_steps=5)
+    with Router(scheds, config=cfg) as router:
+        for r in reqs:
+            router.submit(r)
+        saw_cooling = False
+        while not router.idle():
+            router.step()
+            saw_cooling |= (router.replicas[1].state
+                            == REPLICA_COOLING)
+            router._maybe_sleep()
+        assert saw_cooling, "breaker never opened"
+        # a cooling replica counts as pending fleet work: idle() must
+        # hold ticks coming until the cooldown re-admits it (an
+        # idle-gated driver would otherwise strand it out of rotation
+        # forever — the all-cooling fleet would 429 every submit)
+        while router.replicas[1].state == REPLICA_COOLING:
+            assert not router.idle(), \
+                "idle() released the driver mid-cooldown"
+            router.step()
+        assert router.replicas[1].state == REPLICA_LIVE
+        assert len(router.completions) == len(reqs)
+        for r in reqs:
+            assert router.completions[r.request_id].tokens \
+                == want[r.request_id], r.request_id
+        assert router.summary()["failover_waves"] >= 1
+
+
+# --- drain-for-rolling-restart ----------------------------------------------
+
+
+def test_drain_rebuild_readmit_zero_shed(model, ref_sched):
+    """The rolling-restart primitive: drain a replica mid-burst, let
+    its in-flight requests finish on it, rebuild, re-admit — zero
+    requests shed or errored, streams bit-identical, the rest of the
+    fleet kept serving throughout."""
+    reqs = _reqs(10, seed0=7500)
+    want = _clean_reference(ref_sched, reqs)
+    rec = FlightRecorder()
+    with Router([_mk_sched(model), _mk_sched(model)],
+                recorder=rec) as router:
+        for r in reqs:
+            router.submit(r)
+        for _ in range(2):
+            router.step()
+        router.drain(1)
+        assert router.replicas[1].state == REPLICA_LIVE
+        router.run_until_idle()
+        assert len(router.completions) == len(reqs)
+        for r in reqs:
+            comp = router.completions[r.request_id]
+            assert comp.finish_reason != FINISH_ERROR
+            assert comp.tokens == want[r.request_id], r.request_id
+        assert router.summary()["drains"] == 1
+        shed = sum(sc.summary()["shed"] for sc in
+                   (rep.sched for rep in router.replicas))
+        assert shed == 0
+        phases = [e[3][1] for e in rec.events() if e[2] == "drain"]
+        assert phases == ["begin", "idle", "rebuilt", "readmit"]
+        # draining replica rejoined rotation for real
+        router.submit(Request("after_drain", [3, 5], max_tokens=3))
+        router.run_until_idle()
+        assert "after_drain" in router.completions
+
+
+def test_restart_replaces_failed_replica_from_factory(model):
+    """After a terminal failure, restart(i) builds a fresh replica
+    from the factory, re-admits it, and it serves again."""
+    plans = FleetFaultPlan.kill(1, 2, at=1)
+    built = []
+
+    def factory(i):
+        s = _mk_sched(model)
+        built.append(i)
+        return s
+
+    scheds = [_mk_sched(model, plans[i]) for i in range(2)]
+    with Router(scheds, factory=factory) as router:
+        for r in _reqs(6, seed0=7600):
+            router.submit(r)
+        router.run_until_idle()
+        assert router.replicas[1].state == REPLICA_FAILED
+        with pytest.raises(ValueError, match="terminally failed"):
+            router.drain(1)
+        router.restart(1)
+        assert built == [1]
+        assert router.replicas[1].state == REPLICA_LIVE
+        assert router.replicas[1].routable()
+        # the fresh replica takes traffic
+        router.submit(Request("post_restart", [2, 4, 6], max_tokens=3))
+        router.run_until_idle()
+        assert "post_restart" in router.completions
+        assert router.summary()["restarts"] == 1
+
+
+# --- fleet overload + terminal mapping --------------------------------------
+
+
+def test_fleet_queue_full_and_engine_failed(model):
+    s0 = _mk_sched(model, max_queue=2)
+    s1 = _mk_sched(model, max_queue=2)
+    with Router([s0, s1]) as router:
+        assert router.can_accept(4)
+        assert not router.can_accept(5)
+        for r in _reqs(4, seed0=7700):
+            router.submit(r)
+        with pytest.raises(QueueFull) as ei:
+            router.submit(Request("overflow", [1, 2], max_tokens=2))
+        assert ei.value.retry_after_s >= 0.0
+        assert router.summary()["queue_full"] == 1.0
+        router.run_until_idle()
+        # whole fleet terminal -> EngineFailed, the 503 mapping
+        for rep in router.replicas:
+            rep.sched.health.fail("test")
+        router.step()
+        assert router.health.healthz()[0] == 503
+        assert not router.can_accept(1)
+        with pytest.raises(EngineFailed):
+            router.submit(Request("dead", [1], max_tokens=1))
+
+
+# --- multi-engine recompile sentinel (the satellite regression) -------------
+
+
+def test_second_live_engine_not_attributed_to_first_guard(model):
+    """The router prerequisite: engine B's construction + warmup +
+    serving compiles while engine A's guard is armed must NOT trip A —
+    compile events attribute by tracked-cache ownership, and only
+    unclaimed process-wide strays alarm every guard."""
+    import numpy as np
+
+    a = _mk_sched(model).engine
+    sent_a = a.recompile_sentinel()
+    with a.recompile_guard() as g:
+        # a second live engine: constructed, warmed, and served while
+        # A's guard is armed
+        b = _mk_sched(model).engine
+        sent_b = b.recompile_sentinel()
+        b.admit(0, [1, 2, 3], 4)
+        b.step()
+        assert g.check() == {}, "B's compiles leaked into A's guard"
+    assert not g.tripped, g.alarms
+    # B's own sentinel tracked its programs (claim-based attribution)
+    assert all(v == 1 for v in
+               sent_b.compiles_total()["tracked"].values())
+    # an untracked stray compile is still a process-wide hazard: BOTH
+    # engines' guards see it
+    from apex_tpu.telemetry.recompile import RecompileError
+
+    with pytest.raises(RecompileError):
+        with a.recompile_guard():
+            jax.jit(lambda x: x * 3.5)(np.arange(5.0))
+    assert sent_a.compiles_total()["attributed"] >= 1
+    b.close()
+    a.close()
+
+
+# --- context managers (the close() footgun satellite) -----------------------
+
+
+def test_engine_and_router_context_managers(model):
+    cfg, params, mesh = model
+    with Engine(cfg, params, mesh,
+                EngineConfig(slots=1, max_prompt_len=8,
+                             max_seq_len=24)) as eng:
+        sent = eng.recompile_sentinel()
+        assert eng._sentinel is sent
+    assert eng._sentinel is None  # close() ran on exit
+    s0, s1 = _mk_sched(model), _mk_sched(model)
+    with Router([s0, s1]) as router:
+        assert router.engine is s0.engine
+    assert s0.on_evict is None and s1.on_evict is None
+    assert s0.engine._sentinel is None
+
+
+# --- seeded fleet chaos soak (slow) + its tier-1 smoke ----------------------
+
+
+def _chaos_fleet_run(model, ref, seed, n_reqs, kill_at):
+    """One seeded kill-one-replica soak: random per-replica faults on
+    top of the deterministic replica-1 kill."""
+    reqs = _reqs(n_reqs, seed0=9000 + seed)
+    want = _clean_reference(ref, reqs)
+    kill = FleetFaultPlan.kill(1, 2, at=kill_at)
+    noise = FleetFaultPlan.random(seed, 2, n_faults=2,
+                                  points=("fetch",), max_index=30)
+    # replica 0 gets the random noise (recoverable), replica 1 the
+    # kill — both deterministic, the soak replays exactly
+    plans = [noise[0], kill[1]]
+    scheds = [_mk_sched(model, plans[i]) for i in range(2)]
+    with Router(scheds) as router:
+        for r in reqs:
+            router.submit(r)
+        streamed, statuses = _drive_collecting(router)
+        assert len(router.completions) == n_reqs
+        drift = [r.request_id for r in reqs
+                 if router.completions[r.request_id].tokens
+                 != want[r.request_id]
+                 or streamed[r.request_id]
+                 != router.completions[r.request_id].tokens]
+        errored = [rid for rid, c in router.completions.items()
+                   if c.finish_reason == FINISH_ERROR]
+        assert not drift, f"seed {seed}: stream drift {drift}"
+        assert not errored, f"seed {seed}: errored {errored}"
+        assert 200 in statuses
+        return router.summary()
+
+
+def test_fleet_chaos_smoke(model, ref_sched):
+    """Tier-1 slice of the soak: one seed, kill + one random fetch
+    fault, bit-exact streams."""
+    s = _chaos_fleet_run(model, ref_sched, seed=3, n_reqs=6, kill_at=3)
+    assert s["failover_waves"] >= 1
+
+
+@pytest.mark.slow
+def test_fleet_chaos_soak_randomized(model, ref_sched):
+    """The replayable fleet soak: several seeds, every stream
+    bit-identical to its clean run despite a replica death plus
+    random recoverable faults on the survivor."""
+    for seed in (1, 2, 5):
+        _chaos_fleet_run(model, ref_sched, seed=seed, n_reqs=10, kill_at=2)

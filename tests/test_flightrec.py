@@ -90,7 +90,11 @@ def chaos_bundle(model, tmp_path_factory):
     assert [s for s in plan.injected if s.kind in ("error", "nan")], \
         "seed produced no hard fault — pick another seed"
     assert sched.bundles_written, "no auto-dumped bundle"
-    return sched.bundles_written[0], eng, sched, rec, reqs
+    yield sched.bundles_written[0], eng, sched, rec, reqs
+    # the guard-flat test arms a recompile guard on this engine, which
+    # installs its sentinel: close at module teardown so the listener
+    # never leaks into later modules (the engines-in-a-loop footgun)
+    eng.close()
 
 
 # --- recorder unit coverage (host-only, fast) -------------------------------

@@ -81,7 +81,13 @@ def test_page_allocator_semantics():
 # -- model-level logits parity (the XLA-fallback bit-exact oracle) ----------
 
 
-@pytest.mark.parametrize("kind", ["auto", "int8", "fp8"])
+@pytest.mark.parametrize("kind", [
+    "auto", "int8",
+    # fp8 rides the identical quantized read/write paths as int8 with
+    # only the storage dtype swapped — the costliest variant (~18 s)
+    # runs in the slow tier; int8 keeps the quantized arm in tier-1
+    # (tier-1 budget offset for the fleet-router suite)
+    pytest.param("fp8", marks=pytest.mark.slow)])
 def test_paged_decode_logits_oracle(devices8, kind):
     """Paged ``decode_step``/``decode_verify`` (block table through a
     scrambled page pool) emit BIT-identical logits to the contiguous
@@ -234,7 +240,12 @@ def _baseline(devices8, kind="auto"):
     return _STREAMS[key]
 
 
-@pytest.mark.parametrize("kind", ["auto", "int8"])
+@pytest.mark.parametrize("kind", [
+    "auto",
+    # the int8 engine-level stream parity is the logits oracle's int8
+    # arm composed with the (auto-covered) engine plumbing — slow tier
+    # (tier-1 budget offset for the fleet-router suite)
+    pytest.param("int8", marks=pytest.mark.slow)])
 def test_paged_engine_stream_parity(devices8, kind):
     """A paged engine emits BIT-identical token streams (greedy and
     sampled rows alike) to the contiguous engine — plain and

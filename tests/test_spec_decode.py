@@ -193,13 +193,12 @@ def test_spec_logprobs_and_stop_sequences(devices8):
             break
 
     def run_k(spec_k):
-        eng = Engine(cfg, params, mesh, EngineConfig(
-            slots=1, max_prompt_len=8, max_seq_len=32, decode_chunk=2,
-            spec_k=spec_k)).warmup()  # apex: noqa[TIER1-COST]: per-k helper on the tiny spec engine; warm-cache warmup is seconds
-        sched = _run(eng, [Request("s", prompt, max_tokens=10,
-                                   sampling=sp, stop=[stop])])
-        eng.close()
-        return sched.completions["s"]
+        with Engine(cfg, params, mesh, EngineConfig(
+                slots=1, max_prompt_len=8, max_seq_len=32, decode_chunk=2,
+                spec_k=spec_k)).warmup() as eng:  # apex: noqa[TIER1-COST]: per-k helper on the tiny spec engine; warm-cache warmup is seconds
+            sched = _run(eng, [Request("s", prompt, max_tokens=10,
+                                       sampling=sp, stop=[stop])])
+            return sched.completions["s"]
 
     spec, plain = run_k(3), run_k(0)
     assert spec.finish_reason == plain.finish_reason == "stop"
@@ -209,6 +208,7 @@ def test_spec_logprobs_and_stop_sequences(devices8):
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow  # plain tp2 parity (test_serving) and solo spec parity stay tier-1; the composition is long-suite (fleet-router tier-1 offset)
 def test_spec_tp2_matches_tp1(devices8):
     """Spec decode under tp=2 sharding emits the same streams as
     tp=1."""
@@ -218,16 +218,16 @@ def test_spec_tp2_matches_tp1(devices8):
 
     def run_tp(tp):
         mesh = mx.build_mesh(tp=tp, devices=devices8[:tp])
-        eng = Engine(cfg, params, mesh, EngineConfig(
-            slots=2, max_prompt_len=8, max_seq_len=24, decode_chunk=2,
-            spec_k=2)).warmup()  # apex: noqa[TIER1-COST]: tp-parity helper; tiny spec engine
-        sched = _run(eng, reqs)
-        eng.close()
-        return {k: c.tokens for k, c in sched.completions.items()}
+        with Engine(cfg, params, mesh, EngineConfig(
+                slots=2, max_prompt_len=8, max_seq_len=24, decode_chunk=2,
+                spec_k=2)).warmup() as eng:  # apex: noqa[TIER1-COST]: tp-parity helper; tiny spec engine
+            sched = _run(eng, reqs)
+            return {k: c.tokens for k, c in sched.completions.items()}
 
     assert run_tp(1) == run_tp(2)
 
 
+@pytest.mark.slow  # int8-KV parity and solo spec parity each stay tier-1; the composition is long-suite (fleet-router tier-1 offset)
 def test_spec_int8_kv_parity(devices8):
     """Under an int8 KV cache, spec and plain engines still emit
     bit-identical streams to each other: the verify forward quantizes
@@ -239,12 +239,11 @@ def test_spec_int8_kv_parity(devices8):
     reqs = _requests(3, 8, max_tokens=8)
 
     def run_k(spec_k):
-        eng = Engine(cfg, params, mesh, EngineConfig(
-            slots=2, max_prompt_len=8, max_seq_len=24, decode_chunk=2,
-            spec_k=spec_k)).warmup()  # apex: noqa[TIER1-COST]: int8-KV spec parity helper; tiny engine
-        sched = _run(eng, reqs)
-        eng.close()
-        return {k: c.tokens for k, c in sched.completions.items()}
+        with Engine(cfg, params, mesh, EngineConfig(
+                slots=2, max_prompt_len=8, max_seq_len=24, decode_chunk=2,
+                spec_k=spec_k)).warmup() as eng:  # apex: noqa[TIER1-COST]: int8-KV spec parity helper; tiny engine
+            sched = _run(eng, reqs)
+            return {k: c.tokens for k, c in sched.completions.items()}
 
     assert run_k(2) == run_k(0)
 
@@ -263,13 +262,12 @@ def test_spec_replay_after_fault_exact(devices8):
     reqs = _requests(4, 8, max_tokens=10)
 
     def run_plan(plan):
-        eng = Engine(cfg, params, mesh, EngineConfig(
-            slots=2, max_prompt_len=8, max_seq_len=32, decode_chunk=2,
-            spec_k=3), fault_plan=plan).warmup()  # apex: noqa[TIER1-COST]: fault-replay helper; warmed engine keeps replay exact
-        sched = _run(eng, reqs, resilience=ResilienceConfig(
-            backoff_base_s=0.001))
-        eng.close()
-        return sched
+        with Engine(cfg, params, mesh, EngineConfig(
+                slots=2, max_prompt_len=8, max_seq_len=32, decode_chunk=2,
+                spec_k=3), fault_plan=plan).warmup() as eng:  # apex: noqa[TIER1-COST]: fault-replay helper; warmed engine keeps replay exact
+            sched = _run(eng, reqs, resilience=ResilienceConfig(
+                backoff_base_s=0.001))
+            return sched
 
     chaotic = run_plan(FaultPlan([FaultSpec("fetch", 2, "error")]))
     clean = run_plan(None)

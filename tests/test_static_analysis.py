@@ -34,7 +34,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: the allowlist pin (satellite contract: this number may only go
 #: DOWN; new suppressions need to displace an old one or justify a
 #: bump here with the review that approved it)
-MAX_ACTIVE_SUPPRESSIONS = 25
+#: 25 -> 24 (fleet-router PR): test_fleet.py's shared tiny-replica
+#: builder `_mk_sched` added one def-line suppression (same shape as
+#: test_paged_cache's `_mk_engine`), displaced by slow-marking the
+#: prefix-registration contract test (its two suppressions removed);
+#: tier-1 runtime offset by slow-marking variant-redundant serving
+#: oracles (see the `fleet-router tier-1 offset` markers)
+MAX_ACTIVE_SUPPRESSIONS = 24
 
 
 def _rules_of(result):

@@ -225,7 +225,10 @@ def test_sentinel_uninstall_releases_listener():
     """install/uninstall is listener-neutral — engines created in a
     loop must not grow jax.monitoring's listener list (uninstall used
     to silently no-op: the private unregister helpers live on
-    jax._src.monitoring, not the public re-export)."""
+    jax._src.monitoring, not the public re-export). All live sentinels
+    now share ONE refcounted hub listener: a second sentinel adds no
+    registration, and the LAST uninstall releases the one there is —
+    pinned here so N engine replicas hold exactly one listener."""
     try:
         from jax._src import monitoring as impl
     except ImportError:
@@ -240,7 +243,13 @@ def test_sentinel_uninstall_releases_listener():
     assert len(get()) == n0 + 1
     sent.install()  # idempotent: no second registration
     assert len(get()) == n0 + 1
+    # a SECOND sentinel shares the hub's one listener (refcount), and
+    # releasing either order leaves the other's delivery intact
+    sent2 = rc.RecompileSentinel().install()
+    assert len(get()) == n0 + 1
     sent.uninstall()
+    assert len(get()) == n0 + 1  # sent2 still holds the hub
+    sent2.uninstall()
     assert len(get()) == n0
     sent.uninstall()  # idempotent
 
