@@ -14,6 +14,17 @@ attribute must be *referenced* from the intra-class call closure of
 (when defined). A reference is a direct ``self._X`` read, or — for the
 ``getattr(self, f"_{name}")`` indirection the cache-size probe uses —
 the bare program name appearing as a string constant in the closure.
+
+Knob ladders (``serving.tuner``): a module-level ``VARIANT_KNOBS``
+dict declares which tuner knobs select compiled device variants and
+which program FAMILY attribute holds them (``{"decode_chunk":
+"_step_variants", ...}``). The runtime half of the pre-warm contract —
+every TunerConfig candidate validated against the engine's resolved
+ladder — lives in the scheduler; the static half is pinned here: each
+named family must exist as a compiled-program dict on a
+warmup-defining class (the base checks above then force it through
+``warmup()`` and the trackers), so a knob can never point at variants
+that would compile (and trip the armed recompile guard) mid-serve.
 """
 
 from __future__ import annotations
@@ -21,20 +32,25 @@ from __future__ import annotations
 import ast
 from typing import Dict, Iterable, List, Set, Tuple
 
-from apex_tpu.analysis._astutil import attr_reads, string_constants
+from apex_tpu.analysis._astutil import attr_reads, const_str, string_constants
 from apex_tpu.analysis.core import Finding, Project
 from apex_tpu.analysis.rules.compiled import collect_class_programs
+
+#: the knob→program-family declaration the ladder check keys on
+_KNOB_MAP_NAME = "VARIANT_KNOBS"
 
 
 class WarmupCoverageRule:
     id = "WARMUP-COVERAGE"
     summary = ("every compiled program variant must be reachable from "
                "warmup() and tracked by compiled_cache_sizes()/the "
-               "recompile sentinel")
+               "recompile sentinel; tuner VARIANT_KNOBS must name "
+               "real warmed program families")
     triggers: Tuple[str, ...] = ()
 
     def run(self, project: Project) -> Iterable[Finding]:
         findings: List[Finding] = []
+        findings.extend(self._check_knob_ladders(project))
         for ctx in project.targets:
             for cp in collect_class_programs(ctx):
                 methods: Dict[str, ast.FunctionDef] = {
@@ -63,6 +79,48 @@ class WarmupCoverageRule:
                             f"tracked by compiled_cache_sizes()/"
                             f"recompile_sentinel() — its recompiles "
                             f"would be invisible to the guard"))
+        return findings
+
+    def _check_knob_ladders(self, project: Project) -> List[Finding]:
+        """Link VARIANT_KNOBS declarations to real compiled-program
+        dict families on warmup-defining classes (package-wide — the
+        tuner module and the engine are different files, and a partial
+        run must not read their separation as drift)."""
+        findings: List[Finding] = []
+        declares = []  # (ctx, knob, attr, line)
+        for ctx in project.targets:
+            if ctx.tree is None:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and node.targets[0].id == _KNOB_MAP_NAME
+                        and isinstance(node.value, ast.Dict)):
+                    continue
+                for k, v in zip(node.value.keys, node.value.values):
+                    knob, attr = const_str(k), const_str(v)
+                    if knob is not None and attr is not None:
+                        declares.append((ctx, knob, attr, k.lineno))
+        if not declares:
+            return findings
+        project.ensure_package_index()
+        families: Set[str] = set()
+        for octx in project.by_rel.values():
+            for cp in collect_class_programs(octx):
+                if any(m.name == "warmup" for m in cp.methods()):
+                    families.update(
+                        name for name, p in cp.programs.items()
+                        if p.is_dict)
+        for ctx, knob, attr, line in declares:
+            if attr not in families:
+                findings.append(Finding(
+                    self.id, ctx.rel, line,
+                    f"tuner knob {knob!r} maps to self.{attr}, which "
+                    f"no warmup-defining class builds as a "
+                    f"compiled-program family — its candidate ladder "
+                    f"could select variants warmup() never compiles, "
+                    f"tripping the armed recompile guard mid-serve"))
         return findings
 
     @staticmethod

@@ -44,11 +44,12 @@ from apex_tpu.serving.request import (  # noqa: F401
 
 __all__ = [
     "request", "sampling", "engine", "scheduler", "resilience", "api",
-    "pages", "fleet",
+    "pages", "fleet", "tuner",
     "Request", "SamplingParams", "Completion", "StreamEvent",
     "StopMatcher",
     "Engine", "EngineConfig", "Scheduler", "QueueFull",
-    "SpecGateConfig", "Admission", "AdmitResult", "StepHandle",
+    "SpecGateConfig", "TunerConfig", "Controller",
+    "Admission", "AdmitResult", "StepHandle",
     "ChunkedAdmission", "PageAllocator", "PagesExhausted",
     "FaultPlan", "FaultSpec", "FleetFaultPlan", "ResilienceConfig",
     "HealthMonitor", "EngineFault", "InjectedFault", "EngineFailed",
@@ -78,6 +79,9 @@ _LAZY = {
     "QueueFull": "apex_tpu.serving.scheduler",
     "SpecGateConfig": "apex_tpu.serving.scheduler",
     "EvictedRequest": "apex_tpu.serving.scheduler",
+    "tuner": "apex_tpu.serving.tuner",
+    "TunerConfig": "apex_tpu.serving.tuner",
+    "Controller": "apex_tpu.serving.tuner",
     "fleet": "apex_tpu.serving.fleet",
     "Router": "apex_tpu.serving.fleet",
     "FleetConfig": "apex_tpu.serving.fleet",

@@ -186,6 +186,25 @@ class EngineConfig:
     #: off-TPU config — the ``gpt.prefill_extend`` parity contract).
     #: 0 disables.
     prefill_chunk: int = 0
+    #: static ladder of decode-chunk STEP VARIANTS: each value is one
+    #: compiled step program (spec variants cross with ``spec_ks``),
+    #: all compiled by :meth:`Engine.warmup` and tracked per variant,
+    #: so a self-tuning scheduler (``serving.tuner``) switches chunk
+    #: size per dispatch with the recompile guard armed. Must be
+    #: strictly increasing and contain ``decode_chunk`` (the base
+    #: operating point). None = ``(decode_chunk,)`` — the historical
+    #: single-variant engine. Token streams are bit-identical at every
+    #: rung (the chunk-parity oracle).
+    decode_chunks: Optional[Tuple[int, ...]] = None
+    #: static ladder of speculative draft widths: each non-zero value
+    #: is one compiled spec step variant PER decode-chunk rung (the
+    #: tuner's ``spec_k=0`` rung is the plain variant, not a program).
+    #: Must be strictly increasing, all >= 1, and contain ``spec_k``
+    #: when ``spec_k > 0``. None = ``(spec_k,)`` if ``spec_k > 0``
+    #: else no speculation. ``spec_ks`` with ``spec_k == 0`` is valid:
+    #: the engine carries the drafter machinery and warm spec variants
+    #: but dispatches plain until a tuner asks otherwise.
+    spec_ks: Optional[Tuple[int, ...]] = None
 
 
 #: eos sentinel in the per-slot eos vector: no stop token for this slot
@@ -423,13 +442,15 @@ class Engine:
                 f"decode_chunk {ecfg.decode_chunk} must be >= 1")
         if ecfg.spec_k < 0:
             raise ValueError(f"spec_k {ecfg.spec_k} must be >= 0")
-        if ecfg.spec_k > 0 and ecfg.spec_hist < 2:
+        self._chunk_ladder = self._resolve_chunk_ladder(ecfg)
+        self._spec_ladder = self._resolve_spec_ladder(ecfg)
+        if self._spec_ladder and ecfg.spec_hist < 2:
             raise ValueError(
                 f"spec_hist {ecfg.spec_hist} must be >= 2 with "
-                f"spec_k > 0 (the drafter matches a 2-token suffix)")
-        if ecfg.spec_k > 0 and cfg.num_experts:
+                f"speculation (the drafter matches a 2-token suffix)")
+        if self._spec_ladder and cfg.num_experts:
             raise ValueError(
-                "spec_k > 0 does not compose with num_experts > 0: the "
+                "speculation does not compose with num_experts > 0: the "
                 "batched verify forward routes a different token count "
                 "than sequential steps, so MoE expert capacity breaks "
                 "spec == plain bit-parity (see gpt.decode_verify)")
@@ -614,6 +635,41 @@ class Engine:
         return sizes
 
     @staticmethod
+    def _resolve_chunk_ladder(ecfg: EngineConfig) -> Tuple[int, ...]:
+        chunks = ecfg.decode_chunks
+        if chunks is None:
+            return (ecfg.decode_chunk,)
+        chunks = tuple(int(c) for c in chunks)
+        if not chunks or list(chunks) != sorted(set(chunks)) \
+                or chunks[0] < 1:
+            raise ValueError(
+                f"decode_chunks must be a strictly increasing ladder of "
+                f"values >= 1, got {chunks}")
+        if ecfg.decode_chunk not in chunks:
+            raise ValueError(
+                f"decode_chunks {chunks} must contain decode_chunk "
+                f"{ecfg.decode_chunk} — the base operating point must "
+                f"be a compiled variant")
+        return chunks
+
+    @staticmethod
+    def _resolve_spec_ladder(ecfg: EngineConfig) -> Tuple[int, ...]:
+        ks = ecfg.spec_ks
+        if ks is None:
+            return (ecfg.spec_k,) if ecfg.spec_k > 0 else ()
+        ks = tuple(int(k) for k in ks)
+        if not ks or list(ks) != sorted(set(ks)) or ks[0] < 1:
+            raise ValueError(
+                f"spec_ks must be a strictly increasing ladder of "
+                f"values >= 1 (0 — the plain variant — is a tuner "
+                f"rung, not a compiled spec program), got {ks}")
+        if ecfg.spec_k > 0 and ecfg.spec_k not in ks:
+            raise ValueError(
+                f"spec_ks {ks} must contain spec_k {ecfg.spec_k} — the "
+                f"base operating point must be a compiled variant")
+        return ks
+
+    @staticmethod
     def _resolve_prefix_variants(ecfg: EngineConfig,
                                  buckets: Tuple[int, ...]):
         """The prefix pool's static-shape families: usable SPLIT points
@@ -658,7 +714,7 @@ class Engine:
         pspecs = gpt.param_specs(cfg)
         B = ecfg.slots
         pad = jnp.int32(ecfg.pad_token_id)
-        spec = ecfg.spec_k > 0
+        spec = bool(self._spec_ladder)
         self._spec = spec
         # cache [l, 2, B, heads, S, d]: heads are the tp-sharded dim
         # (under a quantized kv_cache_dtype this is the {"kv", "scale"}
@@ -699,51 +755,57 @@ class Engine:
                                          jnp.int32)
             return cache, state
 
-        def step_core(params, cache, state, masks, table):
-            # the whole per-token body (decode + per-slot draw +
-            # eos/budget masking) lives in gpt.decode_steps — ONE
-            # compiled scan of decode_chunk steps per dispatch; masks
-            # is the per-slot constrained-decoding vocab whitelist
-            # (all-True rows are bit-identical to no mask); table is
-            # the paged block table (None = contiguous layout)
-            hist = state["hist"] if spec else None
-            pos0 = state["pos"]
-            cache, state, toks, lps, fins = gpt.decode_steps(
-                cfg, params, cache, state, ecfg.decode_chunk,
-                pad_token_id=ecfg.pad_token_id, masks=masks,
-                table=table)
-            if spec:
-                # keep the drafter's history fresh across PLAIN chunks
-                # too (a payoff-gated scheduler flips between the two
-                # variants): the chunk's emitted prefix per row is
-                # pos_after - pos_before columns — shift it into the
-                # ring so a later spec chunk drafts from real context
-                state = {**state, "hist": gpt.shift_hist(
-                    hist, toks, state["pos"] - pos0)}
-            return cache, state, toks, lps, fins
+        def make_step_core(chunk: int):
+            def step_core(params, cache, state, masks, table):
+                # the whole per-token body (decode + per-slot draw +
+                # eos/budget masking) lives in gpt.decode_steps — ONE
+                # compiled scan of `chunk` steps per dispatch; masks
+                # is the per-slot constrained-decoding vocab whitelist
+                # (all-True rows are bit-identical to no mask); table
+                # is the paged block table (None = contiguous layout)
+                hist = state["hist"] if spec else None
+                pos0 = state["pos"]
+                cache, state, toks, lps, fins = gpt.decode_steps(
+                    cfg, params, cache, state, chunk,
+                    pad_token_id=ecfg.pad_token_id, masks=masks,
+                    table=table)
+                if spec:
+                    # keep the drafter's history fresh across PLAIN
+                    # chunks too (a payoff-gated or tuner-driven
+                    # scheduler flips between the variants): the
+                    # chunk's emitted prefix per row is pos_after -
+                    # pos_before columns — shift it into the ring so a
+                    # later spec chunk drafts from real context
+                    state = {**state, "hist": gpt.shift_hist(
+                        hist, toks, state["pos"] - pos0)}
+                return cache, state, toks, lps, fins
 
-        def step_spec_core(params, cache, state, masks, table):
-            # the speculative chunk: decode_chunk draft-verify-accept
-            # waves, emitting up to decode_chunk*(spec_k+1) columns
-            # (valid marks the real ones); bit-identical streams to
-            # step_local by the token-matching verification contract
-            return gpt.decode_steps_spec(
-                cfg, params, cache, state, ecfg.decode_chunk,
-                spec_k=ecfg.spec_k, pad_token_id=ecfg.pad_token_id,
-                masks=masks, table=table)
+            return step_core
 
-        if paged:
-            # the cores already take the table last — they ARE the
-            # paged step programs
-            step_local = step_core
-            step_spec_local = step_spec_core
-        else:
+        def make_step_spec_core(chunk: int, k: int):
+            def step_spec_core(params, cache, state, masks, table):
+                # the speculative chunk: `chunk` draft-verify-accept
+                # waves, emitting up to chunk*(k+1) columns (valid
+                # marks the real ones); bit-identical streams to the
+                # plain variants by the token-matching verification
+                # contract
+                return gpt.decode_steps_spec(
+                    cfg, params, cache, state, chunk,
+                    spec_k=k, pad_token_id=ecfg.pad_token_id,
+                    masks=masks, table=table)
+
+            return step_spec_core
+
+        def adapt_step(core):
+            if paged:
+                # the cores already take the table last — they ARE the
+                # paged step programs
+                return core
+
             def step_local(params, cache, state, masks):
-                return step_core(params, cache, state, masks, None)
+                return core(params, cache, state, masks, None)
 
-            def step_spec_local(params, cache, state, masks):
-                return step_spec_core(params, cache, state, masks,
-                                      None)
+            return step_local
 
         def make_admit(bucket: int):
             n_ins = -(-bucket // p_sz) if paged else 0
@@ -827,20 +889,27 @@ class Engine:
         scalar = P()
         n_step_args = 2 if paged else 1  # masks (+ tables)
         self._init = sm(init_local, (pspecs,), (cache_spec, state_spec))
-        self._step = sm(
-            step_local,
-            (pspecs, cache_spec, state_spec) + (scalar,) * n_step_args,
-            (cache_spec, state_spec, scalar, scalar, scalar),
-            donate=(1, 2))
-        self._step_spec = None
-        if spec:
-            self._step_spec = sm(
-                step_spec_local,
+        # one compiled step program per decode-chunk rung, and one
+        # spec variant per (chunk, k) cross — a self-tuning scheduler
+        # switches among them per dispatch, all pre-warmed, so the
+        # armed recompile guard never trips (serving.tuner's contract)
+        self._step_variants: Dict[int, Any] = {}
+        self._spec_variants: Dict[Tuple[int, int], Any] = {}
+        for c in self._chunk_ladder:
+            self._step_variants[c] = sm(
+                adapt_step(make_step_core(c)),
                 (pspecs, cache_spec, state_spec)
                 + (scalar,) * n_step_args,
-                (cache_spec, state_spec, scalar, scalar, scalar,
-                 scalar),
+                (cache_spec, state_spec, scalar, scalar, scalar),
                 donate=(1, 2))
+            for k in self._spec_ladder:
+                self._spec_variants[(c, k)] = sm(
+                    adapt_step(make_step_spec_core(c, k)),
+                    (pspecs, cache_spec, state_spec)
+                    + (scalar,) * n_step_args,
+                    (cache_spec, state_spec, scalar, scalar, scalar,
+                     scalar),
+                    donate=(1, 2))
         # one admission program per (bucket, k) — the k dim and padded
         # width are static shapes, everything request-scoped is data
         # (paged engines thread the per-row page indices, spec engines
@@ -1152,6 +1221,20 @@ class Engine:
         return self._batch_sizes
 
     @property
+    def decode_chunks(self) -> Tuple[int, ...]:
+        """The resolved decode-chunk step-variant ladder (ascending;
+        always contains the base ``decode_chunk``) — every rung is one
+        pre-warmed compiled step program a tuner may dispatch."""
+        return self._chunk_ladder
+
+    @property
+    def spec_ks(self) -> Tuple[int, ...]:
+        """The resolved speculative draft-width ladder (ascending;
+        empty = no speculation) — every rung crosses with every
+        decode-chunk rung as one pre-warmed spec step program."""
+        return self._spec_ladder
+
+    @property
     def prefix_pool_enabled(self) -> bool:
         """True when ``EngineConfig.prefix_pool_slots > 0`` resolved to
         at least one usable split point."""
@@ -1388,6 +1471,8 @@ class Engine:
             "tp": int(self._mesh.shape.get("tp", 1)),
             "prompt_buckets": list(self._buckets),
             "admit_batch_sizes": list(self._batch_sizes),
+            "decode_chunks": list(self._chunk_ladder),
+            "spec_ks": list(self._spec_ladder),
             "prefix_templates": [list(self._prefix_tokens[p])
                                  for p in sorted(self._prefix_tokens)],
             "warmed": self._warmed,
@@ -1837,7 +1922,9 @@ class Engine:
             row[h - 1 - tail.size:] = tail
         return row
 
-    def step_async(self, *, spec: bool = False) -> StepHandle:
+    def step_async(self, *, spec: bool = False,
+                   chunk: Optional[int] = None,
+                   spec_k: Optional[int] = None) -> StepHandle:
         """Dispatch one decode chunk WITHOUT fetching its outputs: the
         engine rebinds its (donated) cache/state to the returned device
         futures immediately, so the caller may enqueue further work —
@@ -1845,22 +1932,51 @@ class Engine:
         the device never idles through the host's fetch + event
         processing. Returns the chunk's :class:`StepHandle`.
 
-        ``spec=True`` dispatches the SPECULATIVE chunk variant
-        (``EngineConfig.spec_k > 0`` required — both variants are
-        pre-warmed, so a payoff-gated scheduler switches per dispatch
-        without a recompile): the handle's tokens/logprobs/finished are
-        ``[B, decode_chunk * (spec_k + 1)]`` with ``handle.valid``
-        marking the real emissions (rejected draft lanes emit pad)."""
+        ``spec=True`` dispatches the SPECULATIVE chunk variant (a
+        compiled ``spec_ks`` rung required — every variant is
+        pre-warmed, so a payoff-gated or tuner-driven scheduler
+        switches per dispatch without a recompile): the handle's
+        tokens/logprobs/finished are ``[B, chunk * (spec_k + 1)]``
+        with ``handle.valid`` marking the real emissions (rejected
+        draft lanes emit pad).
+
+        ``chunk``/``spec_k`` select among the pre-warmed step variants
+        (``EngineConfig.decode_chunks`` / ``spec_ks`` — the self-tuning
+        scheduler's per-dispatch knob values); ``None`` means the base
+        ``decode_chunk`` / ``spec_k``. A value outside the compiled
+        ladder raises instead of compiling mid-serve: dispatching an
+        unwarmed variant is exactly the trace-stability breach the
+        armed recompile guard exists to catch."""
         self._check_poisoned()
+        c = self.engine_cfg.decode_chunk if chunk is None else int(chunk)
+        if c not in self._step_variants:
+            raise ValueError(
+                f"decode_chunk {c} is not a pre-warmed step variant "
+                f"{self._chunk_ladder} — declare it in "
+                f"EngineConfig.decode_chunks (dispatching it would "
+                f"compile mid-serve)")
+        if spec:
+            if not self._spec:
+                raise ValueError(
+                    "step_async(spec=True) needs a compiled spec "
+                    "variant (EngineConfig.spec_k > 0 or spec_ks)")
+            k = (self.engine_cfg.spec_k if spec_k is None
+                 else int(spec_k))
+            if (c, k) not in self._spec_variants:
+                raise ValueError(
+                    f"spec_k {k} (at decode_chunk {c}) is not a "
+                    f"pre-warmed spec variant — declare it in "
+                    f"EngineConfig.spec_ks {self._spec_ladder}")
+        elif spec_k not in (None, 0):
+            raise ValueError(
+                f"spec_k={spec_k} without spec=True — a plain chunk "
+                f"has no draft width")
         fspec = self._take_fault("dispatch")
         if fspec is not None and fspec.kind == KIND_ERROR:
             self._poisoned = True
             raise InjectedFault(
                 f"injected device error at dispatch: "
                 f"{fspec.describe()}", point="dispatch", spec=fspec)
-        if spec and not self._spec:
-            raise ValueError(
-                "step_async(spec=True) needs EngineConfig.spec_k > 0")
         if self._masks_dev is None:
             self._masks_dev = jnp.asarray(self._masks)
         step_extra: Tuple[Any, ...] = ()
@@ -1871,20 +1987,19 @@ class Engine:
             if self._tables_dev is None:
                 self._tables_dev = jnp.asarray(self._tables)
             step_extra = (self._tables_dev,)
-        chunk = self.engine_cfg.decode_chunk
         valid = None
         if spec:
             (self.cache, self.state, emit, logprobs, finished,
-             valid) = self._step_spec(
+             valid) = self._spec_variants[(c, k)](
                 self._params, self.cache, self.state, self._masks_dev,
                 *step_extra)
-            spec_k = self.engine_cfg.spec_k
-            ncols = chunk * (spec_k + 1)
+            spec_k, ncols = k, c * (k + 1)
         else:
             self.cache, self.state, emit, logprobs, finished = \
-                self._step(self._params, self.cache, self.state,
-                           self._masks_dev, *step_extra)
-            spec_k, ncols = 0, chunk
+                self._step_variants[c](
+                    self._params, self.cache, self.state,
+                    self._masks_dev, *step_extra)
+            spec_k, ncols = 0, c
         plan = None if self._warming else self.fault_plan
         return StepHandle(emit, logprobs, finished, plan=plan,
                           hang=fspec if fspec is not None
@@ -2110,13 +2225,15 @@ class Engine:
                 np.ones((1, self.cfg.vocab_size), bool), np.int32(0),
                 *wpages(1, tb), *hseed(1))
             np.asarray(first)
-        handle = self.step_async()
-        handle.fetch()
-        if self._spec:
-            # the speculative chunk variant compiles here too, so the
-            # scheduler's payoff gate can flip spec/plain per dispatch
-            # under an armed recompile guard
-            self.step_async(spec=True).fetch()
+        # every step variant compiles here — each decode-chunk rung
+        # and each (chunk, spec_k) cross — so the scheduler's payoff
+        # gate AND the self-tuning controller can flip variants per
+        # dispatch under an armed recompile guard (the serving.tuner
+        # pre-warm contract; WARMUP-COVERAGE pins this loop statically)
+        for c in sorted(self._step_variants):
+            self.step_async(chunk=c).fetch()
+        for (c, k) in sorted(self._spec_variants):
+            self.step_async(spec=True, chunk=c, spec_k=k).fetch()
         self.state = self._retire(self.state, np.int32(0))
         # drop the warmup junk: a fresh init (compiled at construction)
         # frees every slot again
@@ -2186,10 +2303,26 @@ class Engine:
         size_of = lambda fn: (fn._cache_size()
                               if callable(getattr(fn, "_cache_size", None))
                               else None)
-        names = ("init", "step", "retire") + (
-            ("step_spec",) if self._spec else ())
         out = {name: size_of(getattr(self, f"_{name}"))
-               for name in names}
+               for name in ("init", "retire")}
+        # step variants: one entry per rung (`step_c{chunk}` /
+        # `step_spec_c{chunk}_k{k}`) plus the aggregate MAX under the
+        # historical names, exactly the "admit" convention below — the
+        # tuner switches among these, so each must stay at 1
+        step_sizes, spec_sizes = [], []
+        for c, fn in sorted(self._step_variants.items()):
+            s = size_of(fn)
+            out[f"step_c{c}"] = s
+            if s is not None:
+                step_sizes.append(s)
+        out["step"] = max(step_sizes) if step_sizes else None
+        for (c, k), fn in sorted(self._spec_variants.items()):
+            s = size_of(fn)
+            out[f"step_spec_c{c}_k{k}"] = s
+            if s is not None:
+                spec_sizes.append(s)
+        if self._spec:
+            out["step_spec"] = max(spec_sizes) if spec_sizes else None
         admit_sizes = []
         for (bucket, k), fn in sorted(self._admits.items()):
             s = size_of(fn)
@@ -2230,10 +2363,12 @@ class Engine:
             from apex_tpu.telemetry.recompile import RecompileSentinel
 
             sentinel = RecompileSentinel(registry=registry).install()
-            names = ("init", "step", "retire") + (
-                ("step_spec",) if self._spec else ())
-            for name in names:
+            for name in ("init", "retire"):
                 sentinel.track(name, getattr(self, f"_{name}"))
+            for c, fn in sorted(self._step_variants.items()):
+                sentinel.track(f"step_c{c}", fn)
+            for (c, k), fn in sorted(self._spec_variants.items()):
+                sentinel.track(f"step_spec_c{c}_k{k}", fn)
             for (bucket, k), fn in sorted(self._admits.items()):
                 sentinel.track(self._admit_variant_name(bucket, k), fn)
             for name, fn in (self._prefix_program_items()

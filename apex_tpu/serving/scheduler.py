@@ -79,7 +79,16 @@ import collections
 import dataclasses
 import os
 import time
-from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
+from typing import (
+    Any,
+    Callable,
+    Deque,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 from apex_tpu import profiler
 from apex_tpu.serving.engine import (
@@ -102,12 +111,14 @@ from apex_tpu.serving.request import (
     StreamEvent,
 )
 from apex_tpu.serving.resilience import (
+    HEALTH_DRAINING,
     HEALTH_FAILED,
     KIND_FLOOD,
     EngineFailed,
     HealthMonitor,
     ResilienceConfig,
 )
+from apex_tpu.serving.tuner import Controller, TunerConfig, ewma
 from apex_tpu.telemetry import flightrec as flightrec_mod
 from apex_tpu.telemetry import spans as spans_mod
 from apex_tpu.telemetry.ring import Ring
@@ -185,8 +196,7 @@ class _SpecGate:
         self._open = True           # optimistic until measured
 
     def _ewma(self, prev: float, sample: float) -> float:
-        a = self.cfg.ewma_alpha
-        return sample if prev == 0.0 else (1 - a) * prev + a * sample
+        return ewma(prev, sample, self.cfg.ewma_alpha)
 
     def break_even(self) -> float:
         """Tokens per wave a spec chunk must emit to match the plain
@@ -419,6 +429,32 @@ class _RegistryMetrics:
             "EWMA of tokens emitted per speculative wave (the gate "
             "compares it to the measured wall_spec/wall_plain "
             "break-even)")
+        # -- self-tuning control plane (serving.tuner) --------------------
+        # pre-created even without a tuner (explicit zeros in scrapes,
+        # the ladder-counter convention); per-knob children are bound
+        # by the scheduler once the declared knobs are known
+        self.tuner_state = registry.gauge(
+            "serving_tuner_state",
+            "self-tuning controller: 0 frozen, 1 measuring, 2 steady, "
+            "3 probing")
+        self._tuner_knob_family = registry.gauge(
+            "serving_tuner_knob",
+            "incumbent operating-point value per tuned knob",
+            labels=("knob",))
+        self._tuner_switch_family = registry.counter(
+            "serving_tuner_switches_total",
+            "operating-point switches the controller committed, by "
+            "knob", labels=("knob",))
+        self.tuner_knob: Dict[str, Any] = {}
+        self.tuner_switches: Dict[str, Any] = {}
+
+    def bind_tuner(self, knobs) -> None:
+        """Pre-create the per-knob children for the declared ladder
+        (explicit zeros in scrapes, like every ladder counter)."""
+        for k in knobs:
+            self.tuner_knob[k] = self._tuner_knob_family.labels(knob=k)
+            self.tuner_switches[k] = \
+                self._tuner_switch_family.labels(knob=k)
 
 
 class _Active:
@@ -497,6 +533,25 @@ class Scheduler:
     baseline). ``resilience`` tunes recovery/overload policy
     (defaults: :class:`~apex_tpu.serving.resilience.ResilienceConfig`).
 
+    Self-tuning (``tuner=TunerConfig(...)``,
+    :mod:`apex_tpu.serving.tuner`): a scheduler-owned controller tunes
+    the declared knob ladders — ``decode_chunk`` / ``pipeline_depth``
+    / ``max_admit_batch`` / ``spec_k`` — online from per-chunk
+    tokens-per-second EWMAs, switching ONLY among pre-warmed compiled
+    variants (``EngineConfig.decode_chunks`` / ``spec_ks``; validated
+    at construction) so an armed recompile guard stays flat. One knob
+    moves per probe window (coordinate descent), probes serialize to
+    one in-flight chunk, and the controller hard-freezes to the base
+    operating point during constrained decoding, fault replay,
+    rebuilds, and drain. ``pipeline_depth`` and ``max_admit_batch``
+    become LIVE attributes under a tuner (the controller rewrites them
+    per tick); a tuner owning ``spec_k`` replaces the spec gate. Every
+    decision and every observation it derives from is a
+    flight-recorder event, so a tuning trajectory replays
+    bit-identically from a post-mortem bundle. Token streams stay
+    bit-identical to any fixed-knob run (the chunk-parity and
+    pipelined==serial oracles extend across controller switching).
+
     Black box (``apex_tpu.telemetry.flightrec``): pass ``recorder`` (a
     :class:`~apex_tpu.telemetry.flightrec.FlightRecorder`) to log every
     load-bearing decision as O(1) event appends, and ``bundle_dir`` to
@@ -519,6 +574,7 @@ class Scheduler:
                  max_admit_batch: Optional[int] = None,
                  resilience: Optional[ResilienceConfig] = None,
                  spec_gate: Optional[SpecGateConfig] = None,
+                 tuner: Optional[TunerConfig] = None,
                  recorder=None, bundle_dir: Optional[str] = None,
                  bundle_meta: Optional[Dict] = None,
                  max_auto_bundles: int = 4,
@@ -539,6 +595,12 @@ class Scheduler:
         self.sleep = sleep
         self.pipeline_depth = pipeline_depth
         self.max_admit_batch = max_admit_batch
+        #: constructor values, kept verbatim for the bundle config: a
+        #: tuner rewrites the live attributes per tick (a mid-probe
+        #: dump would otherwise record a transient candidate as "the"
+        #: config and skew replay's rebuilt controller base)
+        self._cfg_pipeline_depth = pipeline_depth
+        self._cfg_max_admit_batch = max_admit_batch
         self.resilience = resilience or ResilienceConfig()
         #: telemetry sinks (both optional): a telemetry.Registry the
         #: scheduler counts/observes into, and a telemetry.SpanRecorder
@@ -619,10 +681,11 @@ class Scheduler:
         self._free: List[int] = self._reset_free()
         #: chunks dispatched but not yet fetched, oldest first; each
         #: entry is (handle, slot->_Active snapshot at dispatch,
-        #: dispatch time, pipeline depth at dispatch incl. this chunk)
+        #: dispatch time, pipeline depth at dispatch incl. this chunk,
+        #: tuner operating point at dispatch — None without a tuner)
         self._inflight: Deque[
-            Tuple[StepHandle, Dict[int, _Active], float, int]] = \
-            collections.deque()
+            Tuple[StepHandle, Dict[int, _Active], float, int,
+                  Optional[Dict[str, int]]]] = collections.deque()
         #: recovery bookkeeping per interrupted request (cleared at
         #: completion)
         self._replay: Dict[str, _ReplayState] = {}
@@ -662,17 +725,30 @@ class Scheduler:
         #: estimator behind deadline shedding and the QueueFull
         #: retry-after hint
         self._chunk_ewma = 0.0
+        #: self-tuning control plane (serving.tuner): a Controller over
+        #: the declared knob ladders, switching ONLY among pre-warmed
+        #: compiled variants (validated against the engine's resolved
+        #: ladders right here, so a bad ladder fails at construction,
+        #: not as a mid-serve recompile). When it owns the ``spec_k``
+        #: knob it REPLACES the spec gate — one controller per knob.
+        tunes_spec = tuner is not None and tuner.spec_k is not None
+        self._tuner: Optional[Controller] = None
+        if tuner is not None:
+            self._tuner = self._build_tuner(tuner, engine)
         #: speculative-decoding payoff gate (None unless the engine
-        #: carries a spec_k > 0 step variant): decides per dispatch
-        #: which pre-warmed chunk variant to run — see SpecGateConfig
-        if engine.engine_cfg.spec_k > 0:
+        #: carries a spec_k > 0 base variant and the tuner does not own
+        #: the knob): decides per dispatch which pre-warmed chunk
+        #: variant to run — see SpecGateConfig
+        if engine.engine_cfg.spec_k > 0 and not tunes_spec:
             self._gate: Optional[_SpecGate] = _SpecGate(
                 spec_gate or SpecGateConfig(), engine.engine_cfg.spec_k)
         else:
             if spec_gate is not None:
                 raise ValueError(
-                    "spec_gate given but the engine has spec_k == 0 — "
-                    "speculation needs EngineConfig.spec_k > 0")
+                    "spec_gate given but unusable — speculation needs "
+                    "EngineConfig.spec_k > 0, and a tuner that owns "
+                    "the spec_k knob replaces the gate (two "
+                    "controllers would fight over one variant choice)")
             self._gate = None
         self._spec_drafted = 0
         self._spec_accepted = 0
@@ -846,6 +922,7 @@ class Scheduler:
         if self._started is None:
             self._started = now
         self._poll_guard_alarms()
+        self._sync_tuner()
         self._expire(now)
         # admissions FIRST, then one chunk of any in-progress chunked
         # prefill, then the decode dispatch: a short prompt's
@@ -886,6 +963,11 @@ class Scheduler:
         The health machine reads ``draining`` for the duration (a live
         ``/healthz`` probe answers 503 — stop routing traffic here),
         then returns to its prior state."""
+        if self._tuner is not None:
+            # drained chunks are shutdown traffic, not steady state —
+            # the controller must neither measure nor steer on them
+            # (it thaws at the next live tick's _sync_tuner)
+            self._tuner.freeze("drain")
         self.health.begin_drain()
         try:
             while self._inflight:
@@ -952,6 +1034,81 @@ class Scheduler:
 
     # -- internals ---------------------------------------------------------
 
+    def _build_tuner(self, cfg: TunerConfig, engine: Engine) -> Controller:
+        """Validate the declared ladders against the engine's WARMED
+        variant ladders and build the controller. Device-shaping knobs
+        may only name compiled variants (the serving.tuner pre-warm
+        contract — WARMUP-COVERAGE pins the engine half statically);
+        host knobs are checked for shape only."""
+        if cfg.decode_chunk is not None:
+            bad = [c for c in cfg.decode_chunk
+                   if c not in engine.decode_chunks]
+            if bad:
+                raise ValueError(
+                    f"tuner decode_chunk candidates {bad} are not "
+                    f"pre-warmed step variants "
+                    f"{engine.decode_chunks} — declare them in "
+                    f"EngineConfig.decode_chunks so warmup() compiles "
+                    f"them (switching to an unwarmed variant would "
+                    f"recompile mid-serve)")
+        if cfg.spec_k is not None:
+            bad = [k for k in cfg.spec_k
+                   if k != 0 and k not in engine.spec_ks]
+            if bad:
+                raise ValueError(
+                    f"tuner spec_k candidates {bad} are not pre-warmed "
+                    f"spec variants {engine.spec_ks} — declare them in "
+                    f"EngineConfig.spec_ks")
+        base = {
+            "decode_chunk": engine.engine_cfg.decode_chunk,
+            "pipeline_depth": self.pipeline_depth,
+            # 0 is the ladder spelling of "unlimited" (None)
+            "max_admit_batch": self.max_admit_batch or 0,
+            "spec_k": engine.engine_cfg.spec_k,
+        }
+        tele = self.telemetry
+        ctl = Controller(
+            cfg, base, recorder=self.recorder,
+            on_switch=(None if tele is None
+                       else lambda knob: tele.tuner_switches[knob].inc()))
+        if tele is not None:
+            tele.bind_tuner(ctl.knobs)
+        return ctl
+
+    def _tuner_freeze_cause(self) -> Optional[str]:
+        """The hard-freeze condition, re-evaluated each tick: the
+        controller must not steer (or measure) while constrained
+        decoding serializes the loop, while any slot is re-deriving a
+        pre-fault stream (:meth:`_exclusion_cause` — THE shared
+        spelling), or while the health machine drains."""
+        if self.health.state == HEALTH_DRAINING:
+            return "drain"
+        return self._exclusion_cause()
+
+    def _sync_tuner(self) -> None:
+        """Tick-start controller sync: freeze/thaw from the live
+        exclusion conditions, then apply the current operating point's
+        HOST knobs (pipeline depth, admission cap) so this tick's
+        admissions and drain target already run the point the next
+        dispatch will use."""
+        tn = self._tuner
+        if tn is None:
+            return
+        cause = self._tuner_freeze_cause()
+        if cause is not None:
+            tn.freeze(cause)
+        else:
+            tn.thaw()
+        point = tn.current_point()
+        if "pipeline_depth" in point:
+            self.pipeline_depth = point["pipeline_depth"]
+        if "max_admit_batch" in point:
+            self.max_admit_batch = point["max_admit_batch"] or None
+        if self.telemetry is not None:
+            self.telemetry.tuner_state.set(tn.state())
+            for k, v in tn.incumbent.items():
+                self.telemetry.tuner_knob[k].set(v)
+
     def _guard_alarm_count(self) -> float:
         """Current value of the engine sentinel's recompile-alarm
         counter (0.0 when no registry-wired sentinel exists) — polled
@@ -1012,7 +1169,7 @@ class Scheduler:
         # ones (conservative: a spec chunk may emit fewer, in which
         # case the next tick's fetch corrects the estimate)
         cols: Dict[int, int] = {}
-        for handle, snapshot, _, _ in self._inflight:
+        for handle, snapshot, _, _, _ in self._inflight:
             for slot, act in snapshot.items():
                 if self.active.get(slot) is act:
                     cols[slot] = cols.get(slot, 0) + handle.ncols
@@ -1020,36 +1177,102 @@ class Scheduler:
             len(act.tokens) + cols.get(slot, 0) < act.request.max_tokens
             for slot, act in self.active.items())
 
-    def _use_spec(self) -> bool:
-        """Whether the next chunk dispatches the speculative variant:
-        the payoff gate must want it, no constrained request may be
-        active (its vocab mask advances per token — the decode_chunk==1
-        serialization from the constrained path extends to forcing
-        plain chunks), and no fault replay may be in flight (replay
-        exactness is simplest to audit on the plain path; streams are
-        bit-identical either way, this keeps the replay invariant
-        independent of gate state)."""
-        g = self._gate
-        if g is None:
-            return False
+    def _exclusion_cause(self) -> Optional[str]:
+        """THE per-slot exclusion conditions, as a cause: a
+        constrained request is active (its vocab mask advances per
+        token — the decode_chunk==1 serialization from the constrained
+        path extends to forcing plain chunks), or a fault replay is in
+        flight (replay exactness is simplest to audit on the plain
+        path; streams are bit-identical either way, this keeps the
+        replay invariant independent of gate/tuner state). One
+        spelling shared by the payoff gate's plain-forcing
+        (:meth:`_plain_only`) and the tuner's freeze causes so the two
+        can never disagree on the exclusions."""
         for act in self.active.values():
             if act.request.constraint is not None:
-                return False
+                return "constrained"
             if len(act.tokens) < act.suppress:
-                return False        # replaying a pre-fault stream
+                return "replay"     # re-deriving a pre-fault stream
+        return None
+
+    def _plain_only(self) -> bool:
+        """Whether speculative dispatch is excluded right now (see
+        :meth:`_exclusion_cause`)."""
+        return self._exclusion_cause() is not None
+
+    def _use_spec(self) -> bool:
+        """Whether the next chunk dispatches the speculative variant
+        under the payoff gate (the non-tuner spec path)."""
+        g = self._gate
+        if g is None or self._plain_only():
+            return False
         return g.want_spec(spec_inflight=sum(
             1 for entry in self._inflight if entry[0].spec))
 
     def _dispatch_chunk(self) -> bool:
         """Dispatch the next decode chunk if it can pay for itself;
-        True when one went out. A dispatch-seam fault triggers
-        recovery (every live slot was in the failing chunk's blast
-        radius)."""
+        True when one went out. With a tuner, the controller picks the
+        operating point (pre-warmed variant + host knobs) — or holds
+        the dispatch while a probe chunk is still in flight (probe
+        serialization). A dispatch-seam fault triggers recovery (every
+        live slot was in the failing chunk's blast radius)."""
         if not self._dispatchable():
             return False
+        tn = self._tuner
+        point: Optional[Dict[str, int]] = None
+        step_kw: Dict[str, Any] = {}
+        if tn is not None:
+            cause = self._exclusion_cause()
+            if cause is not None:
+                # re-evaluated AT dispatch, not just at tick start: a
+                # constrained (or replaying) request admitted THIS
+                # tick — after _sync_tuner's freeze check — must not
+                # decode at the incumbent/probe chunk width (a >1
+                # chunk would scan tokens 2..n against a stale vocab
+                # mask: schema-invalid output, not just a bad sample)
+                tn.freeze(cause)
+            point = tn.want_dispatch(len(self._inflight))
+            if point is None:
+                return False    # a probe chunk is in flight — hold
+            if "pipeline_depth" in point:
+                # the depth knob applies at dispatch too: a probe
+                # window's candidate depth governs its own chunks
+                self.pipeline_depth = point["pipeline_depth"]
+            if "decode_chunk" in point:
+                step_kw["chunk"] = point["decode_chunk"]
+            k = point.get("spec_k", 0)
+            if k > 0 and not self._plain_only():
+                step_kw["spec"], step_kw["spec_k"] = True, k
+            else:
+                # gate-owned speculation composes, EXCEPT during a
+                # probe window: probe chunks force the plain path, or
+                # an open gate (spec chunks are never observed — their
+                # token counts reflect acceptance, not this point's
+                # knobs) would starve the window of its probe_chunks
+                # samples while serialization held the pipeline at one
+                # in-flight chunk
+                step_kw["spec"] = ("spec_k" not in point
+                                   and tn.probe is None
+                                   and self._use_spec())
+                if "spec_k" in point:
+                    # record the EFFECTIVE point: a plain-forced chunk
+                    # (exclusion raced the tick-start freeze) must not
+                    # be attributed to the spec operating point
+                    point["spec_k"] = 0
+            if tn.frozen is not None or (
+                    step_kw["spec"] and "spec_k" not in tn.knobs):
+                # never-observe sentinel: a frozen dispatch carries
+                # replay/constrained traffic even if fetched after the
+                # thaw, and a GATE-driven speculative chunk's token
+                # count reflects the gate's acceptance, not this
+                # point's knobs — folding either into the EWMAs would
+                # poison exactly the comparison the controller makes
+                point = None
+        else:
+            step_kw["spec"] = self._use_spec()
         t0 = self.clock()
         try:
-            handle = self.engine.step_async(spec=self._use_spec())
+            handle = self.engine.step_async(**step_kw)
         except Exception as e:  # device error escaping the dispatch
             self._recover(self.clock(), cause="dispatch", detail=str(e),
                           affected=[a.request for _, a in
@@ -1065,7 +1288,7 @@ class Scheduler:
         # some may have been released (finish seen in an earlier chunk,
         # deadline retire) and their columns must be dropped
         self._inflight.append((handle, dict(self.active), t0,
-                               len(self._inflight) + 1))
+                               len(self._inflight) + 1, point))
         if self.recorder is not None:
             self.recorder.record("dispatch", handle.spec, handle.ncols,
                                  len(self._inflight), len(self.active))
@@ -1074,7 +1297,7 @@ class Scheduler:
         return True
 
     def _collect_oldest(self) -> None:
-        handle, snapshot, t_dispatch, depth_at_dispatch = \
+        handle, snapshot, t_dispatch, depth_at_dispatch, point = \
             self._inflight.popleft()
         t0 = self.clock()
         try:
@@ -1124,6 +1347,15 @@ class Scheduler:
             self._watchdog_trips += 1
             if rec is not None:
                 rec.record("watchdog", chunk_wall)
+            if self._tuner is not None:
+                # a tripped chunk is never observed (below) — without
+                # this freeze, a probe window whose candidate keeps
+                # hanging would never accumulate its probe_chunks
+                # samples and the controller would re-dispatch the
+                # pathological variant forever. The freeze aborts the
+                # window (recorded, so decision replay sees it) and
+                # the next clean tick thaws and moves on.
+                self._tuner.freeze("watchdog")
             self.health.record_fault("watchdog")
             self._maybe_dump("watchdog")
             if tele is not None:
@@ -1162,10 +1394,14 @@ class Scheduler:
         # watchdog-tripped chunk is excluded exactly like the overload
         # EWMA above.
         g = self._gate
-        if g is not None and chunk_wall <= \
-                self.resilience.watchdog_timeout_s:
+        if chunk_wall <= self.resilience.watchdog_timeout_s \
+                and (g is not None or handle.spec):
             sample = chunk_wall / max(depth_at_dispatch, 1)
             if handle.spec:
+                # per-wave accounting runs for EVERY spec chunk —
+                # gate-driven or tuner-driven (the tuner's spec_k knob
+                # has no gate, but acceptance telemetry must not go
+                # dark when the controller owns the choice)
                 self._spec_chunks += 1
                 tpw = None
                 rows = live_rows
@@ -1181,25 +1417,27 @@ class Scheduler:
                         if tele is not None:
                             tele.spec_drafted.inc(drafted)
                             tele.spec_accepted.inc(emitted - live_waves)
-                g.observe_spec(sample, tpw)
+                if g is not None:
+                    g.observe_spec(sample, tpw)
                 if self.spans is not None:
                     # the verify forward's host window: dispatch to
                     # value of the speculative chunk
                     self.spans.section_at("engine.verify", t_dispatch,
                                           now)
-            else:
+            elif g is not None:
                 g.observe_plain(sample)
-            st = g.state()
-            if st != self._gate_state_seen:
-                # a payoff-gate transition is a scheduling decision —
-                # log it once per flip, not per chunk
-                self._gate_state_seen = st
-                if rec is not None:
-                    rec.record("spec_gate", st, g.accept_ewma,
-                               g.break_even())
-            if tele is not None:
-                tele.spec_gate.set(st)
-                tele.spec_accept_ewma.set(g.accept_ewma)
+            if g is not None:
+                st = g.state()
+                if st != self._gate_state_seen:
+                    # a payoff-gate transition is a scheduling decision
+                    # — log it once per flip, not per chunk
+                    self._gate_state_seen = st
+                    if rec is not None:
+                        rec.record("spec_gate", st, g.accept_ewma,
+                                   g.break_even())
+                if tele is not None:
+                    tele.spec_gate.set(st)
+                    tele.spec_accept_ewma.set(g.accept_ewma)
         # in-flight latency of this chunk (dispatch -> value); the
         # decode-time split dedups the overlap so pipelined chunks
         # don't double-count wall time. Spec chunks price latency per
@@ -1213,6 +1451,9 @@ class Scheduler:
                        / max(mean_emitted, 1.0))
         self._decode_time += now - max(self._decode_mark, t_dispatch)
         self._decode_mark = now
+        chunk_tokens = 0    # actual ingested emissions (the tuner's
+        # tokens-per-second numerator: pad columns past a finish are
+        # honestly NOT tokens, so an over-wide chunk scores its waste)
         for j in range(n_cols):
             for slot, act in snapshot.items():
                 # a slot released since dispatch (earlier chunk/column
@@ -1236,11 +1477,22 @@ class Scheduler:
                     reason = (FINISH_EOS
                               if eos is not None and tok == eos
                               else FINISH_LENGTH)
+                chunk_tokens += 1
                 if self._ingest(slot, act, tok,
                                 float(logprobs[slot, j]), now,
                                 device_done=done, device_reason=reason,
                                 latency=per_tok) == _RECOVERED:
                     return  # recovery rebuilt everything mid-unpack
+        tn = self._tuner
+        if tn is not None and point is not None and chunk_wall <= \
+                self.resilience.watchdog_timeout_s:
+            # the control plane's one input: realized tokens at this
+            # chunk's operating point (watchdog-tripped chunks are
+            # excluded exactly like the overload EWMA; a frozen
+            # controller ignores the call). Recorded as tuner_obs, so
+            # telemetry.replay re-derives every decision from it.
+            tn.observe(point, chunk_tokens, chunk_wall,
+                       depth_at_dispatch)
         # a chunk landed end-to-end: recovery streak for the health
         # machine, and the rebuild-storm counter resets
         self._consecutive_rebuilds = 0
@@ -1393,6 +1645,11 @@ class Scheduler:
         tele = self.telemetry
         rec = self.recorder
         rcfg = self.resilience
+        if self._tuner is not None:
+            # the rebuild bracket is a hard freeze: in-flight chunks
+            # are discarded unmeasured, and the replay traffic that
+            # follows re-freezes at the next tick's cause evaluation
+            self._tuner.freeze("rebuild")
         if rec is not None:
             rec.record("fault", cause, detail, len(affected))
         self.health.record_fault(cause)
@@ -1736,11 +1993,18 @@ class Scheduler:
             "engine": engine.describe(),
             "scheduler": {
                 "max_queue": self.max_queue,
-                "pipeline_depth": self.pipeline_depth,
-                "max_admit_batch": self.max_admit_batch,
+                "pipeline_depth": self._cfg_pipeline_depth,
+                "max_admit_batch": self._cfg_max_admit_batch,
                 "resilience": dataclasses.asdict(self.resilience),
                 "spec_gate": (dataclasses.asdict(self._gate.cfg)
                               if self._gate is not None else None),
+                # the tuner's ladders + policy AND its base operating
+                # point: everything replay_decisions needs to re-run
+                # the trajectory from the recorded observations
+                "tuner": (dataclasses.asdict(self._tuner.cfg)
+                          if self._tuner is not None else None),
+                "tuner_base": (dict(self._tuner.base)
+                               if self._tuner is not None else None),
             },
         }
         files: Dict[str, object] = {
@@ -2302,17 +2566,30 @@ class Scheduler:
         if self.engine.chunked_prefill_enabled:
             out["chunked_admissions"] = float(self._chunked_admissions)
             out["chunked_chunks"] = float(self._chunked_chunks)
-        if self._gate is not None:
-            # speculative decoding: per-wave accounting + gate state
+        tn = self._tuner
+        if self._gate is not None or (tn is not None
+                                      and "spec_k" in tn.knobs):
+            # speculative decoding: per-wave accounting (gate-driven
+            # or tuner-driven) + gate state when a gate owns the knob
             out["spec_chunks"] = float(self._spec_chunks)
             out["spec_drafted"] = float(self._spec_drafted)
             out["spec_accepted"] = float(self._spec_accepted)
             out["spec_accept_rate"] = (
                 self._spec_accepted / self._spec_drafted
                 if self._spec_drafted else 0.0)
+        if self._gate is not None:
             out["spec_gate_state"] = self._gate.state()
             out["spec_acceptance_ewma"] = self._gate.accept_ewma
             out["spec_break_even"] = self._gate.break_even()
+        if tn is not None:
+            # the control plane: state, decision counts, and the
+            # incumbent operating point it steered to
+            out["tuner_state"] = tn.state()
+            out["tuner_probes"] = float(tn.probes_total)
+            out["tuner_switches"] = float(
+                sum(tn.switch_counts.values()))
+            for k, v in tn.incumbent.items():
+                out[f"tuner_{k}"] = float(v)
         if elapsed:
             out["tokens_per_sec"] = self._tokens_emitted / elapsed
         if self._decode_time > 0:

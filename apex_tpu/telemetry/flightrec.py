@@ -70,6 +70,11 @@ EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
     "fetch": ("spec", "ncols", "wall_s", "live_rows"),
     "watchdog": ("wall_s",),
     "spec_gate": ("state", "accept_ewma", "break_even"),
+    # -- self-tuning control plane (serving.tuner) --------------------------
+    "tuner_obs": ("point", "tokens", "wall_s", "depth"),
+    "tuner_probe": ("knob", "value", "phase", "ewma", "incumbent_ewma"),
+    "tuner_switch": ("knob", "from", "to", "ewma", "incumbent_ewma"),
+    "tuner_freeze": ("phase", "cause"),
     # -- faults + recovery -------------------------------------------------
     "inject": ("point", "index", "kind"),
     "fault": ("cause", "detail", "affected"),

@@ -8,7 +8,12 @@ trace, all reconstructed from the bundle — re-runs it, and checks that
 every replayed stream reproduces the recorded emitted prefix
 BIT-IDENTICALLY (per-request determinism from the resilience layer
 makes this exact: a request's tokens are a function of its prompt +
-sampling seed only, whatever faults interleave). A completed
+sampling seed only, whatever faults interleave). Bundles from a
+self-tuning run (``Scheduler(tuner=...)``) additionally replay the
+controller's decision sequence from the RECORDED clocks
+(:func:`replay_tuner` — pure host arithmetic over the bundle's
+``tuner_obs`` events), asserting every probe/switch/freeze reproduces
+seq-for-seq with bit-identical triggering EWMAs. A completed
 eos/length/stop request must match exactly; an interrupted (active /
 queued / timed-out) one must extend its recorded prefix. That turns
 "the soak tripped at 3am" from archaeology into a command.
@@ -42,6 +47,45 @@ from apex_tpu.telemetry.flightrec import read_bundle
 #: replay must reproduce them exactly; anything else (timeout shed by a
 #: wall clock, fault-errored) is prefix-checked only
 _EXACT_REASONS = ("eos", "length", "stop")
+
+
+# -- tuner decision replay (stdlib-only, recorded clocks) ---------------------
+
+
+def replay_tuner(bundle: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Re-run a bundle's self-tuning trajectory from its RECORDED
+    clocks: rebuild the controller from ``config.json``'s tuner block,
+    feed it the recorded ``tuner_obs`` observations and freeze
+    transitions in sequence order, and compare the regenerated
+    probe/switch/freeze decision sequence against the recorded one —
+    bit-identical EWMAs included (pure float arithmetic on recorded
+    inputs). Returns ``None`` when the bundle carries no tuner;
+    ``{"skipped": ...}`` when the event ring dropped events (the input
+    stream is incomplete — a verdict would be a guess). Stdlib-only,
+    like the ``--report`` path."""
+    sched_d = (bundle.get("config.json") or {}).get("scheduler") or {}
+    tuner_d = sched_d.get("tuner")
+    base = sched_d.get("tuner_base")
+    if not tuner_d or not base:
+        return None
+    man = bundle.get("manifest.json") or {}
+    fr = man.get("flightrec") or {}
+    if fr.get("events_dropped"):
+        return {"skipped": f"event ring dropped "
+                f"{fr['events_dropped']} events — the recorded input "
+                f"stream is incomplete"}
+    from apex_tpu.serving.tuner import TunerConfig, compare_decisions
+
+    cfg = TunerConfig(**{
+        k: (tuple(v) if isinstance(v, list) else v)
+        for k, v in tuner_d.items()})
+    events = [e for e in bundle.get("events.jsonl", [])
+              if str(e.get("event", "")).startswith("tuner_")]
+    out = compare_decisions(cfg, {k: int(v) for k, v in base.items()},
+                            events)
+    out["observations"] = sum(1 for e in events
+                              if e["event"] == "tuner_obs")
+    return out
 
 
 # -- the stdlib-only report --------------------------------------------------
@@ -173,6 +217,7 @@ def replay_bundle(path: str, *, no_faults: bool = False,
         Scheduler,
         SpecGateConfig,
     )
+    from apex_tpu.serving.tuner import TunerConfig
 
     for k in ("compute_dtype", "param_dtype"):
         # dtype-VALUED fields serialise by numpy name (describe());
@@ -186,7 +231,8 @@ def replay_bundle(path: str, *, no_faults: bool = False,
                            if k in cfg_names})
     e_names = {f.name for f in dataclasses.fields(EngineConfig)}
     e_kwargs = {k: v for k, v in ecfg_d.items() if k in e_names}
-    for k in ("prompt_buckets", "admit_batch_sizes"):
+    for k in ("prompt_buckets", "admit_batch_sizes", "decode_chunks",
+              "spec_ks"):
         if e_kwargs.get(k) is not None:
             e_kwargs[k] = tuple(e_kwargs[k])
     ecfg = EngineConfig(**e_kwargs)
@@ -208,14 +254,26 @@ def replay_bundle(path: str, *, no_faults: bool = False,
     for template in eng_d.get("prefix_templates", []):
         engine.register_prefix(template)
     gate_d = sched_d.get("spec_gate")
+    tuner_d = sched_d.get("tuner")
+    tuner = None
+    if tuner_d:
+        # the LIVE re-run drives the controller too (streams are
+        # knob-invariant, so this just exercises it); the recorded-
+        # clock decision comparison is replay_tuner's separate job
+        tuner = TunerConfig(**{
+            k: (tuple(v) if isinstance(v, list) else v)
+            for k, v in tuner_d.items()})
+    tunes_spec = tuner is not None and tuner.spec_k is not None
     sched = Scheduler(
         engine,
         max_queue=sched_d.get("max_queue", 256),
         pipeline_depth=sched_d.get("pipeline_depth", 1),
         max_admit_batch=sched_d.get("max_admit_batch"),
         resilience=ResilienceConfig(**sched_d["resilience"]),
+        tuner=tuner,
         spec_gate=(SpecGateConfig(**gate_d)
-                   if gate_d and ecfg.spec_k > 0 else None))
+                   if gate_d and ecfg.spec_k > 0 and not tunes_spec
+                   else None))
 
     rows = sorted(bundle.get("requests.jsonl", []),
                   key=lambda r: r["order"])
@@ -292,6 +350,15 @@ def replay_bundle(path: str, *, no_faults: bool = False,
                               if plan is not None else 0),
         "health": sched.health.state,
     }
+    tuner_out = replay_tuner(bundle)
+    if tuner_out is not None:
+        # the recorded-clock decision replay: the tuning trajectory
+        # must reproduce seq-for-seq (its mismatches gate the exit
+        # code exactly like stream mismatches)
+        out["tuner"] = tuner_out
+        mismatches.extend(
+            {"request_id": None, "why": "tuner decision drift",
+             **m} for m in tuner_out.get("mismatches", ()))
     if verbose:
         print(json.dumps(out, sort_keys=True))
     return out

@@ -56,6 +56,21 @@ batch drains. ``--span-trace out.json`` writes the per-request span
 timeline as Chrome-trace JSON (open in Perfetto next to a
 ``profiler.trace`` device capture).
 
+Self-tuning (``apex_tpu.serving.tuner``): ``--autotune`` turns the
+hand-set serving knobs into measured choices — a scheduler-owned
+controller tunes ``decode_chunk`` / ``pipeline_depth`` /
+``max_admit_batch`` / ``spec_k`` online from per-chunk
+tokens-per-second EWMAs, switching only among pre-warmed compiled
+variants (every declared candidate compiles at warmup; the recompile
+guard stays flat), with every probe/switch/freeze a flight-recorder
+event replayable from a post-mortem bundle. Composes with
+``--fault-plan``: the controller hard-freezes to the base operating
+point through rebuild/replay brackets::
+
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python examples/serve_gpt.py --num-requests 8 --max-tokens 24 \
+    --autotune "decode_chunk=1,2,4;pipeline_depth=1,2"
+
 Chaos (``apex_tpu.serving.resilience``): ``--fault-plan SPEC`` injects
 deterministic faults at the engine seams for manual recovery drills —
 ``SPEC`` is ``random:SEED[:N]`` or a comma list of
@@ -226,6 +241,21 @@ def main():
                     "(FleetFaultPlan.kill) and show every stream "
                     "complete anyway via failover; needs "
                     "--replicas >= 2")
+    ap.add_argument("--autotune", metavar="SPEC", nargs="?",
+                    const="default", default=None,
+                    help="self-tuning runtime (apex_tpu.serving.tuner):"
+                    " tune serving knobs online across pre-warmed "
+                    "compiled variants. SPEC is a ';'-separated ladder "
+                    "list, e.g. 'decode_chunk=4,8,16;"
+                    "pipeline_depth=1,2,3;spec_k=0,3' (each ladder "
+                    "must contain the knob's configured base value); "
+                    "bare --autotune derives default ladders from "
+                    "--decode-chunk/--pipeline-depth/--spec-k. Every "
+                    "candidate compiles at warmup "
+                    "(EngineConfig.decode_chunks/spec_ks), switching "
+                    "never recompiles, every decision is a flight-"
+                    "recorder event, and the controller hard-freezes "
+                    "during --fault-plan rebuilds/replay")
     ap.add_argument("--spec-k", type=int, default=0,
                     help="speculative decoding: draft this many tokens "
                     "per wave from a device-side n-gram drafter and "
@@ -311,12 +341,45 @@ def main():
         print(f"fleet kill drill: {kill_plan.describe()}")
     templates = [[int(t) for t in spec.split(",")]
                  for spec in (args.prefix_template or ())]
+    tuner_cfg = None
+    decode_chunks = spec_ks = None
+    if args.autotune is not None:
+        from apex_tpu.serving.tuner import KNOBS, TunerConfig
+
+        if args.autotune == "default":
+            ladders = {
+                "decode_chunk": tuple(sorted(
+                    {args.decode_chunk, 2 * args.decode_chunk})),
+                "pipeline_depth": tuple(sorted(
+                    {1, args.pipeline_depth, args.pipeline_depth + 1})),
+            }
+            if args.spec_k > 0:
+                ladders["spec_k"] = (0, args.spec_k)
+        else:
+            ladders = {}
+            for part in args.autotune.split(";"):
+                knob, _, vals = part.partition("=")
+                knob = knob.strip()
+                if knob not in KNOBS or not vals:
+                    raise SystemExit(
+                        f"--autotune: bad ladder {part!r} (knobs: "
+                        f"{', '.join(KNOBS)}; format knob=v1,v2,...)")
+                ladders[knob] = tuple(int(v) for v in vals.split(","))
+        tuner_cfg = TunerConfig(**ladders)
+        # every declared device-variant candidate becomes a compiled,
+        # warmed step variant — the tuner only ever switches among
+        # warm programs
+        decode_chunks = ladders.get("decode_chunk")
+        sk = tuple(sorted(k for k in ladders.get("spec_k", ()) if k))
+        spec_ks = sk or None
+        print(f"autotune: {ladders}")
     ecfg = EngineConfig(
         slots=args.slots, max_prompt_len=args.max_prompt_len,
         max_seq_len=args.max_seq_len, decode_chunk=args.decode_chunk,
         prefix_pool_slots=len(templates), spec_k=args.spec_k,
         page_size=args.page_size, num_pages=args.max_pages,
-        prefill_chunk=args.prefill_chunk)
+        prefill_chunk=args.prefill_chunk,
+        decode_chunks=decode_chunks, spec_ks=spec_ks)
 
     def replica_plan(i):
         if kill_plan is not None:
@@ -384,7 +447,7 @@ def main():
             Scheduler(e, max_queue=max(256, len(reqs)), spans=spans,
                       pipeline_depth=args.pipeline_depth,
                       recorder=recorder, bundle_dir=args.bundle_dir,
-                      bundle_meta=bundle_meta,
+                      bundle_meta=bundle_meta, tuner=tuner_cfg,
                       resilience=ResilienceConfig(max_retries=8))
             for e in engines]
         sched = Router(replica_scheds, registry=registry,
@@ -397,6 +460,7 @@ def main():
                           registry=registry, spans=spans,
                           pipeline_depth=args.pipeline_depth,
                           recorder=recorder, bundle_dir=args.bundle_dir,
+                          tuner=tuner_cfg,
                           # params provenance: telemetry.replay rebuilds
                           # the model from a bundle with this
                           bundle_meta=bundle_meta)
@@ -442,6 +506,14 @@ def main():
               f"{list(r.prompt)} -> {c.tokens}")
     print("served " + json.dumps(
         {k: round(v, 3) for k, v in sched.summary().items()}))
+    if tuner_cfg is not None and args.replicas == 1:
+        s = sched.summary()
+        point = {name: int(s[f"tuner_{name}"])
+                 for name, _ in tuner_cfg.ladders()
+                 if f"tuner_{name}" in s}
+        print(f"autotune: state={s['tuner_state']:.0f} "
+              f"probes={s['tuner_probes']:.0f} "
+              f"switches={s['tuner_switches']:.0f} incumbent={point}")
     if fault_plan is not None:
         print(f"chaos: {len(fault_plan.injected)} fault(s) fired "
               f"({[s.describe() for s in fault_plan.injected]}), "

@@ -261,6 +261,7 @@ def test_paged_engine_stream_parity(devices8, kind):
     assert s["pages_in_use"] == 0.0  # every release freed its pages
 
 
+@pytest.mark.slow  # plain tp2 parity (test_serving) stays tier-1; this paged-only composition is subsumed by the composed-path oracle below — both long-suite (self-tuning-runtime tier-1 offset)
 def test_paged_tp2_vs_tp1_parity(devices8):
     """Paged decode under tp=2 (heads sharded; pool + tables
     replicated geometry) emits the tp=1 paged streams bit-for-bit."""
@@ -270,6 +271,33 @@ def test_paged_tp2_vs_tp1_parity(devices8):
     toks, _ = _run(eng, _trace())
     eng.close()
     assert toks == base
+
+
+@pytest.mark.slow
+def test_composed_tp2_vs_tp1_full_path_parity(devices8):
+    """THE full composed serving path the ROADMAP flagged as
+    uncovered, tp2 vs tp1 in ONE run: pipelined decode (depth 2) +
+    batched bucketed admission + prefix-pool hits mapped
+    copy-on-write + the paged cache. Every per-feature tp oracle
+    (plain, quantized, spec, paged) passes individually; this pins
+    the COMPOSITION — sharded gathers over shared pages while chunks
+    are in flight behind batched bucketed admissions — bit-identical
+    across shardings."""
+    cfg = _cfg()
+    ecfg = dataclasses.replace(_POOL_ECFG, page_size=8)
+    toks = {}
+    for tp in (1, 2):
+        eng = _mk_engine(cfg, ecfg,
+                         mx.build_mesh(tp=tp, devices=devices8[:tp]))
+        eng.register_prefix(_template())
+        toks[tp], s = _run(eng, _prefix_trace(), pipeline_depth=2)
+        eng.close()
+        # the run must actually exercise every composed feature
+        assert s["prefix_hits"] > 0 and s["page_share_hits"] > 0
+        assert s["pipeline_depth"] == 2.0
+        assert s["admitted_requests"] == 6.0
+        assert s["pages_in_use"] == 16 / 8  # only registration pins
+    assert toks[2] == toks[1]
 
 
 def test_paged_spec_stream_parity(devices8):

@@ -459,6 +459,70 @@ def test_warmup_coverage_fires_on_forgotten_variant(tmp_path):
     assert all("_extra" in f.message for f in hits)
 
 
+_KNOB_ENGINE = '''
+    import jax
+
+    class Eng:
+        def __init__(self):
+            self._step_variants = {}
+            for c in (1, 2):
+                self._step_variants[c] = jax.jit(lambda x: x)
+            self._retire = jax.jit(lambda s: s)
+
+        def warmup(self):
+            for c, fn in sorted(self._step_variants.items()):
+                fn(0)
+            self._retire(0)
+
+        def compiled_cache_sizes(self):
+            out = {"retire": self._retire._cache_size()}
+            for c, fn in sorted(self._step_variants.items()):
+                out[f"step_c{c}"] = fn._cache_size()
+            return out
+'''
+
+
+def test_warmup_coverage_knob_ladder_link(tmp_path):
+    """The serving.tuner half: VARIANT_KNOBS entries must name a
+    compiled-program dict family on a warmup-defining class — a knob
+    pointing at nothing could ladder candidates warmup never compiles."""
+    # positive: the declared family exists, is warmed, is tracked
+    res = _synth(tmp_path, {
+        "pkg/eng.py": _KNOB_ENGINE,
+        "pkg/tuner.py":
+            'VARIANT_KNOBS = {"decode_chunk": "_step_variants"}\n',
+        "pkg/__init__.py": ""})
+    assert "WARMUP-COVERAGE" not in _rules_of(res), \
+        "\n".join(f.render() for f in res.findings)
+    # negative: the knob maps to a family nobody builds
+    (tmp_path / "bad").mkdir()
+    res = _synth(tmp_path / "bad", {
+        "pkg/eng.py": _KNOB_ENGINE,
+        "pkg/tuner.py":
+            'VARIANT_KNOBS = {"spec_k": "_missing_variants"}\n',
+        "pkg/__init__.py": ""})
+    hits = [f for f in res.findings if f.rule == "WARMUP-COVERAGE"]
+    assert len(hits) == 1, "\n".join(f.render() for f in res.findings)
+    assert "_missing_variants" in hits[0].message \
+        and "'spec_k'" in hits[0].message
+    assert hits[0].path == "pkg/tuner.py"
+    # negative: the family exists but warmup never touches it — the
+    # BASE checks fire on the engine side (the ladder link holds)
+    (tmp_path / "unwarmed").mkdir()
+    res = _synth(tmp_path / "unwarmed", {
+        "pkg/eng.py": _KNOB_ENGINE.replace(
+            """            for c, fn in sorted(self._step_variants.items()):
+                fn(0)
+""", ""),
+        "pkg/tuner.py":
+            'VARIANT_KNOBS = {"decode_chunk": "_step_variants"}\n',
+        "pkg/__init__.py": ""})
+    hits = [f for f in res.findings if f.rule == "WARMUP-COVERAGE"]
+    assert any("_step_variants" in f.message
+               and "warmup()" in f.message for f in hits), \
+        "\n".join(f.render() for f in res.findings)
+
+
 def test_warmup_coverage_clean_via_direct_and_getattr_refs(tmp_path):
     res = _synth(tmp_path, {"pkg/mod.py": '''
         import jax

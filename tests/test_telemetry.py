@@ -388,8 +388,10 @@ def test_engine_recompile_guard_stays_flat(served_engine):
     assert not g.tripped
     # compiles_total flat: per-program jit caches did not grow
     totals = sent.compiles_total()
+    # the step program tracks per decode-chunk variant (step_c{chunk}
+    # — the self-tuning ladder's naming; a single-rung engine has one)
     assert totals["tracked"] == {
-        "init": 1, "step": 1, "retire": 1,
+        "init": 1, "step_c8": 1, "retire": 1,
         "admit_p8_k1": 1, "admit_p8_k2": 1,
         "admit_p10_k1": 1, "admit_p10_k2": 1}
     assert eng.compiled_cache_sizes() == sizes0
@@ -483,7 +485,7 @@ def test_metrics_endpoint_live_engine(served_engine):
         status, vars_body = _get(server.url + "/vars")
         v = json.loads(vars_body)
         assert v["spans"]["requests"] == 4
-        assert v["recompile"]["tracked"]["step"] == 1
+        assert v["recompile"]["tracked"]["step_c8"] == 1
         assert v["metrics"]["serving_tokens_emitted_total"][
             "samples"][0]["value"] >= 4.0
         status, _ = _get(server.url + "/metrics?from=test")

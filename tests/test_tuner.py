@@ -1,0 +1,689 @@
+"""apex_tpu.serving.tuner — the self-tuning serving control plane.
+
+Headline oracles: (1) fake-clock convergence — with an injected
+latency model making one operating point strictly dominant, the
+controller finds it within a bounded number of probe windows, and
+re-converges after the model shifts mid-run; (2) stream parity — an
+autotuned run emits bit-identical per-request streams to a fixed-config
+run of the same trace, including under a seeded FaultPlan (the
+chunk-parity / pipelined==serial oracles extended across
+controller-driven switching); (3) replayability — a post-mortem bundle
+from an autotuned chaos run reproduces the controller's decision
+sequence bit-identically from the recorded clocks
+(``telemetry.replay.replay_tuner``); (4) pre-warm safety — the
+controller never dispatches a variant warmup did not compile (ladder
+validation at construction, per-variant cache sizes flat, and — slow
+tier — an armed recompile guard across forced switching)."""
+
+import dataclasses
+
+import jax
+import pytest
+
+from apex_tpu import mesh as mx
+from apex_tpu.models import gpt
+from apex_tpu.serving import Request, SamplingParams
+from apex_tpu.serving.engine import Engine, EngineConfig
+from apex_tpu.serving.resilience import (
+    FaultPlan,
+    FaultSpec,
+    ResilienceConfig,
+)
+from apex_tpu.serving.scheduler import Scheduler, SpecGateConfig
+from apex_tpu.serving.tuner import (
+    TUNER_FROZEN,
+    TUNER_PROBING,
+    TUNER_STEADY,
+    Controller,
+    TunerConfig,
+    compare_decisions,
+    parse_point,
+    point_key,
+)
+from apex_tpu.telemetry.flightrec import FlightRecorder
+from apex_tpu.transformer.testing import standalone_gpt_config
+
+VOCAB = 96
+
+
+# -- pure-controller harness (no jax work; the fake-clock unit half) ---------
+
+
+def _fast_cfg(**kw):
+    base = dict(decode_chunk=(1, 2, 4), pipeline_depth=(1, 2),
+                probe_every=2, probe_chunks=1, min_measure_chunks=2)
+    base.update(kw)
+    return TunerConfig(**base)
+
+
+_BASE = {"decode_chunk": 1, "pipeline_depth": 1, "max_admit_batch": 0,
+         "spec_k": 0}
+
+
+def _drive(ctl, quality, chunks):
+    """Feed ``chunks`` observations where each point's
+    tokens-per-second sample is exactly ``quality(point)`` (tokens=1,
+    depth=1, wall=1/q — observe computes tokens*depth/wall = q):
+    total, deterministic control of the injected latency model."""
+    for _ in range(chunks):
+        point = ctl.want_dispatch(0)
+        ctl.observe(point, 1, 1.0 / quality(point), 1)
+
+
+def test_controller_converges_to_dominant_point():
+    """The acceptance oracle's unit half: a latency model with one
+    strictly dominant operating point is found within a bounded
+    number of probe windows — and when the model SHIFTS mid-run, the
+    symmetric re-probe cadence re-converges onto the new optimum."""
+    best = {"decode_chunk": 4, "pipeline_depth": 2}
+
+    def quality(point):
+        q = 1.0
+        q *= {1: 1.0, 2: 2.0, 4: 4.0}[point["decode_chunk"]]
+        q *= {1: 1.0, 2: 1.5}[point["pipeline_depth"]]
+        return q
+
+    ctl = Controller(_fast_cfg(), _BASE)
+
+    def drive_until(q, target, max_chunks):
+        for _ in range(max_chunks):
+            if ctl.incumbent == target:
+                return
+            point = ctl.want_dispatch(0)
+            ctl.observe(point, 1, 1.0 / q(point), 1)
+        raise AssertionError(
+            f"no convergence to {target} in {max_chunks} chunks — "
+            f"stuck at {ctl.incumbent}")
+
+    drive_until(quality, best, 200)
+    # the BOUND: with a strictly dominant point, every winning probe
+    # switches on its first window — at most one coordinate-descent
+    # pass over the 3 non-incumbent candidates plus the walk's
+    # intermediate wins (chunk 1→2→4, depth 1→2, one losing re-probe
+    # in between). 8 windows is generous; unbounded search would blow
+    # straight past it.
+    assert ctl.probes_total <= 8, ctl.probes_total
+    assert ctl.state() in (TUNER_STEADY, TUNER_PROBING)
+    # the shift: small chunks at depth 1 now dominate (a burst of
+    # short-budget traffic where wide chunks burn pad columns)
+    flipped = {"decode_chunk": 1, "pipeline_depth": 1}
+
+    def quality2(point):
+        return 1.0 / quality(point)
+
+    probes_before = ctl.probes_total
+    drive_until(quality2, flipped, 300)
+    assert ctl.probes_total - probes_before <= 10
+
+
+def test_one_knob_per_window_and_probe_serialization():
+    """Coordinate descent: every probe point differs from the
+    incumbent in exactly ONE knob, and while a (non-depth) probe chunk
+    is in flight the controller holds further dispatches."""
+    ctl = Controller(_fast_cfg(), _BASE)
+    seen_probe_points = []
+    for _ in range(40):
+        point = ctl.want_dispatch(0)
+        if ctl.probe is not None:
+            seen_probe_points.append(dict(point))
+            if ctl.probe[0] != "pipeline_depth":
+                # serialization: a second dispatch with one in flight
+                # is held...
+                assert ctl.want_dispatch(1) is None
+            else:
+                # ...except for the depth knob, whose candidate IS the
+                # in-flight depth being measured
+                assert ctl.want_dispatch(1) == point
+        ctl.observe(point, 1, 1.0, 1)
+    assert seen_probe_points, "no probe ever opened"
+    for p in seen_probe_points:
+        moved = [k for k in ctl.knobs if p[k] != ctl.base[k]]
+        # vs the base incumbent (quality is flat — nothing switches)
+        assert len(moved) == 1, p
+    assert sum(ctl.switch_counts.values()) == 0  # flat model: no wins
+
+
+def test_margin_hysteresis_holds_incumbent():
+    """A challenger within the margin never displaces the incumbent —
+    the noisy-tie flap the spec gate's hysteresis existed to kill."""
+    ctl = Controller(_fast_cfg(decode_chunk=(1, 2), pipeline_depth=None,
+                               margin=1.10), _BASE)
+
+    def quality(point):  # chunk 2 is 5% better: inside the margin
+        return 1.05 if point["decode_chunk"] == 2 else 1.0
+
+    _drive(ctl, quality, 60)
+    assert ctl.incumbent["decode_chunk"] == 1
+    assert ctl.probes_total > 3  # it kept re-probing, kept reverting
+    assert sum(ctl.switch_counts.values()) == 0
+
+
+def test_freeze_aborts_probe_reverts_to_base_and_ignores_samples():
+    """The hard-freeze contract: an active probe aborts (no decision
+    from partial data), dispatches revert to the BASE point,
+    observations are ignored, and thaw resumes cleanly."""
+    rec = FlightRecorder(clock=lambda: 0.0)
+    ctl = Controller(_fast_cfg(), _BASE, recorder=rec)
+
+    def quality(point):
+        return 2.0 if point["decode_chunk"] == 2 else 1.0
+
+    # measure, then drive until a probe window opens
+    for _ in range(200):
+        point = ctl.want_dispatch(0)
+        if ctl.probe is not None:
+            break
+        ctl.observe(point, 1, 1.0 / quality(point), 1)
+    assert ctl.probe is not None
+    ewma_before = ctl.incumbent_ewma
+    ctl.freeze("constrained")
+    assert ctl.probe is None and ctl.state() == TUNER_FROZEN
+    assert ctl.want_dispatch(0) == {"decode_chunk": 1,
+                                    "pipeline_depth": 1}
+    ctl.observe({"decode_chunk": 1, "pipeline_depth": 1}, 1, 0.001, 1)
+    assert ctl.incumbent_ewma == ewma_before  # frozen samples ignored
+    ctl.freeze("replay")  # cause change records a fresh enter
+    ctl.thaw()
+    assert ctl.state() in (TUNER_STEADY, TUNER_PROBING)
+    names = [e[2] for e in rec.events()]
+    assert names.count("tuner_freeze") == 3  # enter, enter, exit
+    aborts = [e for e in rec.events()
+              if e[2] == "tuner_probe" and e[3][2] == "abort"]
+    assert len(aborts) == 1
+
+
+def test_decision_replay_bit_identical_from_recorded_inputs():
+    """replay_decisions over the recorded tuner_obs/tuner_freeze
+    inputs regenerates the probe/switch/freeze sequence EXACTLY —
+    EWMA fields included (pure float arithmetic on recorded clocks)."""
+    rec = FlightRecorder(clock=lambda: 0.0)
+    ctl = Controller(_fast_cfg(), _BASE, recorder=rec)
+
+    def quality(point):
+        return (1.0 + 0.9 * (point["decode_chunk"] == 4)
+                + 0.4 * (point["pipeline_depth"] == 2))
+
+    _drive(ctl, quality, 25)
+    ctl.freeze("rebuild")
+    ctl.thaw()
+    _drive(ctl, quality, 25)
+    events = rec.to_dicts(rec.events())
+    out = compare_decisions(_fast_cfg(), _BASE, events)
+    assert out["mismatches"] == [], out["mismatches"]
+    assert out["decisions_recorded"] == out["decisions_replayed"] > 0
+
+
+def test_point_key_roundtrip_and_config_validation():
+    p = {"decode_chunk": 8, "pipeline_depth": 2, "spec_k": 0}
+    assert parse_point(point_key(p)) == p
+    with pytest.raises(ValueError, match="no knob ladder"):
+        Controller(TunerConfig(), _BASE)
+    with pytest.raises(ValueError, match="margin"):
+        Controller(TunerConfig(decode_chunk=(1, 2), margin=0.9), _BASE)
+    with pytest.raises(ValueError, match="strictly increasing"):
+        Controller(TunerConfig(decode_chunk=(2, 1)), _BASE)
+    with pytest.raises(ValueError, match="base"):
+        Controller(TunerConfig(decode_chunk=(2, 4)), _BASE)
+    with pytest.raises(ValueError, match="probe_every"):
+        Controller(TunerConfig(decode_chunk=(1, 2), probe_every=0),
+                   _BASE)
+    # every-ladder-a-singleton is a silently inert controller — reject
+    # loudly (bench reads probes=0 as a broken A/B, operators would
+    # read it as autotuning that is not happening)
+    with pytest.raises(ValueError, match="single candidate"):
+        Controller(TunerConfig(decode_chunk=(1,),
+                               pipeline_depth=(1,)), _BASE)
+
+
+# -- engine + scheduler integration (tiny engines, lazy compiles) ------------
+
+
+def _cfg(**overrides):
+    base = dict(vocab_size=VOCAB, seq_len=64)
+    base.update(overrides)
+    return standalone_gpt_config(**base)
+
+
+@pytest.fixture(scope="module")
+def model(devices8):
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    return cfg, params, mesh
+
+
+def _reqs(n, *, seed0=7000, max_tokens=10):
+    out = []
+    for i in range(n):
+        p_len = 2 + (3 * i) % 6
+        prompt = [int(t) for t in jax.random.randint(
+            jax.random.PRNGKey(seed0 + i), (p_len,), 0, VOCAB)]
+        sp = (SamplingParams(temperature=0.9, top_k=7, seed=seed0 + i)
+              if i % 2 else SamplingParams())
+        out.append(Request(f"t{i}", prompt, max_tokens=max_tokens,
+                           sampling=sp))
+    return out
+
+
+def test_engine_ladder_validation_and_unwarmed_variant_rejection(model):
+    cfg, params, mesh = model
+    with pytest.raises(ValueError, match="must contain decode_chunk"):
+        Engine(cfg, params, mesh, EngineConfig(
+            slots=2, max_prompt_len=8, max_seq_len=24, decode_chunk=4,
+            decode_chunks=(1, 2)))
+    with pytest.raises(ValueError, match="strictly increasing"):
+        Engine(cfg, params, mesh, EngineConfig(
+            slots=2, max_prompt_len=8, max_seq_len=24,
+            decode_chunks=(2, 2)))
+    with pytest.raises(ValueError, match="spec_ks"):
+        Engine(cfg, params, mesh, EngineConfig(
+            slots=2, max_prompt_len=8, max_seq_len=24, spec_k=3,
+            spec_ks=(2,)))
+    with pytest.raises(ValueError, match="plain variant"):
+        Engine(cfg, params, mesh, EngineConfig(
+            slots=2, max_prompt_len=8, max_seq_len=24, spec_ks=(0, 2)))
+    eng = Engine(cfg, params, mesh, EngineConfig(
+        slots=2, max_prompt_len=8, max_seq_len=24, decode_chunk=1,
+        decode_chunks=(1, 2)))
+    assert eng.decode_chunks == (1, 2) and eng.spec_ks == ()
+    # an unwarmed rung must raise, not compile mid-serve
+    with pytest.raises(ValueError, match="pre-warmed"):
+        eng.step_async(chunk=4)
+    with pytest.raises(ValueError, match="spec"):
+        eng.step_async(spec=True)
+    with pytest.raises(ValueError, match="without spec"):
+        eng.step_async(spec_k=2)
+    assert "step_c1" in eng.compiled_cache_sizes()
+    assert "step_c2" in eng.compiled_cache_sizes()
+    eng.close()
+
+
+def test_scheduler_tuner_ladder_validation(model):
+    cfg, params, mesh = model
+    eng = Engine(cfg, params, mesh, EngineConfig(
+        slots=2, max_prompt_len=8, max_seq_len=24, decode_chunk=1,
+        decode_chunks=(1, 2)))
+    # a candidate outside the engine's warmed ladder fails LOUDLY at
+    # construction — the runtime half of the pre-warm contract
+    with pytest.raises(ValueError, match="not pre-warmed"):
+        Scheduler(eng, tuner=TunerConfig(decode_chunk=(1, 2, 4)))
+    with pytest.raises(ValueError, match="not pre-warmed"):
+        Scheduler(eng, tuner=TunerConfig(spec_k=(0, 2)))
+    with pytest.raises(ValueError, match="base"):
+        Scheduler(eng, pipeline_depth=3,
+                  tuner=TunerConfig(pipeline_depth=(1, 2)))
+    eng.close()
+    # a tuner owning spec_k replaces the gate — passing both is a
+    # config error, and the auto-created gate must be absent
+    eng2 = Engine(cfg, params, mesh, EngineConfig(
+        slots=2, max_prompt_len=8, max_seq_len=24, spec_k=2,
+        spec_hist=8))
+    with pytest.raises(ValueError, match="spec_gate"):
+        Scheduler(eng2, tuner=TunerConfig(spec_k=(0, 2)),
+                  spec_gate=SpecGateConfig())
+    sched = Scheduler(eng2, tuner=TunerConfig(spec_k=(0, 2)))
+    assert sched._gate is None
+    eng2.close()
+
+
+class _FakeClock:
+    """Deterministic scheduler clock: a tiny epsilon per read (strict
+    monotonicity) plus explicit advances from the latency model."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 1e-6
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+class _TimedHandle:
+    """Wrap a StepHandle so its fetch advances the fake clock by the
+    injected latency model's cost for the dispatched variant."""
+
+    def __init__(self, handle, clk, dt):
+        self._handle, self._clk, self._dt = handle, clk, dt
+
+    def fetch(self):
+        self._clk.advance(self._dt)
+        return self._handle.fetch()
+
+    def __getattr__(self, name):
+        return getattr(self._handle, name)
+
+
+def _inject_latency(eng, clk, model):
+    """Shim the engine's dispatch so every chunk's wall time comes
+    from the injected model (keyed on the dispatched variant) instead
+    of host noise — the fake-clock harness's device stand-in."""
+    orig = eng.step_async
+
+    def step_async(*, spec=False, chunk=None, spec_k=None):
+        h = orig(spec=spec, chunk=chunk, spec_k=spec_k)
+        c = chunk if chunk is not None else eng.engine_cfg.decode_chunk
+        return _TimedHandle(h, clk, model(c, spec))
+
+    eng.step_async = step_async
+
+
+def test_fake_clock_scheduler_converges_and_reconverges(model):
+    """The acceptance oracle, end to end on a real engine: an injected
+    latency model makes chunk=2 strictly dominant (fixed per-dispatch
+    overhead amortized over more tokens) — the controller converges to
+    it; flipping the model to punish chunk=2 re-converges back to
+    chunk=1. Every dispatched variant is pre-warmed by construction
+    (step_async validates), and the per-variant compiled caches stay
+    at 1 across all switching."""
+    cfg, params, mesh = model
+    eng = Engine(cfg, params, mesh, EngineConfig(
+        slots=2, max_prompt_len=8, max_seq_len=56, decode_chunk=1,
+        decode_chunks=(1, 2)))
+    clk = _FakeClock()
+    cost = {1: 0.011, 2: 0.012}   # ~2x tokens for ~9% more wall
+
+    def run(reqs):
+        sched = Scheduler(
+            eng, clock=clk, sleep=clk.sleep, pipeline_depth=1,
+            tuner=TunerConfig(decode_chunk=(1, 2), probe_every=3,
+                              probe_chunks=2, min_measure_chunks=2))
+        for r in reqs:
+            sched.submit(r)
+        sched.run_until_idle()
+        return sched
+
+    _inject_latency(eng, clk, lambda c, spec: cost[c])
+    sched = run(_reqs(4, max_tokens=40))
+    s = sched.summary()
+    assert s["tuner_decode_chunk"] == 2.0, s
+    assert s["tuner_switches"] >= 1.0
+    # the shift: chunk=2 becomes 20x worse — the controller must walk
+    # back to chunk=1 within the run
+    eng.rebuild_slots()
+    cost[2] = 0.25
+    sched2 = run(_reqs(4, seed0=7100, max_tokens=40))
+    s2 = sched2.summary()
+    # a fresh scheduler starts from base chunk=1 and must REFUSE the
+    # now-bad chunk=2 after probing it
+    assert s2["tuner_decode_chunk"] == 1.0, s2
+    assert s2["tuner_probes"] >= 1.0 and s2["tuner_switches"] == 0.0
+    # trace stability without warmup: lazily-compiled programs hold at
+    # ONE entry each across all the switching (0 = never dispatched —
+    # this run never needed every admission rung)
+    sizes = {k: v for k, v in eng.compiled_cache_sizes().items()
+             if v is not None}
+    assert all(v in (0, 1) for v in sizes.values()), sizes
+    assert sizes["step_c1"] == 1 and sizes["step_c2"] == 1
+    eng.close()
+
+
+def test_constrained_admission_mid_tick_forces_base_chunk(model):
+    """THE mask-staleness race: a constrained request admitted AFTER
+    the tick-start freeze check, while the incumbent chunk is >1,
+    must still decode at the BASE chunk (=1 — submit validation's
+    precondition) — a wider chunk would scan tokens 2..n against a
+    stale vocab mask and emit schema-invalid output. The exclusion is
+    re-evaluated at dispatch, freezing the controller to base."""
+    from apex_tpu.serving.api.constrain import JsonSchemaConstraint
+
+    _, _, mesh = model
+    # byte-level constraint tokens need a >=256 vocab
+    cfg = _cfg(vocab_size=512, hidden_size=32, num_layers=1)
+    params = gpt.init(cfg, jax.random.PRNGKey(1))
+    eng = Engine(cfg, params, mesh, EngineConfig(
+        slots=2, max_prompt_len=8, max_seq_len=56, decode_chunk=1,
+        decode_chunks=(1, 2)))
+    clk = _FakeClock()
+    # chunk=2 strictly dominant → the incumbent moves off base
+    _inject_latency(eng, clk, lambda c, spec: {1: 0.011, 2: 0.012}[c])
+    rec = FlightRecorder(clock=lambda: 0.0)
+    sched = Scheduler(
+        eng, clock=clk, sleep=clk.sleep, pipeline_depth=1,
+        recorder=rec,
+        tuner=TunerConfig(decode_chunk=(1, 2), probe_every=3,
+                          probe_chunks=2, min_measure_chunks=2))
+    for r in _reqs(3, seed0=7700, max_tokens=30):
+        sched.submit(r)
+    sched.run_until_idle()
+    assert sched.summary()["tuner_decode_chunk"] == 2.0  # off base
+    # the constrained request arrives against a chunk=2 incumbent
+    forced = list(b'"ab"')
+    sched.submit(Request("c0", [3, 4, 5], max_tokens=12,
+                         constraint=JsonSchemaConstraint(
+                             {"enum": ["ab"]})))
+    sched.run_until_idle()
+    comp = sched.completions["c0"]
+    assert comp.tokens == forced and comp.finish_reason == "stop"
+    causes = {e[3][1] for e in rec.events()
+              if e[2] == "tuner_freeze" and e[3][0] == "enter"}
+    assert "constrained" in causes
+    eng.close()
+
+
+def test_gate_driven_spec_chunks_not_observed_by_tuner(model):
+    """With the GATE owning speculation and the tuner owning only
+    decode_chunk, speculative chunks' token counts reflect the gate's
+    acceptance, not the chunk knob — they must be excluded from the
+    tuner's EWMAs (every tuner_obs corresponds to a plain fetch)."""
+    cfg, params, mesh = model
+    eng = Engine(cfg, params, mesh, EngineConfig(
+        slots=2, max_prompt_len=8, max_seq_len=48, decode_chunk=1,
+        decode_chunks=(1, 2), spec_k=2, spec_hist=8))
+    rec = FlightRecorder()
+    sched = Scheduler(
+        eng, pipeline_depth=1, recorder=rec,
+        spec_gate=SpecGateConfig(probe_every=2, min_probe_chunks=1),
+        tuner=TunerConfig(decode_chunk=(1, 2), probe_every=2,
+                          probe_chunks=1, min_measure_chunks=1))
+    for r in _reqs(4, seed0=7600, max_tokens=16):
+        sched.submit(r)
+    sched.run_until_idle()
+    assert sched.summary()["spec_chunks"] > 0  # the gate actually ran
+    fetches = [e for e in rec.events() if e[2] == "fetch"]
+    plain_fetches = [e for e in fetches if not e[3][0]]
+    obs = [e for e in rec.events() if e[2] == "tuner_obs"]
+    assert len(obs) == len(plain_fetches) < len(fetches)
+    eng.close()
+
+
+def test_watchdog_tripping_probe_aborts_instead_of_livelocking(model):
+    """A probe candidate whose chunks keep tripping the watchdog can
+    never accumulate its window samples (tripped chunks are excluded
+    from observation) — the trip must ABORT the window via a freeze,
+    not leave the controller re-dispatching the pathological variant
+    forever."""
+    cfg, params, mesh = model
+    eng = Engine(cfg, params, mesh, EngineConfig(
+        slots=2, max_prompt_len=8, max_seq_len=56, decode_chunk=1,
+        decode_chunks=(1, 2)))
+    clk = _FakeClock()
+    # chunk=2 hangs past the watchdog, chunk=1 is healthy
+    _inject_latency(eng, clk, lambda c, spec: 0.9 if c == 2 else 0.01)
+    rec = FlightRecorder(clock=lambda: 0.0)
+    sched = Scheduler(
+        eng, clock=clk, sleep=clk.sleep, pipeline_depth=1,
+        recorder=rec,
+        resilience=ResilienceConfig(watchdog_timeout_s=0.5),
+        tuner=TunerConfig(decode_chunk=(1, 2), probe_every=2,
+                          probe_chunks=2, min_measure_chunks=2))
+    for r in _reqs(3, seed0=7500, max_tokens=30):
+        sched.submit(r)
+    sched.run_until_idle()   # the livelock regression: must terminate
+    s = sched.summary()
+    assert s["tuner_decode_chunk"] == 1.0  # never switched to the hang
+    assert s["watchdog_trips"] >= 1.0
+    causes = {e[3][1] for e in rec.events()
+              if e[2] == "tuner_freeze" and e[3][0] == "enter"}
+    assert "watchdog" in causes
+    aborts = [e for e in rec.events()
+              if e[2] == "tuner_probe" and e[3][2] == "abort"]
+    assert aborts, "tripping probe window was never aborted"
+    eng.close()
+
+
+def test_autotuned_streams_bit_identical_incl_faults(model):
+    """Stream parity across controller-driven switching: an autotuned
+    run (forced frequent probing over chunk AND depth) emits
+    bit-identical per-request streams to the plain fixed-config run —
+    including under a seeded FaultPlan, where the controller
+    hard-freezes through the rebuild/replay bracket (pinned via the
+    recorded freeze causes)."""
+    cfg, params, mesh = model
+    ecfg = EngineConfig(slots=2, max_prompt_len=8, max_seq_len=40,
+                        decode_chunk=1, decode_chunks=(1, 2))
+    reqs = _reqs(6, max_tokens=12)
+
+    def run(fault_plan, tuner, recorder=None):
+        eng = Engine(cfg, params, mesh, ecfg, fault_plan=fault_plan)
+        sched = Scheduler(
+            eng, pipeline_depth=2, tuner=tuner, recorder=recorder,
+            resilience=ResilienceConfig(backoff_base_s=0.001))
+        for r in _reqs(6, max_tokens=12):
+            sched.submit(r)
+        sched.run_until_idle()
+        toks = {rid: c.tokens for rid, c in sched.completions.items()}
+        eng.close()
+        return toks, sched
+
+    fixed, _ = run(None, None)
+    tn = TunerConfig(decode_chunk=(1, 2), pipeline_depth=(1, 2),
+                     probe_every=1, probe_chunks=1,
+                     min_measure_chunks=1)
+    auto, sched = run(None, tn)
+    assert auto == fixed
+    assert sched.summary()["tuner_probes"] > 0
+    # and under chaos: faults at two seams, streams still exact
+    rec = FlightRecorder()
+    plan = FaultPlan([FaultSpec("dispatch", 4, "error"),
+                      FaultSpec("fetch", 9, "nan", slots=(1,))])
+    chaos, sched2 = run(plan, tn, recorder=rec)
+    assert len(plan.injected) == 2
+    assert chaos == fixed
+    causes = {e[3][1] for e in rec.events()
+              if e[2] == "tuner_freeze" and e[3][0] == "enter"}
+    assert "rebuild" in causes
+    assert sched2.summary()["rebuilds"] >= 1.0
+
+
+def test_autotuned_bundle_decision_replay(model, tmp_path):
+    """An autotuned chaos run's post-mortem bundle replays its tuning
+    decision sequence bit-identically from the recorded clocks — the
+    stdlib replay_tuner path (no engine rebuild needed)."""
+    from apex_tpu.telemetry import Registry
+    from apex_tpu.telemetry.flightrec import read_bundle
+    from apex_tpu.telemetry.replay import replay_tuner
+
+    cfg, params, mesh = model
+    plan = FaultPlan([FaultSpec("fetch", 7, "error")])
+    eng = Engine(cfg, params, mesh, EngineConfig(
+        slots=2, max_prompt_len=8, max_seq_len=40, decode_chunk=1,
+        decode_chunks=(1, 2)), fault_plan=plan)
+    rec = FlightRecorder()
+    registry = Registry()
+    sched = Scheduler(
+        eng, pipeline_depth=2, recorder=rec, registry=registry,
+        bundle_dir=str(tmp_path), bundle_meta={"params": {"init_seed": 0}},
+        tuner=TunerConfig(decode_chunk=(1, 2), pipeline_depth=(1, 2),
+                          probe_every=2, probe_chunks=1,
+                          min_measure_chunks=1),
+        resilience=ResilienceConfig(backoff_base_s=0.001))
+    for r in _reqs(5, seed0=7200, max_tokens=14):
+        sched.submit(r)
+    sched.run_until_idle()
+    # the tuner telemetry surface is live: state gauge + per-knob
+    # incumbents pre-created for the declared ladder
+    snap = registry.to_dict()
+    assert "serving_tuner_state" in snap
+    knob_samples = snap["serving_tuner_knob"]["samples"]
+    assert {s["labels"].get("knob") for s in knob_samples} == {
+        "decode_chunk", "pipeline_depth"}
+    assert plan.injected and sched.bundles_written
+    bundle = read_bundle(sched.bundles_written[0])
+    # the bundle's config carries the ladders + base the replay needs
+    assert bundle["config.json"]["scheduler"]["tuner"][
+        "decode_chunk"] == [1, 2]
+    assert bundle["config.json"]["scheduler"]["tuner_base"][
+        "decode_chunk"] == 1
+    assert bundle["config.json"]["engine"]["engine"][
+        "decode_chunks"] == [1, 2]
+    out = replay_tuner(bundle)
+    assert out["mismatches"] == [], out["mismatches"]
+    assert out["decisions_recorded"] > 0 and out["observations"] > 0
+    eng.close()
+
+
+# -- slow tier: warmup + armed guard across forced switching -----------------
+
+
+@pytest.mark.slow
+def test_tuner_recompile_guard_flat_across_switching(model):
+    """The pre-warm contract under the armed guard: forced frequent
+    probing across chunk, depth, admit-batch AND spec knobs — every
+    dispatch rides a warmed variant, the guard never trips, every
+    per-variant compiled cache holds at 1."""
+    cfg, params, mesh = model
+    eng = Engine(cfg, params, mesh, EngineConfig(
+        slots=2, max_prompt_len=8, max_seq_len=48, decode_chunk=1,
+        decode_chunks=(1, 2), spec_k=0, spec_ks=(2,), spec_hist=8))
+    eng.warmup()
+    # the trace is built BEFORE arming: jax.random prompt generation
+    # is host tooling, not the serving loop under test
+    reqs = _reqs(6, seed0=7300, max_tokens=16)
+    with eng.recompile_guard():
+        sched = Scheduler(
+            eng, pipeline_depth=2,
+            tuner=TunerConfig(decode_chunk=(1, 2),
+                              pipeline_depth=(1, 2),
+                              max_admit_batch=(0, 1),
+                              spec_k=(0, 2),
+                              probe_every=1, probe_chunks=1,
+                              min_measure_chunks=1))
+        for r in reqs:
+            sched.submit(r)
+        sched.run_until_idle()
+    s = sched.summary()
+    assert s["tuner_probes"] >= 4.0  # every knob got probed
+    sizes = {k: v for k, v in eng.compiled_cache_sizes().items()
+             if v is not None}
+    assert all(v == 1 for v in sizes.values()), sizes
+    # the spec cross-variants exist and were exercised via the ladder
+    assert "step_spec_c1_k2" in sizes and "step_spec_c2_k2" in sizes
+    eng.close()
+
+
+@pytest.mark.slow
+def test_autotuned_bundle_full_replay_streams_and_decisions(
+        model, tmp_path):
+    """The full acceptance round trip: replay_bundle on an autotuned
+    chaos bundle rebuilds the engine (ladders included), re-runs the
+    trace to bit-identical streams, AND reproduces the tuning decision
+    sequence from the recorded clocks — one command, both verdicts."""
+    from apex_tpu.telemetry.replay import replay_bundle
+
+    cfg, params, mesh = model
+    plan = FaultPlan([FaultSpec("dispatch", 6, "error")])
+    eng = Engine(cfg, params, mesh, EngineConfig(
+        slots=2, max_prompt_len=8, max_seq_len=40, decode_chunk=1,
+        decode_chunks=(1, 2)), fault_plan=plan)
+    rec = FlightRecorder()
+    sched = Scheduler(
+        eng, pipeline_depth=2, recorder=rec,
+        bundle_dir=str(tmp_path),
+        bundle_meta={"params": {"init_seed": 0}},
+        tuner=TunerConfig(decode_chunk=(1, 2), probe_every=2,
+                          probe_chunks=1, min_measure_chunks=1),
+        resilience=ResilienceConfig(backoff_base_s=0.001))
+    for r in _reqs(5, seed0=7400, max_tokens=12):
+        sched.submit(r)
+    sched.run_until_idle()
+    assert plan.injected and sched.bundles_written
+    out = replay_bundle(sched.bundles_written[0], verbose=False)
+    assert out["mismatches"] == [], out["mismatches"]
+    assert out["tuner"]["decisions_recorded"] > 0
+    eng.close()
