@@ -1,0 +1,118 @@
+"""ADAPTER-STATIC: adapter-pool geometry must be config-derived.
+
+The batched multi-LoRA contract (PAGE-TABLE-STATIC's sibling, one
+feature over): the adapter POOL has static ``[n_adapters, rank, ...]``
+shapes derived from ``EngineConfig.adapter_slots`` /
+``adapter_rank``, and the per-slot adapter-id table is DATA — a ``[B]
+int32`` vector whose *contents* select rows via a gather, never a
+shape. The recompile hazard this feature invites is sizing the pool or
+an id array from live state — ``len(registered_adapters)``, a
+request's rank, a tenant count — at dispatch time: every new tenant
+population then produces a new array shape into a compiled program and
+the engine silently recompiles per registration, exactly the
+per-request shape ladder PAGE-TABLE-STATIC guards the paged cache
+against.
+
+Scope (narrow, like the sibling): array constructor calls (``np/jnp``
+``zeros``/``ones``/``full``/``empty``) whose result is bound to an
+adapter/lora-named target (``*adapter*``, ``*lora*``, ``*aids*`` —
+the naming convention of every adapter surface in the serving stack).
+Inside the constructor's SHAPE argument, a ``len(...)`` call or a
+``.size``/``.shape`` attribute read is flagged: pool and id-table
+shapes are spelled from config attributes and constants. Contents
+(``ids[slot] = adapter``) are unconstrained — ids are data.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Tuple
+
+from apex_tpu.analysis._astutil import dotted
+from apex_tpu.analysis.core import Finding, Project
+
+#: adapter-named binding targets — the multi-LoRA naming convention
+#: (only names that SAY adapter/lora/aids are held to the contract)
+_ADAPTER_RE = re.compile(r"(?i)(^|_)(adapters?|lora|aids?)(_|\d|$)")
+
+#: array constructors whose first argument is a shape
+_CTORS = {"zeros", "ones", "full", "empty"}
+_MODULES = {"np", "numpy", "jnp"}
+
+
+def _target_names(node: ast.Assign) -> List[str]:
+    out: List[str] = []
+    for t in node.targets:
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, ast.Attribute):
+            out.append(t.attr)
+    return out
+
+
+def _shape_arg(call: ast.Call) -> ast.AST:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "shape":
+            return kw.value
+    return call
+
+
+class AdapterStaticRule:
+    id = "ADAPTER-STATIC"
+    summary = ("adapter-pool/id-table array shapes must be "
+               "config-derived constants — len()/.size of live tenant "
+               "or request data in an adapter shape recompiles per "
+               "registration")
+    triggers: Tuple[str, ...] = ()
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for ctx in project.targets:
+            if ctx.tree is None:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Assign) \
+                        or not isinstance(node.value, ast.Call):
+                    continue
+                call = node.value
+                d = dotted(call.func)
+                if d is None:
+                    continue
+                parts = d.split(".")
+                if len(parts) != 2 or parts[0] not in _MODULES \
+                        or parts[1] not in _CTORS:
+                    continue
+                names = [n for n in _target_names(node)
+                         if _ADAPTER_RE.search(n)]
+                if not names:
+                    continue
+                findings.extend(self._scan_shape(
+                    ctx, names[0], _shape_arg(call)))
+        return findings
+
+    def _scan_shape(self, ctx, name: str, shape: ast.AST
+                    ) -> List[Finding]:
+        findings: List[Finding] = []
+        for n in ast.walk(shape):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                    and n.func.id == "len":
+                findings.append(Finding(
+                    self.id, ctx.rel, n.lineno,
+                    f"len(...) flows into the shape of adapter array "
+                    f"{name!r} — adapter-pool geometry must be a "
+                    f"config-derived constant "
+                    f"(EngineConfig.adapter_slots / adapter_rank), or "
+                    f"every registration compiles a new program",
+                    col=n.col_offset))
+            elif isinstance(n, ast.Attribute) and n.attr in ("size",
+                                                            "shape"):
+                findings.append(Finding(
+                    self.id, ctx.rel, n.lineno,
+                    f".{n.attr} of a runtime array flows into the "
+                    f"shape of adapter array {name!r} — derive the "
+                    f"shape from engine config, not from live data",
+                    col=n.col_offset))
+        return findings

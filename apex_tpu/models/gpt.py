@@ -444,28 +444,51 @@ def seq_partial_grad_mask(cfg: GPTConfig) -> Any:
 # forward (local-shard semantics — inside shard_map over cfg.axis)
 # ---------------------------------------------------------------------------
 
-def _qkv_project(cfg: GPTConfig, p, x, *, sequence_parallel=False):
+def _qkv_project(cfg: GPTConfig, p, x, *, sequence_parallel=False,
+                 lora=None):
     """TP entry mapping + the three slab matmuls of the ``[h, 3,
     h_local]`` fused-QKV param → ``(q, k, v)``, each ``[..., h_local]``
     in the flash kernel's operand layout. One mapping shared by the
     three matmuls (its VJP accumulates the three dx cotangents into a
     single psum); single-sourced so the training and decode paths can
-    never diverge."""
+    never diverge.
+
+    ``lora`` (serving only, SP stripped there): ``(site, ids, scale)``
+    with ``site`` the per-layer qkv adapter page ``{"a": [n, r, h],
+    "b": [n, r, 3, hl]}`` — each slab gains its per-row low-rank delta
+    (:func:`_lora_delta`; the rank-r intermediate is shared across the
+    three slabs, mirroring the fused kernel)."""
     w, bias = p["kernel"], p["bias"]
     if sequence_parallel:
+        if lora is not None:
+            raise ValueError(
+                "lora does not compose with sequence_parallel (the "
+                "serving paths strip SP before threading adapters)")
         x = gather_from_sequence_parallel_region(x, cfg.axis, True, 1)
     else:
         x = copy_to_tensor_model_parallel_region(x, cfg.axis)
-    return tuple(jnp.matmul(x, w[:, i]) + bias[i] for i in range(3))
+    outs = tuple(jnp.matmul(x, w[:, i]) + bias[i] for i in range(3))
+    if lora is None:
+        return outs
+    site, ids, scale = lora
+    return tuple(
+        o + _lora_delta(x, site["a"], site["b"][:, :, i], ids, scale)
+        for i, o in enumerate(outs))
 
 
-def _attention(cfg: GPTConfig, p, h, *, return_kv: bool = False):
+def _attention(cfg: GPTConfig, p, h, *, return_kv: bool = False,
+               lora=None):
     """h: [b, s(_local under SP), hidden] → same shape. With
     ``return_kv`` also returns the per-head (k, v) ``[b, heads_local, s,
     head_dim]`` — the cache entries bulk prefill captures — so the
-    projection/layout logic stays single-sourced."""
+    projection/layout logic stays single-sourced. ``lora`` is the
+    per-layer ``(page, ids, scale)`` adapter bundle (serving prefill
+    only): qkv slabs and the output projection gain their per-row
+    low-rank deltas."""
     sp = cfg.sequence_parallel
-    q, k, v = _qkv_project(cfg, p["qkv"], h, sequence_parallel=sp)
+    lq = None if lora is None else (lora[0]["qkv"],) + lora[1:]
+    q, k, v = _qkv_project(cfg, p["qkv"], h, sequence_parallel=sp,
+                           lora=lq)
     b, s, hl = q.shape           # [b, s_full, h_local] each
     d = cfg.head_dim
     heads_local = hl // d
@@ -474,6 +497,11 @@ def _attention(cfg: GPTConfig, p, h, *, return_kv: bool = False):
         out, p["proj"]["kernel"], p["proj"]["bias"], axis=cfg.axis,
         sequence_parallel=sp, sequence_dim=1,
     )
+    if lora is not None:
+        page, ids, scale = lora
+        proj = proj + _lora_delta(out, page["proj"]["a"],
+                                  page["proj"]["b"], ids, scale,
+                                  axis=cfg.axis)
     if return_kv:
         split = lambda t: jnp.transpose(
             t.reshape(b, s, heads_local, d), (0, 2, 1, 3))
@@ -582,18 +610,28 @@ def _xla_attn_probs(cfg: GPTConfig, q, k, mask):
         "(expected 'f32' or 'compute')")
 
 
-def _mlp(cfg: GPTConfig, p, h):
+def _mlp(cfg: GPTConfig, p, h, lora=None):
     sp = cfg.sequence_parallel
     y = column_parallel_linear(
         h, p["fc1"]["kernel"], p["fc1"]["bias"], axis=cfg.axis,
         sequence_parallel=sp, sequence_dim=1,
     )
+    if lora is not None:
+        # fc1's delta lands PRE-gelu (merged-weight semantics: gelu
+        # sees W1 x + delta); fc2's applies to the post-gelu input
+        page, ids, scale = lora
+        y = y + _lora_delta(h, page["fc1"]["a"], page["fc1"]["b"],
+                            ids, scale)
     y = checkpoint_name(y, "mlp_fc1")  # pre-gelu: gelu replays cheaply
     y = jax.nn.gelu(y, approximate=True)
-    return row_parallel_linear(
+    out = row_parallel_linear(
         y, p["fc2"]["kernel"], p["fc2"]["bias"], axis=cfg.axis,
         sequence_parallel=sp, sequence_dim=1,
     )
+    if lora is not None:
+        out = out + _lora_delta(y, page["fc2"]["a"], page["fc2"]["b"],
+                                ids, scale, axis=cfg.axis)
+    return out
 
 
 def _layer_norm(cfg: GPTConfig, h, scale, bias):
@@ -620,12 +658,16 @@ def _moe_cfg(cfg: GPTConfig) -> moe_mod.MoEConfig:
         dispatch=cfg.moe_dispatch)
 
 
-def _block(cfg: GPTConfig, p, h, *, return_kv: bool = False):
+def _block(cfg: GPTConfig, p, h, *, return_kv: bool = False,
+           lora=None):
     """One transformer layer; returns ``(h, aux)`` — aux is the MoE
     load-balance term, 0 for the dense MLP — plus the attention (k, v)
-    when ``return_kv`` (bulk prefill's cache capture)."""
+    when ``return_kv`` (bulk prefill's cache capture). ``lora`` is the
+    per-layer ``(page, ids, scale)`` adapter bundle (serving prefill
+    only — training never threads it)."""
     x = _layer_norm(cfg, h, p["ln1"]["scale"], p["ln1"]["bias"])
-    attn = _attention(cfg, p["attn"], x, return_kv=return_kv)
+    attn = _attention(cfg, p["attn"], x, return_kv=return_kv,
+                      lora=lora)
     kv = None
     if return_kv:
         attn, kv = attn
@@ -642,7 +684,7 @@ def _block(cfg: GPTConfig, p, h, *, return_kv: bool = False):
             _moe_cfg(cfg), p["moe"], x.reshape(b * s, hd))
         h = h + y.reshape(b, s, hd)
     else:
-        h, aux = h + _mlp(cfg, p["mlp"], x), jnp.float32(0.0)
+        h, aux = h + _mlp(cfg, p["mlp"], x, lora=lora), jnp.float32(0.0)
     if return_kv:
         return h, aux, kv
     return h, aux
@@ -1047,6 +1089,172 @@ def dequantize_cache_block(cfg: GPTConfig, block):
     return block
 
 
+# ---------------------------------------------------------------------------
+# batched multi-LoRA: per-slot low-rank adapter deltas on the dense seams
+# (the serving engine's multi-tenant weight play — apex/fused_dense (U)
+# is the seam; apex.transformer layer slicing (U) the subsetting idiom)
+# ---------------------------------------------------------------------------
+
+def _lora_delta(x, a, b, ids, scale, *, axis: Optional[str] = None):
+    """The batched per-row LoRA delta for ONE dense site: ``x [B, din]``
+    or ``[B, T, din]`` with per-row adapter ids ``ids [B] int32`` over a
+    static pool ``a [n, r, din]`` / ``b [n, r, dout]`` →
+    ``gather(b, ids) @ (gather(a, ids) @ x) * scale`` in ``x``'s dtype.
+    Ids are DATA (a gather index, never a shape): one compiled program
+    serves every tenant mix, and the pinned all-zero adapter row 0
+    contributes an exact-zero delta so base traffic stays numerically
+    exact. ``axis`` (row-parallel sites: proj/fc2, whose ``din`` is the
+    tp-sharded dim) psums the TINY ``[.., r]`` intermediate so the
+    delta is exact under tp sharding at rank-r collective cost."""
+    ag = jnp.take(a, ids, axis=0)          # [B, r, din]
+    bg = jnp.take(b, ids, axis=0)          # [B, r, dout]
+    sc = jnp.asarray(scale, x.dtype)
+    if x.ndim == 2:
+        u = jnp.einsum("bh,brh->br", x, ag)
+        if axis is not None:
+            u = lax.psum(u, axis)
+        return jnp.einsum("br,brH->bH", u, bg) * sc
+    u = jnp.einsum("bth,brh->btr", x, ag)
+    if axis is not None:
+        u = lax.psum(u, axis)
+    return jnp.einsum("btr,brH->btH", u, bg) * sc
+
+
+def init_lora_pool(cfg: GPTConfig, params, n_adapters: int, rank: int):
+    """Zero adapter pool for the four dense seams of every layer, sized
+    from this rank's layer/qkv/mlp shards (local semantics — call
+    inside ``shard_map`` like :func:`init_cache`). Layout per site:
+    ``a [L, n, r, din]`` / ``b [L, n, r(, 3), dout]`` in compute dtype,
+    stacked on the leading layer dim so the pool scans with the layer
+    params. Row 0 is the PINNED all-zero adapter (base traffic); the
+    serving engine registers tenants into rows >= 1. Shapes are all
+    config-derived constants — n_adapters and rank are compile-time
+    static (ADAPTER-STATIC), only the per-slot id vector varies."""
+    if cfg.num_experts:
+        raise ValueError(
+            "LoRA adapters do not compose with num_experts > 0 (the "
+            "expert FFN has no per-row dense seam to delta)")
+    qkv_k = params["layers"]["attn"]["qkv"]["kernel"]  # [L, h, 3, hl]
+    l_local = qkv_k.shape[0]
+    hl = qkv_k.shape[-1]
+    h = cfg.hidden_size
+    fl = params["layers"]["mlp"]["fc1"]["kernel"].shape[-1]
+    z = lambda *s: jnp.zeros((l_local, n_adapters, rank) + s,
+                             cfg.compute_dtype)
+    return {
+        "qkv": {"a": z(h), "b": z(3, hl)},
+        "proj": {"a": z(hl), "b": z(h)},
+        "fc1": {"a": z(h), "b": z(fl)},
+        "fc2": {"a": z(fl), "b": z(h)},
+    }
+
+
+def lora_specs(cfg: GPTConfig):
+    """PartitionSpecs matching :func:`init_lora_pool`: column-parallel
+    sites (qkv/fc1) shard ``b``'s output dim like their kernel's
+    tp-sharded dim, row-parallel sites (proj/fc2) shard ``a``'s input
+    dim — the rank-r intermediate psums (:func:`_lora_delta`), so the
+    math is exact under any tp."""
+    t = cfg.axis
+    rep = P(None, None, None, None)
+    return {
+        "qkv": {"a": rep, "b": P(None, None, None, None, t)},
+        "proj": {"a": P(None, None, None, t), "b": rep},
+        "fc1": {"a": rep, "b": P(None, None, None, t)},
+        "fc2": {"a": P(None, None, None, t), "b": rep},
+    }
+
+
+def lora_row_specs(cfg: GPTConfig):
+    """Specs of ONE adapter row (the :func:`lora_set_row` payload —
+    :func:`lora_specs` minus the pool's ``n`` dim)."""
+    drop = lambda s: P(*(tuple(s)[:1] + tuple(s)[2:]))
+    return jax.tree.map(drop, lora_specs(cfg),
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def lora_set_row(pool, row, idx):
+    """Write one adapter's ``[L, r, ...]`` row block into pool row
+    ``idx`` (traced scalar, dim 1) — the registration write, sibling of
+    :func:`cache_insert_slot`."""
+    def ins(c, b):
+        starts = [jnp.int32(0)] * c.ndim
+        starts[1] = jnp.asarray(idx, jnp.int32)
+        return lax.dynamic_update_slice(
+            c, b[:, None].astype(c.dtype), tuple(starts))
+
+    return jax.tree.map(ins, pool, row)
+
+
+def init_lora_weights(cfg: GPTConfig, rank: int, seed: int, *,
+                      std: float = 0.02):
+    """Deterministic synthetic adapter weights (GLOBAL, unsharded,
+    host numpy — tests/bench/demo surface, and the seeded-registration
+    path post-mortem replay rebuilds adapters from): per dense site,
+    ``a [L, r, din]`` / ``b [L, r(, 3), dout]`` ~ N(0, std) fp32. Both
+    factors are nonzero (a trained adapter's B is not the init-time
+    zero), so the delta actually moves logits."""
+    if cfg.num_experts:
+        raise ValueError(
+            "LoRA adapters do not compose with num_experts > 0")
+    rng = np.random.default_rng(int(seed) & 0xFFFFFFFF)
+    h, f, L = cfg.hidden_size, cfg.ffn, cfg.num_layers
+    g = lambda *s: rng.normal(0.0, std, (L, rank) + s).astype(np.float32)
+    return {
+        "qkv": {"a": g(h), "b": g(3, h)},
+        "proj": {"a": g(h), "b": g(h)},
+        "fc1": {"a": g(h), "b": g(f)},
+        "fc2": {"a": g(f), "b": g(h)},
+    }
+
+
+def merge_lora(cfg: GPTConfig, params, weights, alpha: float):
+    """Fold GLOBAL adapter ``weights`` (:func:`init_lora_weights`
+    layout) into a COPY of global ``params`` — ``W += (alpha / r) *
+    a^T b`` per dense site. The merged-weight oracle's reference: a
+    solo forward with merged params matches the engine's batched
+    adapter path within per-dtype tolerance (the adapter path computes
+    the delta separately in compute dtype; the merge folds it in param
+    dtype)."""
+    r = weights["qkv"]["a"].shape[1]
+    sc = float(alpha) / float(r)
+    lay = params["layers"]
+    qkv = lay["attn"]["qkv"]["kernel"]
+    proj = lay["attn"]["proj"]["kernel"]
+    fc1 = lay["mlp"]["fc1"]["kernel"]
+    fc2 = lay["mlp"]["fc2"]["kernel"]
+    d = lambda e, *ops: sc * jnp.einsum(e, *ops).astype(jnp.float32)
+    new_lay = {
+        **lay,
+        "attn": {
+            **lay["attn"],
+            "qkv": {**lay["attn"]["qkv"],
+                    "kernel": (qkv + d("lrh,lrci->lhci",
+                                       weights["qkv"]["a"],
+                                       weights["qkv"]["b"]
+                                       ).astype(qkv.dtype))},
+            "proj": {**lay["attn"]["proj"],
+                     "kernel": (proj + d("lri,lro->lio",
+                                         weights["proj"]["a"],
+                                         weights["proj"]["b"]
+                                         ).astype(proj.dtype))},
+        },
+        "mlp": {
+            "fc1": {**lay["mlp"]["fc1"],
+                    "kernel": (fc1 + d("lrh,lrf->lhf",
+                                       weights["fc1"]["a"],
+                                       weights["fc1"]["b"]
+                                       ).astype(fc1.dtype))},
+            "fc2": {**lay["mlp"]["fc2"],
+                    "kernel": (fc2 + d("lrf,lrh->lfh",
+                                       weights["fc2"]["a"],
+                                       weights["fc2"]["b"]
+                                       ).astype(fc2.dtype))},
+        },
+    }
+    return {**params, "layers": new_lay}
+
+
 def init_cache(cfg: GPTConfig, params, batch: int,
                max_len: Optional[int] = None):
     """Local KV cache (zeros) sized from this rank's layer/qkv shards —
@@ -1257,7 +1465,8 @@ def _paged_attend(cfg: GPTConfig, q, k_new, v_new, kv, pos, table):
     return jnp.einsum("bhs,bhsd->bhd", p_attn, v_cache), new_kv
 
 
-def _decode_layer(cfg: GPTConfig, p, x, kv, pos, table=None):
+def _decode_layer(cfg: GPTConfig, p, x, kv, pos, table=None,
+                  lora=None):
     """One layer for one token: x [b, hidden], kv [2, b, hl, S, d] (or
     the quantized ``{"kv", "scale"}`` pytree of the same shape family;
     under a paged cache — ``table`` given — the per-layer page-pool
@@ -1277,9 +1486,10 @@ def _decode_layer(cfg: GPTConfig, p, x, kv, pos, table=None):
     d = cfg.head_dim
     b = xa.shape[0]
     hl = p["attn"]["qkv"]["kernel"].shape[-1]
+    lq = None if lora is None else (lora[0]["qkv"],) + lora[1:]
     q, k_new, v_new = (
         t.reshape(b, hl // d, d)
-        for t in _qkv_project(cfg, p["attn"]["qkv"], xa))
+        for t in _qkv_project(cfg, p["attn"]["qkv"], xa, lora=lq))
     if table is None:
         ctx, new_kv = _decode_attend(cfg, q, k_new, v_new, kv, pos)
     else:
@@ -1289,12 +1499,17 @@ def _decode_layer(cfg: GPTConfig, p, x, kv, pos, table=None):
     attn = row_parallel_linear(
         out, p["attn"]["proj"]["kernel"], p["attn"]["proj"]["bias"],
         axis=cfg.axis)
+    if lora is not None:
+        page, ids, scale = lora
+        attn = attn + _lora_delta(out, page["proj"]["a"],
+                                  page["proj"]["b"], ids, scale,
+                                  axis=cfg.axis)
     x = x + attn
     xb = _layer_norm(cfg, x, p["ln2"]["scale"], p["ln2"]["bias"])
     if cfg.num_experts:
         y, _ = moe_mod.moe_ffn(_moe_cfg(cfg), p["moe"], xb)  # aux unused
     else:
-        y = _mlp(cfg, p["mlp"], xb)
+        y = _mlp(cfg, p["mlp"], xb, lora=lora)
     return x + y, new_kv
 
 
@@ -1311,7 +1526,8 @@ def _lm_head(cfg: GPTConfig, params, h):
     return lg.astype(jnp.float32)
 
 
-def decode_step(cfg: GPTConfig, params, cache, token, pos, table=None):
+def decode_step(cfg: GPTConfig, params, cache, token, pos, table=None,
+                lora=None):
     """One decoding step: ``token [b] int32`` at position ``pos`` →
     (full-vocab fp32 logits ``[b, vocab]``, updated cache).
 
@@ -1328,6 +1544,13 @@ def decode_step(cfg: GPTConfig, params, cache, token, pos, table=None):
     semantics are identical either way, and garbage cache entries past a
     row's position are masked to exact softmax zeros, so a row's logits
     match a solo run regardless of batch-mates or cache horizon.
+
+    ``lora`` (optional ``(pool, ids, scale)`` — pool from
+    :func:`init_lora_pool`, ``ids [b] int32`` per-row adapter rows,
+    ``scale = alpha / r`` static) applies each row's low-rank adapter
+    delta at every dense seam; ids are DATA like the page table, so one
+    compiled program serves every tenant mix, and id 0 (the pinned
+    all-zero row) leaves base rows numerically exact.
 
     Sequence parallelism is stripped: decode has no sequence dim, and the
     SP gather/scatter would misread the batch dim as one.
@@ -1349,13 +1572,26 @@ def decode_step(cfg: GPTConfig, params, cache, token, pos, table=None):
     x = (emb[:, 0] + pos_e.astype(cfg.compute_dtype)).astype(
         cfg.compute_dtype)
 
-    def body(carry, inp):
-        layer_p, kv = inp
-        y, kv = _decode_layer(cfg, _cast_layer(cfg, layer_p), carry, kv,
-                              pos, table)
-        return y, kv
+    if lora is None:
+        def body(carry, inp):
+            layer_p, kv = inp
+            y, kv = _decode_layer(cfg, _cast_layer(cfg, layer_p), carry,
+                                  kv, pos, table)
+            return y, kv
 
-    x, new_cache = lax.scan(body, x, (params["layers"], cache))
+        x, new_cache = lax.scan(body, x, (params["layers"], cache))
+    else:
+        pool, ids, scale = lora
+
+        def body(carry, inp):
+            layer_p, kv, page = inp
+            y, kv = _decode_layer(cfg, _cast_layer(cfg, layer_p), carry,
+                                  kv, pos, table,
+                                  lora=(page, ids, scale))
+            return y, kv
+
+        x, new_cache = lax.scan(body, x,
+                                (params["layers"], cache, pool))
     return _lm_head(cfg, params, x), new_cache
 
 
@@ -1366,7 +1602,7 @@ _NO_EOS_SENTINEL = -1
 
 def decode_steps(cfg: GPTConfig, params, cache, state, n: int, *,
                  pad_token_id: int = 0, draw_fn=None, masks=None,
-                 table=None):
+                 table=None, lora=None):
     """``n`` fused decode steps as ONE compiled ``lax.scan`` — the
     chunked device-side decode loop. Each step is a
     :func:`decode_step` + on-device sampling + per-slot eos/budget
@@ -1410,7 +1646,7 @@ def decode_steps(cfg: GPTConfig, params, cache, state, n: int, *,
     def body(carry, _):
         cache, st = carry
         logits, cache = decode_step(
-            cfg, params, cache, st["tok"], st["pos"], table)
+            cfg, params, cache, st["tok"], st["pos"], table, lora)
         if draw_fn is None:
             nxt = _sampling.draw_slots(
                 logits, st["key"], st["pos"], st["temp"], st["top_k"],
@@ -1650,7 +1886,8 @@ def _decode_attend_multi(cfg: GPTConfig, q, k_new, v_new, kv, pos):
     return jnp.einsum("bhts,bhsd->bhtd", p_attn, v_cache), new_kv
 
 
-def _verify_layer(cfg: GPTConfig, p, x, kv, pos, table=None):
+def _verify_layer(cfg: GPTConfig, p, x, kv, pos, table=None,
+                  lora=None):
     """:func:`_decode_layer` for ``T`` tokens per row: ``x [b, T,
     hidden]`` at positions ``pos[b] + t``. Projections/LN/MLP are
     per-position (row-independent matmuls — the :func:`prefill_extend`
@@ -1660,9 +1897,10 @@ def _verify_layer(cfg: GPTConfig, p, x, kv, pos, table=None):
     d = cfg.head_dim
     b, t, _ = xa.shape
     hl = p["attn"]["qkv"]["kernel"].shape[-1]
+    lq = None if lora is None else (lora[0]["qkv"],) + lora[1:]
     q, k_new, v_new = (
         jnp.transpose(z.reshape(b, t, hl // d, d), (0, 2, 1, 3))
-        for z in _qkv_project(cfg, p["attn"]["qkv"], xa))
+        for z in _qkv_project(cfg, p["attn"]["qkv"], xa, lora=lq))
     if table is None:
         ctx, new_kv = _decode_attend_multi(cfg, q, k_new, v_new, kv,
                                            pos)
@@ -1673,13 +1911,18 @@ def _verify_layer(cfg: GPTConfig, p, x, kv, pos, table=None):
     attn = row_parallel_linear(
         out, p["attn"]["proj"]["kernel"], p["attn"]["proj"]["bias"],
         axis=cfg.axis)
+    if lora is not None:
+        page, ids, scale = lora
+        attn = attn + _lora_delta(out, page["proj"]["a"],
+                                  page["proj"]["b"], ids, scale,
+                                  axis=cfg.axis)
     x = x + attn
     xb = _layer_norm(cfg, x, p["ln2"]["scale"], p["ln2"]["bias"])
-    return x + _mlp(cfg, p["mlp"], xb), new_kv
+    return x + _mlp(cfg, p["mlp"], xb, lora=lora), new_kv
 
 
 def decode_verify(cfg: GPTConfig, params, cache, tokens, pos,
-                  table=None):
+                  table=None, lora=None):
     """The speculative verify forward: feed ``tokens [b, T] int32``
     (this step's input token followed by T-1 drafted candidates) at
     per-row positions ``pos[b] .. pos[b] + T - 1`` through ONE batched
@@ -1728,20 +1971,34 @@ def decode_verify(cfg: GPTConfig, params, cache, tokens, pos,
     pos_e = jnp.take(params["embedding"]["position"], posn, axis=0)
     x = (emb + pos_e.astype(cfg.compute_dtype)).astype(cfg.compute_dtype)
 
-    def body(carry, inp):
-        layer_p, kv = inp
-        y, kv = _verify_layer(cfg, _cast_layer(cfg, layer_p), carry, kv,
-                              pos, table)
-        return y, kv
+    if lora is None:
+        def body(carry, inp):
+            layer_p, kv = inp
+            y, kv = _verify_layer(cfg, _cast_layer(cfg, layer_p), carry,
+                                  kv, pos, table)
+            return y, kv
 
-    x, new_cache = lax.scan(body, x, (params["layers"], cache))
+        x, new_cache = lax.scan(body, x, (params["layers"], cache))
+    else:
+        pool, ids, scale = lora
+
+        def body(carry, inp):
+            layer_p, kv, page = inp
+            y, kv = _verify_layer(cfg, _cast_layer(cfg, layer_p), carry,
+                                  kv, pos, table,
+                                  lora=(page, ids, scale))
+            return y, kv
+
+        x, new_cache = lax.scan(body, x,
+                                (params["layers"], cache, pool))
     lg = _lm_head(cfg, params, x.reshape(b * t, cfg.hidden_size))
     return lg.reshape(b, t, -1), new_cache
 
 
 def decode_steps_spec(cfg: GPTConfig, params, cache, state, n: int, *,
                       spec_k: int, pad_token_id: int = 0, draw_fn=None,
-                      draft_fn=None, masks=None, table=None):
+                      draft_fn=None, masks=None, table=None,
+                      lora=None):
     """:func:`decode_steps` with draft-k-verify speculation: ``n``
     scan iterations (waves), each drafting ``spec_k`` candidate tokens
     from the slot's token history (:func:`ngram_drafts`, or the
@@ -1793,7 +2050,7 @@ def decode_steps_spec(cfg: GPTConfig, params, cache, state, n: int, *,
                           cfg.vocab_size - 1)
         tokens_in = jnp.concatenate([tok[:, None], drafts], axis=1)
         logits_all, cache = decode_verify(cfg, params, cache, tokens_in,
-                                          pos, table)
+                                          pos, table, lora)
         live0 = ~st["done"]
         rem = st["remaining"]
         done = st["done"]
@@ -1889,7 +2146,8 @@ def _decode_entry_cfg(cfg: GPTConfig, p_len: int,
     return cfg
 
 
-def _prefill_states(cfg: GPTConfig, params, prompt, max_len: int):
+def _prefill_states(cfg: GPTConfig, params, prompt, max_len: int,
+                    lora=None):
     """Shared body of :func:`prefill` / :func:`prefill_at`: one
     training-path forward over ``prompt [b, p_len]`` → (cache
     ``[l, 2, b, hl, max_len, d]``, pre-final-LN hidden ``[b, p_len,
@@ -1899,12 +2157,24 @@ def _prefill_states(cfg: GPTConfig, params, prompt, max_len: int):
         raise ValueError(f"prompt {p_len} exceeds cache max_len {max_len}")
     h = _embed(cfg, params, prompt.astype(jnp.int32))
 
-    def body(carry, layer_p):
-        hh, _, kv = _block(cfg, _cast_layer(cfg, layer_p), carry,
-                           return_kv=True)
-        return hh, kv
+    if lora is None:
+        def body(carry, layer_p):
+            hh, _, kv = _block(cfg, _cast_layer(cfg, layer_p), carry,
+                               return_kv=True)
+            return hh, kv
 
-    h, (ks, vs) = lax.scan(body, h, params["layers"])
+        h, (ks, vs) = lax.scan(body, h, params["layers"])
+    else:
+        pool, ids, scale = lora
+
+        def body(carry, inp):
+            layer_p, page = inp
+            hh, _, kv = _block(cfg, _cast_layer(cfg, layer_p), carry,
+                               return_kv=True,
+                               lora=(page, ids, scale))
+            return hh, kv
+
+        h, (ks, vs) = lax.scan(body, h, (params["layers"], pool))
     # ks/vs [l_local, b, heads_local, p_len, d] → cache [l, 2, b, hl, S, d]
     pad = ((0, 0),) * 3 + ((0, max_len - p_len), (0, 0))
     cache = jnp.stack([jnp.pad(ks, pad), jnp.pad(vs, pad)], axis=1)
@@ -1950,7 +2220,7 @@ def prefill_at(cfg: GPTConfig, params, prompt, last, *,
 
 
 def prefill_many(cfg: GPTConfig, params, prompts, last, *,
-                 max_len: Optional[int] = None):
+                 max_len: Optional[int] = None, lora=None):
     """:func:`prefill_at` for a batch of right-padded prompts with
     PER-ROW end positions: ``prompts [k, P]`` whose real tokens end at
     ``last [k]`` (traced vector) → ``(cache [l, 2, k, hl, max_len, d],
@@ -1962,7 +2232,8 @@ def prefill_many(cfg: GPTConfig, params, prompts, last, *,
     of k queued requests in a single admission dispatch."""
     b, p_len = prompts.shape
     cfg = _decode_entry_cfg(cfg, p_len)
-    cache, h = _prefill_states(cfg, params, prompts, max_len or cfg.seq_len)
+    cache, h = _prefill_states(cfg, params, prompts,
+                               max_len or cfg.seq_len, lora=lora)
     last = jnp.asarray(last, jnp.int32)
     # per-row gather of the hidden state at each prompt's true end
     h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]
@@ -1970,7 +2241,7 @@ def prefill_many(cfg: GPTConfig, params, prompts, last, *,
 
 
 def prefill_extend(cfg: GPTConfig, params, prefix_kv, tail, last, *,
-                   prefix_len: int):
+                   prefix_len: int, lora=None):
     """Tail-only prefill over an already-prefilled shared prefix: run
     ONE forward over the right-padded tail tokens ``tail [b, T]``
     (positions ``prefix_len .. prefix_len + T - 1``; real tokens end at
@@ -2031,11 +2302,14 @@ def prefill_extend(cfg: GPTConfig, params, prefix_kv, tail, last, *,
     rowg = prefix_len + jnp.arange(tb)
     mask = (colg[None] <= rowg[:, None])[None, None]  # [1, 1, T, P+T]
 
-    def body(carry, inp):
-        layer_p, pkv = inp  # pkv [2, b, hl, prefix_len, d]
-        p = _cast_layer(cfg, layer_p)
+    def layer_body(p, pkv, carry, page, ids, scale):
+        # pkv [2, b, hl, prefix_len, d]; page = this layer's adapter
+        # pages (None = base). One body shared by the plain and
+        # adapter scans so the two can never diverge.
+        lo = None if page is None else (page, ids, scale)
+        lq = None if page is None else (page["qkv"], ids, scale)
         x = _layer_norm(cfg, carry, p["ln1"]["scale"], p["ln1"]["bias"])
-        qh, kh, vh = _qkv_project(cfg, p["attn"]["qkv"], x)
+        qh, kh, vh = _qkv_project(cfg, p["attn"]["qkv"], x, lora=lq)
         heads = qh.shape[-1] // d
         split = lambda t: jnp.transpose(
             t.reshape(b, tb, heads, d), (0, 2, 1, 3))
@@ -2046,16 +2320,36 @@ def prefill_extend(cfg: GPTConfig, params, prefix_kv, tail, last, *,
         # included, so hit and cold can never diverge here
         p_attn = _xla_attn_probs(cfg, qs, k_full, mask)
         ctx = jnp.einsum("bhqk,bhkd->bhqd", p_attn, v_full)
-        attn = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(b, tb, heads * d)
+        out = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(b, tb, heads * d)
         attn = row_parallel_linear(
-            attn, p["attn"]["proj"]["kernel"], p["attn"]["proj"]["bias"],
+            out, p["attn"]["proj"]["kernel"], p["attn"]["proj"]["bias"],
             axis=cfg.axis)
+        if page is not None:
+            attn = attn + _lora_delta(out, page["proj"]["a"],
+                                      page["proj"]["b"], ids, scale,
+                                      axis=cfg.axis)
         hh = carry + attn
         x2 = _layer_norm(cfg, hh, p["ln2"]["scale"], p["ln2"]["bias"])
-        hh = hh + _mlp(cfg, p["mlp"], x2)
+        hh = hh + _mlp(cfg, p["mlp"], x2, lora=lo)
         return hh, jnp.stack([kt, vt])
 
-    h, tail_kv = lax.scan(body, h, (params["layers"], prefix_kv))
+    if lora is None:
+        def body(carry, inp):
+            layer_p, pkv = inp
+            return layer_body(_cast_layer(cfg, layer_p), pkv, carry,
+                              None, None, None)
+
+        h, tail_kv = lax.scan(body, h, (params["layers"], prefix_kv))
+    else:
+        pool, ids, scale = lora
+
+        def body(carry, inp):
+            layer_p, pkv, page = inp
+            return layer_body(_cast_layer(cfg, layer_p), pkv, carry,
+                              page, ids, scale)
+
+        h, tail_kv = lax.scan(body, h,
+                              (params["layers"], prefix_kv, pool))
     last = jnp.asarray(last, jnp.int32)
     h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]
     return tail_kv, _lm_head(cfg, params, h_last)

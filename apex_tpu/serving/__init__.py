@@ -44,7 +44,8 @@ from apex_tpu.serving.request import (  # noqa: F401
 
 __all__ = [
     "request", "sampling", "engine", "scheduler", "resilience", "api",
-    "pages", "fleet", "tuner",
+    "pages", "fleet", "tuner", "tenancy",
+    "TenancyConfig", "TenantBook", "TenantThrottled",
     "Request", "SamplingParams", "Completion", "StreamEvent",
     "StopMatcher",
     "Engine", "EngineConfig", "Scheduler", "QueueFull",
@@ -82,6 +83,10 @@ _LAZY = {
     "tuner": "apex_tpu.serving.tuner",
     "TunerConfig": "apex_tpu.serving.tuner",
     "Controller": "apex_tpu.serving.tuner",
+    "tenancy": "apex_tpu.serving.tenancy",
+    "TenancyConfig": "apex_tpu.serving.tenancy",
+    "TenantBook": "apex_tpu.serving.tenancy",
+    "TenantThrottled": "apex_tpu.serving.tenancy",
     "fleet": "apex_tpu.serving.fleet",
     "Router": "apex_tpu.serving.fleet",
     "FleetConfig": "apex_tpu.serving.fleet",

@@ -15,9 +15,14 @@ surface), ``logprobs``, and ``response_format`` (``json_object``, or
 extension echoes raw token ids per choice/chunk — what the bench's
 wire-load mode asserts bit-identical against the in-process engine.
 
-Unsupported-but-harmless OpenAI fields (``model`` is echoed, ``user``
-etc. ignored) pass through silently; malformed values raise
-:class:`ApiError` → a 400 with an OpenAI-shaped error body.
+Tenant identity: the OpenAI ``user`` field is parsed as the request's
+tenant id (the ``X-Tenant-Id`` header, read by the server layer, wins
+when both are present) and drives the scheduler's weighted-fair
+queueing / per-tenant rate limits; ``model`` routes to a registered
+LoRA adapter when it names one (``/v1/models`` lists them) and is
+echoed otherwise. Remaining unsupported OpenAI fields pass through
+silently; malformed values raise :class:`ApiError` → a 400 with an
+OpenAI-shaped error body.
 
 SSE framing: ``data: <json>\\n\\n`` per chunk, ``data: [DONE]\\n\\n``
 terminal — exactly what standard OpenAI client libraries parse.
@@ -108,6 +113,9 @@ class ParsedRequest:
     response_format: Optional[Dict[str, Any]]
     return_token_ids: bool
     echo: bool = False
+    #: the OpenAI ``user`` field — tenant identity (the X-Tenant-Id
+    #: header wins over it at the server layer); None = anonymous
+    user: Optional[str] = None
 
 
 def render_chat_prompt(messages: Sequence[Dict[str, str]]) -> str:
@@ -199,6 +207,7 @@ def _parse_common(body: Dict[str, Any]) -> Dict[str, Any]:
         logprobs=bool(body.get("logprobs") or 0),
         response_format=rf,
         return_token_ids=_get(body, "return_token_ids", bool, False),
+        user=_get(body, "user", str),
     )
 
 

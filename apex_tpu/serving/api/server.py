@@ -55,6 +55,9 @@ from apex_tpu.serving.api import protocol
 from apex_tpu.serving.api.constrain import JsonSchemaConstraint
 from apex_tpu.serving.api.tokenizer import ByteTokenizer
 from apex_tpu.serving.request import Request, SamplingParams
+# stdlib-only by construction (the dependency-free test covers it):
+# tenancy is pure host policy, no jax behind it
+from apex_tpu.serving.tenancy import DEFAULT_TENANT, TenantThrottled
 
 _ROUTES = ("chat", "completions", "models", "healthz", "other")
 
@@ -301,6 +304,15 @@ class ApiServer:
         for i, r in enumerate(sub.requests):
             try:
                 sched.submit(r)
+            except TenantThrottled as e:
+                # per-tenant token budget exhausted: 429 with the
+                # bucket's refill time as Retry-After — tenant-wide,
+                # so unlike QueueFull no other replica is worth trying
+                fail(i, protocol.ApiError(
+                    429, str(e), err_type="rate_limit_error",
+                    code="tenant_rate_limited",
+                    retry_after_s=e.retry_after_s))
+                return
             except QueueFull as e:  # an injected flood / a race lost
                 fail(i, protocol.ApiError(
                     429, str(e), err_type="rate_limit_error",
@@ -318,8 +330,20 @@ class ApiServer:
 
     # -- request building (handler threads; engine-free) --------------------
 
+    def _resolve_adapter(self, model: str) -> int:
+        """Map the request's ``model`` to a LoRA adapter row: a
+        registered adapter name routes to its id, anything else —
+        including the served base model name — routes to the pinned
+        base adapter 0 (the model string is echoed either way, the
+        OpenAI convention)."""
+        names = getattr(self.scheduler.engine, "adapter_names", None)
+        if not names:
+            return 0
+        return names.get(model, 0)
+
     def _build_requests(self, parsed: protocol.ParsedRequest,
-                        base_id: str
+                        base_id: str,
+                        tenant: str = DEFAULT_TENANT
                         ) -> Tuple[List[Request], List[int]]:
         tok = self.tokenizer
         if parsed.messages is not None:
@@ -416,7 +440,9 @@ class ApiServer:
                 max_tokens=max_tokens, sampling=sp,
                 eos_token_id=(constrained_eos if constraint is not None
                               else eos),
-                stop=stops or None, constraint=constraint))
+                stop=stops or None, constraint=constraint,
+                tenant=tenant,
+                adapter=self._resolve_adapter(parsed.model)))
         return requests, prompt
 
 
@@ -475,9 +501,19 @@ def _make_handler(server: ApiServer):
                 route = "models"
                 if server.metrics is not None:
                     server.metrics.requests[route].inc()
-                body = {"object": "list", "data": [{
-                    "id": server.model, "object": "model",
-                    "owned_by": "apex_tpu"}]}
+                # the base model plus every registered LoRA adapter —
+                # an adapter's name IS a model id clients pass in
+                # `model` to route their requests onto its weights
+                data = [{"id": server.model, "object": "model",
+                         "owned_by": "apex_tpu"}]
+                names = getattr(server.scheduler.engine,
+                                "adapter_names", None) or {}
+                data += [{"id": n, "object": "model",
+                          "owned_by": "apex_tpu",
+                          "parent": server.model, "adapter": i}
+                         for n, i in sorted(names.items(),
+                                            key=lambda kv: kv[1])]
+                body = {"object": "list", "data": data}
                 self._reply(route, 200,
                             json.dumps(body).encode("utf-8"))
             else:
@@ -508,7 +544,13 @@ def _make_handler(server: ApiServer):
                           else protocol.parse_completion_request(body))
                 rid = ("chatcmpl-" if route == "chat" else "cmpl-") \
                     + format(server._next_id(), "x")
-                requests, prompt = server._build_requests(parsed, rid)
+                # tenant identity: the X-Tenant-Id header wins over
+                # the OpenAI `user` field; anonymous traffic shares
+                # the default tenant
+                tenant = (self.headers.get("X-Tenant-Id")
+                          or parsed.user or DEFAULT_TENANT)
+                requests, prompt = server._build_requests(
+                    parsed, rid, tenant=tenant)
             except protocol.ApiError as e:
                 self._reply_error(route, e)
                 return

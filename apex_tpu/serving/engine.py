@@ -205,6 +205,28 @@ class EngineConfig:
     #: the engine carries the drafter machinery and warm spec variants
     #: but dispatches plain until a tuner asks otherwise.
     spec_ks: Optional[Tuple[int, ...]] = None
+    #: batched multi-LoRA adapter pool rows (0 disables — no pool
+    #: buffer, no extra program arguments; the historical engine).
+    #: With ``adapter_slots > 0`` every dense seam of every forward
+    #: (prefill / extend / decode / verify) gains a per-slot low-rank
+    #: delta gathered from a static ``[n_adapters, r, ...]`` pool by a
+    #: ``[B] int32`` adapter-id table — ids are DATA (the vocab-mask /
+    #: block-table pattern), so ONE compiled program serves every
+    #: tenant mix and the recompile guard stays flat across adapter
+    #: registration and admission churn. Row 0 is the PINNED all-zero
+    #: adapter: base traffic decodes numerically exact (the delta is
+    #: an exact zero), tenants register into rows 1..n-1 via
+    #: :meth:`Engine.register_adapter` (after :meth:`Engine.warmup`,
+    #: the prefix-pool lifecycle). The pool is never donated, so it
+    #: survives :meth:`Engine.rebuild_slots` and fault replay serves
+    #: the same weights.
+    adapter_slots: int = 0
+    #: low-rank adapter rank r — compile-time static (ADAPTER-STATIC:
+    #: every registered adapter shares it; a per-tenant rank would be
+    #: a shape ladder and recompile per tenant).
+    adapter_rank: int = 8
+    #: LoRA scaling numerator: deltas apply as ``(alpha / r) * B A x``.
+    adapter_alpha: float = 16.0
 
 
 #: eos sentinel in the per-slot eos vector: no stop token for this slot
@@ -223,6 +245,13 @@ class Admission:
     set; it also seeds the slot's per-step mask
     (:meth:`Engine.set_slot_mask` advances it between chunks). ``None``
     = unconstrained (and resets any stale mask the slot carried).
+
+    ``adapter`` selects the request's LoRA adapter row (0 = the pinned
+    base adapter; rows >= 1 come from
+    :meth:`Engine.register_adapter`). It rides the admission prefill
+    AND the slot's decode id-table entry, so every token of the
+    request — prefill, decode, speculative verify — sees the same
+    weights.
 
     ``prefix_page``/``prefix_len`` (optional) ride a prefix-pool hit
     (:meth:`Engine.match_prefix`): ``prompt`` is still the FULL token
@@ -245,6 +274,7 @@ class Admission:
     allowed_tokens: Optional[Sequence[int]] = None
     prefix_page: Optional[int] = None
     prefix_len: int = 0
+    adapter: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -460,6 +490,24 @@ class Engine:
                 raise ValueError(
                     f"serving engine shards over tp only; mesh has "
                     f"{axis}={mesh.shape[axis]}")
+        # -- batched multi-LoRA geometry (all compile-time static:
+        # pool rows and rank shape the programs, ids are data —
+        # ADAPTER-STATIC) ------------------------------------------------
+        if ecfg.adapter_slots < 0:
+            raise ValueError(
+                f"adapter_slots {ecfg.adapter_slots} must be >= 0")
+        self._lora = ecfg.adapter_slots > 0
+        if self._lora:
+            if ecfg.adapter_rank < 1:
+                raise ValueError(
+                    f"adapter_rank {ecfg.adapter_rank} must be >= 1")
+            if cfg.num_experts:
+                raise ValueError(
+                    "adapter_slots > 0 does not compose with "
+                    "num_experts > 0 (the expert FFN has no per-row "
+                    "dense seam to delta — see gpt.init_lora_pool)")
+        self._lora_scale = (ecfg.adapter_alpha / ecfg.adapter_rank
+                            if self._lora else 0.0)
         self._buckets = self._resolve_buckets(ecfg)
         self._batch_sizes = self._resolve_batch_sizes(ecfg)
         if ecfg.prefix_pool_slots > 0 and cfg.num_experts:
@@ -586,6 +634,17 @@ class Engine:
         #: between chunked admissions; the engine serializes them — the
         #: scratch buffer holds one prompt)
         self._chunked: Optional[ChunkedAdmission] = None
+        #: multi-LoRA host state: the per-slot adapter-id table mirror
+        #: (device copy cached like the masks — re-uploaded only when
+        #: a row changes) and the adapter registry (name → row,
+        #: row → metadata incl. the registration seed the post-mortem
+        #: replay rebuilds adapters from)
+        self._adapter_ids = np.zeros((ecfg.slots,), np.int32)
+        self._aids_dev: Optional[Any] = None
+        self._adapter_names: Dict[str, int] = {}
+        self._adapter_meta: Dict[int, Dict[str, Any]] = {}
+        self._adapter_used = 1 if self._lora else 0  # row 0 pinned
+        self.adapters: Optional[Any] = None
         self._build()
         with expected_compiles():
             # construction compiles (the init programs materialise
@@ -597,6 +656,11 @@ class Engine:
                 self._chunk_scratch = self._chunk_scratch_init(params)
             if self._prefix_splits:
                 self.pool = self._pool_init(params)
+            if self._lora:
+                # the adapter pool: zeros everywhere — row 0 IS the
+                # pinned base adapter; never donated, so it survives
+                # rebuild_slots and fault replay
+                self.adapters = self._adapter_init(params)
 
     @staticmethod
     def _resolve_buckets(ecfg: EngineConfig) -> Tuple[int, ...]:
@@ -728,6 +792,9 @@ class Engine:
 
         paged = self._paged
         p_sz = ecfg.page_size
+        lora_on = self._lora
+        l_scale = self._lora_scale
+        lora_spec = gpt.lora_specs(cfg) if lora_on else None
 
         def init_local(params):
             if paged:
@@ -756,19 +823,22 @@ class Engine:
             return cache, state
 
         def make_step_core(chunk: int):
-            def step_core(params, cache, state, masks, table):
+            def step_core(params, cache, state, masks, table, lora):
                 # the whole per-token body (decode + per-slot draw +
                 # eos/budget masking) lives in gpt.decode_steps — ONE
                 # compiled scan of `chunk` steps per dispatch; masks
                 # is the per-slot constrained-decoding vocab whitelist
                 # (all-True rows are bit-identical to no mask); table
-                # is the paged block table (None = contiguous layout)
+                # is the paged block table (None = contiguous layout);
+                # lora is the (adapter pool, [B] id table, scale)
+                # bundle (None = no pool — both pool and ids are DATA,
+                # one program per variant serves every tenant mix)
                 hist = state["hist"] if spec else None
                 pos0 = state["pos"]
                 cache, state, toks, lps, fins = gpt.decode_steps(
                     cfg, params, cache, state, chunk,
                     pad_token_id=ecfg.pad_token_id, masks=masks,
-                    table=table)
+                    table=table, lora=lora)
                 if spec:
                     # keep the drafter's history fresh across PLAIN
                     # chunks too (a payoff-gated or tuner-driven
@@ -783,29 +853,64 @@ class Engine:
             return step_core
 
         def make_step_spec_core(chunk: int, k: int):
-            def step_spec_core(params, cache, state, masks, table):
+            def step_spec_core(params, cache, state, masks, table,
+                               lora):
                 # the speculative chunk: `chunk` draft-verify-accept
                 # waves, emitting up to chunk*(k+1) columns (valid
                 # marks the real ones); bit-identical streams to the
                 # plain variants by the token-matching verification
-                # contract
+                # contract (per adapter mix too — the verify forward
+                # gathers the same adapter rows the plain path does)
                 return gpt.decode_steps_spec(
                     cfg, params, cache, state, chunk,
                     spec_k=k, pad_token_id=ecfg.pad_token_id,
-                    masks=masks, table=table)
+                    masks=masks, table=table, lora=lora)
 
             return step_spec_core
 
         def adapt_step(core):
-            if paged:
-                # the cores already take the table last — they ARE the
-                # paged step programs
-                return core
-
-            def step_local(params, cache, state, masks):
-                return core(params, cache, state, masks, None)
+            # core(params, cache, state, masks, table, lora) → the
+            # compiled signature for this engine's (paged, lora)
+            # feature mix: disabled features contribute NO arguments,
+            # so a featureless engine's programs are byte-for-byte the
+            # historical ones
+            if paged and lora_on:
+                def step_local(params, cache, state, masks, table,
+                               adapters, aids):
+                    return core(params, cache, state, masks, table,
+                                (adapters, aids, l_scale))
+            elif paged:
+                def step_local(params, cache, state, masks, table):
+                    return core(params, cache, state, masks, table,
+                                None)
+            elif lora_on:
+                def step_local(params, cache, state, masks, adapters,
+                               aids):
+                    return core(params, cache, state, masks, None,
+                                (adapters, aids, l_scale))
+            else:
+                def step_local(params, cache, state, masks):
+                    return core(params, cache, state, masks, None,
+                                None)
 
             return step_local
+
+        def _parse_extra(extra):
+            """Unpack the optional trailing data args every admission
+            program shares — (pages, hist0, lora bundle), absent
+            features contributing None — so the paged/spec/lora arg
+            order is spelled exactly once."""
+            i = 0
+            pages = hist0 = lora = None
+            if paged:
+                pages = extra[i]
+                i += 1
+            if spec:
+                hist0 = extra[i]
+                i += 1
+            if lora_on:
+                lora = (extra[i], extra[i + 1], l_scale)
+            return pages, hist0, lora
 
         def make_admit(bucket: int):
             n_ins = -(-bucket // p_sz) if paged else 0
@@ -814,14 +919,14 @@ class Engine:
                             max_tokens, temp, top_k, top_p, keys, eos,
                             req_idx, seeded, masks, *extra):
                 # extra rides the optional data args in a fixed order:
-                # the paged per-row page indices, then the spec
-                # history seed
-                pages = extra[0] if paged else None
-                hist0 = extra[-1] if spec else None
+                # the paged per-row page indices, the spec history
+                # seed, then the adapter pool + per-row adapter ids
+                pages, hist0, lora = _parse_extra(extra)
                 # ONE padded forward admits the whole [k, bucket] batch;
                 # row i's logits/KV are exactly its solo prefill_at's
                 blocks, logits0 = gpt.prefill_many(
-                    cfg, params, prompts, p_lens - 1, max_len=bucket)
+                    cfg, params, prompts, p_lens - 1, max_len=bucket,
+                    lora=lora)
                 # unseeded rows fold the monotonic request counter into
                 # the zero base key ON DEVICE (no host-side compile to
                 # trip a recompile guard); seeded rows keep their host
@@ -888,7 +993,29 @@ class Engine:
             donate_argnums=donate)
         scalar = P()
         n_step_args = 2 if paged else 1  # masks (+ tables)
+        # lora args (the adapter pool is tp-sharded — never a scalar
+        # spec; the [B]/[k] id tables are) ride LAST on every program
+        # that runs a forward
+        lora_in = (lora_spec, scalar) if lora_on else ()
         self._init = sm(init_local, (pspecs,), (cache_spec, state_spec))
+        if lora_on:
+            def adapter_init_local(params):
+                return gpt.init_lora_pool(cfg, params,
+                                          ecfg.adapter_slots,
+                                          ecfg.adapter_rank)
+
+            def adapter_set_local(pool, row, idx):
+                return gpt.lora_set_row(pool, row, idx)
+
+            # the pool rides its own init (NOT the slot init): a fault
+            # rebuild re-inits slots but leaves registered adapters
+            # intact — and the set program is NOT donated, so a failed
+            # registration cannot consume the rows already serving
+            self._adapter_init = sm(adapter_init_local, (pspecs,),
+                                    lora_spec)
+            self._adapter_set = sm(
+                adapter_set_local,
+                (lora_spec, gpt.lora_row_specs(cfg), scalar), lora_spec)
         # one compiled step program per decode-chunk rung, and one
         # spec variant per (chunk, k) cross — a self-tuning scheduler
         # switches among them per dispatch, all pre-warmed, so the
@@ -899,21 +1026,22 @@ class Engine:
             self._step_variants[c] = sm(
                 adapt_step(make_step_core(c)),
                 (pspecs, cache_spec, state_spec)
-                + (scalar,) * n_step_args,
+                + (scalar,) * n_step_args + lora_in,
                 (cache_spec, state_spec, scalar, scalar, scalar),
                 donate=(1, 2))
             for k in self._spec_ladder:
                 self._spec_variants[(c, k)] = sm(
                     adapt_step(make_step_spec_core(c, k)),
                     (pspecs, cache_spec, state_spec)
-                    + (scalar,) * n_step_args,
+                    + (scalar,) * n_step_args + lora_in,
                     (cache_spec, state_spec, scalar, scalar, scalar,
                      scalar),
                     donate=(1, 2))
         # one admission program per (bucket, k) — the k dim and padded
         # width are static shapes, everything request-scoped is data
         # (paged engines thread the per-row page indices, spec engines
-        # the host-packed prompt-tail history seed)
+        # the host-packed prompt-tail history seed, lora engines the
+        # adapter pool + per-row adapter ids)
         n_admit_args = 12 + int(paged) + int(spec)
         self._admits: Dict[Tuple[int, int], Any] = {}
         for bucket in self._buckets:
@@ -921,7 +1049,7 @@ class Engine:
             for k in self._batch_sizes:
                 self._admits[(bucket, k)] = sm(
                     fn, (pspecs, cache_spec, state_spec)
-                    + (scalar,) * n_admit_args,
+                    + (scalar,) * n_admit_args + lora_in,
                     (cache_spec, state_spec, scalar, scalar, scalar,
                      scalar),
                     donate=(1, 2))
@@ -955,28 +1083,33 @@ class Engine:
             self._chunk_scratch_init = sm(scratch_init_local, (pspecs,),
                                           scratch_spec)
 
-            def chunk0_local(params, scratch, tokens):
+            def chunk0_local(params, scratch, tokens, *extra):
+                lora = ((extra[0], extra[1], l_scale) if lora_on
+                        else None)
                 blocks, _ = gpt.prefill_many(
                     cfg_ext, params, tokens,
                     jnp.full((1,), chunk_c - 1, jnp.int32),
-                    max_len=chunk_c)
+                    max_len=chunk_c, lora=lora)
                 return gpt.cache_insert_slot(scratch, blocks,
                                              jnp.int32(0))
 
             self._chunk0 = sm(chunk0_local,
-                              (pspecs, scratch_spec, scalar),
+                              (pspecs, scratch_spec, scalar) + lora_in,
                               scratch_spec, donate=(1,))
 
             def make_chunk_ext(i: int):
                 pfx = i * chunk_c
 
-                def chunk_ext_local(params, scratch, tail, last):
+                def chunk_ext_local(params, scratch, tail, last,
+                                    *extra):
+                    lora = ((extra[0], extra[1], l_scale) if lora_on
+                            else None)
                     prefix = jax.tree.map(
                         lambda x: lax.slice_in_dim(x, 0, pfx, axis=4),
                         scratch)
                     tail_kv, logits = gpt.prefill_extend(
                         cfg, params, prefix, tail, last,
-                        prefix_len=pfx)
+                        prefix_len=pfx, lora=lora)
                     return (gpt.cache_insert_slot(
                         scratch, tail_kv, jnp.int32(0), pos=pfx),
                         logits)
@@ -986,7 +1119,7 @@ class Engine:
             for i in range(1, mpl // chunk_c):
                 self._chunk_exts[i] = sm(
                     make_chunk_ext(i),
-                    (pspecs, scratch_spec, scalar, scalar),
+                    (pspecs, scratch_spec, scalar, scalar) + lora_in,
                     (scratch_spec, scalar), donate=(1,))
 
             def chunk_finish_local(params, cache, state, scratch,
@@ -1122,15 +1255,19 @@ class Engine:
                                    tails, t_lens, max_tokens, temp,
                                    top_k, top_p, keys, eos, req_idx,
                                    seeded, masks, page, *extra):
-                pages = extra[0] if paged else None
-                hist0 = extra[-1] if spec else None
+                pages, hist0, lora = _parse_extra(extra)
                 # the compiled gather: page -> [l, 2, 1, hl, ps, d]
                 # block of EXACT compute-dtype prefix K/V (the pool's
-                # master copy)
+                # master copy). Prefix hits are validated to ride the
+                # BASE adapter (id 0 — the pooled prefix was prefilled
+                # with base weights), so the threaded lora bundle is
+                # an exact zero delta; it rides anyway so the program
+                # signature is uniform across the lora engine's
+                # admission family.
                 block = gpt.cache_gather_page(pool, page, ps)
                 tail_kv, logits0 = gpt.prefill_extend(
                     cfg, params, block, tails, t_lens - 1,
-                    prefix_len=ps)
+                    prefix_len=ps, lora=lora)
                 base = jnp.zeros((2,), jnp.uint32)
                 folded = jax.vmap(
                     lambda i: jax.random.fold_in(base, i))(req_idx)
@@ -1197,7 +1334,7 @@ class Engine:
             self._admit_prefix[(ps, tb)] = sm(
                 make_admit_prefix(ps, tb),
                 (pspecs, cache_spec, state_spec, pool_spec)
-                + (scalar,) * (13 + int(paged) + int(spec)),
+                + (scalar,) * (13 + int(paged) + int(spec)) + lora_in,
                 (cache_spec, state_spec, scalar, scalar, scalar,
                  scalar),
                 donate=(1, 2))
@@ -1448,6 +1585,112 @@ class Engine:
                 return hit[0], split
         return None
 
+    # -- batched multi-LoRA (EngineConfig.adapter_slots > 0) ---------------
+
+    @property
+    def adapter_pool_enabled(self) -> bool:
+        """True when ``EngineConfig.adapter_slots > 0``."""
+        return self._lora
+
+    @property
+    def adapter_names(self) -> Dict[str, int]:
+        """Registered adapter name → pool row (copy; excludes the
+        pinned base row 0) — the ``/v1/models`` listing source."""
+        return dict(self._adapter_names)
+
+    @property
+    def adapters_registered(self) -> int:
+        """Registered adapter count (excluding the pinned base
+        row)."""
+        return max(self._adapter_used - 1, 0)
+
+    def adapter_bytes(self) -> int:
+        """Device bytes held by the adapter pool (0 when disabled)."""
+        if self.adapters is None:
+            return 0
+        return int(sum(x.nbytes
+                       for x in jax.tree.leaves(self.adapters)))
+
+    def _lora_expected_shapes(self) -> Dict[str, Dict[str, Tuple[int, ...]]]:
+        cfg, r = self.cfg, self.engine_cfg.adapter_rank
+        L, h, f = cfg.num_layers, cfg.hidden_size, cfg.ffn
+        return {
+            "qkv": {"a": (L, r, h), "b": (L, r, 3, h)},
+            "proj": {"a": (L, r, h), "b": (L, r, h)},
+            "fc1": {"a": (L, r, h), "b": (L, r, f)},
+            "fc2": {"a": (L, r, f), "b": (L, r, h)},
+        }
+
+    def register_adapter(self, weights=None, *, name: Optional[str] = None,
+                         seed: Optional[int] = None) -> int:
+        """Register one LoRA adapter into the next free pool row;
+        returns its id (the value requests pass as
+        ``Admission.adapter`` / ``Request.adapter``). Either pass
+        ``weights`` — GLOBAL per-site ``{"qkv"/"proj"/"fc1"/"fc2":
+        {"a", "b"}}`` arrays in the :func:`gpt.init_lora_weights`
+        layout — or ``seed`` to generate the deterministic synthetic
+        adapter that seed names (the bench/demo path; post-mortem
+        replay rebuilds seeded adapters bit-identically from the
+        recorded seed). Registering an already-registered ``name``
+        returns the existing id (idempotent, like
+        :meth:`register_prefix`). Call AFTER :meth:`warmup` — the set
+        program is compiled there, so registration never trips an
+        armed recompile guard. The pool is never donated: registered
+        rows survive :meth:`rebuild_slots` and fault replay."""
+        if not self._lora:
+            raise ValueError(
+                "adapter pool disabled (EngineConfig.adapter_slots "
+                "== 0)")
+        if not self._warmed:
+            raise ValueError(
+                "register_adapter() before warmup(): the adapter-set "
+                "program compiles during warmup — call warmup() "
+                "first, then register (the prefix-pool lifecycle)")
+        if (weights is None) == (seed is None):
+            raise ValueError(
+                "pass exactly one of weights= or seed=")
+        if name is None:
+            name = (f"adapter-seed-{seed}" if seed is not None
+                    else f"adapter-{self._adapter_used}")
+        hit = self._adapter_names.get(name)
+        if hit is not None:
+            return hit
+        if seed is not None:
+            weights = gpt.init_lora_weights(
+                self.cfg, self.engine_cfg.adapter_rank, seed)
+        # validate the payload BEFORE the capacity check: a malformed
+        # adapter should fail as malformed whether or not the pool
+        # happens to be full
+        expected = self._lora_expected_shapes()
+        row: Dict[str, Dict[str, np.ndarray]] = {}
+        for site, parts in expected.items():
+            if site not in weights:
+                raise ValueError(f"adapter weights missing site "
+                                 f"{site!r}")
+            row[site] = {}
+            for part, shape in parts.items():
+                arr = np.asarray(weights[site][part], np.float32)
+                if arr.shape != shape:
+                    raise ValueError(
+                        f"adapter {site}.{part} shape {arr.shape} != "
+                        f"expected {shape} (rank/layers/hidden are "
+                        f"compile-time static — ADAPTER-STATIC)")
+                row[site][part] = arr
+        if self._adapter_used >= self.engine_cfg.adapter_slots:
+            raise ValueError(
+                f"adapter pool full ({self.engine_cfg.adapter_slots} "
+                f"rows incl. the pinned base row 0)")
+        idx = self._adapter_used
+        # NOT donated: a failed set leaves every serving row intact
+        self.adapters = self._adapter_set(self.adapters, row,
+                                          np.int32(idx))
+        self._adapter_used += 1
+        self._adapter_names[name] = idx
+        self._adapter_meta[idx] = {"id": idx, "name": name,
+                                   "seed": seed,
+                                   "rank": self.engine_cfg.adapter_rank}
+        return idx
+
     def describe(self) -> Dict[str, Any]:
         """JSON-safe snapshot of everything needed to REBUILD this
         engine elsewhere — the post-mortem bundle's ``config.json``
@@ -1475,6 +1718,11 @@ class Engine:
             "spec_ks": list(self._spec_ladder),
             "prefix_templates": [list(self._prefix_tokens[p])
                                  for p in sorted(self._prefix_tokens)],
+            # seeded registrations replay bit-identically (the seed
+            # regenerates the exact weights); explicit-weight ones
+            # record seed=None and replay skips their requests
+            "adapters": [dict(self._adapter_meta[i])
+                         for i in sorted(self._adapter_meta)],
             "warmed": self._warmed,
             "poisoned": self._poisoned,
         }
@@ -1547,6 +1795,24 @@ class Engine:
             # dispatch if any row is invalid); the expansion itself is
             # owned by set_slot_mask
             self._check_allowed_tokens(a.allowed_tokens)
+        if a.adapter:
+            if not self._lora:
+                raise ValueError(
+                    f"admission carries adapter {a.adapter} but the "
+                    f"adapter pool is disabled "
+                    f"(EngineConfig.adapter_slots == 0)")
+            if not 1 <= a.adapter < self._adapter_used:
+                raise ValueError(
+                    f"adapter {a.adapter} outside the registered rows "
+                    f"[1, {self._adapter_used}) — register_adapter() "
+                    f"first (0 is the pinned base adapter)")
+            if a.prefix_page is not None:
+                raise ValueError(
+                    "prefix-pool hits require the base adapter (id "
+                    "0): the pooled prefix was prefilled with base "
+                    "weights, so an adapter-carrying hit would decode "
+                    "against K/V a cold adapter prefill would not "
+                    "produce")
         if a.prefix_page is not None:
             ps = a.prefix_len
             if not self._prefix_splits:
@@ -1703,6 +1969,16 @@ class Engine:
             if self._spec:
                 extra += (np.stack([self._hist_seed(p)
                                     for p, _ in proms]),)
+            if self._lora:
+                # the slot's decode-path id-table entry is set BEFORE
+                # the dispatch that admits it (the vocab-mask
+                # contract); the admission forward reads the per-row
+                # ids argument
+                for a in batch:
+                    self._set_slot_adapter(a.slot, a.adapter)
+                extra += (self.adapters,
+                          np.asarray([a.adapter for a in batch],
+                                     np.int32))
             self.cache, self.state, first, first_lp, hit_eos, done = fn(
                 self._params, self.cache, self.state,
                 arr([a.slot for a in batch], np.int32), prompts,
@@ -1773,6 +2049,12 @@ class Engine:
             extra += (pages[None],)
         if self._spec:
             extra += (self._hist_seed(prompt)[None],)
+        if self._lora:
+            # validated adapter == 0 on the prefix path — the slot's
+            # table entry resets to base and the zero row rides along
+            self._set_slot_adapter(a.slot, a.adapter)
+            extra += (self.adapters,
+                      np.asarray([a.adapter], np.int32))
         self.cache, self.state, first, first_lp, hit_eos, done = fn(
             self._params, self.cache, self.state, self.pool,
             np.asarray([a.slot], np.int32), tails,
@@ -1827,12 +2109,15 @@ class Engine:
                 f"token chunk — use admit_many")
         if self._paged:
             self._alloc_slot_pages(a.slot, n, a.max_tokens)
+        if self._lora:
+            self._set_slot_adapter(a.slot, a.adapter)
         c = self._chunk_size
         ca = ChunkedAdmission(a, prompt, n, -(-n // c))
         tok0 = prompt[:c].astype(np.int32)[None]
+        lx = self._lora_args(a.adapter)
         try:
             self._chunk_scratch = self._chunk0(
-                self._params, self._chunk_scratch, tok0)
+                self._params, self._chunk_scratch, tok0, *lx)
         except Exception:
             # scratch donated into the failing call
             self._poisoned = True
@@ -1863,7 +2148,8 @@ class Engine:
             try:
                 self._chunk_scratch, ca._logits = self._chunk_exts[i](
                     self._params, self._chunk_scratch, tail,
-                    np.asarray([chunk.size - 1], np.int32))
+                    np.asarray([chunk.size - 1], np.int32),
+                    *self._lora_args(a.adapter))
             except Exception:
                 self._poisoned = True
                 self._chunked = None
@@ -1908,6 +2194,24 @@ class Engine:
             int(np.asarray(first)[0]), bool(np.asarray(hit_eos)[0]),
             bool(np.asarray(done)[0]), bucket=c, batch_size=1,
             group=0, logprob=float(np.asarray(first_lp)[0]))
+
+    def _set_slot_adapter(self, slot: int, adapter: int) -> None:
+        """Point ``slot``'s decode-path adapter-id table entry at
+        ``adapter`` (host mirror; the cached device copy invalidates
+        only when a row actually changes — the vocab-mask upload
+        discipline, so single-tenant steady state never re-uploads)."""
+        if self._adapter_ids[slot] == adapter:
+            return
+        self._adapter_ids[slot] = adapter
+        self._aids_dev = None
+
+    def _lora_args(self, adapter: int) -> Tuple[Any, ...]:
+        """The trailing (pool, ids) args of a k=1 forward program
+        (chunked prefill's chunk/extend dispatches) — empty when the
+        pool is disabled."""
+        if not self._lora:
+            return ()
+        return (self.adapters, np.asarray([adapter], np.int32))
 
     def _hist_seed(self, prompt) -> np.ndarray:
         """The drafter-ring admission seed for one prompt: its last
@@ -1987,6 +2291,13 @@ class Engine:
             if self._tables_dev is None:
                 self._tables_dev = jnp.asarray(self._tables)
             step_extra = (self._tables_dev,)
+        if self._lora:
+            # the adapter pool + per-slot id table ride every dispatch
+            # as DATA (ids cached like the masks/tables; the pool is
+            # the engine-owned device buffer registrations update)
+            if self._aids_dev is None:
+                self._aids_dev = jnp.asarray(self._adapter_ids)
+            step_extra += (self.adapters, self._aids_dev)
         valid = None
         if spec:
             (self.cache, self.state, emit, logprobs, finished,
@@ -2118,6 +2429,12 @@ class Engine:
                     np.asarray([self._prefix_pages[page]], np.int32))
         self._masks[:, :] = True
         self._masks_dev = None
+        if self._lora:
+            # the adapter POOL survives (never donated — registered
+            # tenants keep serving); only the per-slot id table resets
+            # with the slots it describes
+            self._adapter_ids[:] = 0
+            self._aids_dev = None
         self._poisoned = False
 
     def warmup(self) -> "Engine":
@@ -2155,6 +2472,10 @@ class Engine:
         wpages = lambda k, span: (
             (np.full((k, -(-span // ecfg.page_size)), SINK, np.int32),)
             if self._paged else ())
+        # lora warm args: every row rides the pinned zero adapter —
+        # shapes are what compile, and id 0 is the base row anyway
+        wlora = lambda k: ((self.adapters, np.zeros((k,), np.int32))
+                           if self._lora else ())
         for (bucket, k), fn in sorted(self._admits.items()):
             # dummy args exercise shapes only: k pad-token prompts of
             # length 1, budget 1 (done at admission), no sampling
@@ -2169,8 +2490,21 @@ class Engine:
                 np.full((k,), _NO_EOS, np.int32),
                 np.zeros((k,), np.int32), np.zeros((k,), bool),
                 np.ones((k, self.cfg.vocab_size), bool),
-                *wpages(k, bucket), *hseed(k))
+                *wpages(k, bucket), *hseed(k), *wlora(k))
             np.asarray(first)
+        if self._lora:
+            # compile the registration write against a zero row — row
+            # 0 is the pinned zero adapter, so the warm write is a
+            # no-op on pool CONTENT and register_adapter() later never
+            # trips an armed recompile guard. Shapes come from THE
+            # shape table registration validates against, so the two
+            # can never compile different programs.
+            zero_row = {
+                site: {part: np.zeros(shape, np.float32)
+                       for part, shape in parts.items()}
+                for site, parts in self._lora_expected_shapes().items()}
+            self.adapters = self._adapter_set(self.adapters, zero_row,
+                                              np.int32(0))
         if self._chunk_size:
             # the chunked-prefill ladder: chunk 0, every extend
             # variant, then the finish — junk tokens, logits flow
@@ -2178,13 +2512,14 @@ class Engine:
             c = self._chunk_size
             self._chunk_scratch = self._chunk0(
                 self._params, self._chunk_scratch,
-                np.full((1, c), ecfg.pad_token_id, np.int32))
+                np.full((1, c), ecfg.pad_token_id, np.int32),
+                *wlora(1))
             lg = None
             for i, fn in sorted(self._chunk_exts.items()):
                 self._chunk_scratch, lg = fn(
                     self._params, self._chunk_scratch,
                     np.full((1, c), ecfg.pad_token_id, np.int32),
-                    np.zeros((1,), np.int32))
+                    np.zeros((1,), np.int32), *wlora(1))
             self.cache, self.state, first, _, _, _ = self._chunk_finish(
                 self._params, self.cache, self.state,
                 self._chunk_scratch, lg,
@@ -2223,7 +2558,7 @@ class Engine:
                 np.full((1,), _NO_EOS, np.int32),
                 np.zeros((1,), np.int32), np.zeros((1,), bool),
                 np.ones((1, self.cfg.vocab_size), bool), np.int32(0),
-                *wpages(1, tb), *hseed(1))
+                *wpages(1, tb), *hseed(1), *wlora(1))
             np.asarray(first)
         # every step variant compiles here — each decode-chunk rung
         # and each (chunk, spec_k) cross — so the scheduler's payoff
@@ -2258,6 +2593,17 @@ class Engine:
             self._prefix_index.clear()
             self._prefix_tokens.clear()
             self._prefix_used = 0
+        if self._lora:
+            # symmetric reset: warmup only ever wrote zeros into the
+            # (all-zero) pool, but a fresh init keeps the adapter
+            # lifecycle identical to the prefix pool's — warmup, then
+            # register on a clean pool, both programs already compiled
+            self.adapters = self._adapter_init(self._params)
+            self._adapter_names.clear()
+            self._adapter_meta.clear()
+            self._adapter_used = 1
+            self._adapter_ids[:] = 0
+            self._aids_dev = None
 
     def _admit_variant_name(self, bucket: int, k: int) -> str:
         return f"admit_p{bucket}_k{k}"
@@ -2275,6 +2621,18 @@ class Engine:
                 items.append((f"pool_pagein_p{pb}", fn))
             for (ps, tb), fn in sorted(self._admit_prefix.items()):
                 items.append((f"admit_prefix_p{ps}_t{tb}", fn))
+        return items
+
+    def _lora_program_items(self):
+        """(name, compiled fn) for the multi-LoRA programs — shared by
+        :meth:`compiled_cache_sizes` and the recompile sentinel, same
+        contract as :meth:`_prefix_program_items`. (``adapter_init``
+        runs at construction, ``adapter_set`` at warmup + every
+        registration — both must stay at one cache entry.)"""
+        items = []
+        if self._lora:
+            items.append(("adapter_init", self._adapter_init))
+            items.append(("adapter_set", self._adapter_set))
         return items
 
     def _chunk_program_items(self):
@@ -2330,7 +2688,8 @@ class Engine:
             if s is not None:
                 admit_sizes.append(s)
         for name, fn in (self._prefix_program_items()
-                         + self._chunk_program_items()):
+                         + self._chunk_program_items()
+                         + self._lora_program_items()):
             s = size_of(fn)
             out[name] = s
             if s is not None and name.startswith("admit_prefix"):
@@ -2372,7 +2731,8 @@ class Engine:
             for (bucket, k), fn in sorted(self._admits.items()):
                 sentinel.track(self._admit_variant_name(bucket, k), fn)
             for name, fn in (self._prefix_program_items()
-                             + self._chunk_program_items()):
+                             + self._chunk_program_items()
+                             + self._lora_program_items()):
                 sentinel.track(name, fn)
             self._sentinel = sentinel
         return self._sentinel

@@ -64,7 +64,16 @@ import collections
 import dataclasses
 import os
 import time
-from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
+from typing import (
+    Any,
+    Callable,
+    Deque,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 from apex_tpu.serving.request import FINISH_ERROR, Completion, Request, \
     StreamEvent
@@ -308,6 +317,7 @@ class Router:
                  config: Optional[FleetConfig] = None,
                  registry=None, recorder=None,
                  bundle_dir: Optional[str] = None,
+                 tenancy=None,
                  clock: Callable[[], float] = time.monotonic):
         scheds = list(schedulers)
         if not scheds:
@@ -351,6 +361,27 @@ class Router:
         self.health = FleetHealth(self)
         self._pending: Deque[_Pending] = collections.deque()
         self._failover_counts: Dict[str, int] = {}
+        #: tenant → last replica index that served it (the affinity
+        #: HINT: a warm-cache tiebreak in routing, never a constraint)
+        self._tenant_affinity: Dict[str, int] = {}
+        #: fleet-level tenant rate limiting (serving.tenancy): a
+        #: tenant's token budget is a FLEET property — per-replica
+        #: buckets would multiply the effective cap by the replica
+        #: count and 429 one replica while others sat full — so rate
+        #: limits belong HERE, at ingress, with ONE bucket per tenant.
+        #: Pass a TenancyConfig with `rates` to the Router and leave
+        #: the replica schedulers' tenancy rate-free (their WFQ
+        #: weights still apply per replica). Failover re-placements
+        #: bypass it the same way the scheduler-level bucket does —
+        #: the original submit already charged the budget.
+        self._tenant_book = None
+        if tenancy is not None:
+            from apex_tpu.serving.tenancy import TenantBook
+
+            self._tenant_book = TenantBook(tenancy, clock)
+        #: fleet-level adapter registrations, replayed onto factory
+        #: replacements so ids mean the same weights fleet-wide
+        self._adapter_registrations: List[Dict[str, Any]] = []
         self._steps = 0
         self._routed = 0
         self._failover_waves = 0
@@ -370,13 +401,20 @@ class Router:
                 and ea.engine_cfg.max_seq_len == eb.engine_cfg.max_seq_len
                 and ea.engine_cfg.decode_chunk
                 == eb.engine_cfg.decode_chunk
-                and ea.engine_cfg.spec_k == eb.engine_cfg.spec_k)
+                and ea.engine_cfg.spec_k == eb.engine_cfg.spec_k
+                and ea.engine_cfg.adapter_slots
+                == eb.engine_cfg.adapter_slots
+                and ea.engine_cfg.adapter_rank
+                == eb.engine_cfg.adapter_rank
+                and ea.engine_cfg.adapter_alpha
+                == eb.engine_cfg.adapter_alpha)
         if not same:
             raise ValueError(
                 "replica engine configs differ (vocab / prompt room / "
-                "seq len / decode_chunk / spec_k) — any replica must "
-                "be able to serve any request, and failover streams "
-                "must be bit-identical across replicas")
+                "seq len / decode_chunk / spec_k / adapter pool) — "
+                "any replica must be able to serve any request, and "
+                "failover streams must be bit-identical across "
+                "replicas")
 
     # -- intake -------------------------------------------------------------
 
@@ -395,6 +433,23 @@ class Router:
                 or any(rid in rep.sched._req_records
                        for rep in self.replicas):
             raise ValueError(f"duplicate request_id {rid!r}")
+        book = self._tenant_book
+        if book is not None:
+            from apex_tpu.serving.tenancy import TenantThrottled
+
+            tenant = request.tenant = book.admit_tenant(
+                request.tenant or "default")
+            wait = book.throttle(tenant, request.max_tokens)
+            if wait is not None:
+                book.stats(tenant).throttled += 1
+                book.stats(tenant).shed += 1
+                if self.recorder is not None:
+                    self.recorder.record("tenant_throttle", rid,
+                                         tenant, wait)
+                raise TenantThrottled(
+                    f"tenant {tenant!r} over its fleet token budget; "
+                    f"retry in ~{wait:.3f}s", tenant=tenant,
+                    retry_after_s=wait)
         self._route(request, None, None, exclude=None, fresh=True)
 
     def can_accept(self, n: int = 1) -> bool:
@@ -417,17 +472,27 @@ class Router:
                  for rep in self.replicas if rep.routable()]
         return min(hints) if hints else 0.0
 
-    def _candidates(self, exclude: Optional[int]) -> List[_Replica]:
+    def _candidates(self, exclude: Optional[int],
+                    tenant: Optional[str] = None) -> List[_Replica]:
         reps = [r for r in self.replicas
                 if r.routable() and r.index != exclude]
         if not reps and exclude is not None:
             # the excluded source is the only replica left standing —
             # better the same replica than an error outcome
             reps = [r for r in self.replicas if r.routable()]
+        # tenant affinity is a HINT, deliberately the weakest key:
+        # among replicas tied on health AND load, prefer the one that
+        # last served this tenant (its adapter gathers / prefix pages
+        # are warm there) — never at the cost of routing onto a
+        # sicker or busier replica, so fairness and failover
+        # determinism are untouched
+        sticky = (self._tenant_affinity.get(tenant)
+                  if tenant is not None else None)
         return sorted(reps, key=lambda r: (
             0 if r.health_state == HEALTH_OK else 1,
             r.sched.overload_hint_s(),
             len(r.sched.queue) + len(r.sched.active),
+            0 if r.index == sticky else 1,
             r.index))
 
     def _route(self, request: Request, tokens: Optional[List[int]],
@@ -436,7 +501,8 @@ class Router:
         """Place one request (fresh submit, or a failover with its
         emitted prefix). Fresh submits raise on fleet saturation;
         failovers return False and stay pending."""
-        candidates = self._candidates(exclude)
+        candidates = self._candidates(exclude,
+                                      getattr(request, "tenant", None))
         if not candidates:
             if all(r.state == REPLICA_FAILED or
                    r.health_state == HEALTH_FAILED
@@ -465,6 +531,9 @@ class Router:
                 continue  # lost a race with a terminal transition
             rep.routed += 1
             self._routed += 1
+            tenant = getattr(request, "tenant", None)
+            if tenant:
+                self._tenant_affinity[tenant] = rep.index
             if self.recorder is not None:
                 self.recorder.record(
                     "route", request.request_id, rep.index,
@@ -776,6 +845,11 @@ class Router:
                              "on_evict owner")
         sched.engine.warmup()   # idempotent; a cold replacement must
         # never recompile mid-rotation under the fleet's armed guards
+        for kw in self._adapter_registrations:
+            # a replacement replica must serve every registered
+            # adapter at the SAME ids as its siblings, or a tenant's
+            # failed-over stream would decode on the wrong weights
+            sched.register_adapter(**kw)
         old = rep.sched
         rep.sched = sched
         sched.on_evict = self._evict_hook(rep)
@@ -849,6 +923,19 @@ class Router:
         but only a fleet-wide registration keeps the admission
         SPEEDUP after a request moves replicas."""
         return [rep.sched.engine.register_prefix(tokens)
+                for rep in self.replicas]
+
+    def register_adapter(self, weights=None, *, name=None,
+                         seed=None) -> List[int]:
+        """Register a LoRA adapter into EVERY replica's pool (after
+        warmup) — registration order is identical across replicas by
+        construction, so a tenant's adapter id means the same weights
+        everywhere and failover streams stay bit-identical. Recorded
+        fleet-side too: a factory replacement replays the sequence."""
+        self._adapter_registrations.append(
+            {"weights": weights, "name": name, "seed": seed})
+        return [rep.sched.register_adapter(weights, name=name,
+                                           seed=seed)
                 for rep in self.replicas]
 
     # -- reporting -----------------------------------------------------------

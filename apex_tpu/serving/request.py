@@ -69,6 +69,15 @@ class Request:
     back, so a client never sees part of a stop sequence (the
     byte-level API front end compiles stop STRINGS down to these).
 
+    ``tenant`` is the request's tenant identity (the API layer fills
+    it from the ``X-Tenant-Id`` header or the OpenAI ``user`` field;
+    ``"default"`` otherwise) — the scheduler's weighted-fair queueing,
+    rate limits, and per-tenant accounting key
+    (:mod:`apex_tpu.serving.tenancy`). ``adapter`` selects the
+    request's LoRA adapter row in the engine's static pool (0 = the
+    pinned base model; ids come from ``Engine.register_adapter``), so
+    many fine-tunes share one compiled engine batch.
+
     ``constraint`` is an optional schema-constrained-decoding DFA (see
     :mod:`apex_tpu.serving.api.constrain` for the JSON implementation)
     the scheduler drives opaquely; it must expose ``reset()`` (called
@@ -89,6 +98,8 @@ class Request:
     arrival_time: Optional[float] = None  # stamped by Scheduler.submit
     stop: Optional[Sequence[Sequence[int]]] = None
     constraint: Optional[Any] = None
+    tenant: str = "default"
+    adapter: int = 0
 
 
 @dataclasses.dataclass

@@ -118,6 +118,12 @@ from apex_tpu.serving.resilience import (
     HealthMonitor,
     ResilienceConfig,
 )
+from apex_tpu.serving.tenancy import (
+    DEFAULT_TENANT,
+    TenancyConfig,
+    TenantBook,
+    TenantThrottled,
+)
 from apex_tpu.serving.tuner import Controller, TunerConfig, ewma
 from apex_tpu.telemetry import flightrec as flightrec_mod
 from apex_tpu.telemetry import spans as spans_mod
@@ -129,7 +135,7 @@ from apex_tpu.telemetry.ring import Ring
 FAULT_CAUSES = ("admit", "dispatch", "fetch", "retire", "invalid_token")
 
 #: shed reasons (label values of ``serving_requests_shed_total``)
-SHED_REASONS = ("queue_full", "deadline")
+SHED_REASONS = ("queue_full", "deadline", "tenant_rate")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -429,6 +435,26 @@ class _RegistryMetrics:
             "EWMA of tokens emitted per speculative wave (the gate "
             "compares it to the measured wall_spec/wall_plain "
             "break-even)")
+        # -- multi-tenant serving (serving.tenancy) -----------------------
+        # tenant-labeled children are created lazily per tenant (the
+        # label set is the live tenant population, not a config-time
+        # ladder) and cached so the per-token path pays a dict get
+        tt = registry.counter(
+            "serving_tenant_tokens_total",
+            "generated tokens streamed, by tenant", labels=("tenant",))
+        ta = registry.counter(
+            "serving_tenant_admissions_total",
+            "requests prefilled into a slot, by tenant",
+            labels=("tenant",))
+        ts = registry.counter(
+            "serving_tenant_sheds_total",
+            "requests shed or rate-throttled, by tenant and reason",
+            labels=("tenant", "reason"))
+        tq = registry.gauge(
+            "serving_tenant_queue_depth",
+            "queued requests, by tenant", labels=("tenant",))
+        self._tenant_families = (tt, ta, ts, tq)
+        self._tenant_children: Dict[str, Dict[str, Any]] = {}
         # -- self-tuning control plane (serving.tuner) --------------------
         # pre-created even without a tuner (explicit zeros in scrapes,
         # the ladder-counter convention); per-knob children are bound
@@ -447,6 +473,21 @@ class _RegistryMetrics:
             "knob", labels=("knob",))
         self.tuner_knob: Dict[str, Any] = {}
         self.tuner_switches: Dict[str, Any] = {}
+
+    def tenant(self, t: str) -> Dict[str, Any]:
+        """Cached per-tenant metric children (created on first
+        sight)."""
+        ch = self._tenant_children.get(t)
+        if ch is None:
+            tt, ta, ts, tq = self._tenant_families
+            ch = self._tenant_children[t] = {
+                "tokens": tt.labels(tenant=t),
+                "admitted": ta.labels(tenant=t),
+                "queue": tq.labels(tenant=t),
+                "shed": {r: ts.labels(tenant=t, reason=r)
+                         for r in SHED_REASONS},
+            }
+        return ch
 
     def bind_tuner(self, knobs) -> None:
         """Pre-create the per-knob children for the declared ladder
@@ -575,6 +616,7 @@ class Scheduler:
                  resilience: Optional[ResilienceConfig] = None,
                  spec_gate: Optional[SpecGateConfig] = None,
                  tuner: Optional[TunerConfig] = None,
+                 tenancy: Optional[TenancyConfig] = None,
                  recorder=None, bundle_dir: Optional[str] = None,
                  bundle_meta: Optional[Dict] = None,
                  max_auto_bundles: int = 4,
@@ -602,6 +644,18 @@ class Scheduler:
         self._cfg_pipeline_depth = pipeline_depth
         self._cfg_max_admit_batch = max_admit_batch
         self.resilience = resilience or ResilienceConfig()
+        #: multi-tenant policy (serving.tenancy): per-tenant
+        #: weighted-fair queueing with deficit counters + priority
+        #: aging (engaged whenever more than one tenant is backlogged
+        #: — a single-tenant queue pops strict FIFO, bit-identical to
+        #: the pre-tenancy scheduler), token-budget rate limits
+        #: (submit raises TenantThrottled → the API's 429 +
+        #: Retry-After), and per-tenant accounting. The book exists
+        #: even without a TenancyConfig so tenant-labeled telemetry
+        #: and summaries always work; rates require a config.
+        self._tenancy_cfg = tenancy
+        self.tenants = TenantBook(tenancy, clock)
+        self._throttled = 0
         #: telemetry sinks (both optional): a telemetry.Registry the
         #: scheduler counts/observes into, and a telemetry.SpanRecorder
         #: receiving per-request phase marks + dispatch sections. The
@@ -824,12 +878,36 @@ class Scheduler:
                 f"dispatches; a {ecfg.decode_chunk}-token chunk would "
                 f"apply a stale mask), got decode_chunk="
                 f"{ecfg.decode_chunk}")
+        if not request.tenant:
+            request.tenant = DEFAULT_TENANT
+        if request.adapter:
+            # validated HERE, not at admission: a bad adapter id that
+            # only surfaced mid-serve would be quarantined as a fault
+            if not self.engine.adapter_pool_enabled:
+                raise ValueError(
+                    f"request carries adapter {request.adapter} but "
+                    f"the engine's adapter pool is disabled "
+                    f"(EngineConfig.adapter_slots == 0)")
+            n_reg = self.engine.adapters_registered
+            if not 1 <= request.adapter <= n_reg:
+                raise ValueError(
+                    f"adapter {request.adapter} outside the "
+                    f"registered ids [1, {n_reg}] (0 is the pinned "
+                    f"base adapter; Engine.register_adapter issues "
+                    f"the rest)")
         now = self.clock()
         request.arrival_time = now
         self._dump_token += 1
         rec = self.recorder
+        book = self.tenants
+        # bounded tenant cardinality: unauthenticated per-request
+        # identities fold into the overflow tenant past max_tenants
+        # (the request is REWRITTEN so every downstream consumer —
+        # WFQ, buckets, metrics, bundle records — sees one identity)
+        tenant = request.tenant = book.admit_tenant(request.tenant)
         if (request.eos_token_id is not None
                 and prompt[-1] == request.eos_token_id):
+            book.stats(tenant).submitted += 1
             if self.telemetry is not None:
                 self.telemetry.submitted.inc()
             self._record_request(request, now)
@@ -849,13 +927,48 @@ class Scheduler:
                            flooded)
             self.health.record_fault("queue_full")
             self._maybe_dump("queue_full")
+            book.stats(tenant).shed += 1
             if self.telemetry is not None:
                 self.telemetry.shed["queue_full"].inc()
+                self.telemetry.tenant(tenant)["shed"][
+                    "queue_full"].inc()
             raise QueueFull(
                 f"queue at capacity ({depth}"
                 f"{', injected flood' if flooded else ''}); retry in "
                 f"~{hint:.3f}s", queue_depth=depth, retry_after_s=hint)
-        if self.engine.prefix_pool_enabled:
+        # per-tenant token-budget rate limit — checked AFTER the
+        # queue-capacity gate so a QueueFull rejection never debits
+        # the bucket (the request served nothing; charging it would
+        # starve a well-behaved tenant through repeated flood
+        # rejections), and SKIPPED for failover hand-offs
+        # (replay_prefix: the original submit already charged this
+        # request's budget — a second charge on re-placement would
+        # double-bill the tenant and could crash the router loop with
+        # an un-routable throttle). Other tenants' streams are
+        # untouched either way (the zero-drift contract); the
+        # rejection carries the bucket refill time as Retry-After.
+        if replay_prefix is None:
+            wait = book.throttle(tenant, request.max_tokens, now)
+            if wait is not None:
+                self._throttled += 1
+                book.stats(tenant).throttled += 1
+                book.stats(tenant).shed += 1
+                if rec is not None:
+                    rec.record("tenant_throttle", request.request_id,
+                               tenant, wait)
+                if self.telemetry is not None:
+                    self.telemetry.shed["tenant_rate"].inc()
+                    self.telemetry.tenant(tenant)["shed"][
+                        "tenant_rate"].inc()
+                raise TenantThrottled(
+                    f"tenant {tenant!r} over its token budget; retry "
+                    f"in ~{wait:.3f}s", tenant=tenant,
+                    retry_after_s=wait)
+        if self.engine.prefix_pool_enabled and not request.adapter:
+            # adapter-carrying requests never match the prefix pool:
+            # pooled prefixes hold BASE-weight K/V, and a hit would
+            # decode against cache bytes a cold adapter prefill would
+            # not produce (the engine rejects the combination too)
             hit = self.engine.match_prefix(prompt)
             if hit is not None:
                 self._prefix_hits[request.request_id] = hit
@@ -890,7 +1003,21 @@ class Scheduler:
             if len(replay_prefix) > len(st.tokens):
                 st.tokens = [int(t) for t in replay_prefix]
                 st.logprobs = list(replay_logprobs or [])
+        # a tenant (re-)entering the backlog competes from "now": its
+        # deficit counter clamps up to the minimum among the tenants
+        # currently holding queued/active work — idle time is not
+        # banked credit (the backlog set is computed BEFORE this
+        # request joins it; submit already walks the queue for the
+        # duplicate-id check, so this adds no new asymptotics)
+        backlogged = {a.request.tenant for a in self.active.values()}
+        backlogged.update(r.tenant for r in self.queue)
+        if tenant not in backlogged:
+            book.rejoin(tenant, min(
+                (book.service_of(t) for t in backlogged),
+                default=book.service_of(tenant)))
         self.queue.append(request)
+        book.stats(tenant).submitted += 1
+        book.note_backlogged(tenant)
         if rec is not None:
             rec.record("submit", request.request_id, len(prompt),
                        request.max_tokens, len(self.queue))
@@ -941,6 +1068,16 @@ class Scheduler:
             self.telemetry.steps.inc()
             self.telemetry.queue_depth.set(len(self.queue))
             self.telemetry.active_slots.set(len(self.active))
+            if len(self.tenants._stats) > 1:
+                # per-tenant depth gauges only once a SECOND tenant
+                # exists — the universal single-tenant case must not
+                # pay an extra O(queue) walk per tick
+                depth: Dict[str, int] = {}
+                for r in self.queue:
+                    depth[r.tenant] = depth.get(r.tenant, 0) + 1
+                for t in self.tenants._stats:
+                    self.telemetry.tenant(t)["queue"].set(
+                        depth.get(t, 0))
             if self.engine.paged:
                 ps = self.engine.page_stats()
                 self.telemetry.pages_in_use.set(ps["pages_in_use"])
@@ -1023,6 +1160,29 @@ class Scheduler:
         terminal health surfaces as :class:`EngineFailed` from
         :meth:`submit` (a 503, not a 429)."""
         return len(self.queue) + n <= self.max_queue
+
+    def register_adapter(self, weights=None, *,
+                         name: Optional[str] = None,
+                         seed: Optional[int] = None) -> int:
+        """Register a LoRA adapter into the engine's pool
+        (:meth:`Engine.register_adapter`) and log the
+        ``adapter_register`` flight-recorder event — the scheduler is
+        the recorder's owner, so registration evidence lands in
+        post-mortem bundles next to the admissions that used it."""
+        aid = self.engine.register_adapter(weights, name=name,
+                                           seed=seed)
+        if self.recorder is not None:
+            meta = self.engine._adapter_meta.get(aid, {})
+            self.recorder.record("adapter_register",
+                                 meta.get("name"), aid,
+                                 meta.get("seed"))
+        return aid
+
+    def tenant_summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-tenant accounting: weight, submitted/admitted/shed/
+        throttled counts, served tokens, and the live WFQ deficit
+        counter (:meth:`apex_tpu.serving.tenancy.TenantBook.summary`)."""
+        return self.tenants.summary()
 
     @property
     def chunk_latency_ewma_s(self) -> float:
@@ -1516,6 +1676,11 @@ class Scheduler:
                 tele.replayed.inc()
             return
         self._tokens_emitted += 1
+        # the WFQ deficit counter charges on ACTUAL served tokens —
+        # fairness settles on delivered service, not admission-time
+        # estimates (replay-suppressed re-derivations were charged
+        # when first streamed, so they are not double-billed)
+        self.tenants.on_tokens(act.request.tenant, 1)
         if latency is not None:
             self._decode_tokens += 1
             self.token_latency_stats.add(latency)
@@ -1523,6 +1688,7 @@ class Scheduler:
                 tele.token_latency.observe(latency)
         if tele is not None:
             tele.tokens.inc()
+            tele.tenant(act.request.tenant)["tokens"].inc()
         self.events.append(StreamEvent(
             act.request.request_id, tok, finished, reason, logprob=lp))
 
@@ -1878,6 +2044,12 @@ class Scheduler:
             "constrained": request.constraint is not None,
             "deadline": request.deadline,
             "arrival": now,
+            # the tenancy pair: replay resubmits with the same tenant
+            # (fair-queue decisions re-derive) and the same adapter
+            # row (seeded registrations rebuild the exact weights, so
+            # the replayed stream is bit-identical)
+            "tenant": request.tenant,
+            "adapter": request.adapter,
         }
         self._submit_seq += 1
 
@@ -2005,6 +2177,17 @@ class Scheduler:
                           if self._tuner is not None else None),
                 "tuner_base": (dict(self._tuner.base)
                                if self._tuner is not None else None),
+                # weights/rates serialize as plain dicts so replay
+                # rebuilds the same WFQ + rate policy
+                "tenancy": (None if self._tenancy_cfg is None else {
+                    "weights": dict(self._tenancy_cfg.weights),
+                    "default_weight":
+                        self._tenancy_cfg.default_weight,
+                    "rates": dict(self._tenancy_cfg.rates),
+                    "default_rate": self._tenancy_cfg.default_rate,
+                    "burst_s": self._tenancy_cfg.burst_s,
+                    "aging_per_s": self._tenancy_cfg.aging_per_s,
+                }),
             },
         }
         files: Dict[str, object] = {
@@ -2062,11 +2245,14 @@ class Scheduler:
                     and self._chunk_ewma > 0.0 and pos >= n_free
                     and now + wave * self._chunk_ewma > r.deadline):
                 self._shed += 1
+                self.tenants.stats(r.tenant).shed += 1
                 if self.recorder is not None:
                     self.recorder.record("shed", r.request_id,
                                          "deadline")
                 if self.telemetry is not None:
                     self.telemetry.shed["deadline"].inc()
+                    self.telemetry.tenant(r.tenant)["shed"][
+                        "deadline"].inc()
                 self._abort(r, FINISH_TIMEOUT, now)
                 continue
             kept.append(r)
@@ -2127,7 +2313,8 @@ class Scheduler:
                 tuple(r.constraint.allowed_tokens())
                 if r.constraint is not None else None),
             prefix_page=None if hit is None else hit[0],
-            prefix_len=0 if hit is None else hit[1])
+            prefix_len=0 if hit is None else hit[1],
+            adapter=r.adapter)
 
     def _request_pages_needed(self, r: Request) -> int:
         """One request's PRIVATE page need — copy-on-write prefix
@@ -2202,8 +2389,10 @@ class Scheduler:
         if rec is not None:
             rec.record("admit", r.request_id, slot, res.bucket,
                        res.batch_size, res.group, 0)
+        self.tenants.stats(r.tenant).admitted += 1
         tele = self.telemetry
         if tele is not None:
+            tele.tenant(r.tenant)["admitted"].inc()
             tele.admitted.inc()
             tele.chunked_admissions.inc()
             tele.admit_dispatches.inc()
@@ -2273,27 +2462,65 @@ class Scheduler:
             self.telemetry.chunked_chunks.inc()
             self.telemetry.queue_depth.set(len(self.queue))
 
+    def _admit_eligible(self, r: Request, now: float) -> bool:
+        """Whether a queued request may admit through the batched path
+        THIS wave: its retry-backoff gate (if any) has opened, and it
+        is not chunked-path-only (chunked-eligible prompts admit
+        through the chunked path — one at a time, `_start_chunked`;
+        batching one here would be exactly the monolithic
+        long-prefill stall chunking exists to remove)."""
+        st = self._replay.get(r.request_id)
+        if st is not None and now < st.not_before:
+            return False
+        return not (self.engine.chunked_for(len(r.prompt))
+                    and r.request_id not in self._prefix_hits)
+
     def _pop_eligible(self, now: float, n: int) -> List[Request]:
-        """Pop up to ``n`` queued requests whose retry-backoff gate
-        (if any) has opened, preserving queue order for the rest —
-        a backing-off request must not block the head of the line."""
+        """Pop up to ``n`` admissible queued requests, preserving
+        queue order for the rest — a backing-off request must not
+        block the head of the line.
+
+        Pop ORDER is tenant-aware weighted-fair queueing
+        (:mod:`apex_tpu.serving.tenancy`): each pick takes the
+        head-of-line request of the backlogged tenant most behind its
+        fair share (lowest served-tokens/weight deficit counter, aged
+        by head-of-line wait so no tenant starves). Within a tenant
+        order stays FIFO; with a single backlogged tenant every pick
+        IS the first eligible request — the historical strict-FIFO
+        scheduler, bit-identically."""
+        book = self.tenants
+        # ONE eligibility scan per wave (the historical single pass),
+        # then n picks off the per-tenant head cursors — deficits do
+        # not move between picks (tokens charge at emission), so
+        # rescanning per pick would buy nothing but O(queue × n)
+        by_tenant: Dict[str, List[Tuple[int, Request]]] = {}
+        for idx, r in enumerate(self.queue):
+            if self._admit_eligible(r, now):
+                by_tenant.setdefault(r.tenant, []).append((idx, r))
+        heads = {t: 0 for t in by_tenant}
         picked: List[Request] = []
-        skipped: List[Request] = []
-        while self.queue and len(picked) < n:
-            r = self.queue.popleft()
-            st = self._replay.get(r.request_id)
-            if st is not None and now < st.not_before:
-                skipped.append(r)
-            elif self.engine.chunked_for(len(r.prompt)) \
-                    and r.request_id not in self._prefix_hits:
-                # chunked-eligible prompts admit through the chunked
-                # path only (one at a time — _start_chunked); batching
-                # one here would be exactly the monolithic long-prefill
-                # stall chunking exists to remove
-                skipped.append(r)
+        picked_idx: List[int] = []
+        while len(picked) < n:
+            live = {t: lst[heads[t]] for t, lst in by_tenant.items()
+                    if heads[t] < len(lst)}
+            if not live:
+                break
+            if len(live) == 1:
+                t = next(iter(live))
             else:
-                picked.append(r)
-        self.queue.extendleft(reversed(skipped))
+                t = book.pick({
+                    tt: max(now - (rr.arrival_time
+                                   if rr.arrival_time is not None
+                                   else now), 0.0)
+                    for tt, (_, rr) in live.items()})
+            idx, r = live[t]
+            heads[t] += 1
+            picked_idx.append(idx)
+            picked.append(r)
+        if picked_idx:
+            drop = set(picked_idx)
+            self.queue = collections.deque(
+                r for i, r in enumerate(self.queue) if i not in drop)
         return picked
 
     def _admit_queued(self, now: float) -> None:
@@ -2406,6 +2633,7 @@ class Scheduler:
                 act.suppress = 0 if st is None else len(st.tokens)
                 act.first_token_time = t_first
                 self.active[slot] = act
+                self.tenants.stats(r.tenant).admitted += 1
                 hit = self._prefix_hits.get(r.request_id)
                 if rec is not None:
                     rec.record("admit", r.request_id, slot, res.bucket,
@@ -2423,6 +2651,7 @@ class Scheduler:
                         tele.page_share_hits.inc()
                 if tele is not None:
                     tele.admitted.inc()
+                    tele.tenant(r.tenant)["admitted"].inc()
                     tele.admit_batch[res.batch_size].inc()
                     tele.bucket[res.bucket].inc()
                 if act.suppress < 1:
@@ -2551,7 +2780,14 @@ class Scheduler:
             "cache_bytes": float(self.engine.cache_bytes()),
             "prefix_hits": float(self._prefix_hit_count),
             "prefix_misses": float(self._prefix_miss_count),
+            # multi-tenant serving: live tenant population + rate-limit
+            # rejections (per-tenant detail via tenant_summary())
+            "tenants_seen": float(len(self.tenants.tenants_seen)),
+            "tenant_throttled": float(self._throttled),
         }
+        if self.engine.adapter_pool_enabled:
+            out["adapters_registered"] = float(
+                self.engine.adapters_registered)
         if self.engine.paged:
             # paged-cache capacity: occupancy, CoW sharing, chunked
             # admissions, and backpressure waits this run

@@ -85,6 +85,9 @@ EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
     "guard_alarm": ("alarms_total",),
     "health": ("from", "to", "cause"),
     "failed": ("cause",),
+    # -- multi-tenant serving (serving.tenancy) ------------------------------
+    "tenant_throttle": ("request_id", "tenant", "retry_after_s"),
+    "adapter_register": ("name", "adapter", "seed"),
     # -- outcomes ----------------------------------------------------------
     "finish": ("request_id", "reason", "n_tokens"),
     "bundle": ("cause", "path"),

@@ -253,6 +253,26 @@ def replay_bundle(path: str, *, no_faults: bool = False,
     engine.warmup()
     for template in eng_d.get("prefix_templates", []):
         engine.register_prefix(template)
+    # re-register adapters in the RECORDED order so ids line up with
+    # the request rows; seeded registrations regenerate the exact
+    # weights (gpt.init_lora_weights is deterministic in the seed) —
+    # explicit-weight ones (seed null) cannot be rebuilt, so requests
+    # that used them are skipped like constrained ones below
+    unreplayable_adapters = set()
+    for ad in eng_d.get("adapters", []):
+        if ad.get("seed") is None:
+            # placeholder zero row under the recorded name: keeps the
+            # SEQUENTIAL ids of later seeded registrations aligned
+            # with the request rows
+            unreplayable_adapters.add(int(ad["id"]))
+            zero = {site: {part: np.zeros_like(arr)
+                           for part, arr in parts.items()}
+                    for site, parts in gpt.init_lora_weights(
+                        cfg, ecfg.adapter_rank, 0).items()}
+            engine.register_adapter(zero, name=ad.get("name"))
+        else:
+            engine.register_adapter(name=ad.get("name"),
+                                    seed=int(ad["seed"]))
     gate_d = sched_d.get("spec_gate")
     tuner_d = sched_d.get("tuner")
     tuner = None
@@ -264,6 +284,21 @@ def replay_bundle(path: str, *, no_faults: bool = False,
             k: (tuple(v) if isinstance(v, list) else v)
             for k, v in tuner_d.items()})
     tunes_spec = tuner is not None and tuner.spec_k is not None
+    tenancy = None
+    ten_d = sched_d.get("tenancy")
+    if ten_d:
+        from apex_tpu.serving.tenancy import TenancyConfig
+
+        # same WFQ weights + aging; RATES are dropped — replay
+        # resubmits the whole recorded trace as fast as the queue
+        # drains, and re-arming the buckets would throttle requests
+        # the live run admitted (replay compares streams per request,
+        # which are rate-independent)
+        tenancy = TenancyConfig(
+            weights=ten_d.get("weights") or {},
+            default_weight=ten_d.get("default_weight", 1.0),
+            burst_s=ten_d.get("burst_s", 2.0),
+            aging_per_s=ten_d.get("aging_per_s", 1.0))
     sched = Scheduler(
         engine,
         max_queue=sched_d.get("max_queue", 256),
@@ -271,6 +306,7 @@ def replay_bundle(path: str, *, no_faults: bool = False,
         max_admit_batch=sched_d.get("max_admit_batch"),
         resilience=ResilienceConfig(**sched_d["resilience"]),
         tuner=tuner,
+        tenancy=tenancy,
         spec_gate=(SpecGateConfig(**gate_d)
                    if gate_d and ecfg.spec_k > 0 and not tunes_spec
                    else None))
@@ -285,6 +321,11 @@ def replay_bundle(path: str, *, no_faults: bool = False,
             skipped.append({"request_id": row["request_id"],
                             "why": "constrained (DFA not serialisable)"})
             continue
+        if row.get("adapter", 0) in unreplayable_adapters:
+            skipped.append({"request_id": row["request_id"],
+                            "why": "adapter registered from explicit "
+                            "weights (no seed to rebuild from)"})
+            continue
         req = Request(
             row["request_id"], list(row["prompt"]),
             max_tokens=row["max_tokens"],
@@ -294,7 +335,9 @@ def replay_bundle(path: str, *, no_faults: bool = False,
                 top_p=row.get("top_p", 1.0),
                 seed=row.get("seed")),
             eos_token_id=row.get("eos_token_id"),
-            stop=row.get("stop"))
+            stop=row.get("stop"),
+            tenant=row.get("tenant") or "default",
+            adapter=int(row.get("adapter", 0)))
         while True:
             try:
                 sched.submit(req)

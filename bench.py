@@ -37,8 +37,8 @@ BASELINE_TOKENS_PER_SEC = 58600.0
 
 #: stable trajectory keys for the BENCH_serve.json series (bumped per
 #: PR so the per-line provenance is plottable without git archaeology)
-BENCH_PR = 14
-BENCH_LABEL = "self-tuning-runtime"
+BENCH_PR = 15
+BENCH_LABEL = "multi-tenant"
 
 
 def _append_traj(*rows):
@@ -428,7 +428,12 @@ def serve(telemetry_out=None, api=False):
     control plane vs every fixed (chunk, depth) corner on a SHIFTING
     burst trace — decode-heavy phase, then a short-request admission
     flood — reported as the paired-median ratio vs the best fixed
-    corner). A/B ratios are PAIRED per interleaved
+    corner), and a multi-tenant A/B (adapter-pool overhead on base
+    traffic, plus a contended three-tenant trace at skewed weights
+    with two registered LoRA adapters: mid-flood weighted fairness
+    ratio, WFQ-vs-FIFO token-drift assert, and a rate-limited-tenant
+    rerun whose 429s leave other tenants' streams bit-identical).
+    A/B ratios are PAIRED per interleaved
     round with the median reported (independent per-side best-of-N
     let host drift land asymmetrically — the PR-10 flightrec line's
     1.334 lesson), and a sweep-WIDE token-drift assert pins every
@@ -1239,6 +1244,143 @@ def serve(telemetry_out=None, api=False):
     eng_c2.close()
     eng_c8.close()
 
+    # -- multi-tenant serving A/B (tenancy + batched multi-LoRA) ---------
+    # (a) adapter-pool overhead: the SAME standard burst on an engine
+    # whose every dense seam carries the gather+rank-r delta, all rows
+    # riding the pinned zero adapter — paired per-round ratio vs the
+    # plain chunk=8 engine, and the zero-adapter streams join the
+    # sweep-wide drift assert (base traffic must be bit-identical);
+    # (b) a contended multi-tenant trace — three tenants at skewed
+    # weights, two of them on registered LoRA adapters — measured
+    # MID-FLOOD for the weighted fairness ratio (min/max per-tenant
+    # tokens/weight; 1.0 = perfect WFQ convergence), with a
+    # weighted-vs-unweighted rerun drift assert (scheduling order must
+    # never change a stream's tokens) and a rate-limit shed count from
+    # a throttled-tenant rerun.
+    from apex_tpu.serving.tenancy import TenancyConfig, TenantThrottled
+
+    eng_mt = Engine(cfg, params, mesh, dataclasses.replace(
+        ecfg, decode_chunk=8, adapter_slots=3, adapter_rank=4,
+        adapter_alpha=8.0))
+    eng_mt.warmup()
+    eng_mt.register_adapter(seed=71)
+    eng_mt.register_adapter(seed=72)
+    ovr = []
+    for rnd in range(reps):
+        tps = {}
+        for name, eng_, kw in _ab_order(rnd, (
+                ("chunk8", engine, dict(pipeline_depth=2)),
+                ("tenant_base", eng_mt, dict(pipeline_depth=2)))):
+            toks, s = run(eng_, trace(100, n_requests), **kw)
+            tokens_by_cfg.setdefault(name, toks)
+            assert tokens_by_cfg[name] == toks, f"{name} rerun drift"
+            tps[name] = s["tokens_per_sec"]
+        ovr.append(tps["tenant_base"] / max(tps["chunk8"], 1e-9))
+
+    def tenant_trace(seed0, mult=12):
+        # staggered budgets: uniform ones make all slots release in
+        # lockstep, so service moves in whole-tenant quanta and the
+        # fairness window reads noise — varied budgets stagger the
+        # releases and WFQ picks happen per slot
+        reqs = []
+        lanes = (("ta", 1), ("tb", 2), ("tc", 0))
+        for i in range(mult * n_requests):
+            t, adapter = lanes[i % 3]
+            p_len = 1 + (7 * i + 3) % ecfg.max_prompt_len
+            prompt = [int(x) for x in jax.random.randint(
+                jax.random.PRNGKey(seed0 + i), (p_len,), 0,
+                cfg.vocab_size)]
+            sp = (SamplingParams(temperature=0.9, top_k=40, seed=i)
+                  if i % 2 else SamplingParams())
+            reqs.append(Request(f"{t}-{i}", prompt,
+                                max_tokens=2 + (5 * i) % max_tokens,
+                                sampling=sp, tenant=t,
+                                adapter=adapter))
+        return reqs
+
+    def run_tenants(tenancy, depth=2, admit_cap=None):
+        sched = Scheduler(eng_mt, tenancy=tenancy,
+                          pipeline_depth=depth,
+                          max_admit_batch=admit_cap,
+                          max_queue=16 * 3 * n_requests)
+        reqs = tenant_trace(700)
+        for r in reqs:
+            sched.submit(r)
+        # steady-state fairness window: per-tenant served-token DELTAS
+        # over the [1/4, 1/2] completion window, normalized by weight
+        # — the start cut drops the round-robin first wave (deficits
+        # start equal), the end cut keeps every tenant backlogged (the
+        # favoured tenant drains its backlog first, and a later window
+        # would read its empty-queue tail as unfairness)
+        snap = {}
+        total = len(reqs)
+        marks = (total // 4, total // 2)
+        while len(sched.completions) < total:
+            sched.step()
+            done = len(sched.completions)
+            for mark in marks:
+                if mark not in snap and done >= mark:
+                    snap[mark] = {t: row["tokens"] for t, row in
+                                  sched.tenant_summary().items()}
+        sched.run_until_idle()
+        mid = None
+        if len(snap) == 2:
+            s1, s2 = (snap[m] for m in marks)
+            book = sched.tenants
+            mid = {t: (s2[t] - s1.get(t, 0.0)) / book.weight(t)
+                   for t in s2}
+        return ({rid: c.tokens for rid, c in
+                 sched.completions.items()}, mid, sched.summary())
+
+    weights = {"ta": 3.0, "tb": 2.0, "tc": 1.0}
+    # the fairness side runs the SERIAL loop with one admission per
+    # tick: WFQ picks then see deficits fresh to the last fetched
+    # chunk (a deep pipeline's stale-by-a-wave deficits blur the
+    # shares at smoke scale); streams are depth/batch-invariant, so
+    # the drift assert against the pipelined unweighted run still
+    # pins WFQ-order token invariance
+    toks_w, mid_w, sum_w = run_tenants(
+        TenancyConfig(weights=weights, aging_per_s=0.1), depth=1,
+        admit_cap=1)
+    toks_u, _, _ = run_tenants(None)
+    assert toks_w == toks_u, \
+        "tenant A/B token drift (WFQ order changed a stream)"
+    fairness = (min(mid_w.values()) / max(max(mid_w.values()), 1e-9)
+                if mid_w else 0.0)
+    # rate-limited rerun: tenant tc capped hard — its overflow 429s
+    # while ta/tb streams stay bit-identical to the uncapped run
+    sched_rl = Scheduler(
+        eng_mt, pipeline_depth=2, max_queue=16 * 3 * n_requests,
+        tenancy=TenancyConfig(weights=weights,
+                              rates={"tc": float(max_tokens)},
+                              burst_s=1.0))
+    throttled = 0
+    for r in tenant_trace(700):
+        try:
+            sched_rl.submit(r)
+        except TenantThrottled:
+            throttled += 1
+    sched_rl.run_until_idle()
+    for rid, c in sched_rl.completions.items():
+        if not rid.startswith("tc"):
+            assert c.tokens == toks_w[rid], \
+                f"throttled-tenant run changed {rid}'s stream"
+    assert throttled > 0, "rate-limit rerun never throttled"
+    tenant_ab = {
+        "tenants": len(weights),
+        "weights": weights,
+        "adapters": int(eng_mt.adapters_registered),
+        "adapter_overhead_ratio": round(_median(ovr), 3),
+        "fairness_min_max_ratio": round(fairness, 3),
+        "midpoint_tokens_per_weight": {
+            t: round(v, 1) for t, v in sorted(mid_w.items())},
+        "throttled_429s": throttled,
+        "tenant_throttled_metric": sched_rl.summary().get(
+            "tenant_throttled", 0.0),
+        "token_drift": 0,
+    }
+    eng_mt.close()
+
     # the loop/admission knobs must not change a single emitted token —
     # sweep-wide: every chunk setting, serial vs pipelined, flat vs
     # bucketed/batched admission, spec on vs off (the int8 side is
@@ -1290,6 +1432,7 @@ def serve(telemetry_out=None, api=False):
         "spec_ab": spec_ab,
         "flightrec_ab": flightrec_ab,
         "tuner_ab": tuner_ab,
+        "tenant_ab": tenant_ab,
     }
     if not on_tpu:
         line["probe_ab_1l32h"] = line_probe
@@ -1335,6 +1478,11 @@ def serve(telemetry_out=None, api=False):
         # self-tuning: autotuned vs the best fixed corner on the
         # shifting burst trace (paired per-round median)
         "tuner_ab": tuner_ab["ratio_vs_best_fixed"],
+        # multi-tenant serving: adapter-pool overhead on base traffic
+        # (paired median, 1.0 = free) and mid-flood weighted fairness
+        # (min/max per-tenant tokens/weight, 1.0 = perfect WFQ)
+        "adapter_overhead_ratio": tenant_ab["adapter_overhead_ratio"],
+        "tenant_fairness": tenant_ab["fairness_min_max_ratio"],
     }
     line["bench_out"] = _append_traj(traj)
     print(json.dumps(line))

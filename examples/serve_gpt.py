@@ -149,7 +149,8 @@ def load_requests(path, vocab_size):
 
 
 def synthetic_requests(n, prompt_len, max_tokens, vocab_size,
-                       prefix=None, long_prompt_len=0):
+                       prefix=None, long_prompt_len=0, tenants=None,
+                       adapters=0):
     """Seeded stand-in trace: half greedy, half sampled; every third
     request carries a stop sequence (trimmed emission when it fires).
     With ``prefix`` (a pooled template's token list), every other
@@ -158,9 +159,16 @@ def synthetic_requests(n, prompt_len, max_tokens, vocab_size,
     every fourth request (offset 1, so it never collides with a
     prefix row) carries a prompt of that length — the long-admission
     traffic chunked prefill (``--prefill-chunk``) interleaves with
-    decode waves instead of stalling everyone's TTFT on."""
+    decode waves instead of stalling everyone's TTFT on. ``tenants``
+    (a list of tenant ids) and ``adapters`` (registered LoRA adapter
+    count) spread the trace round-robin across tenant identities and
+    adapter rows — the many-fine-tunes-one-engine workload the
+    tenancy subsystem exists for (adapter-carrying rows skip the
+    shared prefix: pooled prefixes are base-weight K/V)."""
     reqs = []
     for i in range(n):
+        adapter = (i % (adapters + 1)) if adapters else 0
+        tenant = tenants[i % len(tenants)] if tenants else "default"
         if long_prompt_len and i % 4 == 1:
             tail = [int(t) for t in jax.random.randint(
                 jax.random.PRNGKey(2000 + i), (long_prompt_len,), 0,
@@ -169,14 +177,15 @@ def synthetic_requests(n, prompt_len, max_tokens, vocab_size,
             tail = [int(t) for t in jax.random.randint(
                 jax.random.PRNGKey(1000 + i),
                 (1 + (prompt_len + i) % prompt_len,), 0, vocab_size)]
-        prompt = (list(prefix) + tail[:2]) if prefix and i % 2 == 0 \
-            else tail
+        prompt = (list(prefix) + tail[:2]) \
+            if prefix and i % 2 == 0 and not adapter else tail
         sp = (SamplingParams(temperature=0.9, top_k=20, seed=i)
               if i % 2 else SamplingParams())
         stop = [[(17 * i + 3) % vocab_size,
                  (17 * i + 4) % vocab_size]] if i % 3 == 0 else None
         reqs.append(Request(f"r{i}", prompt, max_tokens=max_tokens,
-                            sampling=sp, stop=stop))
+                            sampling=sp, stop=stop, tenant=tenant,
+                            adapter=adapter))
     return reqs
 
 
@@ -299,7 +308,48 @@ def main():
                     "admission). The synthetic trace gains a "
                     "long-prompt line (every 4th request) to "
                     "exercise it")
+    ap.add_argument("--adapters", type=int, default=0,
+                    help="register this many seeded LoRA adapters "
+                    "into the engine's static pool "
+                    "(EngineConfig.adapter_slots) and spread the "
+                    "synthetic trace round-robin across them + the "
+                    "base model — many fine-tunes, one compiled "
+                    "batch, zero recompiles")
+    ap.add_argument("--tenant-weights", metavar="SPEC", default=None,
+                    help="tenant fair-share weights, e.g. 'a:3,b:1' — "
+                    "the scheduler's weighted-fair queueing converges "
+                    "per-tenant served-token shares to this ratio "
+                    "under contention; the synthetic trace spreads "
+                    "requests round-robin over the named tenants")
+    ap.add_argument("--tenant-rate", metavar="SPEC", default=None,
+                    help="per-tenant token budgets (tokens/s), e.g. "
+                    "'a:50': a submit over budget is rejected with a "
+                    "retry-after (the API maps it to 429) while other "
+                    "tenants are untouched")
     args = ap.parse_args()
+
+    def parse_tenant_spec(spec):
+        out = {}
+        for part in spec.split(","):
+            name, _, val = part.partition(":")
+            if not name.strip() or not val:
+                raise SystemExit(
+                    f"bad tenant spec {part!r} (format name:value,...)")
+            out[name.strip()] = float(val)
+        return out
+
+    tenancy_cfg = None
+    tenant_names = None
+    if args.tenant_weights or args.tenant_rate:
+        from apex_tpu.serving.tenancy import TenancyConfig
+
+        weights = parse_tenant_spec(args.tenant_weights or "") \
+            if args.tenant_weights else {}
+        rates = parse_tenant_spec(args.tenant_rate or "") \
+            if args.tenant_rate else {}
+        tenancy_cfg = TenancyConfig(weights=weights, rates=rates)
+        tenant_names = sorted(set(weights) | set(rates)) or None
+        print(f"tenancy: weights={weights} rates={rates}")
 
     cfg = gpt.GPTConfig(vocab_size=1024, hidden_size=128, num_layers=4,
                         num_heads=4, seq_len=128, remat=False,
@@ -379,7 +429,8 @@ def main():
         prefix_pool_slots=len(templates), spec_k=args.spec_k,
         page_size=args.page_size, num_pages=args.max_pages,
         prefill_chunk=args.prefill_chunk,
-        decode_chunks=decode_chunks, spec_ks=spec_ks)
+        decode_chunks=decode_chunks, spec_ks=spec_ks,
+        adapter_slots=args.adapters + 1 if args.adapters else 0)
 
     def replica_plan(i):
         if kill_plan is not None:
@@ -408,7 +459,9 @@ def main():
                                     cfg.vocab_size,
                                     prefix=templates[0] if templates
                                     else None,
-                                    long_prompt_len=long_len))
+                                    long_prompt_len=long_len,
+                                    tenants=tenant_names,
+                                    adapters=args.adapters))
 
     # telemetry: spans whenever a trace is requested; the registry +
     # process-wide recompile sentinel only when there is a /metrics
@@ -443,29 +496,50 @@ def main():
         # per-replica-labeled serving_fleet_* surface instead; the
         # shared recorder gives ONE merged incident timeline. The
         # kill drill needs retry headroom (see FleetFaultPlan.kill).
+        # fleet tenancy split: WFQ weights apply per replica, RATE
+        # limits apply at the router's ingress (one fleet-wide bucket
+        # per tenant — per-replica buckets would multiply the cap by
+        # the replica count)
+        rep_tenancy = fleet_tenancy = None
+        if tenancy_cfg is not None:
+            from apex_tpu.serving.tenancy import TenancyConfig
+
+            if dict(tenancy_cfg.weights):
+                rep_tenancy = TenancyConfig(
+                    weights=tenancy_cfg.weights)
+            if dict(tenancy_cfg.rates):
+                fleet_tenancy = TenancyConfig(rates=tenancy_cfg.rates)
         replica_scheds = [
             Scheduler(e, max_queue=max(256, len(reqs)), spans=spans,
                       pipeline_depth=args.pipeline_depth,
                       recorder=recorder, bundle_dir=args.bundle_dir,
                       bundle_meta=bundle_meta, tuner=tuner_cfg,
+                      tenancy=rep_tenancy,
                       resilience=ResilienceConfig(max_retries=8))
             for e in engines]
         sched = Router(replica_scheds, registry=registry,
-                       recorder=recorder, bundle_dir=args.bundle_dir)
+                       recorder=recorder, bundle_dir=args.bundle_dir,
+                       tenancy=fleet_tenancy)
         for t in templates:  # every replica serves the hit
             sched.register_prefix(t)
+        for i in range(args.adapters):
+            # fleet-wide: same ids mean the same weights on every
+            # replica, so failover streams stay bit-identical
+            sched.register_adapter(seed=100 + i)
         bundle_sched = replica_scheds[0]   # SIGUSR1 / /debug/bundle
     else:
         sched = Scheduler(engine, max_queue=max(256, len(reqs)),
                           registry=registry, spans=spans,
                           pipeline_depth=args.pipeline_depth,
                           recorder=recorder, bundle_dir=args.bundle_dir,
-                          tuner=tuner_cfg,
+                          tuner=tuner_cfg, tenancy=tenancy_cfg,
                           # params provenance: telemetry.replay rebuilds
                           # the model from a bundle with this
                           bundle_meta=bundle_meta)
         for t in templates:  # after warmup (which resets the pool)
             engine.register_prefix(t)
+        for i in range(args.adapters):
+            sched.register_adapter(seed=100 + i)
         bundle_sched = sched
     if args.bundle_dir is not None:
         import signal
@@ -497,15 +571,31 @@ def main():
                 if args.bundle_dir is not None else None))
         print(f"metrics: {server.url}/metrics  /healthz  /vars  "
               f"/debug/events")
+    from apex_tpu.serving.tenancy import TenantThrottled
+
+    throttled = []
     for r in reqs:
-        sched.submit(r)
+        try:
+            sched.submit(r)
+        except TenantThrottled as e:
+            # the offline-demo spelling of the API's 429: report and
+            # move on — other tenants' requests are untouched
+            throttled.append(r.request_id)
+            print(f"request {r.request_id} throttled "
+                  f"(tenant {e.tenant!r}, retry in "
+                  f"{e.retry_after_s:.1f}s)")
     sched.run_until_idle()
     for r in reqs:
+        if r.request_id in throttled:
+            continue
         c = sched.completions[r.request_id]
         print(f"request {c.request_id} [{c.finish_reason}] "
               f"{list(r.prompt)} -> {c.tokens}")
     print("served " + json.dumps(
         {k: round(v, 3) for k, v in sched.summary().items()}))
+    if (tenancy_cfg is not None or args.adapters) \
+            and args.replicas == 1:
+        print("tenants " + json.dumps(sched.tenant_summary()))
     if tuner_cfg is not None and args.replicas == 1:
         s = sched.summary()
         point = {name: int(s[f"tuner_{name}"])

@@ -369,6 +369,7 @@ def test_register_prefix_failure_resets_pool(devices8):
     assert 0 <= res.first_token < VOCAB
 
 
+@pytest.mark.slow  # the hit==cold BIT-parity oracle stays tier-1 per dtype; this two-engine scheduler/telemetry composition is long-suite (multi-tenant tier-1 offset)
 def test_scheduler_prefix_detection_and_oracle(devices8):
     """End-to-end through the scheduler: hits are detected at submit
     (hash-keyed, transparent to callers), counted in telemetry and
@@ -387,7 +388,7 @@ def test_scheduler_prefix_detection_and_oracle(devices8):
     clone = lambda: [Request(r.request_id, r.prompt, r.max_tokens,
                              sampling=r.sampling) for r in reqs]
     registry = Registry()
-    eng = Engine(cfg, params, mesh, ecfg).warmup()  # apex: noqa[TIER1-COST]: tiny engine; scheduler prefix detection oracle
+    eng = Engine(cfg, params, mesh, ecfg).warmup()
     eng.register_prefix(template)
     sched = _run_trace(eng, clone(), registry=registry,
                        pipeline_depth=2)
@@ -402,7 +403,7 @@ def test_scheduler_prefix_detection_and_oracle(devices8):
         eng.cache_bytes()
     cold = _run_trace(
         Engine(cfg, params, mesh, dataclasses.replace(
-            ecfg, prefix_pool_slots=0)).warmup(), clone(),  # apex: noqa[TIER1-COST]: cold-engine twin for the detection oracle; same tiny shape
+            ecfg, prefix_pool_slots=0)).warmup(), clone(),
         pipeline_depth=2)
     assert {rid: c.tokens for rid, c in sched.completions.items()} == \
         {rid: c.tokens for rid, c in cold.completions.items()}

@@ -1,0 +1,647 @@
+"""Multi-tenant serving: batched per-slot LoRA adapters + tenant-aware
+fair scheduling.
+
+The contracts under test (``gpt`` multi-LoRA threading + the engine's
+``adapter_slots`` pool + ``serving.tenancy``'s WFQ/rate-limit book):
+
+- the PINNED zero adapter is numerically exact — base (adapter 0)
+  traffic on an adapter-pool engine is token-identical to solo
+  ``gpt.generate`` (which the pre-tenancy engine is itself pinned to);
+- an adapter-carrying stream matches a solo merged-weight forward
+  (``W + B A · alpha/r``) within per-dtype tolerance;
+- a mixed-tenant batch equals per-tenant solo runs token-for-token —
+  adapter ids are a per-row gather, rows never see batch-mates;
+- parity composes: tp2-vs-tp1, paged + int8-KV + speculative decoding,
+  and fault replay all hold with a heterogeneous adapter table, and
+  the recompile guard stays flat across adapter registration and
+  mixed-tenant admission churn (ids and pool content are DATA);
+- weighted-fair queueing converges served-token shares to the weight
+  ratio under a flood, priority aging rescues a starved tenant, and a
+  rate-limited tenant 429s with Retry-After while other tenants'
+  streams stay bit-identical to an uncontended run.
+"""
+
+import dataclasses
+import http.client
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import mesh as mx
+from apex_tpu.models import gpt
+from apex_tpu.serving import Request, SamplingParams
+from apex_tpu.serving.engine import Admission, Engine, EngineConfig
+from apex_tpu.serving.resilience import FaultPlan, FaultSpec
+from apex_tpu.serving.scheduler import Scheduler
+from apex_tpu.serving.tenancy import (
+    DEFAULT_TENANT,
+    TenancyConfig,
+    TenantBook,
+    TenantThrottled,
+)
+from apex_tpu.transformer.testing import standalone_gpt_config
+
+VOCAB = 96
+RANK, ALPHA = 4, 8.0
+
+
+def _cfg(**overrides):
+    base = dict(vocab_size=VOCAB, seq_len=96)
+    base.update(overrides)
+    return standalone_gpt_config(**base)
+
+
+def _mk_engine(cfg, params, mesh, *, fault_plan=None, **over):
+    base = dict(slots=3, max_prompt_len=10, max_seq_len=24,
+                decode_chunk=2, adapter_slots=4, adapter_rank=RANK,
+                adapter_alpha=ALPHA)
+    base.update(over)
+    return Engine(cfg, params, mesh, EngineConfig(**base),
+                  fault_plan=fault_plan).warmup()  # apex: noqa[TIER1-COST]: shared tiny adapter-engine builder — one def-line suppression covers the tenancy suite (the test_fleet _mk_sched shape)
+
+
+def _solo_generate(cfg, params, mesh, prompt, n_new, sp: SamplingParams):
+    pspecs = gpt.param_specs(cfg)
+    key = (jax.random.PRNGKey(sp.seed)
+           if sp.temperature > 0 and sp.seed is not None else None)
+    out = jax.jit(jax.shard_map(
+        lambda p, t: gpt.generate(
+            cfg, p, t, n_new, temperature=sp.temperature,
+            top_k=sp.top_k, top_p=sp.top_p, key=key, pad_token_id=0),
+        mesh=mesh, in_specs=(pspecs, P(None, None)),
+        out_specs=P(None, None), check_vma=False))(
+            params, jnp.asarray([prompt], jnp.int32))
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def _requests(n, max_prompt_len, *, adapters=(0,), tenants=("default",),
+              max_tokens=8, seed0=500):
+    reqs = []
+    for i in range(n):
+        p_len = 1 + (7 * i + 3) % max_prompt_len
+        prompt = [int(t) for t in jax.random.randint(
+            jax.random.PRNGKey(seed0 + i), (p_len,), 0, VOCAB)]
+        sp = (SamplingParams(temperature=0.9, top_k=7, seed=17 + i)
+              if i % 3 == 1 else SamplingParams())
+        reqs.append(Request(
+            f"r{i}", prompt, max_tokens=max_tokens, sampling=sp,
+            adapter=adapters[i % len(adapters)],
+            tenant=tenants[i % len(tenants)]))
+    return reqs
+
+
+def _clone(reqs):
+    return [dataclasses.replace(r, arrival_time=None) for r in reqs]
+
+
+def _run(engine, reqs, **kw):
+    sched = Scheduler(engine, **kw)
+    for r in reqs:
+        sched.submit(r)
+    sched.run_until_idle()
+    return sched
+
+
+@pytest.fixture(scope="module")
+def env(devices8):
+    """One warmed adapter-pool engine + two seeded adapters, shared by
+    the suite (each test rebuilds the slots it dirtied)."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    eng = _mk_engine(cfg, params, mesh)
+    a1 = eng.register_adapter(seed=7)
+    a2 = eng.register_adapter(seed=9)
+    ns = dataclasses.make_dataclass(
+        "Env", ["cfg", "params", "mesh", "eng", "a1", "a2"])(
+        cfg, params, mesh, eng, a1, a2)
+    yield ns
+    eng.close()
+
+
+# --- adapter numerics oracles ------------------------------------------------
+
+
+def test_zero_adapter_matches_solo_generate(env):
+    """Base traffic on an adapter-pool engine is token-identical to
+    solo ``gpt.generate`` — the pinned all-zero row 0 contributes an
+    exact-zero delta, so the pool's presence costs nothing numerically
+    (and the pre-tenancy engine is itself pinned to solo generate, so
+    this is transitively the zero-adapter == pre-PR-base contract)."""
+    env.eng.rebuild_slots()
+    reqs = _requests(4, 10)
+    sched = _run(env.eng, reqs)
+    for r in reqs:
+        solo = _solo_generate(env.cfg, env.params, env.mesh,
+                              list(r.prompt), r.max_tokens, r.sampling)
+        assert sched.completions[r.request_id].tokens == solo, \
+            r.request_id
+
+
+def test_adapter_stream_matches_merged_weights(env):
+    """The merged-weight oracle: adapter-1 streams equal solo generate
+    over ``merge_lora(params, W1, alpha)`` — token-for-token, with
+    per-token logprobs inside the fp32 tolerance band (the adapter
+    path computes the delta separately; the merge folds it into the
+    kernels)."""
+    env.eng.rebuild_slots()
+    merged = gpt.merge_lora(env.cfg, env.params,
+                            gpt.init_lora_weights(env.cfg, RANK, 7),
+                            ALPHA)
+    reqs = _requests(3, 10, adapters=(env.a1,))
+    sched = _run(env.eng, reqs)
+    for r in reqs:
+        comp = sched.completions[r.request_id]
+        solo = _solo_generate(env.cfg, merged, env.mesh,
+                              list(r.prompt), r.max_tokens, r.sampling)
+        assert comp.tokens == solo, (
+            f"{r.request_id}: adapter {comp.tokens} != merged {solo}")
+    # a registered adapter actually moves the stream (nonzero delta):
+    # the same trace on the base adapter must diverge somewhere
+    env.eng.rebuild_slots()
+    base = _run(env.eng, _requests(3, 10))
+    assert any(base.completions[r.request_id].tokens
+               != sched.completions[r.request_id].tokens
+               for r in reqs), "adapter delta never moved a token"
+
+
+def test_mixed_adapter_batch_matches_solo_runs(env):
+    """A heterogeneous adapter batch [base, a1, a2] emits exactly what
+    each request emits riding the batch alone — the id table is a
+    per-row gather; rows never see their batch-mates' weights."""
+    reqs = _requests(3, 10, adapters=(0, env.a1, env.a2))
+    env.eng.rebuild_slots()
+    mixed = _run(env.eng, _clone(reqs))
+    for i, r in enumerate(reqs):
+        env.eng.rebuild_slots()
+        solo = _run(env.eng, _clone([reqs[i]]))
+        assert (mixed.completions[r.request_id].tokens
+                == solo.completions[r.request_id].tokens), r.request_id
+
+
+def test_tp2_matches_tp1_heterogeneous_adapters(devices8):
+    """tp=2 sharding with a heterogeneous adapter table emits the tp=1
+    streams: column-parallel sites shard B's output dim, row-parallel
+    sites shard A's input dim with the rank-r intermediate psummed —
+    the sharded delta is the unsharded delta."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    reqs = _requests(3, 8, adapters=(0, 1, 2), max_tokens=6)
+
+    def run_tp(tp):
+        mesh = mx.build_mesh(tp=tp, devices=devices8[:tp])
+        with _mk_engine(cfg, params, mesh, slots=2) as eng:
+            eng.register_adapter(seed=7)
+            eng.register_adapter(seed=9)
+            sched = _run(eng, _clone(reqs))
+            return {k: c.tokens for k, c in sched.completions.items()}
+
+    assert run_tp(1) == run_tp(2)
+
+
+def test_paged_int8_spec_adapter_parity(devices8):
+    """The composition oracle: a paged + int8-KV + speculative engine
+    with a heterogeneous adapter table emits the same streams as the
+    contiguous plain-decode int8 engine — paged == contiguous and
+    spec == plain both survive the adapter gather."""
+    cfg = _cfg(kv_cache_dtype="int8")
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    reqs = _requests(3, 8, adapters=(0, 1, 2), max_tokens=6)
+
+    def run(**over):
+        with _mk_engine(cfg, params, mesh, slots=2, **over) as eng:
+            eng.register_adapter(seed=7)
+            eng.register_adapter(seed=9)
+            sched = _run(eng, _clone(reqs))
+            return {k: c.tokens for k, c in sched.completions.items()}
+
+    assert run() == run(page_size=8, spec_k=2, spec_hist=12)
+
+
+def test_adapter_fault_replay_exact(devices8):
+    """A dispatch-seam fault mid-trace rebuilds the slots and replays
+    interrupted adapter requests bit-identically — the adapter pool is
+    never donated, so the replayed gather reads the same rows."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    reqs = _requests(4, 10, adapters=(0, 1, 2))
+
+    def run(plan):
+        with _mk_engine(cfg, params, mesh, fault_plan=plan) as eng:
+            eng.register_adapter(seed=7)
+            eng.register_adapter(seed=9)
+            sched = _run(eng, _clone(reqs))
+            assert sched.health.state != "failed"
+            return ({k: c.tokens for k, c in
+                     sched.completions.items()}, sched.summary())
+
+    clean, _ = run(None)
+    faulted, s = run(FaultPlan([FaultSpec("dispatch", 2, "error")]))
+    assert s["rebuilds"] >= 1.0, "the fault never fired"
+    assert faulted == clean
+
+
+def test_guard_flat_across_registration_and_churn(env):
+    """The recompile guard stays flat across a THIRD adapter
+    registration (the set program is warmed) and a mixed-tenant,
+    mixed-adapter admission/decode churn — pool content and ids are
+    data, never shapes."""
+    env.eng.rebuild_slots()
+    sizes0 = env.eng.compiled_cache_sizes()
+    assert sizes0["adapter_init"] == 1 and sizes0["adapter_set"] == 1
+    # trace built OUTSIDE the guard: jax.random prompt generation
+    # compiles tiny host programs the guard would (rightly) flag
+    reqs = _requests(5, 10, adapters=(0, env.a1, env.a2, 3),
+                     tenants=("x", "y"), seed0=900)
+    with env.eng.recompile_guard():
+        a3 = env.eng.register_adapter(seed=11)
+        assert a3 == 3
+        sched = _run(env.eng, reqs,
+                     tenancy=TenancyConfig(weights={"x": 2.0,
+                                                    "y": 1.0}))
+    assert len(sched.completions) == 5
+    sizes = env.eng.compiled_cache_sizes()
+    assert sizes == sizes0, (sizes0, sizes)
+
+
+def test_engine_adapter_validation(env):
+    """The loud edges: unregistered ids, adapter traffic on a
+    pool-less engine, registration before warmup / past capacity /
+    with bad shapes, and the prefix-pool × adapter exclusion."""
+    eng = env.eng
+    eng.rebuild_slots()
+    with pytest.raises(ValueError, match="registered rows"):
+        eng.admit_many([Admission(slot=0, prompt=[1, 2], max_tokens=2,
+                                  adapter=3 + eng.adapters_registered)])
+    with pytest.raises(ValueError, match="exactly one"):
+        eng.register_adapter()
+    bad = gpt.init_lora_weights(env.cfg, RANK + 1, 0)
+    with pytest.raises(ValueError, match="ADAPTER-STATIC"):
+        eng.register_adapter(bad, name="bad-rank")
+    cfg2 = _cfg()
+    eng2 = Engine(env.cfg, env.params, env.mesh, EngineConfig(
+        slots=1, max_prompt_len=8, max_seq_len=16,
+        adapter_slots=2, adapter_rank=RANK))
+    with pytest.raises(ValueError, match="warmup"):
+        eng2.register_adapter(seed=1)
+    eng2.close()
+    del cfg2
+    # pool capacity: the shared engine has 4 rows (0 pinned + 3) —
+    # fill up, then the next registration must overflow loudly
+    while eng.adapters_registered < 3:
+        eng.register_adapter(seed=100 + eng.adapters_registered)
+    with pytest.raises(ValueError, match="full"):
+        eng.register_adapter(seed=99)
+    # idempotent by name: re-registering returns the existing id
+    assert eng.register_adapter(seed=7) == env.a1
+
+
+def test_scheduler_adapter_validation_and_prefix_exclusion(devices8):
+    """submit() validates adapter ids up front (never a mid-serve
+    fault), and adapter-carrying prompts skip the prefix pool — the
+    pooled K/V is base-weight."""
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    with _mk_engine(cfg, params, mesh, slots=2,
+                    prefix_pool_slots=1) as eng:
+        a1 = eng.register_adapter(seed=7)
+        template = [int(t) for t in jax.random.randint(
+            jax.random.PRNGKey(77), (9,), 0, VOCAB)]
+        eng.register_prefix(template)
+        sched = Scheduler(eng)
+        with pytest.raises(ValueError, match="registered ids"):
+            sched.submit(Request("bad", [1, 2], max_tokens=2,
+                                 adapter=5))
+        prompt = template[:8] + [3, 5]
+        assert eng.match_prefix(prompt) is not None
+        sched.submit(Request("hit", prompt, max_tokens=2))
+        sched.submit(Request("skip", prompt, max_tokens=2,
+                             adapter=a1))
+        sched.run_until_idle()
+        assert sched.summary()["prefix_hits"] == 1.0
+        # engine-level belt-and-braces: the combination is rejected
+        with pytest.raises(ValueError, match="base adapter"):
+            eng.admit_many([Admission(
+                slot=0, prompt=prompt, max_tokens=2, adapter=a1,
+                prefix_page=0, prefix_len=8)])
+
+
+# --- weighted-fair queueing + rate limits ------------------------------------
+
+
+def test_tenant_book_wfq_and_aging_units():
+    """The book in isolation: deficit counters converge picks to the
+    weight ratio; a newcomer clamps to the live floor; aging drags a
+    heavy-deficit tenant back after enough wait."""
+    t = [0.0]
+    book = TenantBook(TenancyConfig(weights={"a": 3.0, "b": 1.0},
+                                    aging_per_s=1.0), lambda: t[0])
+    picks = {"a": 0, "b": 0}
+    for _ in range(400):
+        who = book.pick({"a": 0.0, "b": 0.0})
+        picks[who] += 1
+        book.on_tokens(who, 10)
+    ratio = picks["a"] / picks["b"]
+    assert 2.5 <= ratio <= 3.5, picks
+    # newcomer clamp: c starts at the floor, not at zero-forever credit
+    book.note_backlogged("c")
+    assert book.service_of("c") == min(book.service_of("a"),
+                                       book.service_of("b"))
+    # aging: b owes 50 normalized tokens more than a, but 60s of
+    # head-of-line wait outweighs it
+    book2 = TenantBook(TenancyConfig(aging_per_s=1.0), lambda: 0.0)
+    book2.on_tokens("b", 50)
+    assert book2.pick({"a": 0.0, "b": 0.0}) == "a"
+    assert book2.pick({"a": 0.0, "b": 60.0}) == "b"
+    # rejoin: an idle tenant returning does NOT bank its idle time —
+    # its counter clamps UP to the backlogged floor, and never down
+    bk = TenantBook(TenancyConfig(), lambda: 0.0)
+    bk.on_tokens("a", 5)     # a served a little, then went idle
+    bk.on_tokens("b", 100)   # b kept serving (enters at a's floor: 5)
+    assert bk.service_of("b") == 105.0
+    bk.rejoin("a", floor=bk.service_of("b"))
+    assert bk.service_of("a") == bk.service_of("b")
+    bk.rejoin("b", floor=0.0)
+    assert bk.service_of("b") == 105.0  # rejoin never LOWERS a counter
+    # overflow cap: past max_tenants, unseen ids fold into the shared
+    # overflow identity (configured ids keep theirs)
+    from apex_tpu.serving.tenancy import OVERFLOW_TENANT
+
+    capped = TenantBook(TenancyConfig(weights={"vip": 2.0},
+                                      max_tenants=2), lambda: 0.0)
+    assert capped.admit_tenant("u1") == "u1"
+    capped.stats("u1")
+    assert capped.admit_tenant("u2") == "u2"
+    capped.stats("u2")
+    assert capped.admit_tenant("u3") == OVERFLOW_TENANT
+    assert capped.admit_tenant("vip") == "vip"  # configured: exempt
+    assert capped.admit_tenant("u1") == "u1"    # known: keeps identity
+
+
+def test_tenant_bucket_units():
+    """Token buckets: charges debit, refill is continuous, an
+    over-budget charge reports the refill wait, and oversize requests
+    clamp to the bucket capacity (gated, not unservable)."""
+    t = [0.0]
+    book = TenantBook(TenancyConfig(rates={"a": 10.0}, burst_s=2.0),
+                      lambda: t[0])
+    assert book.throttle("a", 20) is None          # full bucket
+    wait = book.throttle("a", 10)
+    assert wait == pytest.approx(1.0)              # needs 10 @ 10/s
+    t[0] += 1.0
+    assert book.throttle("a", 10) is None          # refilled
+    assert book.throttle("unlimited", 10**6) is None
+    # oversize: charge clamps to capacity (20), so it passes on a full
+    # bucket instead of never
+    t[0] += 10.0
+    assert book.throttle("a", 10**6) is None
+
+
+def test_tenancy_config_validation():
+    for bad in (dict(weights={"a": 0.0}), dict(default_weight=0.0),
+                dict(rates={"a": -1.0}), dict(burst_s=0.0),
+                dict(aging_per_s=-1.0)):
+        with pytest.raises(ValueError):
+            TenancyConfig(**bad)
+
+
+def test_wfq_fairness_and_aging_end_to_end(env):
+    """Acceptance: under a 2-tenant flood with weights 3:1, mid-flood
+    per-tenant served-token shares converge to 3:1 within ±15%, and a
+    near-zero-weight third tenant still completes via priority aging
+    (never starved)."""
+    env.eng.rebuild_slots()
+    tcfg = TenancyConfig(weights={"a": 3.0, "b": 1.0, "c": 0.001},
+                         aging_per_s=50.0)
+    sched = Scheduler(env.eng, tenancy=tcfg, max_queue=512)
+    n = 24
+    for i in range(n):
+        for t in ("a", "b"):
+            prompt = [int(x) for x in jax.random.randint(
+                jax.random.PRNGKey(1000 + i), (3,), 0, VOCAB)]
+            sched.submit(Request(f"{t}{i}", prompt, max_tokens=8,
+                                 tenant=t))
+    sched.submit(Request("c0", [5, 6, 7], max_tokens=4, tenant="c"))
+    total = 2 * n + 1
+    # steady-state shares: served-token DELTAS over the [1/4, 1/2]
+    # completion window. The start cut drops the first admission wave
+    # (deficits start equal, so it is round-robin by construction);
+    # the end cut keeps BOTH tenants backlogged — the favoured tenant
+    # drains its whole backlog ~3x sooner, and a window reaching into
+    # the b-only tail would under-read the contended share
+    marks = (total // 4, total // 2)
+    snap = {}
+    steps = 0
+    while len(sched.completions) < total:
+        sched.step()
+        steps += 1
+        assert steps < 50_000
+        done = len(sched.completions)
+        for mark in marks:
+            if mark not in snap and done >= mark:
+                ts = sched.tenant_summary()
+                snap[mark] = (ts["a"]["tokens"], ts["b"]["tokens"])
+    (a1, b1), (a2, b2) = (snap[m] for m in marks)
+    ratio = (a2 - a1) / max(b2 - b1, 1.0)
+    assert 3 * 0.85 <= ratio <= 3 * 1.15, (ratio, snap)
+    assert sched.completions["c0"].tokens, "aged tenant starved"
+
+
+def test_rate_limit_throttles_with_zero_drift(env):
+    """Acceptance: a rate-limited tenant gets TenantThrottled (the
+    API's 429) with a finite Retry-After while the other tenants'
+    streams are bit-identical to an unthrottled run."""
+    reqs = _requests(4, 10, tenants=("a", "b"), seed0=760)
+    env.eng.rebuild_slots()
+    clean = _run(env.eng, _clone(reqs))
+    env.eng.rebuild_slots()
+    sched = Scheduler(env.eng, tenancy=TenancyConfig(
+        rates={"c": 1.0}, burst_s=8.0))
+    throttled = []
+    for r in _clone(reqs) + [
+            Request("c0", [1, 2], max_tokens=8, tenant="c"),
+            Request("c1", [1, 2], max_tokens=8, tenant="c")]:
+        try:
+            sched.submit(r)
+        except TenantThrottled as e:
+            assert e.tenant == "c" and e.retry_after_s > 0
+            throttled.append(r.request_id)
+    sched.run_until_idle()
+    assert throttled == ["c1"]  # burst 8 covers c0's budget, not c1's
+    for r in reqs:
+        assert (sched.completions[r.request_id].tokens
+                == clean.completions[r.request_id].tokens)
+    ts = sched.tenant_summary()
+    assert ts["c"]["throttled"] == 1.0
+    assert sched.summary()["tenant_throttled"] == 1.0
+
+
+def test_single_tenant_pops_strict_fifo(env):
+    """A single-tenant queue is the historical FIFO scheduler —
+    streams AND admission order are unchanged by the tenancy book."""
+    reqs = _requests(5, 10, seed0=820)
+    env.eng.rebuild_slots()
+    plain = _run(env.eng, _clone(reqs))
+    env.eng.rebuild_slots()
+    fair = _run(env.eng, _clone(reqs), tenancy=TenancyConfig())
+    assert ({k: c.tokens for k, c in plain.completions.items()}
+            == {k: c.tokens for k, c in fair.completions.items()})
+
+
+def test_fleet_rate_limit_is_one_bucket(env):
+    """Fleet rate limits live at the ROUTER's ingress — one bucket per
+    tenant fleet-wide (per-replica buckets would multiply the cap by
+    the replica count)."""
+    from apex_tpu.serving.fleet import Router
+
+    env.eng.rebuild_slots()
+    sched = Scheduler(env.eng)
+    router = Router([sched], tenancy=TenancyConfig(
+        rates={"c": 1.0}, burst_s=8.0))
+    router.submit(Request("c0", [1, 2], max_tokens=8, tenant="c"))
+    with pytest.raises(TenantThrottled) as e:
+        router.submit(Request("c1", [1, 2], max_tokens=8, tenant="c"))
+    assert e.value.retry_after_s > 0
+    router.run_until_idle()
+    assert router.completions["c0"].tokens
+    sched.on_evict = None  # release the router's ownership hook
+
+
+# --- API + analysis + replay -------------------------------------------------
+
+
+def test_api_tenant_identity_models_and_429(env):
+    """The wire surface: X-Tenant-Id beats the OpenAI `user` field,
+    `/v1/models` lists registered adapters (routable via `model`), and
+    a rate-limited tenant's request maps to 429 + Retry-After."""
+    from apex_tpu.serving.api.server import ApiServer
+    from apex_tpu.serving.api.tokenizer import ByteTokenizer
+
+    env.eng.rebuild_slots()
+    sched = Scheduler(env.eng, tenancy=TenancyConfig(
+        rates={"capped": 4.0}, burst_s=1.0))
+    # the byte codec needs one id per byte; the toy vocab is smaller,
+    # so the tokenizer over-claims 256 and the test sticks to
+    # token-id prompts within the engine's real vocab
+    server = ApiServer(sched, ByteTokenizer(256), port=0).start()
+    try:
+        def post(body, headers=None):
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=30)
+            conn.request("POST", "/v1/completions", json.dumps(body),
+                         {"Content-Type": "application/json",
+                          **(headers or {})})
+            resp = conn.getresponse()
+            out = (resp.status, dict(resp.getheaders()),
+                   json.loads(resp.read() or b"{}"))
+            conn.close()
+            return out
+
+        # token-id prompts: the byte codec's printable range exceeds
+        # this toy vocab, so the legacy list form keeps ids in range
+        # header wins over user
+        st, _, _ = post({"prompt": [1, 2, 3], "max_tokens": 2,
+                         "user": "u-field"},
+                        {"X-Tenant-Id": "u-header"})
+        assert st == 200
+        st, _, _ = post({"prompt": [1, 2, 3], "max_tokens": 2,
+                         "user": "u-field2"})
+        assert st == 200
+        seen = sched.tenant_summary()
+        assert "u-header" in seen and "u-field2" in seen
+        assert "u-field" not in seen
+        # /v1/models lists base + adapters with routable ids
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=30)
+        conn.request("GET", "/v1/models")
+        models = json.loads(conn.getresponse().read())["data"]
+        conn.close()
+        ids = [m["id"] for m in models]
+        assert ids[0] == server.model
+        assert "adapter-seed-7" in ids and "adapter-seed-9" in ids
+        assert server._resolve_adapter("adapter-seed-7") == env.a1
+        assert server._resolve_adapter(server.model) == 0
+        # adapter routing end-to-end: model= the adapter name
+        st, _, _ = post({"prompt": [1, 2, 3], "max_tokens": 2,
+                         "model": "adapter-seed-9"})
+        assert st == 200
+        # rate limit: burst 4 — the first request (2 tokens) passes,
+        # the next (4) overdraws → 429 with Retry-After
+        st, _, _ = post({"prompt": [1, 2, 3], "max_tokens": 2},
+                        {"X-Tenant-Id": "capped"})
+        assert st == 200
+        st, hdrs, body = post({"prompt": [1, 2, 3], "max_tokens": 4},
+                              {"X-Tenant-Id": "capped"})
+        assert st == 429
+        assert int(hdrs["Retry-After"]) >= 1
+        assert body["error"]["code"] == "tenant_rate_limited"
+    finally:
+        server.stop()
+
+
+def test_adapter_static_rule_synthetic(tmp_path):
+    """ADAPTER-STATIC pos/neg: a len()-shaped adapter array fires, a
+    config-derived one (and a non-adapter name) stays clean."""
+    import textwrap
+
+    from apex_tpu.analysis.core import run_analysis
+
+    (tmp_path / "pyproject.toml").write_text("[project]\nname='s'\n")
+    (tmp_path / "mod.py").write_text(textwrap.dedent("""
+        import numpy as np
+
+        def bad(live_requests, cfg):
+            adapter_ids = np.zeros((len(live_requests),), np.int32)
+            lora_pool = np.zeros((len(live_requests), 4), np.float32)
+            return adapter_ids, lora_pool
+
+        def good(cfg, arr):
+            adapter_ids = np.zeros((cfg.slots,), np.int32)
+            table = np.zeros((cfg.slots, cfg.max_pages), np.int32)
+            scratch = np.zeros((len(arr),), np.float32)
+            return adapter_ids, table, scratch
+    """))
+    res = run_analysis([str(tmp_path / "mod.py")], root=str(tmp_path),
+                       rules=["ADAPTER-STATIC"])
+    hits = [f for f in res.findings if f.rule == "ADAPTER-STATIC"]
+    assert len(hits) == 2, [f.render() for f in hits]
+    assert all(f.line in (5, 6) for f in hits), [f.render()
+                                                for f in hits]
+
+
+@pytest.mark.slow
+def test_bundle_replay_with_adapters(devices8, tmp_path):
+    """The black-box acceptance: a run with seeded adapters + tenants
+    dumps a bundle whose replay re-registers the adapters from their
+    recorded seeds and reproduces every stream bit-identically."""
+    from apex_tpu.telemetry import FlightRecorder
+    from apex_tpu.telemetry.replay import replay_bundle
+
+    cfg = _cfg()
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    with _mk_engine(cfg, params, mesh) as eng:
+        sched = Scheduler(
+            eng, recorder=FlightRecorder(), bundle_dir=str(tmp_path),
+            bundle_meta={"params": {"init_seed": 0}},
+            tenancy=TenancyConfig(weights={"x": 2.0, "y": 1.0}))
+        sched.register_adapter(seed=7)
+        sched.register_adapter(seed=9)
+        for r in _requests(4, 10, adapters=(0, 1, 2),
+                           tenants=("x", "y")):
+            sched.submit(r)
+        sched.run_until_idle()
+        path = sched.dump_bundle("tenancy-test")
+        events = [e["event"] for e in
+                  sched.recorder.to_dicts(sched.recorder.events())]
+        assert events.count("adapter_register") == 2
+    res = replay_bundle(path, verbose=False)
+    assert not res["mismatches"], res["mismatches"]
+    assert res["matched"] >= 4
