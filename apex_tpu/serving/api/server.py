@@ -16,6 +16,11 @@ Routes::
     GET  /healthz               the scheduler's live health machine
                                 (same callback shape MetricsServer
                                 takes — 200 ok/degraded, 503 otherwise)
+    GET  /slo                   the SLO observatory snapshot (objective
+                                states, burn rates, budget remaining,
+                                percentiles) when the scheduler — or
+                                every fleet replica — runs an
+                                SLOMonitor; 404 otherwise
 
 Error mapping rides the PR-5 resilience surface: queue backpressure /
 flood (:class:`~apex_tpu.serving.scheduler.QueueFull`) → 429 with
@@ -211,6 +216,18 @@ class ApiServer:
         with self._counter_lock:
             self._counter += 1
             return self._counter
+
+    def slo_status(self) -> Optional[Dict[str, Any]]:
+        """The ``/slo`` payload: the scheduler's SLO-observatory
+        status, or — when serving a fleet Router — the router's
+        aggregate (which folds every replica's monitor plus the
+        fleet-merged percentiles). None when no monitor is wired, so
+        the route 404s exactly like an unwired debug route."""
+        agg = getattr(self.scheduler, "slo_status", None)
+        if agg is not None:  # fleet Router aggregate
+            return agg()
+        mon = getattr(self.scheduler, "slo", None)
+        return None if mon is None else mon.status()
 
     # -- the driver thread (sole owner of the scheduler) --------------------
 
@@ -516,9 +533,23 @@ def _make_handler(server: ApiServer):
                 body = {"object": "list", "data": data}
                 self._reply(route, 200,
                             json.dumps(body).encode("utf-8"))
+            elif path == "/slo":
+                route = "other"
+                if server.metrics is not None:
+                    server.metrics.requests[route].inc()
+                status = server.slo_status()
+                if status is None:
+                    self.send_error(
+                        404, "no SLO monitor wired — construct the "
+                        "scheduler with slo=SLOConfig(...)")
+                    return
+                self._reply(route, 200,
+                            json.dumps(status, sort_keys=True,
+                                       default=str).encode("utf-8"))
             else:
                 self.send_error(404, "try /v1/chat/completions "
-                                "/v1/completions /v1/models /healthz")
+                                "/v1/completions /v1/models /healthz "
+                                "/slo")
 
         def do_POST(self):
             path = self.path.split("?", 1)[0]

@@ -228,6 +228,15 @@ class _FleetMetrics:
             "requests placed on a replica by the router",
             labels=("replica",))
         self.routed = {i: r.labels(replica=str(i)) for i in range(n)}
+        p = registry.gauge(
+            "serving_fleet_predicted_ttft_seconds",
+            "per-replica predicted time-to-first-token for the NEXT "
+            "submit (queue depth x chunk-latency EWMA + sketch-backed "
+            "admission overhead) — the SLO-aware routing signal "
+            "precursor",
+            labels=("replica",))
+        self.predicted_ttft = {i: p.labels(replica=str(i))
+                               for i in range(n)}
         self.failovers = registry.counter(
             "serving_fleet_failovers_total",
             "eviction waves failed over (replica deaths, breaker "
@@ -952,12 +961,72 @@ class Router:
             b = tele.breaker.get(rep.index)
             if b is not None:
                 b.set(0.0 if rep.state == REPLICA_LIVE else 1.0)
+            p = tele.predicted_ttft.get(rep.index)
+            if p is not None:
+                p.set(rep.sched.predicted_ttft_s())
+
+    def fleet_sketch(self, metric: str):
+        """Merge every replica's quantile sketch for ``metric`` into
+        one fleet sketch (merge works on COPIES — a replica's live
+        sketch is never mutated by reporting). DDSketch merge is exact
+        bucket addition, so the fleet percentile equals the percentile
+        of the pooled samples within the configured relative error —
+        NOT an average of per-replica percentiles, which would be
+        meaningless. None when no replica runs an SLO monitor (or all
+        sketches are empty)."""
+        merged = None
+        for rep in self.replicas:
+            mon = rep.sched.slo
+            if mon is None:
+                continue
+            sk = mon.sketch(metric)
+            if sk is None or not sk.count:
+                continue
+            merged = sk.copy() if merged is None else merged.merge(
+                sk.copy())
+        return merged
+
+    def fleet_percentiles(self, metric: str) -> Dict[str, float]:
+        """Fleet-pooled ``{count, p50_ms, p95_ms, p99_ms}`` for one
+        SLO metric — empty dict when nothing is recorded yet."""
+        sk = self.fleet_sketch(metric)
+        if sk is None:
+            return {}
+        return {"count": float(sk.count),
+                "p50_ms": sk.quantile(0.50) * 1e3,
+                "p95_ms": sk.quantile(0.95) * 1e3,
+                "p99_ms": sk.quantile(0.99) * 1e3}
+
+    def slo_status(self) -> Optional[Dict[str, Any]]:
+        """The fleet ``/slo`` aggregate: per-replica monitor status
+        plus fleet-merged percentiles and the worst objective state
+        across the fleet. None when no replica runs a monitor (the
+        route 404s, matching the single-scheduler contract)."""
+        from apex_tpu.telemetry.slo import METRICS as SLO_METRICS
+        per_replica = {
+            str(rep.index): rep.sched.slo.status()
+            for rep in self.replicas if rep.sched.slo is not None}
+        if not per_replica:
+            return None
+        order = ("ok", "warning", "burning")
+        worst = max((s["state"] for s in per_replica.values()),
+                    key=order.index)
+        return {
+            "state": worst,
+            "fleet": {m: self.fleet_percentiles(m)
+                      for m in SLO_METRICS},
+            "replicas": per_replica,
+            "predicted_ttft_s": {
+                str(rep.index): rep.sched.predicted_ttft_s()
+                for rep in self.replicas},
+        }
 
     def summary(self) -> Dict[str, float]:
         """Fleet-level aggregate (flat floats, like
         ``Scheduler.summary()`` — the bench's JSON line): routing /
-        failover / restart counters plus per-replica health codes and
-        routed counts."""
+        failover / restart counters plus per-replica health codes,
+        routed counts, predicted TTFT, and — when SLO monitors are
+        wired — fleet-pooled latency percentiles."""
         out: Dict[str, float] = {
             "replicas": float(len(self.replicas)),
             "replicas_routable": float(
@@ -980,8 +1049,15 @@ class Router:
             out[f"replica{rep.index}_health"] = float(
                 HEALTH_STATES.index(rep.health_state))
             out[f"replica{rep.index}_routed"] = float(rep.routed)
+            out[f"replica{rep.index}_predicted_ttft_s"] = \
+                rep.sched.predicted_ttft_s()
             out["tokens_emitted"] += rep.sched.summary().get(
                 "tokens_emitted", 0.0)
+        if any(rep.sched.slo is not None for rep in self.replicas):
+            from apex_tpu.telemetry.slo import METRICS as SLO_METRICS
+            for metric in SLO_METRICS:
+                for k, v in self.fleet_percentiles(metric).items():
+                    out[f"fleet_slo_{metric}_{k}"] = v
         return out
 
     # -- lifecycle -----------------------------------------------------------

@@ -128,6 +128,13 @@ from apex_tpu.serving.tuner import Controller, TunerConfig, ewma
 from apex_tpu.telemetry import flightrec as flightrec_mod
 from apex_tpu.telemetry import spans as spans_mod
 from apex_tpu.telemetry.ring import Ring
+from apex_tpu.telemetry.slo import (
+    METRICS as SLO_METRICS,
+    STATE_CODE as SLO_STATE_CODE,
+    SLOConfig,
+    SLOMonitor,
+    SLOObjective,
+)
 
 #: fault causes the scheduler can detect (label values of
 #: ``serving_faults_detected_total``, pre-created so scrapes show
@@ -473,6 +480,36 @@ class _RegistryMetrics:
             "knob", labels=("knob",))
         self.tuner_knob: Dict[str, Any] = {}
         self.tuner_switches: Dict[str, Any] = {}
+        # -- SLO observatory (telemetry.slo) ------------------------------
+        # pre-created even without an SLO config (explicit zeros in
+        # scrapes); quantile/objective children are bound lazily by
+        # the scheduler's gauge refresh once the monitor exists
+        self._slo_quantile_family = registry.gauge(
+            "serving_slo_quantile_seconds",
+            "streaming sketch-backed latency quantiles, by metric "
+            "(ttft/token_latency/queue_wait/e2e) and quantile "
+            "(p50/p95/p99)", labels=("metric", "quantile"))
+        self._slo_burn_family = registry.gauge(
+            "serving_slo_burn_rate",
+            "error-budget burn rate per objective and window (1.0 = "
+            "consuming the budget exactly on schedule)",
+            labels=("objective", "window"))
+        self._slo_state_family = registry.gauge(
+            "serving_slo_state",
+            "burn-rate machine state per objective: 0 ok, 1 warning, "
+            "2 burning", labels=("objective",))
+        self._slo_budget_family = registry.gauge(
+            "serving_slo_budget_remaining",
+            "fraction of the error budget left per objective (1 "
+            "untouched, 0 exhausted, negative = overrun)",
+            labels=("objective",))
+        self._slo_alert_family = registry.counter(
+            "serving_slo_alerts_total",
+            "burn-rate alerts fired (transitions into warning or "
+            "burning), by objective and state",
+            labels=("objective", "state"))
+        self.slo_quantile: Dict[Tuple[str, str], Any] = {}
+        self.slo_children: Dict[str, Dict[str, Any]] = {}
 
     def tenant(self, t: str) -> Dict[str, Any]:
         """Cached per-tenant metric children (created on first
@@ -496,6 +533,29 @@ class _RegistryMetrics:
             self.tuner_knob[k] = self._tuner_knob_family.labels(knob=k)
             self.tuner_switches[k] = \
                 self._tuner_switch_family.labels(knob=k)
+
+    def bind_slo(self, metrics, objective_keys) -> None:
+        """Pre-create the SLO children for the declared surface —
+        quantile gauges per metric and burn/state/budget/alert
+        children per objective (explicit zeros in scrapes)."""
+        for m in metrics:
+            for q in ("p50", "p95", "p99"):
+                self.slo_quantile[(m, q)] = \
+                    self._slo_quantile_family.labels(metric=m,
+                                                     quantile=q)
+        for k in objective_keys:
+            self.slo_children[k] = {
+                "fast": self._slo_burn_family.labels(objective=k,
+                                                     window="fast"),
+                "slow": self._slo_burn_family.labels(objective=k,
+                                                     window="slow"),
+                "state": self._slo_state_family.labels(objective=k),
+                "budget": self._slo_budget_family.labels(objective=k),
+                "alerts": {
+                    s: self._slo_alert_family.labels(objective=k,
+                                                     state=s)
+                    for s in ("warning", "burning")},
+            }
 
 
 class _Active:
@@ -617,6 +677,7 @@ class Scheduler:
                  spec_gate: Optional[SpecGateConfig] = None,
                  tuner: Optional[TunerConfig] = None,
                  tenancy: Optional[TenancyConfig] = None,
+                 slo: Optional[SLOConfig] = None,
                  recorder=None, bundle_dir: Optional[str] = None,
                  bundle_meta: Optional[Dict] = None,
                  max_auto_bundles: int = 4,
@@ -809,6 +870,24 @@ class Scheduler:
         self._spec_chunks = 0
         self._alarms_seen = self._guard_alarm_count()
         self._started: Optional[float] = None
+        #: SLO observatory (telemetry.slo): streaming quantile sketches
+        #: over the four latency surfaces this scheduler already
+        #: timestamps (ttft / token_latency / queue_wait / e2e, global
+        #: + per-tenant), plus one burn-rate machine per declared
+        #: objective. The monitor shares the scheduler clock and
+        #: recorder, so its evaluation inputs and every state
+        #: transition land in bundles and replay bit-identically
+        #: (telemetry.replay.replay_slo). None = no sketches, summary()
+        #: unchanged.
+        self._slo_cfg = slo
+        self.slo: Optional[SLOMonitor] = None
+        if slo is not None:
+            self.slo = SLOMonitor(slo, clock=self.clock,
+                                  recorder=recorder,
+                                  on_state=self._on_slo_state)
+            if self.telemetry is not None:
+                self.telemetry.bind_slo(
+                    SLO_METRICS, [o.key() for o in slo.objectives])
         # steady-decode split: wall time attributable to decode chunks
         # (dispatch-to-fetch, overlap-deduplicated so pipelined chunks
         # never double-count an interval) and the tokens they emitted —
@@ -1050,6 +1129,7 @@ class Scheduler:
             self._started = now
         self._poll_guard_alarms()
         self._sync_tuner()
+        self._sync_slo(now)
         self._expire(now)
         # admissions FIRST, then one chunk of any in-progress chunked
         # prefill, then the decode dispatch: a short prompt's
@@ -1192,6 +1272,24 @@ class Scheduler:
         weight replicas by how fast they actually serve."""
         return self._chunk_ewma
 
+    def predicted_ttft_s(self) -> float:
+        """What a request submitted NOW would likely see as TTFT on
+        this replica: the queue-drain estimate (depth × measured chunk
+        latency — :meth:`overload_hint_s`) plus the measured admission
+        component — the median gap between this scheduler's observed
+        TTFT and queue-wait distributions (sketch-backed; 0 before SLO
+        sketches have samples). The fleet router's routing-signal
+        precursor: rank replicas by the latency a tenant would
+        experience, not just by queue depth."""
+        base = len(self.queue) * self._chunk_ewma
+        if self.slo is None:
+            return base
+        ttft_p50 = self.slo.quantile("ttft", 0.5)
+        wait_p50 = self.slo.quantile("queue_wait", 0.5)
+        if ttft_p50 is None or wait_p50 is None:
+            return base
+        return base + max(ttft_p50 - wait_p50, 0.0)
+
     # -- internals ---------------------------------------------------------
 
     def _build_tuner(self, cfg: TunerConfig, engine: Engine) -> Controller:
@@ -1268,6 +1366,40 @@ class Scheduler:
             self.telemetry.tuner_state.set(tn.state())
             for k, v in tn.incumbent.items():
                 self.telemetry.tuner_knob[k].set(v)
+
+    def _on_slo_state(self, obj: SLOObjective, old: str,
+                      new: str) -> None:
+        """Burn-machine transition hook: count page-worthy alerts into
+        the registry (the transition + alert EVENTS are the monitor's
+        own recorder job)."""
+        if self.telemetry is None:
+            return
+        ch = self.telemetry.slo_children.get(obj.key())
+        if ch is not None and new in ch["alerts"]:
+            ch["alerts"][new].inc()
+
+    def _sync_slo(self, now: float) -> None:
+        """Tick-cadence SLO work: run any due burn-machine evaluation,
+        and refresh the quantile/burn/state/budget gauges whenever one
+        ran (gauge refresh is eval-cadence, never per-token)."""
+        mon = self.slo
+        if mon is None:
+            return
+        if not mon.tick(now) or self.telemetry is None:
+            return
+        for metric in SLO_METRICS:
+            sk = mon.sketch(metric)
+            if sk is None or not sk.count:
+                continue
+            for q, g in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+                self.telemetry.slo_quantile[(metric, g)].set(
+                    sk.quantile(q))
+        for key, m in mon.machines.items():
+            ch = self.telemetry.slo_children[key]
+            ch["fast"].set(m.fast_burn)
+            ch["slow"].set(m.slow_burn)
+            ch["state"].set(SLO_STATE_CODE[m.state])
+            ch["budget"].set(m.budget_remaining())
 
     def _guard_alarm_count(self) -> float:
         """Current value of the engine sentinel's recompile-alarm
@@ -1684,6 +1816,9 @@ class Scheduler:
         if latency is not None:
             self._decode_tokens += 1
             self.token_latency_stats.add(latency)
+            if self.slo is not None:
+                self.slo.observe("token_latency", latency,
+                                 act.request.tenant)
             if tele is not None:
                 tele.token_latency.observe(latency)
         if tele is not None:
@@ -2188,6 +2323,11 @@ class Scheduler:
                     "burst_s": self._tenancy_cfg.burst_s,
                     "aging_per_s": self._tenancy_cfg.aging_per_s,
                 }),
+                # objectives + burn policy: everything replay_slo needs
+                # to re-run the alert sequence from the recorded
+                # evaluation inputs
+                "slo": (self._slo_cfg.to_dict()
+                        if self._slo_cfg is not None else None),
             },
         }
         files: Dict[str, object] = {
@@ -2400,6 +2540,11 @@ class Scheduler:
                 tele.bucket[res.bucket].inc()
         if act.suppress < 1:
             self.ttft_stats.add(t_first - r.arrival_time)
+            if self.slo is not None:
+                self.slo.observe("ttft", t_first - r.arrival_time,
+                                 r.tenant, now=t_first)
+            if self._tuner is not None:
+                self._tuner.observe_ttft(t_first - r.arrival_time)
             if self.spans is not None:
                 self.spans.mark(r.request_id,
                                 spans_mod.PHASE_FIRST_TOKEN)
@@ -2455,6 +2600,11 @@ class Scheduler:
         self._chunked = (ca, r)
         self._chunked_fresh = True
         self._chunked_chunks += 1
+        if self.slo is not None and st is None:
+            # the chunked path's queue wait lands when the request
+            # leaves the queue (admission dispatch starts here)
+            self.slo.observe("queue_wait", now - r.arrival_time,
+                             r.tenant, now=now)
         if self.recorder is not None:
             self.recorder.record("prefill_chunk", r.request_id, 0,
                                  ca.chunks_total)
@@ -2660,6 +2810,19 @@ class Scheduler:
                     # the wire; a replaying request's re-derived first
                     # token is not a first token
                     self.ttft_stats.add(t_first - r.arrival_time)
+                    if self.slo is not None:
+                        # queue wait is arrival → admission dispatch
+                        # (the slice a router's predicted-TTFT models);
+                        # TTFT adds the prefill on top
+                        self.slo.observe(
+                            "ttft", t_first - r.arrival_time,
+                            r.tenant, now=t_first)
+                        self.slo.observe(
+                            "queue_wait", t_admit - r.arrival_time,
+                            r.tenant, now=t_first)
+                    if self._tuner is not None:
+                        self._tuner.observe_ttft(
+                            t_first - r.arrival_time)
                     if self.spans is not None:
                         self.spans.mark(r.request_id,
                                         spans_mod.PHASE_FIRST_TOKEN)
@@ -2727,6 +2890,9 @@ class Scheduler:
             # finished event (no token)
             self.events.append(StreamEvent(
                 request.request_id, None, True, reason))
+        if self.slo is not None:
+            self.slo.observe("e2e", comp.latency, request.tenant,
+                             now=now)
         if self.telemetry is not None:
             self.telemetry.finished[reason].inc()
             self.telemetry.request_latency.observe(comp.latency)
@@ -2841,4 +3007,9 @@ class Scheduler:
                             ("token_latency", self.token_latency_stats)):
             for k, v in stats.summary().items():
                 out[f"{name}_{k}"] = v
+        if self.slo is not None:
+            # the SLO observatory's sketch-backed percentiles (full-run
+            # streaming, not the LatencyStats window) + alert roll-up
+            out.update(self.slo.summary())
+            out["predicted_ttft_s"] = self.predicted_ttft_s()
         return out

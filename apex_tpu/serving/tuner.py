@@ -179,7 +179,7 @@ class Controller:
                  "incumbent_ewma", "samples", "since_probe", "probe",
                  "probe_seen", "probes_total", "switch_counts",
                  "frozen", "recorder", "on_switch", "_knob_order",
-                 "_knob_i", "_cand_i")
+                 "_knob_i", "_cand_i", "ttft_ewma", "ttft_counts")
 
     def __init__(self, cfg: TunerConfig, base: Dict[str, int], *,
                  recorder=None,
@@ -233,6 +233,14 @@ class Controller:
         self.probe_seen = 0
         self.probes_total = 0
         self.switch_counts: Dict[str, int] = {k: 0 for k in self.knobs}
+        #: TTFT EWMA per full operating point (point_key → seconds) —
+        #: OBSERVATION only this round: the admission knobs shape TTFT,
+        #: not decode tok/s (DESIGN "Serving round 10"), so a future
+        #: latency-aware policy needs per-point TTFT measured alongside
+        #: the tok/s EWMAs before it can earn movement. Decisions still
+        #: derive exclusively from tok/s.
+        self.ttft_ewma: Dict[str, float] = {}
+        self.ttft_counts: Dict[str, int] = {}
         #: freeze cause while hard-frozen (None = live)
         self.frozen: Optional[str] = None
         self.recorder = recorder
@@ -335,6 +343,31 @@ class Controller:
 
     def _ewma(self, prev: float, sample: float) -> float:
         return ewma(prev, sample, self.cfg.ewma_alpha)
+
+    def observe_ttft(self, ttft_s: float) -> None:
+        """Fold one request's time-to-first-token into the EWMA of the
+        operating point it admitted under (:meth:`current_point` — the
+        point the admission dispatch ran). Pure observation: no
+        decision reads it yet (latency-aware control is the declared
+        next step, and it needs this record to exist first). Ignored
+        while frozen, like :meth:`observe` — freeze-window traffic is
+        atypical by construction."""
+        if self.frozen is not None or ttft_s <= 0.0:
+            return
+        key = point_key(self.current_point())
+        if self.recorder is not None:
+            self.recorder.record("tuner_ttft", key, float(ttft_s))
+        self.ttft_ewma[key] = ewma(self.ttft_ewma.get(key, 0.0),
+                                   ttft_s, self.cfg.ewma_alpha)
+        self.ttft_counts[key] = self.ttft_counts.get(key, 0) + 1
+
+    def ttft_by_point(self) -> Dict[str, Dict[str, float]]:
+        """Per-operating-point TTFT observations:
+        ``{point_key: {"ttft_ewma_s", "count"}}`` — the record the next
+        round's latency-aware policy will read."""
+        return {k: {"ttft_ewma_s": self.ttft_ewma[k],
+                    "count": float(self.ttft_counts.get(k, 0))}
+                for k in sorted(self.ttft_ewma)}
 
     # -- decisions -----------------------------------------------------------
 

@@ -28,7 +28,12 @@ layer funnels through:
 - :mod:`apex_tpu.telemetry.replay`    — ``python -m
   apex_tpu.telemetry.replay <bundle>`` deterministic incident replay
   (bit-identical stream check) and the stdlib-only ``--report``
-  timeline.
+  timeline,
+- :mod:`apex_tpu.telemetry.slo`       — the SLO observatory: mergeable
+  fixed-γ quantile sketches (streaming p50/p95/p99 for TTFT,
+  inter-token gap, queue wait, e2e), declared objectives with error
+  budgets, and deterministic multi-window burn-rate alerting — all
+  replayable from bundles.
 
 Dependency-free by contract: no torch, no tensorboard (a tier-1 test
 imports every module here with both purged); ``recompile`` is the only
@@ -40,11 +45,13 @@ from __future__ import annotations
 
 __all__ = [
     "ring", "registry", "spans", "recompile", "http", "flightrec",
-    "replay",
+    "replay", "slo",
     "Ring", "Registry", "DEFAULT_BUCKETS", "parse_prometheus_text",
     "SpanRecorder", "RecompileSentinel", "RecompileGuard",
     "RecompileError", "MetricsServer", "start_metrics_server",
     "FlightRecorder", "EVENT_FIELDS",
+    "QuantileSketch", "SLOConfig", "SLOObjective", "SLOMonitor",
+    "parse_objective",
 ]
 
 _LAZY = {
@@ -55,6 +62,12 @@ _LAZY = {
     "http": "apex_tpu.telemetry.http",
     "flightrec": "apex_tpu.telemetry.flightrec",
     "replay": "apex_tpu.telemetry.replay",
+    "slo": "apex_tpu.telemetry.slo",
+    "QuantileSketch": "apex_tpu.telemetry.slo",
+    "SLOConfig": "apex_tpu.telemetry.slo",
+    "SLOObjective": "apex_tpu.telemetry.slo",
+    "SLOMonitor": "apex_tpu.telemetry.slo",
+    "parse_objective": "apex_tpu.telemetry.slo",
     "FlightRecorder": "apex_tpu.telemetry.flightrec",
     "EVENT_FIELDS": "apex_tpu.telemetry.flightrec",
     "Ring": "apex_tpu.telemetry.ring",

@@ -72,6 +72,7 @@ EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
     "spec_gate": ("state", "accept_ewma", "break_even"),
     # -- self-tuning control plane (serving.tuner) --------------------------
     "tuner_obs": ("point", "tokens", "wall_s", "depth"),
+    "tuner_ttft": ("point", "ttft_s"),
     "tuner_probe": ("knob", "value", "phase", "ewma", "incumbent_ewma"),
     "tuner_switch": ("knob", "from", "to", "ewma", "incumbent_ewma"),
     "tuner_freeze": ("phase", "cause"),
@@ -96,6 +97,12 @@ EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
     "failover": ("replica", "cause", "requests"),
     "drain": ("replica", "phase"),
     "restart": ("replica", "cause"),
+    # -- SLO observatory (telemetry.slo) -------------------------------------
+    "slo_eval": ("objective", "fast_good", "fast_bad", "slow_good",
+                 "slow_bad"),
+    "slo_state": ("objective", "from", "to", "fast_burn", "slow_burn"),
+    "slo_alert": ("objective", "state", "burn"),
+    "slo_sketch": ("metric", "tenant", "count", "p50", "p95", "p99"),
 }
 
 

@@ -21,7 +21,12 @@ dependencies (stdlib ``http.server`` on a daemon thread):
 - ``/debug/bundle`` — trigger a post-mortem bundle on demand when
   ``bundle_trigger=`` is given (e.g. ``sched.dump_bundle``); answers
   the written path. Both answer 404 when unwired, so the no-recorder
-  server behaves exactly as before.
+  server behaves exactly as before,
+- ``/slo``      — one JSON snapshot of the SLO observatory (objective
+  states, burn rates, budget remaining, per-metric and per-tenant
+  percentiles) when ``slo=`` is given a callback — wire
+  ``sched.slo.status`` (or the fleet aggregate). 404 when unwired,
+  same contract as the debug routes.
 
 ``port=0`` binds an ephemeral port (tests; ``server.port`` tells you
 what you got). The handler only reads snapshot methods that take their
@@ -53,7 +58,8 @@ class MetricsServer:
                  extra_vars: Optional[Callable[[], Dict[str, Any]]] = None,
                  health: Optional[Callable[[], Tuple[int, str]]] = None,
                  recorder=None,
-                 bundle_trigger: Optional[Callable[[], str]] = None):
+                 bundle_trigger: Optional[Callable[[], str]] = None,
+                 slo: Optional[Callable[[], Dict[str, Any]]] = None):
         self.registry = registry
         self.spans = spans
         self.sentinel = sentinel
@@ -68,6 +74,9 @@ class MetricsServer:
         #: bundle path (wire ``sched.dump_bundle`` — or a lambda
         #: tagging the cause)
         self.bundle_trigger = bundle_trigger
+        #: optional ``/slo`` callback returning the SLO-observatory
+        #: status dict (wire ``sched.slo.status``)
+        self.slo = slo
         self._host = host
         self._requested_port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -124,9 +133,14 @@ class MetricsServer:
                         return
                     body = json.dumps({"bundle": out}).encode("utf-8")
                     ctype = "application/json"
+                elif path == "/slo" and server.slo is not None:
+                    body = json.dumps(server.slo(), indent=1,
+                                      sort_keys=True,
+                                      default=str).encode("utf-8")
+                    ctype = "application/json"
                 else:
                     self.send_error(404, "try /metrics /healthz /vars "
-                                    "/debug/events /debug/bundle")
+                                    "/slo /debug/events /debug/bundle")
                     return
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
@@ -181,7 +195,7 @@ class MetricsServer:
 def start_metrics_server(registry, *, host: str = "127.0.0.1",
                          port: int = 0, spans=None, sentinel=None,
                          extra_vars=None, health=None, recorder=None,
-                         bundle_trigger=None) -> MetricsServer:
+                         bundle_trigger=None, slo=None) -> MetricsServer:
     """Construct AND start a :class:`MetricsServer` in one call — the
     one-liner for scripts::
 
@@ -191,4 +205,5 @@ def start_metrics_server(registry, *, host: str = "127.0.0.1",
     return MetricsServer(registry, host=host, port=port, spans=spans,
                          sentinel=sentinel, extra_vars=extra_vars,
                          health=health, recorder=recorder,
-                         bundle_trigger=bundle_trigger).start()
+                         bundle_trigger=bundle_trigger,
+                         slo=slo).start()

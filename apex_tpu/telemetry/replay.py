@@ -13,7 +13,11 @@ self-tuning run (``Scheduler(tuner=...)``) additionally replay the
 controller's decision sequence from the RECORDED clocks
 (:func:`replay_tuner` — pure host arithmetic over the bundle's
 ``tuner_obs`` events), asserting every probe/switch/freeze reproduces
-seq-for-seq with bit-identical triggering EWMAs. A completed
+seq-for-seq with bit-identical triggering EWMAs. Bundles from an
+SLO-monitored run (``Scheduler(slo=...)``) likewise replay the
+burn-rate alert sequence from the recorded per-evaluation window
+counts (:func:`replay_slo` — integer inputs, so the burn floats
+re-derive bit-identically). A completed
 eos/length/stop request must match exactly; an interrupted (active /
 queued / timed-out) one must extend its recorded prefix. That turns
 "the soak tripped at 3am" from archaeology into a command.
@@ -85,6 +89,43 @@ def replay_tuner(bundle: Dict[str, Any]) -> Optional[Dict[str, Any]]:
                             events)
     out["observations"] = sum(1 for e in events
                               if e["event"] == "tuner_obs")
+    return out
+
+
+# -- SLO alert replay (stdlib-only, recorded window counts) -------------------
+
+
+def replay_slo(bundle: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Re-derive a bundle's SLO alert sequence from its RECORDED
+    evaluation inputs: rebuild the burn-rate machines from
+    ``config.json``'s ``slo`` block, feed them the recorded
+    ``slo_eval`` window counts (integers — the same float divisions
+    reproduce bit-identically), and compare the regenerated
+    state-transition/alert sequence against the recorded one
+    field-for-field, burn floats included
+    (:func:`apex_tpu.telemetry.slo.compare_alerts`). Returns ``None``
+    when the bundle carries no SLO config; ``{"skipped": ...}`` when
+    the event ring dropped events. Stdlib-only, like
+    :func:`replay_tuner`."""
+    sched_d = (bundle.get("config.json") or {}).get("scheduler") or {}
+    slo_d = sched_d.get("slo")
+    if not slo_d:
+        return None
+    man = bundle.get("manifest.json") or {}
+    fr = man.get("flightrec") or {}
+    if fr.get("events_dropped"):
+        return {"skipped": f"event ring dropped "
+                f"{fr['events_dropped']} events — the recorded input "
+                f"stream is incomplete"}
+    from apex_tpu.telemetry.slo import (compare_alerts,
+                                        slo_config_from_dict)
+
+    cfg = slo_config_from_dict(slo_d)
+    events = [e for e in bundle.get("events.jsonl", [])
+              if str(e.get("event", "")).startswith("slo_")]
+    out = compare_alerts(cfg, events)
+    out["evaluations"] = sum(1 for e in events
+                             if e["event"] == "slo_eval")
     return out
 
 
@@ -402,6 +443,15 @@ def replay_bundle(path: str, *, no_faults: bool = False,
         mismatches.extend(
             {"request_id": None, "why": "tuner decision drift",
              **m} for m in tuner_out.get("mismatches", ()))
+    slo_out = replay_slo(bundle)
+    if slo_out is not None:
+        # the recorded-input alert replay: every burn-rate transition
+        # and alert must re-derive bit-identically from the recorded
+        # window counts (drift gates the exit code like the streams)
+        out["slo"] = slo_out
+        mismatches.extend(
+            {"request_id": None, "why": "slo alert drift",
+             **m} for m in slo_out.get("mismatches", ()))
     if verbose:
         print(json.dumps(out, sort_keys=True))
     return out

@@ -37,14 +37,44 @@ BASELINE_TOKENS_PER_SEC = 58600.0
 
 #: stable trajectory keys for the BENCH_serve.json series (bumped per
 #: PR so the per-line provenance is plottable without git archaeology)
-BENCH_PR = 15
-BENCH_LABEL = "multi-tenant"
+BENCH_PR = 18
+BENCH_LABEL = "slo-observatory"
+
+#: every BENCH_serve.json line must carry these, with these types —
+#: the provenance triple that makes the series plottable without git
+#: archaeology. Validated at append time (the PR-12 lesson upgraded
+#: from convention to contract: a mode writing a key-drifted line now
+#: fails ITS OWN run loudly instead of silently breaking the cross-PR
+#: trajectory for whoever plots it next)
+_TRAJ_REQUIRED = (("pr", int), ("label", str), ("metric", str))
+
+
+def _validate_traj_row(row):
+    for key, typ in _TRAJ_REQUIRED:
+        if key not in row:
+            raise ValueError(
+                f"BENCH_serve.json line missing required key {key!r}: "
+                f"{sorted(row)}")
+        if not isinstance(row[key], typ) or (typ is str
+                                             and not row[key]):
+            raise ValueError(
+                f"BENCH_serve.json line key {key!r} must be a "
+                f"non-empty {typ.__name__}, got {row[key]!r}")
+    if not any(k == "tokens_per_sec" or k.endswith("_tokens_per_sec")
+               for k in row):
+        raise ValueError(
+            f"BENCH_serve.json line carries no *tokens_per_sec "
+            f"throughput key: {sorted(row)}")
 
 
 def _append_traj(*rows):
     """Append trajectory lines to BENCH_serve.json (one JSON object
     per line) — THE writer every serve mode shares, so the file's
-    format cannot drift between modes."""
+    format cannot drift between modes. Every row is schema-checked
+    first (:data:`_TRAJ_REQUIRED` + a throughput key); nothing is
+    written unless ALL rows pass, so a drifted mode cannot half-append."""
+    for row in rows:
+        _validate_traj_row(row)
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_serve.json")
     with open(path, "a") as f:
@@ -1110,6 +1140,63 @@ def serve(telemetry_out=None, api=False):
         "token_drift": 0,
     }
 
+    # SLO-observatory A/B — full ingestion on (four quantile sketches
+    # fed per token/admission/completion + a live burn-rate machine)
+    # vs off, same trace, same knobs, paired per-round ratios like the
+    # flight-recorder A/B above. Sketch adds are O(1) dict bumps and
+    # gauge refresh is eval-cadence, so the ratio must sit inside the
+    # host noise band. The slo side's sketch-backed p99 TTFT rides
+    # into the trajectory next to tok/s.
+    from apex_tpu.telemetry.slo import SLOConfig, parse_objective
+
+    slo_cfg_ab = SLOConfig(
+        objectives=(parse_objective("p99:ttft:0.2"),
+                    parse_objective("p95:e2e:1.0")),
+        eval_every_s=0.02, snapshot_every_s=0.1)
+    best_slo = {}
+    slo_ratios = []
+    slo_summary = None
+    for rnd in range(reps + 3):
+        round_tps = {}
+        for name in _ab_order(rnd, ("slo", "plain")):
+            sched = Scheduler(
+                engine, pipeline_depth=2,
+                slo=slo_cfg_ab if name == "slo" else None)
+            for r in trace(100, n_requests):
+                sched.submit(r)
+            sched.run_until_idle()
+            toks = {rid: c.tokens for rid, c in
+                    sched.completions.items()}
+            assert toks == tokens_by_cfg["chunk8"], \
+                f"slo ab {name} token drift"
+            s = sched.summary()
+            round_tps[name] = s["tokens_per_sec"]
+            if name == "slo":
+                slo_summary = s
+            if name not in best_slo or s["tokens_per_sec"] > \
+                    best_slo[name]["tokens_per_sec"]:
+                best_slo[name] = s
+        slo_ratios.append(round_tps["slo"]
+                          / max(round_tps["plain"], 1e-9))
+    slo_ab = {
+        "slo_tokens_per_sec": round(
+            best_slo["slo"]["tokens_per_sec"], 1),
+        "plain_tokens_per_sec": round(
+            best_slo["plain"]["tokens_per_sec"], 1),
+        # median of the interleaved per-round paired ratios
+        "overhead_ratio": round(_median(slo_ratios), 3),
+        "sketch_ttft_p50_ms": round(
+            slo_summary.get("slo_ttft_p50_ms", 0.0), 3),
+        "sketch_ttft_p99_ms": round(
+            slo_summary.get("slo_ttft_p99_ms", 0.0), 3),
+        "sketch_token_latency_p99_ms": round(
+            slo_summary.get("slo_token_latency_p99_ms", 0.0), 3),
+        "budget_remaining": round(
+            slo_summary.get("slo_budget_remaining", 1.0), 6),
+        "state": slo_summary.get("slo_state", 0.0),
+        "token_drift": 0,
+    }
+
     # Self-tuning A/B — the serving.tuner control plane vs every FIXED
     # operating point on a SHIFTING burst trace: phase A is
     # decode-heavy (few requests, long budgets — big chunks amortize
@@ -1431,6 +1518,7 @@ def serve(telemetry_out=None, api=False):
         "chunked_ab": chunked_ab,
         "spec_ab": spec_ab,
         "flightrec_ab": flightrec_ab,
+        "slo_ab": slo_ab,
         "tuner_ab": tuner_ab,
         "tenant_ab": tenant_ab,
     }
@@ -1475,6 +1563,12 @@ def serve(telemetry_out=None, api=False):
         "flightrec_overhead_ratio": flightrec_ab["overhead_ratio"],
         "events_per_sec": flightrec_ab["events_per_sec"],
         "bundle_write_ms": flightrec_ab["bundle_write_ms"],
+        # SLO observatory: sketch-backed p99 TTFT next to tok/s (the
+        # headline LatencyStats p99 for cross-checking) + the paired
+        # ingestion-overhead ratio (1.0 = free)
+        "ttft_p99_ms": line["ttft_p99_ms"],
+        "slo_ttft_p99_ms": slo_ab["sketch_ttft_p99_ms"],
+        "slo_overhead_ratio": slo_ab["overhead_ratio"],
         # self-tuning: autotuned vs the best fixed corner on the
         # shifting burst trace (paired per-round median)
         "tuner_ab": tuner_ab["ratio_vs_best_fixed"],

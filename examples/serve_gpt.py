@@ -71,6 +71,20 @@ point through rebuild/replay brackets::
   python examples/serve_gpt.py --num-requests 8 --max-tokens 24 \
     --autotune "decode_chunk=1,2,4;pipeline_depth=1,2"
 
+SLO observatory (``apex_tpu.telemetry.slo``): ``--slo SPEC`` declares
+latency objectives — ``SPEC`` is a comma list of
+``pQQ:metric:threshold_s[:tenant]`` objectives over ``ttft`` /
+``token_latency`` / ``queue_wait`` / ``e2e`` — and the scheduler then
+feeds streaming quantile sketches from its existing timings, runs
+multi-window burn-rate alerting against the declared error budgets,
+and prints sketch-backed p50/p95/p99 plus per-objective budget status
+at exit (with ``--metrics-port``, ``/slo`` serves the live snapshot
+and ``serving_slo_*`` gauges ride ``/metrics``)::
+
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python examples/serve_gpt.py --num-requests 8 \
+    --slo "p99:ttft:0.2,p95:e2e:1.0"
+
 Chaos (``apex_tpu.serving.resilience``): ``--fault-plan SPEC`` injects
 deterministic faults at the engine seams for manual recovery drills —
 ``SPEC`` is ``random:SEED[:N]`` or a comma list of
@@ -321,6 +335,15 @@ def main():
                     "per-tenant served-token shares to this ratio "
                     "under contention; the synthetic trace spreads "
                     "requests round-robin over the named tenants")
+    ap.add_argument("--slo", metavar="SPEC", default=None,
+                    help="declare latency SLOs (apex_tpu.telemetry."
+                    "slo): a comma list of pQQ:metric:threshold_s"
+                    "[:tenant] objectives, e.g. 'p99:ttft:0.2,"
+                    "p95:e2e:1.0' (metrics: ttft, token_latency, "
+                    "queue_wait, e2e). The scheduler feeds streaming "
+                    "quantile sketches + burn-rate error-budget "
+                    "machines and the run prints sketch percentiles "
+                    "and per-objective budget status at exit")
     ap.add_argument("--tenant-rate", metavar="SPEC", default=None,
                     help="per-tenant token budgets (tokens/s), e.g. "
                     "'a:50': a submit over budget is rejected with a "
@@ -350,6 +373,19 @@ def main():
         tenancy_cfg = TenancyConfig(weights=weights, rates=rates)
         tenant_names = sorted(set(weights) | set(rates)) or None
         print(f"tenancy: weights={weights} rates={rates}")
+
+    slo_cfg = None
+    if args.slo:
+        from apex_tpu.telemetry.slo import SLOConfig, parse_objective
+
+        try:
+            slo_cfg = SLOConfig(objectives=tuple(
+                parse_objective(part)
+                for part in args.slo.split(",") if part.strip()))
+        except ValueError as e:
+            raise SystemExit(f"--slo: {e}")
+        print("slo objectives: "
+              + ", ".join(o.key() for o in slo_cfg.objectives))
 
     cfg = gpt.GPTConfig(vocab_size=1024, hidden_size=128, num_layers=4,
                         num_heads=4, seq_len=128, remat=False,
@@ -514,7 +550,7 @@ def main():
                       pipeline_depth=args.pipeline_depth,
                       recorder=recorder, bundle_dir=args.bundle_dir,
                       bundle_meta=bundle_meta, tuner=tuner_cfg,
-                      tenancy=rep_tenancy,
+                      tenancy=rep_tenancy, slo=slo_cfg,
                       resilience=ResilienceConfig(max_retries=8))
             for e in engines]
         sched = Router(replica_scheds, registry=registry,
@@ -533,6 +569,7 @@ def main():
                           pipeline_depth=args.pipeline_depth,
                           recorder=recorder, bundle_dir=args.bundle_dir,
                           tuner=tuner_cfg, tenancy=tenancy_cfg,
+                          slo=slo_cfg,
                           # params provenance: telemetry.replay rebuilds
                           # the model from a bundle with this
                           bundle_meta=bundle_meta)
@@ -568,9 +605,13 @@ def main():
             health=sched.health.healthz, recorder=recorder,
             bundle_trigger=(
                 (lambda: bundle_sched.dump_bundle("http"))
-                if args.bundle_dir is not None else None))
+                if args.bundle_dir is not None else None),
+            slo=((sched.slo_status if args.replicas > 1
+                  else bundle_sched.slo.status)
+                 if slo_cfg is not None else None))
         print(f"metrics: {server.url}/metrics  /healthz  /vars  "
-              f"/debug/events")
+              f"/debug/events"
+              + ("  /slo" if slo_cfg is not None else ""))
     from apex_tpu.serving.tenancy import TenantThrottled
 
     throttled = []
@@ -604,6 +645,35 @@ def main():
         print(f"autotune: state={s['tuner_state']:.0f} "
               f"probes={s['tuner_probes']:.0f} "
               f"switches={s['tuner_switches']:.0f} incumbent={point}")
+    if slo_cfg is not None:
+        # sketch-backed exit report: percentiles per metric, then each
+        # objective's budget verdict (a final evaluation first, so a
+        # run shorter than the eval cadence still gets a verdict)
+        mon = (sched.slo if args.replicas == 1
+               else bundle_sched.slo)
+        for m in mon.machines.values():
+            m.evaluate(mon.clock())
+        for metric in ("ttft", "token_latency", "queue_wait", "e2e"):
+            pct = mon.percentiles(metric)
+            if not pct.get("count"):
+                continue
+            print(f"slo {metric}: p50={pct['p50_ms']:.2f}ms "
+                  f"p95={pct['p95_ms']:.2f}ms "
+                  f"p99={pct['p99_ms']:.2f}ms "
+                  f"(n={pct['count']:.0f})")
+        for key, m in mon.machines.items():
+            st = m.status()
+            print(f"slo {key}: state={st['state']} "
+                  f"budget_remaining={st['budget_remaining']:.4f} "
+                  f"good={st['good']:.0f} bad={st['bad']:.0f}")
+        if args.replicas > 1:
+            for metric in ("ttft", "e2e"):
+                pct = sched.fleet_percentiles(metric)
+                if pct.get("count"):
+                    print(f"slo fleet {metric}: "
+                          f"p99={pct['p99_ms']:.2f}ms "
+                          f"(n={pct['count']:.0f}, pooled across "
+                          f"{len(sched.replicas)} replicas)")
     if fault_plan is not None:
         print(f"chaos: {len(fault_plan.injected)} fault(s) fired "
               f"({[s.describe() for s in fault_plan.injected]}), "

@@ -1,0 +1,460 @@
+"""apex_tpu.telemetry.slo — SLO observatory oracles.
+
+Headline oracles: (1) sketch accuracy — the DDSketch-style quantile
+sketch stays inside its configured relative-error bound against exact
+numpy percentiles on bimodal, heavy-tail, and constant distributions;
+(2) merge algebra — merging is commutative/associative and a fleet
+merge of shard sketches is *bucket-identical* to a pooled sketch over
+the concatenated stream, so fleet percentiles equal pooled percentiles;
+(3) bounded memory — buckets_in_use stays <= max_buckets across 1M
+samples spanning nine decades; (4) burn-rate determinism — the
+multi-window state machine driven by a fake clock produces an exact
+ok->burning->warning->ok transition sequence, with hysteresis killing
+threshold-hover flap and the fast window alone never paging; (5)
+replayability — the recorded ``slo_eval`` integer stream re-derives
+the full ``slo_state``/``slo_alert`` sequence bit-identically through
+a JSON round-trip (``compare_alerts`` / ``replay_slo``), and a
+corrupted history is *detected*, not absorbed."""
+
+import json
+import math
+import random
+
+import numpy as np
+import pytest
+
+from apex_tpu.telemetry.flightrec import FlightRecorder
+from apex_tpu.telemetry.replay import replay_slo
+from apex_tpu.telemetry.slo import (
+    METRICS,
+    STATE_BURNING,
+    STATE_OK,
+    STATE_WARNING,
+    BurnMachine,
+    QuantileSketch,
+    SLOConfig,
+    SLOMonitor,
+    SLOObjective,
+    compare_alerts,
+    parse_objective,
+    slo_config_from_dict,
+)
+
+QS = (0.5, 0.9, 0.95, 0.99)
+
+
+def _rank_error(sketch, values, q):
+    """Rank of the sketch's estimate within the exact sample, vs q."""
+    est = sketch.quantile(q)
+    xs = np.sort(np.asarray(values))
+    rank = np.searchsorted(xs, est, side="right") / len(xs)
+    return abs(rank - q)
+
+
+# ---------------------------------------------------------------------------
+# sketch accuracy vs exact numpy
+# ---------------------------------------------------------------------------
+
+
+def _check_accuracy(values, rel_err=0.01):
+    sk = QuantileSketch(rel_err=rel_err)
+    for v in values:
+        sk.add(v)
+    exact = np.quantile(np.asarray(values), QS)
+    for q, ex in zip(QS, exact):
+        est = sk.quantile(q)
+        if ex > 1e-9:
+            # the guarantee: relative error on the value axis
+            assert abs(est - ex) / ex <= 2.0 * rel_err + 1e-12, (
+                q, est, ex)
+        # rank-error sanity (loose: a dense mode packs many samples
+        # inside one gamma bucket, so rank error can exceed rel_err)
+        assert _rank_error(sk, values, q) <= 0.05, q
+    return sk
+
+
+def test_sketch_bimodal_accuracy():
+    rng = random.Random(11)
+    values = ([rng.gauss(0.020, 0.002) for _ in range(4000)]
+              + [rng.gauss(0.300, 0.030) for _ in range(1000)])
+    values = [abs(v) + 1e-6 for v in values]
+    _check_accuracy(values)
+
+
+def test_sketch_heavy_tail_accuracy():
+    rng = random.Random(12)
+    values = [math.exp(rng.gauss(-3.0, 1.2)) for _ in range(6000)]
+    _check_accuracy(values)
+
+
+def test_sketch_constant_stream():
+    sk = QuantileSketch(rel_err=0.01)
+    for _ in range(1000):
+        sk.add(0.125)
+    for q in (0.0, 0.5, 0.99, 1.0):
+        est = sk.quantile(q)
+        assert abs(est - 0.125) / 0.125 <= 0.01
+    assert sk.count == 1000 and sk.min == sk.max == 0.125
+
+
+def test_sketch_edge_cases():
+    sk = QuantileSketch()
+    assert sk.quantile(0.5) is None and sk.mean == 0.0
+    with pytest.raises(ValueError):
+        sk.quantile(1.5)
+    sk.add(0.0)        # zero bucket
+    sk.add(-1.0)       # clamped into zero bucket, not an error
+    sk.add(0.5)
+    assert sk.quantile(0.0) == 0.0
+    assert sk.quantile(1.0) == 0.5
+    sk.add(0.7, n=0)   # n<=0 is a no-op
+    assert sk.count == 3
+
+
+# ---------------------------------------------------------------------------
+# merge algebra: fleet merge == pooled
+# ---------------------------------------------------------------------------
+
+
+def _shard_sketches(rng, shards=3, per=2000):
+    pooled = QuantileSketch(rel_err=0.01)
+    parts, all_values = [], []
+    for s in range(shards):
+        sk = QuantileSketch(rel_err=0.01)
+        mu = -4.0 + 0.7 * s  # heterogeneous replicas
+        for _ in range(per):
+            v = math.exp(rng.gauss(mu, 0.8))
+            sk.add(v)
+            pooled.add(v)
+            all_values.append(v)
+        parts.append(sk)
+    return parts, pooled, all_values
+
+
+def test_merge_equals_pooled_and_is_commutative_associative():
+    parts, pooled, values = _shard_sketches(random.Random(13))
+    a, b, c = parts
+
+    ab_c = a.copy().merge(b).merge(c)
+    a_bc = a.copy().merge(b.copy().merge(c))
+    cba = c.copy().merge(b).merge(a)
+
+    for merged in (ab_c, a_bc, cba):
+        # bucket-count addition makes merged == pooled exactly (sum
+        # alone may differ in the last ulp from addition order)
+        md, pd = merged.to_dict(), pooled.to_dict()
+        assert math.isclose(md.pop("sum"), pd.pop("sum"),
+                            rel_tol=1e-12)
+        assert md == pd
+        for q in QS:
+            assert merged.quantile(q) == pooled.quantile(q)
+            assert _rank_error(merged, values, q) <= 0.05
+
+    # merge() must not mutate its argument
+    assert b.count == 2000 and c.count == 2000
+    with pytest.raises(ValueError, match="gamma"):
+        a.merge(QuantileSketch(rel_err=0.05))
+
+
+def test_sketch_bounded_memory_under_1m_samples():
+    sk = QuantileSketch(rel_err=0.01, max_buckets=2048)
+    rng = random.Random(14)
+    # 1M samples spanning nine decades, added in bulk counts so the
+    # test stays fast; the bucket count must stay O(1) regardless.
+    total = 0
+    for _ in range(10_000):
+        v = 10.0 ** rng.uniform(-6.0, 3.0)
+        sk.add(v, n=100)
+        total += 100
+    assert total == 1_000_000 and sk.count == 1_000_000
+    assert sk.buckets_in_use <= 2048
+    assert sk.quantile(0.99) <= sk.max
+
+
+def test_sketch_collapse_keeps_upper_tail():
+    # tiny bucket budget: lowest buckets collapse, p99 must survive
+    sk = QuantileSketch(rel_err=0.01, max_buckets=64)
+    rng = random.Random(15)
+    values = [10.0 ** rng.uniform(-6.0, 1.0) for _ in range(5000)]
+    for v in values:
+        sk.add(v)
+    assert sk.buckets_in_use <= 64
+    ex = float(np.quantile(np.asarray(values), 0.99))
+    assert abs(sk.quantile(0.99) - ex) / ex <= 0.03
+
+
+def test_sketch_dict_round_trip():
+    sk = QuantileSketch(rel_err=0.02, max_buckets=512)
+    for v in (0.0, 1e-4, 0.02, 0.02, 5.0):
+        sk.add(v)
+    back = QuantileSketch.from_dict(
+        json.loads(json.dumps(sk.to_dict())))
+    assert back.to_dict() == sk.to_dict()
+    for q in QS:
+        assert back.quantile(q) == sk.quantile(q)
+
+
+# ---------------------------------------------------------------------------
+# objectives + config validation
+# ---------------------------------------------------------------------------
+
+
+def test_parse_objective_round_trip():
+    obj = parse_objective("p99:ttft:0.2")
+    assert (obj.metric, obj.quantile, obj.threshold_s) == ("ttft", 0.99, 0.2)
+    assert obj.tenant is None and obj.key() == "p99:ttft:0.2"
+    ten = parse_objective("p95:e2e:1.5:acme")
+    assert ten.tenant == "acme" and ten.key() == "p95:e2e:1.5:acme"
+    for bad in ("ttft:0.2", "p99:bogus:0.2", "q99:ttft:0.2",
+                "p99:ttft:-1", "p99:ttft:0.2:a:b", "p0:ttft:0.2"):
+        with pytest.raises(ValueError):
+            parse_objective(bad)
+
+
+def test_slo_config_validation_and_round_trip():
+    with pytest.raises(ValueError):
+        SLOConfig(fast_window_s=600.0, slow_window_s=60.0)
+    with pytest.raises(ValueError):
+        SLOConfig(warn_burn=8.0, burn=6.0)
+    with pytest.raises(ValueError):
+        SLOConfig(hysteresis=1.0)
+    with pytest.raises(ValueError):
+        SLOConfig(rel_err=0.0)
+    cfg = SLOConfig(objectives=(parse_objective("p99:ttft:0.2"),
+                                parse_objective("p95:e2e:1:acme")))
+    back = slo_config_from_dict(json.loads(json.dumps(cfg.to_dict())))
+    assert back == cfg
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError):
+        SLOObjective(metric="nope")
+    with pytest.raises(ValueError):
+        SLOObjective(metric="ttft", quantile=1.0)
+    with pytest.raises(ValueError):
+        SLOObjective(metric="ttft", target=1.0)
+    assert "ttft" in METRICS
+
+
+# ---------------------------------------------------------------------------
+# burn-rate state machine (fake clock throughout)
+# ---------------------------------------------------------------------------
+
+
+def _mk_machine(recorder=None, on_state=None):
+    obj = SLOObjective(metric="ttft", quantile=0.99, threshold_s=0.2,
+                       target=0.99)  # budget = 1%
+    cfg = SLOConfig(objectives=(obj,), fast_window_s=60.0,
+                    slow_window_s=600.0, warn_burn=1.0, burn=6.0,
+                    hysteresis=0.8)
+    return BurnMachine(obj, cfg, recorder=recorder, on_state=on_state)
+
+
+def _drive(m, t0, seconds, bad_per_s, good_per_s=None, n=1):
+    """Feed `n` samples/sec for `seconds`, bad_per_s of them violating."""
+    if good_per_s is None:
+        good_per_s = n - bad_per_s
+    for i in range(int(seconds)):
+        now = t0 + float(i)
+        for _ in range(bad_per_s):
+            m.observe(now, 0.5)
+        for _ in range(good_per_s):
+            m.observe(now, 0.05)
+        m.evaluate(now)
+    return t0 + float(seconds)
+
+
+def test_burn_machine_full_cycle_deterministic():
+    transitions = []
+    m = _mk_machine(on_state=lambda o, a, b: transitions.append((a, b)))
+    # healthy ten minutes: nothing fires
+    t = _drive(m, 0.0, 600, 0)
+    assert m.state == STATE_OK and transitions == []
+    # hard outage: 100% violations.  The slow (600 s) window crosses
+    # 1x budget ~6 s in (-> warning) and 6x ~36 s in (-> burning).
+    t = _drive(m, t, 120, 1, good_per_s=0)
+    assert m.state == STATE_BURNING
+    # recovery: fast window drains first -> back to warning, then ok
+    # once the slow window clears the hysteresis-scaled exit threshold
+    t = _drive(m, t, 700, 0)
+    assert m.state == STATE_OK
+    assert transitions == [(STATE_OK, STATE_WARNING),
+                           (STATE_WARNING, STATE_BURNING),
+                           (STATE_BURNING, STATE_WARNING),
+                           (STATE_WARNING, STATE_OK)]
+    # re-running the identical drive yields the identical sequence
+    transitions2 = []
+    m2 = _mk_machine(on_state=lambda o, a, b: transitions2.append((a, b)))
+    t = _drive(m2, 0.0, 600, 0)
+    t = _drive(m2, t, 120, 1, good_per_s=0)
+    _drive(m2, t, 700, 0)
+    assert transitions2 == transitions
+
+
+def test_fast_window_spike_alone_does_not_page():
+    # 20s spike at 100% bad: fast burn explodes (20/60 = 33x budget)
+    # but the slow window (600 s) peaks at 20/600 = 3.3x < 6x ->
+    # multi-window gating keeps the page from firing.
+    m = _mk_machine()
+    t = _drive(m, 0.0, 600, 0)
+    burned = []
+    m.on_state = lambda o, a, b: burned.append(b)
+    t = _drive(m, t, 20, 1, good_per_s=0)
+    assert m.fast_burn >= 6.0
+    assert STATE_BURNING not in burned
+    assert m.state in (STATE_OK, STATE_WARNING)
+
+
+def test_hysteresis_prevents_threshold_flap():
+    # hover the violation rate around the warn threshold: 2x budget
+    # for a minute, then 0.9x (inside the 0.8x hysteresis exit band).
+    # Without hysteresis this flaps warning<->ok on every dip.
+    m = _mk_machine()
+    flips = []
+    m.on_state = lambda o, a, b: flips.append((a, b))
+    t = _drive(m, 0.0, 660, 0, n=1000)
+    for _ in range(10):
+        t = _drive(m, t, 60, 20, n=1000)  # 2.0% bad = 2.0x budget
+        t = _drive(m, t, 60, 9, n=1000)   # 0.9% bad = 0.9x budget
+    assert flips.count((STATE_OK, STATE_WARNING)) == 1
+    assert (STATE_WARNING, STATE_OK) not in flips
+    assert (STATE_WARNING, STATE_BURNING) not in flips
+
+
+def test_budget_remaining_accounting():
+    m = _mk_machine()
+    assert m.budget_remaining() == 1.0
+    for i in range(1000):
+        m.observe(float(i), 0.5 if i < 5 else 0.05)
+    m.evaluate(999.0)
+    # 5 bad / 1000 total against a 1% budget -> half the budget left
+    assert abs(m.budget_remaining() - 0.5) < 1e-9
+    st = m.status()
+    assert st["good"] == 995 and st["bad"] == 5
+    assert st["state"] == m.state
+
+
+# ---------------------------------------------------------------------------
+# monitor: tenants, cadence, summary
+# ---------------------------------------------------------------------------
+
+
+def _mk_monitor(**kw):
+    cfg = SLOConfig(objectives=(parse_objective("p99:ttft:0.2"),
+                                parse_objective("p99:ttft:0.2:acme")),
+                    eval_every_s=1.0, snapshot_every_s=5.0)
+    t = [0.0]
+    mon = SLOMonitor(cfg, clock=lambda: t[0], **kw)
+    return mon, t
+
+
+def test_monitor_tenant_labels_and_overflow_fold():
+    cfg = SLOConfig(objectives=(parse_objective("p99:ttft:0.2"),))
+    t = [0.0]
+    mon = SLOMonitor(cfg, clock=lambda: t[0], max_tenants=2)
+    mon.observe("ttft", 0.05, tenant="a")
+    mon.observe("ttft", 0.05, tenant="b")
+    mon.observe("ttft", 0.05, tenant="c")  # folds into _overflow
+    mon.observe("ttft", 0.07)              # global only
+    names = set(mon.status()["tenants"])
+    assert "a" in names and "b" in names and "c" not in names
+    assert mon.sketch("ttft").count == 4   # folding must not double-count
+
+
+def test_monitor_tick_cadence_and_snapshots():
+    rec = FlightRecorder(capacity=512, clock=lambda: 0.0)
+    mon, t = _mk_monitor(recorder=rec)
+    assert mon.tick() is False  # first tick arms, never evaluates
+    for i in range(1, 12):
+        t[0] = float(i)
+        mon.observe("ttft", 0.05)
+        mon.tick()
+    kinds = [e["event"] for e in FlightRecorder.to_dicts(rec.events())]
+    assert kinds.count("slo_eval") >= 10       # 1 Hz eval cadence
+    assert kinds.count("slo_sketch") >= 1      # 5 s snapshot cadence
+    snap = next(e for e in FlightRecorder.to_dicts(rec.events()) if e["event"] == "slo_sketch")
+    assert snap["metric"] in METRICS and snap["count"] >= 1
+
+
+def test_monitor_summary_and_alert_counter():
+    mon, t = _mk_monitor()
+    mon.tick()
+    for i in range(1, 1300):
+        t[0] = float(i)
+        mon.observe("ttft", 0.5, tenant="acme")  # everything violates
+        mon.tick()
+    s = mon.summary()
+    assert s["slo_state"] == 2.0               # burning (worst state)
+    assert s["slo_alerts"] >= 1.0
+    assert s["slo_budget_remaining"] < 1.0
+    assert s["slo_ttft_p99_ms"] >= 490.0       # 0.5 s in ms, within gamma
+    assert mon.worst_state() == STATE_BURNING
+    pct = mon.percentiles("ttft")
+    assert pct["count"] == 1299.0 and pct["p50_ms"] > 0.0
+
+
+def test_monitor_rejects_duplicate_objectives():
+    cfg = SLOConfig(objectives=(parse_objective("p99:ttft:0.2"),
+                                parse_objective("p99:ttft:0.2")))
+    with pytest.raises(ValueError):
+        SLOMonitor(cfg)
+
+
+# ---------------------------------------------------------------------------
+# replay: recorded slo_eval stream re-derives alerts bit-identically
+# ---------------------------------------------------------------------------
+
+
+def _recorded_run():
+    cfg = SLOConfig(objectives=(parse_objective("p99:ttft:0.2"),),
+                    eval_every_s=1.0, snapshot_every_s=30.0)
+    t = [0.0]
+    rec = FlightRecorder(capacity=8192, clock=lambda: t[0])
+    mon = SLOMonitor(cfg, clock=lambda: t[0], recorder=rec)
+    mon.tick()
+    for i in range(1, 760):
+        t[0] = float(i)
+        bad = 620 <= i < 690  # a 70s full outage mid-run
+        mon.observe("ttft", 0.5 if bad else 0.05)
+        mon.tick()
+    return cfg, FlightRecorder.to_dicts(rec.events())
+
+
+def test_compare_alerts_round_trips_bit_identically():
+    cfg, events = _recorded_run()
+    # through JSON, as a bundle would carry them
+    events = json.loads(json.dumps(events))
+    out = compare_alerts(cfg, events)
+    assert out["transitions_recorded"] >= 2
+    assert out["transitions_replayed"] == out["transitions_recorded"]
+    assert out["mismatches"] == []
+
+
+def test_compare_alerts_detects_corrupted_history():
+    cfg, events = _recorded_run()
+    evals = [e for e in events if e["event"] == "slo_eval"]
+    assert evals, "run must have recorded evaluations"
+    # flip one recorded window count: replay must flag drift, because
+    # the regenerated transition stream no longer matches the recording
+    evals[len(evals) // 2]["slow_bad"] += 500
+    out = compare_alerts(cfg, events)
+    assert out["mismatches"] != []
+
+
+def test_replay_slo_from_synthetic_bundle():
+    cfg, events = _recorded_run()
+    bundle = {
+        "config.json": {"scheduler": {"slo": cfg.to_dict()}},
+        "manifest.json": {"flightrec": {"events_dropped": 0}},
+        "events.jsonl": json.loads(json.dumps(events)),
+    }
+    out = replay_slo(bundle)
+    assert out["mismatches"] == []
+    assert out["transitions_recorded"] >= 2
+    assert out["evaluations"] >= 700
+    # no slo block in config -> replay_slo declines, not crashes
+    assert replay_slo({"config.json": {"scheduler": {}},
+                       "manifest.json": {}, "events.jsonl": []}) is None
+    dropped = dict(bundle)
+    dropped["manifest.json"] = {"flightrec": {"events_dropped": 3}}
+    assert "skipped" in replay_slo(dropped)

@@ -45,7 +45,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: slow-marking the two-engine scheduler prefix-detection composition
 #: (its two suppressions removed) and the spec×constrained composition
 #: (one removed) — see the `multi-tenant tier-1 offset` markers
-MAX_ACTIVE_SUPPRESSIONS = 22
+#: 22 -> 21 (slo-observatory PR): test_slo.py is host-only (no warmup,
+#: no new suppressions); the quantized+prefix+guard composition in
+#: test_kv_cache was slow-marked as the tier-1 runtime offset and its
+#: one suppression removed — see the `slo-observatory tier-1 offset`
+#: marker
+MAX_ACTIVE_SUPPRESSIONS = 21
 
 
 def _rules_of(result):
@@ -657,6 +662,37 @@ def test_metric_drift_label_and_alternation_tokens(tmp_path):
         "\n".join(f.render() for f in res.findings)
 
 
+def test_metric_drift_slo_family_pos_and_neg(tmp_path):
+    """The SLO observatory's gauge families follow the labelled-family
+    shape (`serving_slo_quantile_seconds{metric="ttft",quantile="p99"}`
+    in the doc) — pin that the rule accepts the documented spelling
+    AND still fires on an slo-prefixed orphan/ghost pair."""
+    res = _synth(tmp_path, {
+        "apex_tpu/__init__.py": "",
+        "apex_tpu/serving/__init__.py": "",
+        "apex_tpu/serving/sched.py": '''
+            def wire(registry):
+                registry.gauge("serving_slo_quantile_seconds", "",
+                               labels=("metric", "quantile"))
+                registry.counter("serving_slo_alerts_total", "",
+                                 labels=("objective", "state"))
+                registry.gauge("serving_slo_orphan", "undocumented")
+        ''',
+        "docs/API.md":
+            '`serving_slo_quantile_seconds{metric="ttft",quantile="p99"}`'
+            ' and `serving_slo_alerts_total{objective="o",state="s"}` '
+            'are exported, as is `serving_slo_ghost_total`.\n',
+    }, targets=["apex_tpu"])
+    hits = [f for f in res.findings if f.rule == "METRIC-DRIFT"]
+    msgs = "\n".join(f.render() for f in hits)
+    assert len(hits) == 2, msgs
+    assert any("serving_slo_ghost_total" in f.message
+               and f.path == "docs/API.md" for f in hits), msgs
+    assert any("serving_slo_orphan" in f.message
+               and f.path == "apex_tpu/serving/sched.py"
+               for f in hits), msgs
+
+
 # --------------------------------------------------------------------------
 # EVENT-DRIFT
 # --------------------------------------------------------------------------
@@ -780,6 +816,46 @@ def test_event_drift_absent_on_foreign_trees(tmp_path):
     }, targets=["apex_tpu"], rules=["EVENT-DRIFT"])
     assert "EVENT-DRIFT" not in _rules_of(res), \
         "\n".join(f.render() for f in res.findings)
+
+
+def test_event_drift_slo_vocabulary_pos_and_neg(tmp_path):
+    """SLO burn/alert events ride the same vocabulary contract: a
+    documented + recorded `slo_state` stays clean, a recorded-but-
+    unregistered `slo_ghost` fires at the call site, and a vocabulary
+    entry `slo_dead` with no record() call fires as dead vocabulary."""
+    res = _synth(tmp_path, {
+        "apex_tpu/__init__.py": "",
+        "apex_tpu/telemetry/__init__.py": "",
+        "apex_tpu/telemetry/flightrec.py": '''
+            EVENT_FIELDS = {
+                "slo_state": ("objective", "from", "to",
+                              "fast_burn", "slow_burn"),
+                "slo_dead": ("x",),
+            }
+        ''',
+        "apex_tpu/serving/__init__.py": "",
+        "apex_tpu/serving/sched.py": '''
+            def wire(recorder):
+                recorder.record("slo_state", "o", "ok", "warning",
+                                1.0, 1.0)
+                recorder.record("slo_ghost", 1)
+        ''',
+        "docs/API.md": ("#### Flight-recorder event names\n"
+                        "| event | fields | meaning |\n"
+                        "|---|---|---|\n"
+                        "| `slo_state` | objective, from, to, "
+                        "fast_burn, slow_burn | transition |\n"
+                        "| `slo_dead` | x | never recorded |\n"),
+    }, targets=["apex_tpu"], rules=["EVENT-DRIFT"])
+    hits = [f for f in res.findings if f.rule == "EVENT-DRIFT"]
+    msgs = "\n".join(f.render() for f in hits)
+    assert any("'slo_ghost'" in f.message
+               and f.path == "apex_tpu/serving/sched.py"
+               for f in hits), msgs
+    assert any("'slo_dead'" in f.message
+               and "no record() call" in f.message for f in hits), msgs
+    assert not any("slo_state" in f.message for f in hits), msgs
+    assert len(hits) == 2, msgs
 
 
 # --------------------------------------------------------------------------
