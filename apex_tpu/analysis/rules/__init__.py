@@ -4,6 +4,7 @@ from apex_tpu.analysis.rules.tracer_leak import TracerLeakRule
 from apex_tpu.analysis.rules.donation import UseAfterDonateRule
 from apex_tpu.analysis.rules.recompile_hazard import RecompileHazardRule
 from apex_tpu.analysis.rules.page_table_static import PageTableStaticRule
+from apex_tpu.analysis.rules.host_tier_static import HostTierStaticRule
 from apex_tpu.analysis.rules.adapter_static import AdapterStaticRule
 from apex_tpu.analysis.rules.warmup_coverage import WarmupCoverageRule
 from apex_tpu.analysis.rules.abi_lockstep import AbiLockstepRule
@@ -17,6 +18,7 @@ ALL_RULES = [
     UseAfterDonateRule(),
     RecompileHazardRule(),
     PageTableStaticRule(),
+    HostTierStaticRule(),
     AdapterStaticRule(),
     WarmupCoverageRule(),
     AbiLockstepRule(),

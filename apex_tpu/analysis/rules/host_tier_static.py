@@ -1,0 +1,123 @@
+"""HOST-TIER-STATIC: host-mirror geometry must be config-derived.
+
+PAGE-TABLE-STATIC's sibling, one tier down. The host-swap layer
+(``serving/hostswap.py``) moves parked conversations' pages through
+COMPILED gather/scatter programs — one variant per swap-batch rung,
+all warmup-covered — so every array that crosses the swap boundary
+(pinned host buffers, page-index vectors, spill staging rows for
+adapter paging) must have a shape spelled from the engine config
+(``swap_rungs(max_pages)``, ``page_size``, head/dim constants), never
+from a live measurement. The failure mode is identical to a
+``len()``-sized block table but sneakier: sizing a host mirror from
+``len(act.pages)`` or ``payload.size`` *works* — host numpy arrays
+carry no compile contract — right up until that array is fed back
+through ``pages_in``, where its data-dependent shape misses every
+compiled rung and the scatter silently recompiles per parked
+conversation (the exact per-request recompile the rung ladder exists
+to prevent).
+
+Scope (narrow, like the sibling): array constructor calls (``np`` /
+``jnp`` ``zeros``/``ones``/``full``/``empty``) whose result is bound
+to a host-tier-named target (``*host*``, ``*swap*``, ``*spill*``,
+``*park*`` — the naming convention of every host-mirror surface in
+the swap stack). Inside the constructor's SHAPE argument, a
+``len(...)`` call or a ``.size``/``.shape`` attribute read is
+flagged. Contents are unconstrained — a host buffer is data; only
+its geometry is contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Tuple
+
+from apex_tpu.analysis._astutil import dotted
+from apex_tpu.analysis.core import Finding, Project
+
+#: host-tier-named binding targets — the host-mirror naming convention
+#: of the swap stack (``host_buf``, ``_swap_rows``, ``spill_stage``);
+#: generic names (``row``, ``buf``) are excluded: only names that SAY
+#: host/swap/spill/park are held to the geometry contract
+_HOST_RE = re.compile(r"(?i)(^|_)(host|swap|spill|park(ed)?)(_|\d|$)")
+
+#: array constructors whose first argument is a shape
+_CTORS = {"zeros", "ones", "full", "empty"}
+_MODULES = {"np", "numpy", "jnp"}
+
+
+def _target_names(node: ast.Assign) -> List[str]:
+    out: List[str] = []
+    for t in node.targets:
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, ast.Attribute):
+            out.append(t.attr)
+    return out
+
+
+def _shape_arg(call: ast.Call) -> ast.AST:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "shape":
+            return kw.value
+    return call
+
+
+class HostTierStaticRule:
+    id = "HOST-TIER-STATIC"
+    summary = ("host-mirror array shapes (swap buffers, spill staging) "
+               "must be config-derived rung constants — len()/.size of "
+               "live data in a host-tier shape recompiles the swap "
+               "program per parked conversation")
+    triggers: Tuple[str, ...] = ()
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for ctx in project.targets:
+            if ctx.tree is None:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Assign) \
+                        or not isinstance(node.value, ast.Call):
+                    continue
+                call = node.value
+                d = dotted(call.func)
+                if d is None:
+                    continue
+                parts = d.split(".")
+                if len(parts) != 2 or parts[0] not in _MODULES \
+                        or parts[1] not in _CTORS:
+                    continue
+                names = [n for n in _target_names(node)
+                         if _HOST_RE.search(n)]
+                if not names:
+                    continue
+                findings.extend(self._scan_shape(
+                    ctx, names[0], _shape_arg(call)))
+        return findings
+
+    def _scan_shape(self, ctx, name: str, shape: ast.AST
+                    ) -> List[Finding]:
+        findings: List[Finding] = []
+        for n in ast.walk(shape):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                    and n.func.id == "len":
+                findings.append(Finding(
+                    self.id, ctx.rel, n.lineno,
+                    f"len(...) flows into the shape of host-tier "
+                    f"array {name!r} — swap-boundary geometry must be "
+                    f"a config-derived rung constant (plan_rungs over "
+                    f"swap_rungs(max_pages)), or every parked "
+                    f"conversation compiles a new swap program",
+                    col=n.col_offset))
+            elif isinstance(n, ast.Attribute) and n.attr in ("size",
+                                                            "shape"):
+                findings.append(Finding(
+                    self.id, ctx.rel, n.lineno,
+                    f".{n.attr} of a runtime array flows into the "
+                    f"shape of host-tier array {name!r} — derive the "
+                    f"shape from engine config, not from live data",
+                    col=n.col_offset))
+        return findings

@@ -2426,6 +2426,19 @@ def cache_insert_pages(cache, blocks, pages, *, page_size: int):
     return cache
 
 
+def cache_gather_pages(cache, pages):
+    """The host-swap tier's compiled gather: pull ``n`` whole pages
+    (``pages [n] int32``, traced) out of a PAGED cache along the page
+    dim — ``[l, 2, n, hl, P, d]`` in the cache's own STORAGE dtype
+    (the quantized pytree gathers both planes), so a swapped-out block
+    round-trips through host RAM bit-exactly and
+    :func:`cache_insert_pages` can scatter it straight back with
+    ``pages[:, None]``. ``n`` is static from the index shape — one
+    compiled variant per swap-batch rung."""
+    idx = jnp.asarray(pages, jnp.int32)
+    return jax.tree.map(lambda x: jnp.take(x, idx, axis=2), cache)
+
+
 def cache_gather_page(cache, page, length: int):
     """The prefix pool's compiled gather: slice page ``page`` (traced
     scalar, dim 2) of a pool cache down to its first ``length`` (static)

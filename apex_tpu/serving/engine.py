@@ -44,6 +44,7 @@ front). Host-side policy (queueing, deadlines, metrics) lives in
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -53,7 +54,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.models import gpt
-from apex_tpu.serving import sampling
+from apex_tpu.serving import hostswap, sampling
 from apex_tpu.serving.pages import SINK, PageAllocator, PagesExhausted
 from apex_tpu.telemetry.recompile import expected_compiles
 from apex_tpu.serving.resilience import (
@@ -227,6 +228,33 @@ class EngineConfig:
     adapter_rank: int = 8
     #: LoRA scaling numerator: deltas apply as ``(alpha / r) * B A x``.
     adapter_alpha: float = 16.0
+    #: host-RAM page tier under the device pool (paged mode only —
+    #: requires ``page_size > 0``). True compiles the swap programs
+    #: (``pages_out``/``pages_in`` gather/scatter over the page dim,
+    #: one variant per power-of-two swap-batch rung, all warmed) and
+    #: arms :meth:`Engine.park_slot` / :meth:`Engine.resume_slot`: a
+    #: paused conversation's private pages move to host buffers in
+    #: storage form (bit-exact round trip, quantized planes included)
+    #: so active streams keep every HBM page, and the scheduler can
+    #: oversubscribe the pool far past ``num_pages``. Also lifts the
+    #: ``register_adapter`` hard cap: cold adapter rows spill to host
+    #: under the same LRU and page back in on demand (ids stay DATA —
+    #: no recompile). False = the historical hard-capped engine.
+    host_swap: bool = False
+    #: host-tier capacity in PAGES (0 = unbounded): parking past it
+    #: LRU-drops the coldest payloads, whose conversations fall back
+    #: to recompute-resume from the emitted-prefix snapshot.
+    host_swap_pages: int = 0
+    #: how a parked conversation comes back: ``"swap"`` scatters the
+    #: host payload into freshly allocated pages and restores the
+    #: slot's state row (PRNG key included — bit-identical
+    #: continuation); ``"recompute"`` drops the payload and replays
+    #: prompt + emitted prefix through the fault-replay machinery
+    #: (also bit-identical — same seed, suppressed re-emission);
+    #: ``"auto"`` prices the two per resume from measured swap-in cost
+    #: vs replay cost and picks the cheaper. Both paths are pinned
+    #: equal, so the policy is pure performance.
+    resume_policy: str = "auto"
 
 
 #: eos sentinel in the per-slot eos vector: no stop token for this slot
@@ -560,6 +588,27 @@ class Engine:
                     (ps, tb) for ps, tb in self._extend_variants
                     if ps in splits)
                 self._prefix_splits = splits
+        # -- host-swap tier geometry (rungs config-derived from the
+        # worst-case private page count — HOST-TIER-STATIC) -------------
+        if ecfg.resume_policy not in ("auto", "swap", "recompute"):
+            raise ValueError(
+                f"resume_policy {ecfg.resume_policy!r} must be one of "
+                f"'auto' | 'swap' | 'recompute'")
+        if ecfg.host_swap_pages < 0:
+            raise ValueError(
+                f"host_swap_pages {ecfg.host_swap_pages} must be >= 0")
+        self._host_swap = bool(ecfg.host_swap)
+        if self._host_swap and not self._paged:
+            raise ValueError(
+                "host_swap requires the paged KV cache (page_size > 0) "
+                "— the swap tier moves pages, not contiguous stripes")
+        if ecfg.host_swap_pages and not self._host_swap:
+            raise ValueError(
+                "host_swap_pages without host_swap — the host tier "
+                "only exists with host_swap=True")
+        self._swap_rungs: Tuple[int, ...] = ()
+        if self._host_swap:
+            self._swap_rungs = hostswap.swap_rungs(self._max_pages)
         # -- chunked prefill geometry -----------------------------------
         if ecfg.prefill_chunk < 0:
             raise ValueError(
@@ -645,6 +694,28 @@ class Engine:
         self._adapter_meta: Dict[int, Dict[str, Any]] = {}
         self._adapter_used = 1 if self._lora else 0  # row 0 pinned
         self.adapters: Optional[Any] = None
+        #: host-swap tier state: the parked-conversation LRU store
+        #: (opaque payloads: storage-form page blocks + the slot's
+        #: state row + table/mask/adapter mirrors) and the measured
+        #: per-page swap-in cost the auto resume policy prices from
+        self._host_tier: Optional[hostswap.HostPageTier] = None
+        self._swap_in_ewma_s = 0.0
+        if self._host_swap:
+            self._host_tier = hostswap.HostPageTier(ecfg.host_swap_pages)
+        #: adapter paging (host_swap engines): every registration's
+        #: host-side weight rows (virtual id → numpy pytree), the
+        #: virtual → physical residency maps, and the LRU over resident
+        #: physical rows. Without host_swap these stay empty and
+        #: virtual == physical (the historical hard-capped registry).
+        self._adapter_rows_host: Dict[int, Any] = {}
+        self._adapter_phys: Dict[int, int] = {}
+        self._adapter_virt: Dict[int, int] = {}
+        self._adapter_lru = hostswap.LRUIndex()
+        self._adapter_free_rows: List[int] = (
+            list(range(ecfg.adapter_slots - 1, 0, -1))
+            if self._lora and self._host_swap else [])
+        self._adapter_spills = 0
+        self._adapter_pageins = 0
         self._build()
         with expected_compiles():
             # construction compiles (the init programs materialise
@@ -1056,6 +1127,45 @@ class Engine:
         self._retire = sm(retire_local, (state_spec, scalar), state_spec,
                           donate=(0,))
 
+        # -- host-swap tier programs (host_swap=True) ---------------------
+        # pages_out gathers n whole pages (storage form — the quantized
+        # planes travel as-is, so the host round trip is bit-exact) and
+        # pages_in scatters them back; one compiled variant per
+        # power-of-two swap-batch rung (plan_rungs decomposes any
+        # count), all warmed, both enumerated by _swap_program_items so
+        # the recompile sentinel and the flatness pin cover them. The
+        # gather does NOT donate (the cache keeps serving); the scatter
+        # donates the cache exactly like every other insert.
+        self._swap_outs: Dict[int, Any] = {}
+        self._swap_ins: Dict[int, Any] = {}
+        if self._host_swap:
+            def swap_out_local(cache, pages):
+                return gpt.cache_gather_pages(cache, pages)
+
+            def swap_in_local(cache, block, pages):
+                return gpt.cache_insert_pages(cache, block,
+                                              pages[:, None],
+                                              page_size=p_sz)
+
+            for n in self._swap_rungs:
+                self._swap_outs[n] = sm(
+                    swap_out_local, (cache_spec, scalar), cache_spec)
+                self._swap_ins[n] = sm(
+                    swap_in_local, (cache_spec, cache_spec, scalar),
+                    cache_spec, donate=(0,))
+
+            # the resume scatter's state half: write one parked slot's
+            # full state row (PRNG key included — the sampled-parity
+            # crux) back at a traced slot index, donating state like
+            # retire does
+            def state_restore_local(state, row, slot):
+                return {k: state[k].at[slot].set(row[k][0])
+                        for k in state}
+
+            self._state_restore = sm(
+                state_restore_local, (state_spec, state_spec, scalar),
+                state_spec, donate=(0,))
+
         # -- chunked-prefill programs (prefill_chunk > 0) -----------------
         # chunk 0 is a bucket-sized cold prefill into the compute-dtype
         # scratch; chunk i attends the scratch's first i*C columns
@@ -1433,6 +1543,11 @@ class Engine:
         admission's overwrite)."""
         if self._paged:
             self._free_slot_pages(slot)
+        if self._host_swap and self._lora:
+            # unpin the slot's adapter row so the paging LRU can spill
+            # it (done lanes emit pad regardless of the row they read,
+            # so rebinding a freed slot to base is stream-invisible)
+            self._set_slot_adapter(slot, 0)
 
     def page_stats(self) -> Optional[Dict[str, float]]:
         """Allocator occupancy snapshot (None in contiguous mode)."""
@@ -1478,6 +1593,201 @@ class Engine:
         self._page_alloc.used_tokens += footprint
         self._slot_pages[slot] = (priv, shared, footprint)
         return self._tables[slot]
+
+    # -- host-swap tier (EngineConfig.host_swap) ---------------------------
+
+    @property
+    def host_swap_enabled(self) -> bool:
+        """True when ``EngineConfig.host_swap`` is on."""
+        return self._host_swap
+
+    def host_parked(self, key: Any) -> bool:
+        """Whether ``key``'s swap payload is still in the host tier
+        (False after a capacity eviction — the recompute-fallback
+        signal)."""
+        return (self._host_tier is not None
+                and key in self._host_tier)
+
+    def swap_in_cost_s(self, n_pages: int) -> Optional[float]:
+        """Measured swap-in wall cost for ``n_pages`` (the per-page
+        EWMA the auto resume policy prices against replay); ``None``
+        before the first measured resume."""
+        if self._swap_in_ewma_s <= 0.0:
+            return None
+        return self._swap_in_ewma_s * max(n_pages, 1)
+
+    def host_tier_stats(self) -> Optional[Dict[str, float]]:
+        """Host-tier occupancy snapshot (None without host_swap)."""
+        if self._host_tier is None:
+            return None
+        return self._host_tier.stats()
+
+    def parked_pages(self, key: Any) -> int:
+        """Private pages ``key``'s parked payload holds (0 when not
+        swap-parked) — what a swap-resume must allocate."""
+        if self._host_tier is None:
+            return 0
+        ent = self._host_tier._entries.get(key)
+        return 0 if ent is None else ent.n_pages
+
+    def parked_bytes(self, key: Any) -> int:
+        """Host-RAM bytes ``key``'s parked payload holds (0 when not
+        swap-parked) — the ``page_swap_out`` flight event's byte
+        field."""
+        if self._host_tier is None:
+            return 0
+        ent = self._host_tier._entries.get(key)
+        return 0 if ent is None else ent.nbytes
+
+    def slot_page_count(self, slot: int) -> int:
+        """PRIVATE pages ``slot``'s live mapping holds (0 when
+        unmapped, or in contiguous mode) — what preempting the slot
+        would free back to the pool."""
+        if not self._paged:
+            return 0
+        ent = self._slot_pages.get(slot)
+        return 0 if ent is None else len(ent[0])
+
+    def park_slot(self, slot: int, key: Any) -> List[Any]:
+        """Swap ``slot`` out to the host tier under ``key``: gather its
+        PRIVATE pages (compiled per-rung ``pages_out`` — storage form,
+        bit-exact round trip) and its full state row (PRNG key
+        included) into a host payload, retire the lane, free the
+        device pages, and park the payload in the LRU. Shared
+        copy-on-write prefix pages never move — they drop the slot's
+        pin here and re-pin at resume (the registration pin keeps them
+        alive and :meth:`rebuild_slots` re-pages them into the same
+        ids, so a parked conversation even survives a fault rebuild).
+
+        Returns the keys the tier capacity-evicted to make room
+        (possibly including ``key`` itself) — the caller downgrades
+        those conversations to recompute-resume; their page/byte
+        accounting is dropped here. The caller must ensure no chunk is
+        in flight (parking never happens mid-chunk — the dispatched
+        tables still map the pages being freed)."""
+        self._check_poisoned()
+        if not self._host_swap:
+            raise ValueError(
+                "park_slot without host_swap (EngineConfig.host_swap "
+                "== False)")
+        if not 0 <= slot < self.slots:
+            raise ValueError(f"slot {slot} outside [0, {self.slots})")
+        ent = self._slot_pages.get(slot)
+        if ent is None:
+            raise ValueError(
+                f"slot {slot} has no page mapping — nothing to park")
+        priv, shared, footprint = ent
+        # the state row FIRST (retire below flips its done flag)
+        row = {k: np.asarray(self.state[k])[slot:slot + 1].copy()
+               for k in self.state}
+        blocks: List[Tuple[int, Any]] = []
+        off = 0
+        for n in hostswap.plan_rungs(len(priv)):
+            chunk = np.asarray(priv[off:off + n], np.int32)
+            blocks.append((n, jax.tree.map(
+                np.asarray, self._swap_outs[n](self.cache, chunk))))
+            off += n
+        nbytes = int(sum(x.nbytes for _, b in blocks
+                         for x in jax.tree.leaves(b)))
+        payload = {
+            "blocks": blocks, "state": row, "shared": list(shared),
+            "n_priv": len(priv), "footprint": footprint,
+            "mask": self._masks[slot].copy(),
+            "adapter": int(self._adapter_virtual(
+                int(self._adapter_ids[slot]))),
+        }
+        # freeze the lane, then release its device footprint: the
+        # table row redirects to the sink, so the frozen column's
+        # writes land in garbage
+        self.retire(slot)
+        self._free_slot_pages(slot)
+        self._page_alloc.note_swap_out(len(priv), nbytes)
+        evicted = self._host_tier.park(key, payload, len(priv), nbytes)
+        out: List[Any] = []
+        for ek, e in evicted:
+            self._page_alloc.note_swap_drop(e.n_pages, e.nbytes)
+            out.append(ek)
+        return out
+
+    def resume_slot(self, slot: int, key: Any) -> None:
+        """Swap ``key``'s parked conversation back into ``slot``:
+        allocate fresh private pages (:class:`PagesExhausted`
+        propagates BEFORE any device work — check
+        ``page_allocator.can_alloc(parked_pages(key))`` first), re-pin
+        its shared prefix pages, scatter the host payload through the
+        per-rung ``pages_in`` programs, and restore the state row /
+        vocab mask / adapter binding. The continued stream is
+        bit-identical to an uninterrupted run (the restored PRNG key
+        and token-history ring carry the sampled path). Raises
+        ``KeyError`` when the payload was capacity-evicted — the
+        caller's recompute fallback."""
+        self._check_poisoned()
+        if not self._host_swap:
+            raise ValueError(
+                "resume_slot without host_swap (EngineConfig.host_swap "
+                "== False)")
+        if not 0 <= slot < self.slots:
+            raise ValueError(f"slot {slot} outside [0, {self.slots})")
+        if slot in self._slot_pages:
+            raise ValueError(
+                f"slot {slot} still holds a page mapping — free it "
+                f"before resuming into it")
+        if not self.host_parked(key):
+            raise KeyError(
+                f"{key!r} has no host payload (capacity-evicted or "
+                f"never swap-parked) — resume by recompute")
+        t0 = time.perf_counter()
+        ent = self._host_tier.take(key)
+        p = ent.payload
+        n_priv, shared = p["n_priv"], p["shared"]
+        priv = self._page_alloc.alloc(n_priv)
+        self._page_alloc.share(shared)
+        tab = np.full((self._max_pages,), SINK, np.int32)
+        tab[:len(shared)] = shared
+        tab[len(shared):len(shared) + n_priv] = priv
+        self._tables[slot] = tab
+        self._tables_dev = None
+        self._page_alloc.used_tokens += p["footprint"]
+        self._slot_pages[slot] = (priv, list(shared), p["footprint"])
+        try:
+            off = 0
+            for n, block in p["blocks"]:
+                self.cache = self._swap_ins[n](
+                    self.cache, block,
+                    np.asarray(priv[off:off + n], np.int32))
+                off += n
+            self.state = self._state_restore(self.state, p["state"],
+                                             np.int32(slot))
+        except Exception:
+            # the scatter DONATES cache/state — a failure may have
+            # consumed them; poison until rebuild_slots() like every
+            # other donating seam (the payload is already consumed, so
+            # the caller falls back to recompute)
+            self._free_slot_pages(slot)
+            self._poisoned = True
+            raise
+        if not np.array_equal(self._masks[slot], p["mask"]):
+            self._masks[slot] = p["mask"]
+            self._masks_dev = None
+        self._bind_slot_adapter(slot, p["adapter"])
+        self._page_alloc.note_swap_in(n_priv, ent.nbytes)
+        # sync via value fetch (never block_until_ready) so the EWMA
+        # prices the whole round trip the auto policy compares
+        np.asarray(self.state["tok"])
+        sample = (time.perf_counter() - t0) / max(n_priv, 1)
+        self._swap_in_ewma_s = (
+            sample if self._swap_in_ewma_s <= 0.0
+            else 0.7 * self._swap_in_ewma_s + 0.3 * sample)
+
+    def drop_parked(self, key: Any) -> None:
+        """Discard ``key``'s swap payload (a recompute-resume or an
+        expired parked conversation) — accounting only, no device
+        work. No-op when absent."""
+        if self._host_tier is None:
+            return
+        ent = self._host_tier.take(key)
+        if ent is not None:
+            self._page_alloc.note_swap_drop(ent.n_pages, ent.nbytes)
 
     def register_prefix(self, tokens) -> int:
         """Prefill a shared prompt prefix (a system-prompt template)
@@ -1676,6 +1986,27 @@ class Engine:
                         f"expected {shape} (rank/layers/hidden are "
                         f"compile-time static — ADAPTER-STATIC)")
                 row[site][part] = arr
+        if self._host_swap:
+            # paged registry: ids are LOGICAL (no cap — hundreds of
+            # registrations against a static pool); the row lives in
+            # the host registry and pages into a physical pool row at
+            # admission (immediately while free rows remain, so the
+            # under-capacity path matches the historical engine)
+            idx = self._adapter_used
+            self._adapter_rows_host[idx] = row
+            self._adapter_used += 1
+            if self._adapter_free_rows:
+                try:
+                    self._adapter_physical(idx)
+                except Exception:
+                    self._adapter_rows_host.pop(idx, None)
+                    self._adapter_used -= 1
+                    raise
+            self._adapter_names[name] = idx
+            self._adapter_meta[idx] = {
+                "id": idx, "name": name, "seed": seed,
+                "rank": self.engine_cfg.adapter_rank}
+            return idx
         if self._adapter_used >= self.engine_cfg.adapter_slots:
             raise ValueError(
                 f"adapter pool full ({self.engine_cfg.adapter_slots} "
@@ -1973,12 +2304,14 @@ class Engine:
                 # the slot's decode-path id-table entry is set BEFORE
                 # the dispatch that admits it (the vocab-mask
                 # contract); the admission forward reads the per-row
-                # ids argument
-                for a in batch:
-                    self._set_slot_adapter(a.slot, a.adapter)
+                # (physical — any cold row pages in here, BEFORE the
+                # pool is captured into extra) ids argument
+                phys = [self._adapter_physical(a.adapter)
+                        for a in batch]
+                for a, pr in zip(batch, phys):
+                    self._set_slot_adapter(a.slot, pr)
                 extra += (self.adapters,
-                          np.asarray([a.adapter for a in batch],
-                                     np.int32))
+                          np.asarray(phys, np.int32))
             self.cache, self.state, first, first_lp, hit_eos, done = fn(
                 self._params, self.cache, self.state,
                 arr([a.slot for a in batch], np.int32), prompts,
@@ -2110,7 +2443,7 @@ class Engine:
         if self._paged:
             self._alloc_slot_pages(a.slot, n, a.max_tokens)
         if self._lora:
-            self._set_slot_adapter(a.slot, a.adapter)
+            self._bind_slot_adapter(a.slot, a.adapter)
         c = self._chunk_size
         ca = ChunkedAdmission(a, prompt, n, -(-n // c))
         tok0 = prompt[:c].astype(np.int32)[None]
@@ -2205,13 +2538,79 @@ class Engine:
         self._adapter_ids[slot] = adapter
         self._aids_dev = None
 
+    def _adapter_physical(self, adapter: int) -> int:
+        """Resolve a request's adapter id to its resident pool row,
+        paging the row in from the host registry when cold (host_swap
+        engines — ids stay DATA and the set program is pre-warmed, so
+        a page-in never recompiles; identity elsewhere, where virtual
+        == physical by construction). Eviction skips rows bound to a
+        live slot's id-table entry — spilling one would silently swap
+        weights under a decoding stream."""
+        if not (self._host_swap and self._lora) or adapter == 0:
+            return adapter
+        phys = self._adapter_phys.get(adapter)
+        if phys is not None:
+            self._adapter_lru.touch(phys)
+            return phys
+        if self._adapter_free_rows:
+            phys = self._adapter_free_rows.pop()
+        else:
+            pinned = {int(r) for r in self._adapter_ids if r}
+            phys = self._adapter_lru.pop_coldest(pinned)
+            if phys is None:
+                raise ValueError(
+                    f"adapter pool thrash: every resident row "
+                    f"(adapter_slots={self.engine_cfg.adapter_slots}) "
+                    f"is bound to a live slot — raise adapter_slots")
+            stale = self._adapter_virt.pop(phys)
+            self._adapter_phys.pop(stale, None)
+            self._adapter_spills += 1
+        # NOT donated — a failed page-in leaves every serving row
+        # intact (and the maps untouched: they update after the call)
+        self.adapters = self._adapter_set(
+            self.adapters, self._adapter_rows_host[adapter],
+            np.int32(phys))
+        self._adapter_phys[adapter] = phys
+        self._adapter_virt[phys] = adapter
+        self._adapter_lru.touch(phys)
+        self._adapter_pageins += 1
+        return phys
+
+    def _adapter_virtual(self, phys: int) -> int:
+        """Inverse of :meth:`_adapter_physical` for a bound row — the
+        id a park payload stores, so resume re-resolves (the physical
+        row may have been spilled while parked)."""
+        if not (self._host_swap and self._lora) or phys == 0:
+            return phys
+        return self._adapter_virt.get(phys, 0)
+
+    def _bind_slot_adapter(self, slot: int, adapter: int) -> None:
+        """Resolve-and-bind: the admission/resume seam (virtual in,
+        physical in the slot's id-table entry)."""
+        self._set_slot_adapter(slot, self._adapter_physical(adapter))
+
+    def adapter_paging_stats(self) -> Optional[Dict[str, float]]:
+        """Adapter-paging snapshot (None unless host_swap + adapters):
+        logical registrations vs resident pool rows, spill/page-in
+        traffic."""
+        if not (self._host_swap and self._lora):
+            return None
+        return {
+            "registered": float(self.adapters_registered),
+            "resident": float(len(self._adapter_virt)),
+            "rows": float(self.engine_cfg.adapter_slots - 1),
+            "spills_total": float(self._adapter_spills),
+            "pageins_total": float(self._adapter_pageins),
+        }
+
     def _lora_args(self, adapter: int) -> Tuple[Any, ...]:
         """The trailing (pool, ids) args of a k=1 forward program
         (chunked prefill's chunk/extend dispatches) — empty when the
         pool is disabled."""
         if not self._lora:
             return ()
-        return (self.adapters, np.asarray([adapter], np.int32))
+        aid = self._adapter_physical(adapter)
+        return (self.adapters, np.asarray([aid], np.int32))
 
     def _hist_seed(self, prompt) -> np.ndarray:
         """The drafter-ring admission seed for one prompt: its last
@@ -2569,6 +2968,23 @@ class Engine:
             self.step_async(chunk=c).fetch()
         for (c, k) in sorted(self._spec_variants):
             self.step_async(spec=True, chunk=c, spec_k=k).fetch()
+        if self._host_swap:
+            # the swap tier: gather sink junk out at every rung and
+            # scatter it straight back into the sink page — allocator
+            # untouched, shapes/dtypes exactly what park/resume pass
+            # (host-fetched blocks and state rows), so the armed guard
+            # stays flat across swap churn
+            srow = {k: np.asarray(self.state[k])[:1]
+                    for k in self.state}
+            self.state = self._state_restore(self.state, srow,
+                                             np.int32(0))
+            for n in self._swap_rungs:
+                pages = np.full((n,), SINK, np.int32)
+                block = jax.tree.map(np.asarray,
+                                     self._swap_outs[n](self.cache,
+                                                        pages))
+                self.cache = self._swap_ins[n](self.cache, block,
+                                               pages)
         self.state = self._retire(self.state, np.int32(0))
         # drop the warmup junk: a fresh init (compiled at construction)
         # frees every slot again
@@ -2604,6 +3020,13 @@ class Engine:
             self._adapter_used = 1
             self._adapter_ids[:] = 0
             self._aids_dev = None
+            if self._host_swap:
+                self._adapter_rows_host.clear()
+                self._adapter_phys.clear()
+                self._adapter_virt.clear()
+                self._adapter_lru = hostswap.LRUIndex()
+                self._adapter_free_rows = list(
+                    range(self.engine_cfg.adapter_slots - 1, 0, -1))
 
     def _admit_variant_name(self, bucket: int, k: int) -> str:
         return f"admit_p{bucket}_k{k}"
@@ -2633,6 +3056,20 @@ class Engine:
         if self._lora:
             items.append(("adapter_init", self._adapter_init))
             items.append(("adapter_set", self._adapter_set))
+        return items
+
+    def _swap_program_items(self):
+        """(name, compiled fn) for every host-swap program — shared by
+        :meth:`compiled_cache_sizes` and the recompile sentinel, same
+        contract as :meth:`_prefix_program_items`: one gather + one
+        scatter per swap-batch rung, plus the state-row restore."""
+        items = []
+        if self._host_swap:
+            for n, fn in sorted(self._swap_outs.items()):
+                items.append((f"swap_out_n{n}", fn))
+            for n, fn in sorted(self._swap_ins.items()):
+                items.append((f"swap_in_n{n}", fn))
+            items.append(("state_restore", self._state_restore))
         return items
 
     def _chunk_program_items(self):
@@ -2689,7 +3126,8 @@ class Engine:
                 admit_sizes.append(s)
         for name, fn in (self._prefix_program_items()
                          + self._chunk_program_items()
-                         + self._lora_program_items()):
+                         + self._lora_program_items()
+                         + self._swap_program_items()):
             s = size_of(fn)
             out[name] = s
             if s is not None and name.startswith("admit_prefix"):
@@ -2732,7 +3170,8 @@ class Engine:
                 sentinel.track(self._admit_variant_name(bucket, k), fn)
             for name, fn in (self._prefix_program_items()
                              + self._chunk_program_items()
-                             + self._lora_program_items()):
+                             + self._lora_program_items()
+                             + self._swap_program_items()):
                 sentinel.track(name, fn)
             self._sentinel = sentinel
         return self._sentinel

@@ -110,12 +110,22 @@ class FleetConfig:
     deterministic). ``max_failovers`` bounds how many times one
     request may be failed over before the router completes it with an
     ``error`` outcome (a request that kills every replica it touches
-    must not ping-pong forever)."""
+    must not ping-pong forever).
+
+    ``breaker_half_open`` softens the trip: instead of discarding the
+    replica's in-flight chunks unfetched, the router lets them finish
+    (collects them host-side — no new routes either way) BEFORE
+    evicting, so every failed-over snapshot carries the longest
+    stream its client saw and fewer tokens re-derive on the healthy
+    replicas. Off by default: a watchdog-tripped replica's chunks may
+    be the very thing hanging, and the hard trip must stay the safe
+    floor."""
 
     breaker_watchdog_trips: int = 2
     breaker_guard_alarms: int = 1
     breaker_retry_exhausted: int = 2
     breaker_cooldown_steps: int = 50
+    breaker_half_open: bool = False
     max_failovers: int = 2
     drain_max_steps: int = 100_000
 
@@ -497,10 +507,16 @@ class Router:
         # determinism are untouched
         sticky = (self._tenant_affinity.get(tenant)
                   if tenant is not None else None)
+        # parked conversations and queued resumes are LATENT load: a
+        # host-swap replica's idle slots are spoken for by streams
+        # that will swap back in, so the occupancy key counts them —
+        # routing spreads new arrivals away from oversubscribed
+        # replicas before their resumes reclaim the pages
         return sorted(reps, key=lambda r: (
             0 if r.health_state == HEALTH_OK else 1,
             r.sched.overload_hint_s(),
-            len(r.sched.queue) + len(r.sched.active),
+            len(r.sched.queue) + len(r.sched.active)
+            + len(r.sched._parked) + len(r.sched._resume_q),
             0 if r.index == sticky else 1,
             r.index))
 
@@ -690,7 +706,21 @@ class Router:
         """Open the replica's circuit: evict its current work to the
         healthy replicas, rebuild its buffers, and cool it down out of
         rotation. The health machine stays whatever it was — the
-        breaker is ROUTER policy layered on top."""
+        breaker is ROUTER policy layered on top.
+
+        Half-open mode (``FleetConfig.breaker_half_open``) first
+        collects the replica's in-flight chunks so their tokens land
+        in the eviction snapshots instead of being discarded unfetched
+        — the failed-over streams re-derive less on arrival. A seam
+        fault during that collection recovers through the scheduler's
+        own machinery (snapshots grow either way); the eviction below
+        proceeds regardless."""
+        if self.cfg.breaker_half_open:
+            try:
+                while rep.sched._inflight:
+                    rep.sched._collect_oldest()
+            except Exception:  # collection died with the replica —
+                pass           # eject what the snapshots already hold
         rep.sched.eject_all(f"breaker ({cause})")
         rep.sched.engine.rebuild_slots()
         rep.state = REPLICA_COOLING

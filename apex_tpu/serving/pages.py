@@ -76,7 +76,8 @@ class PageAllocator:
 
     __slots__ = ("num_pages", "page_size", "_free", "_ref",
                  "used_tokens", "allocs_total", "frees_total",
-                 "shares_total")
+                 "shares_total", "host_pages", "host_bytes",
+                 "swap_outs_total", "swap_ins_total")
 
     def __init__(self, num_pages: int, page_size: int):
         if num_pages < 2:
@@ -99,6 +100,17 @@ class PageAllocator:
         self.allocs_total = 0
         self.frees_total = 0
         self.shares_total = 0
+        #: host-tier occupancy (the swap tier under this pool — see
+        #: serving/hostswap.py): pages currently parked in host RAM
+        #: and their byte footprint, plus cumulative swap traffic. The
+        #: engine notes swaps here so ``stats()`` is the one snapshot
+        #: the gauges and flight recorder read. Survives ``reset()``:
+        #: a fault rebuild wipes the DEVICE pool, but parked host
+        #: payloads stay valid (they were copied out).
+        self.host_pages = 0
+        self.host_bytes = 0
+        self.swap_outs_total = 0
+        self.swap_ins_total = 0
 
     # -- core ----------------------------------------------------------------
 
@@ -171,6 +183,31 @@ class PageAllocator:
         self._ref = [0] * self.num_pages
         self.used_tokens = 0
 
+    # -- host tier -----------------------------------------------------------
+
+    def note_swap_out(self, n_pages: int, nbytes: int) -> None:
+        """Record ``n_pages`` leaving the device pool for host RAM
+        (``nbytes`` of storage-form payload). Pure accounting — the
+        actual gather/free is the engine's."""
+        self.host_pages += n_pages
+        self.host_bytes += nbytes
+        self.swap_outs_total += n_pages
+
+    def note_swap_in(self, n_pages: int, nbytes: int) -> None:
+        """Record ``n_pages`` returning from host RAM to the device
+        pool (or being dropped after a recompute-resume — either way
+        the host tier no longer holds them)."""
+        self.host_pages -= n_pages
+        self.host_bytes -= nbytes
+        self.swap_ins_total += n_pages
+
+    def note_swap_drop(self, n_pages: int, nbytes: int) -> None:
+        """Record a parked payload discarded without a device scatter
+        (capacity eviction or a recompute-resume) — it leaves the host
+        tier but is not a swap-in."""
+        self.host_pages -= n_pages
+        self.host_bytes -= nbytes
+
     # -- observability -------------------------------------------------------
 
     def fragmentation(self) -> float:
@@ -197,4 +234,8 @@ class PageAllocator:
             "allocs_total": float(self.allocs_total),
             "frees_total": float(self.frees_total),
             "shares_total": float(self.shares_total),
+            "pages_swapped": float(self.host_pages),
+            "swap_bytes": float(self.host_bytes),
+            "swap_outs_total": float(self.swap_outs_total),
+            "swap_ins_total": float(self.swap_ins_total),
         }

@@ -411,6 +411,20 @@ class _RegistryMetrics:
             "admission waves deferred because the page pool had fewer "
             "free pages than the head request needed (backpressure — "
             "the request stays queued)")
+        # -- host-swap oversubscription (EngineConfig.host_swap) ----------
+        self.pages_swapped = registry.gauge(
+            "serving_pages_swapped",
+            "KV-cache pages parked in the host-RAM swap tier (paused "
+            "conversations' private pages; 0 without host_swap)")
+        self.swap_bytes = registry.gauge(
+            "serving_swap_bytes",
+            "host-RAM bytes held by parked swap payloads (storage-form "
+            "page blocks plus state rows)")
+        self.preemptions = registry.counter(
+            "serving_preemptions_total",
+            "active requests preempted under page pressure (the WFQ "
+            "victim's pages freed; its stream resumes bit-identically "
+            "via fault replay)")
         self.chunked_chunks = registry.counter(
             "serving_chunked_prefill_chunks_total",
             "chunked-prefill chunk forwards dispatched (long-prompt "
@@ -593,6 +607,24 @@ class _ReplayState:
         self.not_before = float("-inf")
 
 
+class _Parked:
+    """One paused conversation in the host-swap tier: the live
+    :class:`_Active` it continues as on a swap-resume (stream state,
+    stop matcher, held tokens — all intact), plus park metadata.
+    ``swap`` flips False when the tier capacity-evicts the payload;
+    the conversation then resumes by recompute from the grow-only
+    emitted-prefix snapshot the park took first."""
+
+    __slots__ = ("act", "n_pages", "swap", "parked_at")
+
+    def __init__(self, act: _Active, n_pages: int, swap: bool,
+                 parked_at: float):
+        self.act = act
+        self.n_pages = n_pages
+        self.swap = swap
+        self.parked_at = parked_at
+
+
 #: _ingest outcomes: the slot is still decoding, was released, or a
 #: retire-seam fault triggered recovery mid-call (the caller must
 #: abandon its unpack/admission loop — scheduler state was rebuilt)
@@ -682,6 +714,7 @@ class Scheduler:
                  bundle_meta: Optional[Dict] = None,
                  max_auto_bundles: int = 4,
                  request_log: int = 4096,
+                 preempt: Optional[bool] = None,
                  on_evict: Optional[
                      Callable[[List[EvictedRequest], str], None]] = None):
         if pipeline_depth < 1:
@@ -825,6 +858,28 @@ class Scheduler:
         self._chunked_chunks = 0
         self._page_share_hits = 0
         self._pages_exhausted_waits = 0
+        #: host-swap oversubscription (EngineConfig.host_swap): paused
+        #: conversations by request id (their _Active intact for a
+        #: swap-resume) and the FIFO of ids queued for resumption —
+        #: drained BEFORE admissions each tick, so a resuming client
+        #: mid-stream never waits behind new arrivals. ``preempt``
+        #: (default: on whenever the engine has a host tier) lets page
+        #: pressure evict the WFQ-furthest-ahead tenant's pages; the
+        #: victim replays bit-identically through the fault machinery.
+        if preempt and not engine.host_swap_enabled:
+            raise ValueError(
+                "preempt=True needs EngineConfig.host_swap — without "
+                "the emitted-prefix replay contract the host tier "
+                "anchors, an evicted stream could not continue")
+        self.preempt = (engine.host_swap_enabled if preempt is None
+                        else bool(preempt))
+        self._parked: Dict[str, _Parked] = {}
+        self._resume_q: Deque[str] = collections.deque()
+        self._pauses = 0
+        self._preemptions = 0
+        self._swap_resumes = 0
+        self._recompute_resumes = 0
+        self._swap_capacity_drops = 0
         self._steps = 0
         self._tokens_emitted = 0
         self._admitted_requests = 0
@@ -922,7 +977,8 @@ class Scheduler:
         if request.request_id in self.completions or any(
                 a.request.request_id == request.request_id
                 for a in self.active.values()) or any(
-                r.request_id == request.request_id for r in self.queue):
+                r.request_id == request.request_id for r in self.queue) \
+                or request.request_id in self._parked:
             raise ValueError(f"duplicate request_id {request.request_id!r}")
         request.sampling.validate()
         prompt = list(request.prompt)
@@ -1165,6 +1221,8 @@ class Scheduler:
                 self.telemetry.pages_shared.set(ps["pages_shared"])
                 self.telemetry.page_fragmentation.set(
                     ps["fragmentation"])
+                self.telemetry.pages_swapped.set(ps["pages_swapped"])
+                self.telemetry.swap_bytes.set(ps["swap_bytes"])
         if self.metrics is not None:
             elapsed = max(self.clock() - self._started, 1e-9)
             self.metrics.log(self._steps, {
@@ -1199,7 +1257,7 @@ class Scheduler:
         via ``sleep`` instead of spinning."""
         steps = 0
         while (self.queue or self.active or self._inflight
-               or self._chunked is not None):
+               or self._chunked is not None or self._resume_q):
             self.step()
             steps += 1
             if steps > max_steps:
@@ -1219,10 +1277,12 @@ class Scheduler:
 
     def idle(self) -> bool:
         """True when there is nothing to do — queue, slots, pipeline,
-        and any chunked admission are all empty (the API driver thread
-        sleeps instead of spinning ticks)."""
+        any chunked admission, and the resume queue are all empty (the
+        API driver thread sleeps instead of spinning ticks). Parked
+        conversations do NOT count: they wait for an explicit
+        :meth:`resume`."""
         return not (self.queue or self.active or self._inflight
-                    or self._chunked is not None)
+                    or self._chunked is not None or self._resume_q)
 
     def overload_hint_s(self) -> float:
         """The queue-drain estimate behind :class:`QueueFull`'s
@@ -1263,6 +1323,240 @@ class Scheduler:
         throttled counts, served tokens, and the live WFQ deficit
         counter (:meth:`apex_tpu.serving.tenancy.TenantBook.summary`)."""
         return self.tenants.summary()
+
+    # -- host-swap oversubscription (EngineConfig.host_swap) ----------------
+
+    def pause(self, request_id: str) -> bool:
+        """Park an ACTIVE request's conversation in the host-RAM swap
+        tier (:meth:`Engine.park_slot`): its private HBM pages swap
+        out, the slot frees for other traffic, and the stream
+        continues bit-identically after :meth:`resume` — held stop-
+        matcher tokens, PRNG state, everything. Never mid-chunk: every
+        in-flight chunk is collected first (the dispatched tables
+        still map the pages being freed). Returns False when the
+        request is not active by then — it finished in a collected
+        chunk, is still queued, or was already parked."""
+        if not self.engine.host_swap_enabled:
+            raise ValueError(
+                "pause() needs EngineConfig.host_swap — the engine "
+                "has no host tier to park into")
+        while self._inflight:
+            self._collect_oldest()
+        for slot, act in sorted(self.active.items()):
+            if act.request.request_id == request_id:
+                self._park(slot, act, self.clock())
+                return True
+        return False
+
+    def resume(self, request_id: str) -> bool:
+        """Queue a parked conversation for resumption — drained BEFORE
+        admissions each tick, and attempted immediately here when a
+        slot is free. ``EngineConfig.resume_policy`` prices the path
+        per conversation: ``swap`` scatters the parked payload back
+        and the SAME stream object continues; ``recompute`` drops the
+        payload and re-derives the emitted prefix through fault
+        replay; ``auto`` compares the measured swap-in EWMA against
+        replay's (emitted tokens × chunk-latency EWMA) and takes the
+        cheaper one. Returns False for an id that is not parked."""
+        if request_id not in self._parked:
+            return False
+        if request_id not in self._resume_q:
+            self._resume_q.append(request_id)
+        self._admit_parked(self.clock())
+        return True
+
+    @property
+    def parked_requests(self) -> List[str]:
+        """Ids of paused conversations, oldest park first."""
+        return sorted(self._parked,
+                      key=lambda rid: self._parked[rid].parked_at)
+
+    def _park(self, slot: int, act: _Active, now: float) -> None:
+        """Move one active slot into the host tier: grow the replay
+        snapshot FIRST (the recompute fallback — and the bundle's
+        record of what the client saw), swap the pages out, free the
+        slot. An engine-seam failure recovers like any other fault
+        (the conversation replays from the snapshot just taken)."""
+        rid = act.request.request_id
+        st = self._replay.setdefault(rid, _ReplayState())
+        if len(act.tokens) > len(st.tokens):
+            st.tokens = list(act.tokens)
+            st.logprobs = list(act.logprobs)
+        n_pages = self.engine.slot_page_count(slot)
+        try:
+            evicted = self.engine.park_slot(slot, rid)
+        except Exception as e:  # park rides the retire seam
+            self._recover(now, cause="retire", detail=str(e),
+                          affected=[])
+            return
+        self.active.pop(slot)
+        self._free.append(slot)
+        self._pauses += 1
+        self._parked[rid] = _Parked(act, n_pages,
+                                    self.engine.host_parked(rid), now)
+        for ek in evicted:
+            # capacity eviction only drops swap payloads — those
+            # conversations (possibly including this one) downgrade
+            # to recompute-resume; nothing is lost
+            pk = self._parked.get(ek)
+            if pk is not None and pk.swap:
+                pk.swap = False
+                self._swap_capacity_drops += 1
+        if self.recorder is not None:
+            self.recorder.record("page_swap_out", rid, slot, n_pages,
+                                 self.engine.parked_bytes(rid))
+        if self.spans is not None:
+            self.spans.mark(rid, spans_mod.PHASE_QUEUED,
+                            note=f"parked ({n_pages} pages)")
+        if self.telemetry is not None:
+            self.telemetry.active_slots.set(len(self.active))
+
+    def _admit_parked(self, now: float) -> None:
+        """Drain the resume queue into free slots. A swap-resume that
+        cannot get a slot or pages waits at the queue head — the same
+        backpressure admission sees (page pressure may preempt on its
+        behalf); a recompute-resume re-enters the request queue's
+        FRONT and replays through the fault machinery."""
+        while self._resume_q:
+            rid = self._resume_q[0]
+            pk = self._parked.get(rid)
+            if pk is None:      # expired/aborted while queued
+                self._resume_q.popleft()
+                continue
+            act = pk.act
+            n_pages = self.engine.parked_pages(rid)
+            policy = self.engine.engine_cfg.resume_policy
+            use_swap = (pk.swap and self.engine.host_parked(rid)
+                        and policy != "recompute")
+            if use_swap and policy == "auto":
+                cost = self.engine.swap_in_cost_s(n_pages)
+                if (cost is not None and self._chunk_ewma > 0.0
+                        and cost > len(act.tokens) * self._chunk_ewma):
+                    use_swap = False
+            if not use_swap:
+                # recompute: drop the payload (snapshot was grown at
+                # park) and replay from the request queue's front —
+                # the resuming client jumps new arrivals
+                self._resume_q.popleft()
+                self._parked.pop(rid)
+                self.engine.drop_parked(rid)
+                self._recompute_resumes += 1
+                self.queue.appendleft(act.request)
+                if self.recorder is not None:
+                    self.recorder.record("page_swap_in", rid, -1,
+                                         n_pages, "recompute")
+                continue
+            if not self._free:
+                return
+            if not self.engine.page_allocator.can_alloc(n_pages):
+                self._note_pages_exhausted(act.request, n_pages)
+                return
+            slot = self._free.pop()
+            try:
+                self.engine.resume_slot(slot, rid)
+            except PagesExhausted as e:
+                self._free.append(slot)
+                self._note_pages_exhausted(act.request, e.requested)
+                return
+            except KeyError:
+                # capacity-evicted between the check and the take —
+                # the next spin takes the recompute branch
+                self._free.append(slot)
+                pk.swap = False
+                continue
+            except Exception as e:
+                # the scatter donates cache/state: the payload is
+                # consumed and the engine poisoned — recover, and
+                # replay this conversation from its snapshot alongside
+                # every interrupted slot
+                self._free.append(slot)
+                self._resume_q.popleft()
+                self._parked.pop(rid, None)
+                self._recover(now, cause="admit", detail=str(e),
+                              affected=[], batch_reqs=[act.request])
+                return
+            self._resume_q.popleft()
+            self._parked.pop(rid)
+            self.active[slot] = act
+            self._swap_resumes += 1
+            if self.recorder is not None:
+                self.recorder.record("page_swap_in", rid, slot,
+                                     n_pages, "swap")
+            if self.spans is not None:
+                self.spans.mark(rid, spans_mod.PHASE_DECODE,
+                                note=f"swap-resume slot {slot}")
+            if self.telemetry is not None:
+                self.telemetry.active_slots.set(len(self.active))
+
+    def _maybe_preempt(self, r: Request, needed: int) -> None:
+        """Page pressure meets oversubscription: free the pages of the
+        tenant furthest AHEAD of its WFQ fair share
+        (:meth:`~apex_tpu.serving.tenancy.TenantBook.pick_victim`) so
+        the starved request admits next tick. Never mid-chunk — every
+        in-flight chunk collects first — and never the starved
+        request's own lane. The victim replays through the fault
+        machinery (snapshot grown here, re-queued at the BACK — it
+        yielded its turn); attempts are NOT charged: preemption is a
+        scheduling decision, not a fault. Its continued stream is
+        bit-identical."""
+        if not self.preempt or not self.active:
+            return
+        while self._inflight:
+            self._collect_oldest()
+        # collection may have released slots/pages (or recovered a
+        # fault) — re-check the pressure before evicting anyone
+        if (not self.active
+                or self.engine.page_allocator.can_alloc(needed)):
+            return
+        # only tenants strictly AHEAD of the starved one are fair
+        # game: preemption flows one way down the WFQ ordering, so a
+        # fresh victim can never preempt its preemptor right back
+        # (equal-service tenants fall through to plain backpressure)
+        floor = self.tenants.service_of(r.tenant)
+        candidates = {
+            a.request.tenant: self.tenants.service_of(a.request.tenant)
+            for a in self.active.values()
+            if self.tenants.service_of(a.request.tenant) > floor}
+        if not candidates:
+            return
+        victim_tenant = self.tenants.pick_victim(candidates)
+        victims = sorted(
+            (len(a.tokens), slot)
+            for slot, a in self.active.items()
+            if a.request.tenant == victim_tenant
+            and a.request.request_id != r.request_id)
+        if not victims:
+            return
+        _, slot = victims[0]    # least sunk work first
+        act = self.active[slot]
+        vid = act.request.request_id
+        n_pages = self.engine.slot_page_count(slot)
+        st = self._replay.setdefault(vid, _ReplayState())
+        if len(act.tokens) > len(st.tokens):
+            st.tokens = list(act.tokens)
+            st.logprobs = list(act.logprobs)
+        if self.recorder is not None:
+            self.recorder.record(
+                "preempt", vid, slot, victim_tenant, n_pages,
+                candidates[victim_tenant], dict(sorted(candidates.items())))
+        try:
+            self.engine.retire(slot)
+        except Exception as e:
+            self._recover(self.clock(), cause="retire", detail=str(e),
+                          affected=[])
+            return
+        self.engine.free_slot(slot)
+        self.active.pop(slot)
+        self._free.append(slot)
+        self._preemptions += 1
+        self.queue.append(act.request)
+        if self.spans is not None:
+            self.spans.mark(vid, spans_mod.PHASE_QUEUED,
+                            note="preempted")
+        if self.telemetry is not None:
+            self.telemetry.preemptions.inc()
+            self.telemetry.queue_depth.set(len(self.queue))
+            self.telemetry.active_slots.set(len(self.active))
 
     @property
     def chunk_latency_ewma_s(self) -> float:
@@ -2093,6 +2387,12 @@ class Scheduler:
         for r in self.queue:
             self._abort(r, FINISH_ERROR, now, error=cause)
         self.queue.clear()
+        for rid, pk in sorted(self._parked.items()):
+            self.engine.drop_parked(rid)
+            self._abort(pk.act.request, FINISH_ERROR, now, act=pk.act,
+                        error=cause)
+        self._parked.clear()
+        self._resume_q.clear()
         self._replay.clear()
         self._inflight.clear()
         if self.telemetry is not None:
@@ -2143,6 +2443,11 @@ class Scheduler:
             take(cr, None)
         for r in self.queue:
             take(r, None)
+        for rid, pk in sorted(self._parked.items()):
+            self.engine.drop_parked(rid)
+            take(pk.act.request, pk.act)
+        self._parked.clear()
+        self._resume_q.clear()
         self.active.clear()
         self.queue.clear()
         self._reset_free()
@@ -2269,15 +2574,19 @@ class Scheduler:
         requests = [dict(r) for r in self._req_done.values()]
         by_id = {a.request.request_id: a
                  for a in list(self.active.values())}
+        parked = {pk.act.request.request_id: pk.act
+                  for pk in list(self._parked.values())}
         for rid, row in list(self._req_records.items()):
             row = dict(row)
-            act = by_id.get(rid)
+            act = by_id.get(rid) or parked.get(rid)
             toks = list(act.tokens) if act is not None else []
             st = self._replay.get(rid)
             if st is not None and len(st.tokens) > len(toks):
                 toks = list(st.tokens)
             row["emitted"] = toks
-            row["status"] = "active" if act is not None else "queued"
+            row["status"] = ("active" if rid in by_id
+                             else "parked" if rid in parked
+                             else "queued")
             requests.append(row)
         requests.sort(key=lambda r: r["order"])
         manifest: Dict[str, object] = {
@@ -2422,6 +2731,20 @@ class Scheduler:
                 self.events.append(StreamEvent(
                     act.request.request_id, None, True, FINISH_TIMEOUT))
                 self._release(slot, FINISH_TIMEOUT)
+        for rid in list(self._parked):
+            pk = self._parked[rid]
+            dl = pk.act.request.deadline
+            if dl is not None and now >= dl:
+                # a parked conversation's deadline still bites: drop
+                # the swap payload and time out with the stream so far
+                del self._parked[rid]
+                try:
+                    self._resume_q.remove(rid)
+                except ValueError:
+                    pass
+                self.engine.drop_parked(rid)
+                self._abort(pk.act.request, FINISH_TIMEOUT, now,
+                            act=pk.act)
 
     def _expire_queued(self, request: Request, now: float) -> bool:
         dl = request.deadline
@@ -2468,7 +2791,11 @@ class Scheduler:
     def _note_pages_exhausted(self, r: Request, needed: int) -> None:
         """Backpressure, not a fault: the head request waits queued
         until releases free enough pages (an ingress layer sees the
-        pressure as queue growth → :class:`QueueFull` 429s)."""
+        pressure as queue growth → :class:`QueueFull` 429s). Under
+        oversubscription (:attr:`preempt`) the wait also triggers the
+        WFQ preemption pass — the freed pages let the head admit next
+        tick instead of waiting out a long-running lowest-priority
+        stream."""
         self._pages_exhausted_waits += 1
         if self.recorder is not None:
             self.recorder.record(
@@ -2476,6 +2803,7 @@ class Scheduler:
                 self.engine.page_allocator.free_pages)
         if self.telemetry is not None:
             self.telemetry.pages_exhausted.inc()
+        self._maybe_preempt(r, needed)
 
     def _advance_chunked(self, now: float) -> None:
         """Drive the in-progress chunked-prefill admission one device
@@ -2674,9 +3002,12 @@ class Scheduler:
         return picked
 
     def _admit_queued(self, now: float) -> None:
-        # batched short admissions first, chunked start last: the wave
+        # parked resumes first (their clients are waiting MID-stream),
+        # then batched short admissions, chunked start last: the wave
         # of shorts must not queue behind chunk 0's forward (see
         # step()'s ordering note)
+        if self._resume_q:
+            self._admit_parked(now)
         self._admit_batches(now)
         self._start_chunked(now)
 
@@ -2965,6 +3296,22 @@ class Scheduler:
             out["page_share_hits"] = float(self._page_share_hits)
             out["pages_exhausted_waits"] = float(
                 self._pages_exhausted_waits)
+            out["pages_swapped"] = ps["pages_swapped"]
+            out["swap_bytes"] = ps["swap_bytes"]
+        if self.engine.host_swap_enabled:
+            # the oversubscription ledger: conversations parked now,
+            # swap traffic, and how the scheduler resolved pressure
+            out["parked_conversations"] = float(len(self._parked))
+            out["pauses"] = float(self._pauses)
+            out["preemptions"] = float(self._preemptions)
+            out["swap_resumes"] = float(self._swap_resumes)
+            out["recompute_resumes"] = float(self._recompute_resumes)
+            out["swap_capacity_drops"] = float(
+                self._swap_capacity_drops)
+            ap = self.engine.adapter_paging_stats()
+            if ap is not None:
+                for k, v in ap.items():
+                    out[f"adapter_{k}"] = float(v)
         if self.engine.chunked_prefill_enabled:
             out["chunked_admissions"] = float(self._chunked_admissions)
             out["chunked_chunks"] = float(self._chunked_chunks)

@@ -254,6 +254,19 @@ class TenantBook:
     def service_of(self, tenant: str) -> float:
         return self._service.get(tenant, 0.0)
 
+    def pick_victim(self, service: Mapping[str, float]) -> str:
+        """:meth:`pick` mirrored for preemption: among tenants holding
+        active slots (``service`` maps tenant → its deficit counter,
+        snapshotted by the scheduler so the ``preempt`` flight event
+        carries the exact decision inputs), evict from the one
+        furthest AHEAD of its fair share — the largest counter.
+        Deterministic tie-break on name, so a post-mortem replay
+        (``telemetry.replay.replay_preemptions``) re-derives the same
+        victim from the recorded candidates."""
+        if not service:
+            raise ValueError("pick_victim() needs at least one tenant")
+        return max(sorted(service), key=lambda t: service[t])
+
     # -- token-budget rate limits --------------------------------------------
 
     def _refill(self, tenant: str, rate: float, now: float) -> list:

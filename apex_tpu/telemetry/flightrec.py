@@ -65,6 +65,11 @@ EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
     "page_share": ("request_id", "shared_pages"),
     "pages_exhausted": ("request_id", "needed", "free"),
     "prefill_chunk": ("request_id", "chunk", "chunks_total"),
+    # -- host-swap oversubscription (serving.hostswap) -----------------------
+    "page_swap_out": ("request_id", "slot", "pages", "bytes"),
+    "page_swap_in": ("request_id", "slot", "pages", "policy"),
+    "preempt": ("request_id", "slot", "tenant", "pages", "service",
+                "candidates"),
     # -- the decode loop ---------------------------------------------------
     "dispatch": ("spec", "ncols", "inflight", "active_slots"),
     "fetch": ("spec", "ncols", "wall_s", "live_rows"),

@@ -129,6 +129,79 @@ def replay_slo(bundle: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     return out
 
 
+# -- preemption decision replay (stdlib-only, recorded candidates) ------------
+
+
+def replay_preemptions(bundle: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Re-derive a bundle's page-pressure preemption decisions from
+    their RECORDED inputs: each ``preempt`` event carries the exact
+    WFQ candidate map (tenant → deficit counter) the scheduler saw, so
+    :meth:`~apex_tpu.serving.tenancy.TenantBook.pick_victim` must
+    reproduce the recorded victim tenant from it — and the recorded
+    ``service`` must be that tenant's candidate entry. Each preempted
+    request must later RE-ADMIT (a later ``admit`` event) before it
+    finishes — a ``finish`` with no re-admission in between means the
+    stream could not have continued bit-identically. Requests still
+    queued when the bundle dumped count as ``unresolved``, not drift.
+    Returns ``None`` when the bundle's engine has no host-swap tier;
+    ``{"skipped": ...}`` when the event ring dropped events.
+    Stdlib-only, like :func:`replay_tuner`."""
+    eng_d = (bundle.get("config.json") or {}).get("engine") or {}
+    if not (eng_d.get("engine") or {}).get("host_swap"):
+        return None
+    man = bundle.get("manifest.json") or {}
+    fr = man.get("flightrec") or {}
+    if fr.get("events_dropped"):
+        return {"skipped": f"event ring dropped "
+                f"{fr['events_dropped']} events — the recorded input "
+                f"stream is incomplete"}
+    from apex_tpu.serving.tenancy import TenantBook
+
+    events = bundle.get("events.jsonl", [])
+    preempts = [e for e in events if e.get("event") == "preempt"]
+    book = TenantBook(None, lambda: 0.0)   # pick_victim is pure
+    mismatches: List[Dict[str, Any]] = []
+    readmitted = unresolved = 0
+    for e in preempts:
+        cand = {str(t): float(s)
+                for t, s in (e.get("candidates") or {}).items()}
+        rid, tenant = e.get("request_id"), e.get("tenant")
+        if not cand:
+            mismatches.append({"seq": e.get("seq"), "request_id": rid,
+                               "why": "preempt event carries no "
+                                      "candidates"})
+            continue
+        want = book.pick_victim(cand)
+        if want != tenant:
+            mismatches.append({
+                "seq": e.get("seq"), "request_id": rid,
+                "why": "victim tenant does not re-derive from the "
+                       "recorded candidates",
+                "recorded": tenant, "rederived": want})
+        elif float(e.get("service", -1.0)) != cand.get(tenant):
+            mismatches.append({
+                "seq": e.get("seq"), "request_id": rid,
+                "why": "recorded service differs from the victim's "
+                       "candidate entry",
+                "recorded": e.get("service"),
+                "candidate": cand.get(tenant)})
+        later = [x for x in events
+                 if x.get("seq", 0) > e.get("seq", 0)
+                 and x.get("request_id") == rid]
+        if any(x.get("event") == "admit" for x in later):
+            readmitted += 1
+        elif any(x.get("event") == "finish" for x in later):
+            mismatches.append({
+                "seq": e.get("seq"), "request_id": rid,
+                "why": "preempted request finished without a "
+                       "re-admission — its stream cannot have "
+                       "continued"})
+        else:
+            unresolved += 1
+    return {"preemptions": len(preempts), "readmitted": readmitted,
+            "unresolved": unresolved, "mismatches": mismatches}
+
+
 # -- the stdlib-only report --------------------------------------------------
 
 
@@ -452,6 +525,16 @@ def replay_bundle(path: str, *, no_faults: bool = False,
         mismatches.extend(
             {"request_id": None, "why": "slo alert drift",
              **m} for m in slo_out.get("mismatches", ()))
+    pre_out = replay_preemptions(bundle)
+    if pre_out is not None:
+        # the recorded-candidates decision replay: every preemption's
+        # victim must re-derive from its recorded WFQ candidate map and
+        # the evicted request must re-admit before finishing (drift
+        # gates the exit code like the streams)
+        out["preemptions"] = pre_out
+        mismatches.extend(
+            {"request_id": None, "why": "preemption decision drift",
+             **m} for m in pre_out.get("mismatches", ()))
     if verbose:
         print(json.dumps(out, sort_keys=True))
     return out

@@ -37,8 +37,8 @@ BASELINE_TOKENS_PER_SEC = 58600.0
 
 #: stable trajectory keys for the BENCH_serve.json series (bumped per
 #: PR so the per-line provenance is plottable without git archaeology)
-BENCH_PR = 18
-BENCH_LABEL = "slo-observatory"
+BENCH_PR = 19
+BENCH_LABEL = "kv-oversubscription"
 
 #: every BENCH_serve.json line must carry these, with these types —
 #: the provenance triple that makes the series plottable without git
@@ -333,6 +333,206 @@ def fleet_smoke():
             "fleet_tokens_per_sec": line["fleet_tokens_per_sec"],
             "single_tokens_per_sec": line["single_tokens_per_sec"],
             "failed_over_requests": s["failed_over_requests"],
+            "token_drift": 0,
+        })
+    print(json.dumps(line))
+
+
+def oversub_smoke():
+    """``--mode serve --oversub``: the KV-oversubscription A/B — a
+    mixed idle-heavy trace (conversations go idle mid-stream, the
+    pause/park regime host swap exists for) driven through a
+    host-swap engine over a deliberately small page pool, vs the SAME
+    trace and pool hard-capped (no host tier: an idle conversation
+    either squats on its HBM pages or waits in the queue holding no
+    state). Headline: peak conversations RESIDENT per chip (active +
+    parked-with-state) vs the hard-capped pool's peak — the
+    oversubscription gain; acceptance wants >= 4x. Every stream
+    (greedy AND sampled) must be bit-identical to an uninterrupted
+    run, and a paired swap-vs-recompute resume A/B prices the
+    ``resume_policy`` decision. Appends the standard smoke line plus
+    the oversub extras to BENCH_serve.json. One JSON line printed."""
+    import time as _time
+
+    from apex_tpu.serving import Request, SamplingParams
+    from apex_tpu.serving.engine import Engine, EngineConfig
+    from apex_tpu.serving.scheduler import Scheduler
+
+    cfg = gpt.GPTConfig(
+        vocab_size=1024, hidden_size=256, num_layers=4, num_heads=8,
+        seq_len=256, remat=False, compute_dtype=jnp.float32)
+    mesh = mx.build_mesh(tp=1, devices=jax.devices()[:1])
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    # page pool sized to ~3 worst-case conversations (+1 sink): each
+    # request pins <= 3 pages (prompt <= 16 + budget 8 over 8-token
+    # pages), so the hard-capped side can never hold more than 3
+    # conversations' KV state at once — the floor the host tier lifts
+    base = dict(slots=4, max_prompt_len=16, max_seq_len=32,
+                decode_chunk=2, page_size=8, num_pages=10)
+    n_convs = 16
+
+    def trace():
+        reqs = []
+        for i in range(n_convs):
+            p_len = 1 + (11 * i + 5) % base["max_prompt_len"]
+            prompt = [int(t) for t in jax.random.randint(
+                jax.random.PRNGKey(950 + i), (p_len,), 0,
+                cfg.vocab_size)]
+            sp = (SamplingParams(temperature=0.9, top_k=40, seed=i)
+                  if i % 2 else SamplingParams())
+            reqs.append(Request(f"r{i}", prompt, max_tokens=8,
+                                sampling=sp))
+        return reqs
+
+    # uninterrupted reference: same numerics (paged, same page size),
+    # ample pool — the oracle every swapped/preempted/resumed stream
+    # must match bit-for-bit
+    ref_kw = dict(base, num_pages=0)
+    with Engine(cfg, params, mesh,
+                EngineConfig(**ref_kw)).warmup() as eng:
+        sched = Scheduler(eng, max_queue=2 * n_convs)
+        for r in trace():
+            sched.submit(r)
+        sched.run_until_idle()
+        ref = {rid: c.tokens for rid, c in sched.completions.items()}
+
+    def resident(sched):
+        # conversations holding KV state on/off chip: active slots +
+        # parked payloads/snapshots (queued requests hold nothing)
+        return len(sched.active) + len(sched.parked_requests)
+
+    def idle_heavy_drive(sched, pauses):
+        """Submit one conversation per wave, tick a couple of chunks,
+        then park every still-running stream (its user went idle) —
+        returns (peak resident, peak parked) counts."""
+        peak = peak_parked = 0
+        for r in trace():
+            sched.submit(r)
+            for _ in range(2):
+                sched.step()
+                peak = max(peak, resident(sched))
+            if pauses:
+                for rid in sorted(a.request.request_id
+                                  for a in sched.active.values()):
+                    sched.pause(rid)
+                peak = max(peak, resident(sched))
+                peak_parked = max(peak_parked,
+                                  len(sched.parked_requests))
+        return peak, peak_parked
+
+    # oversubscribed side: host tier + preemption on, same tiny pool
+    eng_o = Engine(cfg, params, mesh, EngineConfig(
+        **base, host_swap=True, resume_policy="auto")).warmup()
+    sen0 = eng_o.recompile_sentinel()
+    s_o = Scheduler(eng_o, max_queue=2 * n_convs, preempt=True)
+    t0 = _time.perf_counter()
+    peak_over, peak_parked = idle_heavy_drive(s_o, pauses=True)
+    for rid in list(s_o.parked_requests):
+        s_o.resume(rid)
+    s_o.run_until_idle()
+    over_wall = _time.perf_counter() - t0
+    over = {rid: c.tokens for rid, c in s_o.completions.items()}
+    summ_o = s_o.summary()
+    assert eng_o.recompile_sentinel() == sen0, \
+        "oversub run recompiled — swap variants missed warmup"
+    eng_o.close()
+
+    # hard-capped side: same pool, no host tier — a paused
+    # conversation is impossible, so the drive just backpressures
+    with Engine(cfg, params, mesh,
+                EngineConfig(**base)).warmup() as eng_c:
+        s_c = Scheduler(eng_c, max_queue=2 * n_convs)
+        peak_cap, _ = idle_heavy_drive(s_c, pauses=False)
+        s_c.run_until_idle()
+        capped = {rid: c.tokens for rid, c in s_c.completions.items()}
+
+    # zero drift, both sides, greedy and sampled alike
+    drift = sorted(rid for rid in ref
+                   if over.get(rid) != ref[rid]
+                   or capped.get(rid) != ref[rid])
+    assert not drift, f"oversubscription token drift: {drift}"
+    gain = peak_over / max(peak_cap, 1)
+    assert gain >= 4.0, (
+        f"oversubscription gain {gain:.2f}x < 4x "
+        f"(resident {peak_over} vs hard-capped {peak_cap})")
+
+    # paired swap-vs-recompute resume A/B on an ample pool (no
+    # preemption noise): park the whole wave mid-stream, then time
+    # resume -> drain under each policy — the decode work is
+    # identical, so the pair prices exactly swap-in scatter vs
+    # replay-from-snapshot. Value-fetch synced (run_until_idle
+    # fetches every completion); paired per round, median reported.
+    engines = {
+        pol: Engine(cfg, params, mesh, EngineConfig(
+            **dict(base, num_pages=0), host_swap=True,
+            resume_policy=pol)).warmup()
+        for pol in ("swap", "recompute")}
+    walls = {"swap": [], "recompute": []}
+    ratios = []
+    ab_toks = {}
+    for rnd in range(5):
+        round_wall = {}
+        for pol in _ab_order(rnd, ("swap", "recompute")):
+            sched = Scheduler(engines[pol], max_queue=2 * n_convs)
+            for r in trace()[:6]:
+                sched.submit(r)
+            for _ in range(2):
+                sched.step()
+            for rid in sorted(a.request.request_id
+                              for a in sched.active.values()):
+                sched.pause(rid)
+            assert sched.parked_requests, \
+                "resume A/B parked nothing — pause came too late"
+            t0 = _time.perf_counter()
+            for rid in list(sched.parked_requests):
+                sched.resume(rid)
+            sched.run_until_idle()
+            round_wall[pol] = _time.perf_counter() - t0
+            walls[pol].append(round_wall[pol])
+            toks = {rid: c.tokens for rid, c in
+                    sched.completions.items()}
+            ab_toks.setdefault(pol, toks)
+            assert ab_toks[pol] == toks, f"resume ab {pol} rerun drift"
+            assert all(toks[rid] == ref[rid] for rid in toks), \
+                f"resume ab {pol} drift vs uninterrupted"
+        ratios.append(round_wall["recompute"]
+                      / max(round_wall["swap"], 1e-9))
+    for e in engines.values():
+        e.close()
+
+    line = {
+        "metric": "gpt_serve_oversub",
+        "value": round(gain, 3),
+        "unit": "x_resident_conversations",
+        "conversations": n_convs,
+        "num_pages": base["num_pages"],
+        "peak_resident_oversub": peak_over,
+        "peak_resident_capped": peak_cap,
+        "parked_conversations_per_chip": peak_parked,
+        "pauses": summ_o["pauses"],
+        "preemptions": summ_o["preemptions"],
+        "swap_resumes": summ_o["swap_resumes"],
+        "recompute_resumes": summ_o["recompute_resumes"],
+        "oversub_tokens_per_sec": round(
+            summ_o["tokens_emitted"] / over_wall, 1),
+        "swap_resume_ms": round(1e3 * _median(walls["swap"]), 2),
+        "recompute_resume_ms": round(
+            1e3 * _median(walls["recompute"]), 2),
+        "recompute_vs_swap_ratio": round(_median(ratios), 3),
+        "token_drift": 0,
+    }
+    smoke = _smoke_headline()
+    line["bench_out"] = _append_traj(
+        {"pr": BENCH_PR, "label": BENCH_LABEL, **smoke},
+        {
+            "pr": BENCH_PR,
+            "label": BENCH_LABEL,
+            "metric": line["metric"],
+            "oversub_tokens_per_sec": line["oversub_tokens_per_sec"],
+            "parked_conversations_per_chip": line[
+                "parked_conversations_per_chip"],
+            "resident_gain": line["value"],
+            "recompute_vs_swap_ratio": line["recompute_vs_swap_ratio"],
             "token_drift": 0,
         })
     print(json.dumps(line))
@@ -1669,12 +1869,21 @@ if __name__ == "__main__":
                     "replica-mid-burst drill vs a clean single "
                     "replica) — asserts recovery + zero token drift "
                     "and appends a fleet-router BENCH_serve.json line")
+    ap.add_argument("--oversub", action="store_true",
+                    help="serve mode: run the KV-oversubscription A/B "
+                    "(idle-heavy trace over a host-swap engine vs the "
+                    "same hard-capped page pool) — asserts >= 4x "
+                    "resident conversations per chip + zero token "
+                    "drift, prices swap-vs-recompute resume, and "
+                    "appends an oversub BENCH_serve.json line")
     args = ap.parse_args()
     if args.mode == "serve":
         if args.chaos:
             chaos_smoke()
         elif args.fleet:
             fleet_smoke()
+        elif args.oversub:
+            oversub_smoke()
         else:
             serve(telemetry_out=args.telemetry_out, api=args.api)
     else:

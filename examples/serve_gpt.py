@@ -46,6 +46,23 @@ trace gains a long-prompt line so both paths actually run::
   python examples/serve_gpt.py --slots 4 --max-prompt-len 32 \
     --page-size 8 --prefill-chunk 16 --num-requests 8
 
+KV oversubscription (``apex_tpu.serving.hostswap``): ``--host-swap``
+adds a host-RAM page tier under the device pool — an idle
+conversation parks (its pages gather out through compiled swap
+programs to pinned host buffers, its slot and HBM pages free up) and
+resumes later bit-identically, so far more conversations stay
+resident per chip than the pool holds; under ``PagesExhausted``
+pressure the scheduler preempts the lowest-priority tenant's pages
+(WFQ-aware, replayed through fault-replay on re-admission, streams
+still bit-identical). ``--resume-policy swap|recompute|auto`` picks
+scatter-back vs replay-from-snapshot (auto prices it from measured
+swap cost). The demo parks every conversation mid-stream and resumes
+it::
+
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \\
+  python examples/serve_gpt.py --slots 4 --page-size 8 \\
+    --max-pages 10 --host-swap --num-requests 8
+
 Observability (``apex_tpu.telemetry``): ``--metrics-port N`` serves
 ``/metrics`` (Prometheus text), ``/healthz`` (live-wired to the
 scheduler's health state machine: 200 ok/degraded, 503
@@ -313,6 +330,23 @@ def main():
                     "request). Set lower to oversubscribe — admission "
                     "then backpressures when the pool runs dry "
                     "instead of stranding idle capacity")
+    ap.add_argument("--host-swap", action="store_true",
+                    help="host-RAM page tier under the device pool "
+                    "(needs --page-size): idle conversations park to "
+                    "pinned host buffers through compiled swap "
+                    "programs and resume bit-identically, so the "
+                    "chip holds far more conversations than its "
+                    "pages; page pressure preempts the lowest-"
+                    "priority tenant (WFQ-aware) instead of just "
+                    "backpressuring. The demo parks every "
+                    "conversation mid-stream and resumes it")
+    ap.add_argument("--resume-policy", default="auto",
+                    choices=("auto", "swap", "recompute"),
+                    help="how a parked conversation comes back: "
+                    "'swap' scatters the host payload into fresh "
+                    "pages, 'recompute' replays from the emitted-"
+                    "prefix snapshot, 'auto' (default) prices swap-in "
+                    "against replay from measured swap cost")
     ap.add_argument("--prefill-chunk", type=int, default=0,
                     help="chunked prefill: prompts longer than this "
                     "admit in chunk-sized slices interleaved with "
@@ -459,12 +493,16 @@ def main():
         sk = tuple(sorted(k for k in ladders.get("spec_k", ()) if k))
         spec_ks = sk or None
         print(f"autotune: {ladders}")
+    if args.host_swap and not args.page_size:
+        raise SystemExit("--host-swap needs --page-size (the host "
+                         "tier pages a paged pool)")
     ecfg = EngineConfig(
         slots=args.slots, max_prompt_len=args.max_prompt_len,
         max_seq_len=args.max_seq_len, decode_chunk=args.decode_chunk,
         prefix_pool_slots=len(templates), spec_k=args.spec_k,
         page_size=args.page_size, num_pages=args.max_pages,
         prefill_chunk=args.prefill_chunk,
+        host_swap=args.host_swap, resume_policy=args.resume_policy,
         decode_chunks=decode_chunks, spec_ks=spec_ks,
         adapter_slots=args.adapters + 1 if args.adapters else 0)
 
@@ -625,6 +663,25 @@ def main():
             print(f"request {r.request_id} throttled "
                   f"(tenant {e.tenant!r}, retry in "
                   f"{e.retry_after_s:.1f}s)")
+    if args.host_swap and args.replicas == 1:
+        # the park-and-resume demo: tick a couple of chunks, park
+        # every running conversation (its user walked away — pages
+        # swap out to the host tier, the slot frees), show the host
+        # tier holding them, then resume; streams stay bit-identical
+        for _ in range(2):
+            sched.step()
+        for rid in sorted(a.request.request_id
+                          for a in sched.active.values()):
+            sched.pause(rid)
+        parked = list(sched.parked_requests)
+        if parked:
+            print(f"parked {len(parked)} conversation(s) to host RAM "
+                  f"({args.resume_policy} resume): {parked}")
+            print(f"host tier: " + json.dumps(
+                {k: round(v, 1)
+                 for k, v in engine.host_tier_stats().items()}))
+            for rid in parked:
+                sched.resume(rid)
     sched.run_until_idle()
     for r in reqs:
         if r.request_id in throttled:

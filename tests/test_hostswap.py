@@ -1,0 +1,434 @@
+"""Host-swap oversubscription oracles (serving.hostswap).
+
+Oracle pattern (SURVEY.md §4): a conversation that parked to host RAM
+mid-stream — or was preempted for a higher-priority tenant and later
+replayed — must emit BIT-identical tokens (greedy AND sampled rows
+alike) to the same request served uninterrupted; the baseline side is
+the plain paged engine, itself pinned bit-identical to contiguous and
+to solo ``gpt.generate`` by the paged-cache and serving suites, so the
+chain grounds out at the solo oracle. Swap churn must never move the
+recompile sentinel (every swap-batch rung is a warmup-compiled
+variant), preemption decisions must re-derive from a post-mortem
+bundle's recorded candidates (``replay_preemptions``), and the same
+LRU mechanism pages cold LoRA adapter rows to host — registrations
+past the static pool stream identically to an all-resident pool.
+
+Pure-host units (rung planner, LRU index, tier capacity eviction,
+allocator host-tier counters) run device-free up top.
+"""
+
+import dataclasses
+
+import jax
+import pytest
+
+from apex_tpu import mesh as mx
+from apex_tpu.models import gpt
+from apex_tpu.serving import Request, SamplingParams
+from apex_tpu.serving.engine import Engine, EngineConfig
+from apex_tpu.serving.hostswap import (
+    HostPageTier, LRUIndex, plan_rungs, swap_rungs)
+from apex_tpu.serving.pages import PageAllocator
+from apex_tpu.serving.scheduler import Scheduler
+from apex_tpu.telemetry.flightrec import FlightRecorder, read_bundle
+from apex_tpu.telemetry.replay import replay_preemptions
+from apex_tpu.transformer.testing import standalone_gpt_config
+
+VOCAB = 96
+
+
+def _cfg(**overrides):
+    base = dict(vocab_size=VOCAB, seq_len=64)
+    base.update(overrides)
+    return standalone_gpt_config(**base)
+
+
+_PARAMS = {}
+
+
+def params_of(cfg):
+    # one shared init — parameters are storage-kind independent
+    if "p" not in _PARAMS:
+        base = dataclasses.replace(cfg, kv_cache_dtype="auto")
+        _PARAMS["p"] = gpt.init(base, jax.random.PRNGKey(0))
+    return _PARAMS["p"]
+
+
+def _mk_engine(cfg, ecfg, mesh, fault_plan=None):  # apex: noqa[TIER1-COST]: shared tiny-engine builder — one warm-cache warmup per host-swap variant serves every test below
+    return Engine(cfg, params_of(cfg), mesh, ecfg,
+                  fault_plan=fault_plan).warmup()
+
+
+# paged base + the host tier on top; resume_policy per test
+_ECFG = EngineConfig(slots=3, max_prompt_len=16, max_seq_len=32,
+                     decode_chunk=2, prompt_buckets=(8, 16),
+                     admit_batch_sizes=(1, 2), page_size=8,
+                     host_swap=True)
+
+
+def _trace(n=5, mt=12, tenants=None, adapters=0):
+    reqs = []
+    for i in range(n):
+        p_len = 1 + (7 * i + 3) % 14
+        prompt = [int(t) for t in jax.random.randint(
+            jax.random.PRNGKey(50 + i), (p_len,), 0, VOCAB)]
+        sp = (SamplingParams(temperature=0.9, top_k=20, seed=i)
+              if i % 2 else SamplingParams())
+        reqs.append(Request(
+            f"r{i}", prompt, max_tokens=mt, sampling=sp,
+            tenant=tenants[i % len(tenants)] if tenants else "default",
+            adapter=(i % (adapters + 1)) if adapters else 0))
+    return reqs
+
+
+def _run(engine, reqs, **kw):
+    sched = Scheduler(engine, **kw)
+    for r in reqs:
+        sched.submit(r)
+    sched.run_until_idle()
+    return ({rid: c.tokens for rid, c in sched.completions.items()},
+            sched.summary())
+
+
+def _run_paused(engine, reqs, pause_after=2, resume_after=2, **kw):
+    """The park-mid-stream drive: a few ticks in, pause every active
+    conversation, keep serving, resume them all, drain."""
+    sched = Scheduler(engine, **kw)
+    for r in reqs:
+        sched.submit(r)
+    for _ in range(pause_after):
+        sched.step()
+    paused = [rid for rid in sorted(a.request.request_id
+                                    for a in sched.active.values())
+              if sched.pause(rid)]
+    assert paused, "nothing was mid-stream to pause — trace too short"
+    for _ in range(resume_after):
+        sched.step()
+    for rid in paused:
+        assert sched.resume(rid)
+    sched.run_until_idle()
+    return ({rid: c.tokens for rid, c in sched.completions.items()},
+            sched.summary(), sched)
+
+
+# -- the swap-batch rung planner (pure host) ---------------------------------
+
+
+def test_swap_rung_planner():
+    assert swap_rungs(1) == (1,)
+    assert swap_rungs(4) == (1, 2, 4)
+    # powers of two only — binary decomposition covers everything in
+    # between without a padding page ever travelling
+    assert swap_rungs(6) == (1, 2, 4)
+    for n in range(1, 40):
+        plan = plan_rungs(n)
+        assert sum(plan) == n
+        assert plan == sorted(plan, reverse=True)  # largest first
+        rungs = set(swap_rungs(n))
+        assert all(r in rungs for r in plan), (n, plan)
+    assert plan_rungs(5) == [4, 1]  # the binary decomposition
+    assert plan_rungs(0) == []  # nothing to move
+    with pytest.raises(ValueError):
+        plan_rungs(-1)
+    with pytest.raises(ValueError):
+        swap_rungs(0)
+
+
+def test_lru_index():
+    lru = LRUIndex()
+    for k in ("a", "b", "c"):
+        lru.touch(k)
+    assert list(lru) == ["a", "b", "c"]  # coldest first
+    lru.touch("a")  # refresh: a becomes hottest
+    assert lru.pop_coldest() == "b"
+    assert lru.pop_coldest(pinned={"c"}) == "a"  # pinned survives
+    lru.discard("zz")  # absent discard is a no-op
+    lru.discard("c")
+    assert lru.pop_coldest() is None
+
+
+def test_host_tier_capacity_eviction():
+    tier = HostPageTier(capacity_pages=4)
+    assert tier.park("a", "pay-a", 2, 100) == []
+    assert tier.park("b", "pay-b", 2, 100) == []
+    # over capacity: the COLDEST entry spills out of the tier (its
+    # conversation silently downgrades to recompute-resume)
+    evicted = tier.park("c", "pay-c", 2, 100)
+    assert [k for k, _ in evicted] == ["a"]
+    assert "a" not in tier and "b" in tier
+    # touch refreshes recency, so the next eviction picks c, not b
+    tier.touch("b")
+    assert [k for k, _ in tier.park("d", "pay-d", 2, 100)] == ["c"]
+    ent = tier.take("b")
+    assert ent.payload == "pay-b" and ent.n_pages == 2
+    assert tier.take("b") is None  # taken is gone
+    with pytest.raises(ValueError):
+        tier.park("d", "again", 1, 1)  # re-park is a bug
+    s = tier.stats()
+    assert s["parks_total"] == 4.0 and s["drops_total"] == 2.0
+    assert s["takes_total"] == 1.0 and s["parked_entries"] == 1.0
+
+
+def test_page_allocator_host_tier_counters():
+    a = PageAllocator(num_pages=9, page_size=8)
+    a.note_swap_out(3, 300)
+    a.note_swap_out(2, 200)
+    a.note_swap_in(3, 300)   # scatter-back resume
+    a.note_swap_drop(2, 200)  # capacity eviction / recompute-resume
+    s = a.stats()
+    assert s["pages_swapped"] == 0.0 and s["swap_bytes"] == 0.0
+    # cumulative traffic counts PAGES moved, and a drop is not an in
+    assert s["swap_outs_total"] == 5.0 and s["swap_ins_total"] == 3.0
+    a.note_swap_out(4, 400)
+    assert a.stats()["pages_swapped"] == 4.0
+    assert a.stats()["swap_bytes"] == 400.0
+    # reset() rebuilds the DEVICE pool (fault recovery) — parked host
+    # payloads stay valid (they were copied out), so the host-tier
+    # occupancy and traffic counters must survive the rebuild
+    a.reset()
+    s = a.stats()
+    assert s["pages_swapped"] == 4.0 and s["swap_outs_total"] == 9.0
+
+
+# -- park/resume stream parity (the oversubscription oracle) -----------------
+
+
+@pytest.mark.parametrize("policy", ["swap", "recompute", "auto"])
+def test_pause_resume_stream_parity(devices8, policy):
+    """A conversation parked to host RAM mid-stream and resumed —
+    scatter-back, replay-from-snapshot, or the auto-priced choice —
+    emits BIT-identical tokens (greedy and sampled rows alike) to the
+    same trace served uninterrupted, the recompile sentinel never
+    moves (every swap rung is a warmed variant), and the resume-path
+    counters attribute the policy taken."""
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    eng = _mk_engine(_cfg(), dataclasses.replace(
+        _ECFG, resume_policy=policy), mesh)
+    try:
+        base, _ = _run(eng, _trace())
+        sen0 = eng.recompile_sentinel()
+        toks, summ, _ = _run_paused(eng, _trace())
+        assert toks == base
+        assert eng.recompile_sentinel() == sen0, "swap churn recompiled"
+        assert summ["pauses"] >= 1.0
+        if policy == "swap":
+            assert summ["swap_resumes"] >= 1.0
+            assert summ["recompute_resumes"] == 0.0
+        elif policy == "recompute":
+            assert summ["recompute_resumes"] >= 1.0
+            assert summ["swap_resumes"] == 0.0
+        else:  # auto resolves to SOME resume path, bit-identically
+            assert summ["swap_resumes"] + summ["recompute_resumes"] \
+                >= 1.0
+        assert summ["parked_conversations"] == 0.0  # all came back
+        assert summ["pages_in_use"] == 0.0
+    finally:
+        eng.close()
+
+
+@pytest.mark.parametrize("kind", [
+    "lora", "spec",
+    # the int8 composition is the paged suite's int8 stream-parity arm
+    # composed with the (auto-covered) swap plumbing — slow tier
+    # (tier-1 budget offset for the host-swap suite)
+    pytest.param("int8", marks=pytest.mark.slow)])
+def test_pause_resume_composed_parity(devices8, kind):
+    """Park/resume stays bit-identical composed with the other cache
+    tenants of the page pool: quantized KV storage, batched per-slot
+    LoRA adapters, and speculative decode (drafter history parks and
+    resumes with the slot row)."""
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    cfg = _cfg() if kind != "int8" else dataclasses.replace(
+        _cfg(), kv_cache_dtype="int8")
+    ecfg = dataclasses.replace(_ECFG, resume_policy="swap")
+    adapters = 0
+    if kind == "lora":
+        ecfg = dataclasses.replace(ecfg, adapter_slots=3,
+                                   adapter_rank=4, adapter_alpha=8.0)
+        adapters = 2
+    elif kind == "spec":
+        ecfg = dataclasses.replace(ecfg, spec_k=2, spec_hist=12)
+    eng = _mk_engine(cfg, ecfg, mesh)
+    try:
+        for i in range(adapters):
+            eng.register_adapter(seed=70 + i)
+        base, _ = _run(eng, _trace(adapters=adapters))
+        # pause after ONE step: a spec wave emits up to
+        # decode_chunk * (spec_k + 1) tokens per step, so later pauses
+        # can find the whole trace already finished
+        toks, summ, _ = _run_paused(eng, _trace(adapters=adapters),
+                                    pause_after=1)
+        assert toks == base
+        assert summ["swap_resumes"] >= 1.0
+    finally:
+        eng.close()
+
+
+def test_recompile_guard_flat_over_swap_churn(devices8):
+    """Many park/resume cycles across varying page counts and both
+    resume paths never trace a new program — the armed recompile
+    guard's oversubscription extension."""
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    eng = _mk_engine(_cfg(), dataclasses.replace(
+        _ECFG, resume_policy="auto"), mesh)
+    try:
+        sen0 = eng.recompile_sentinel()
+        base, _ = _run(eng, _trace())
+        for rnd in range(3):
+            toks, _, _ = _run_paused(eng, _trace(),
+                                     pause_after=1 + rnd)
+            assert toks == base, f"round {rnd} drift"
+        assert eng.recompile_sentinel() == sen0
+    finally:
+        eng.close()
+
+
+# -- host-tier capacity pressure (engine level) ------------------------------
+
+
+def test_host_tier_pressure_downgrades_to_recompute(devices8):
+    """A bounded host tier (``host_swap_pages``) evicts the coldest
+    parked payload under parking pressure; the evicted conversation
+    still resumes bit-identically through the replay snapshot, and
+    the scheduler counts the capacity drop."""
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    # room for ~one parked conversation's pages — parking a wave of
+    # three MUST spill the coldest payloads
+    eng = _mk_engine(_cfg(), dataclasses.replace(
+        _ECFG, resume_policy="swap", host_swap_pages=3), mesh)
+    try:
+        base, _ = _run(eng, _trace())
+        toks, summ, _ = _run_paused(eng, _trace())
+        assert toks == base
+        assert summ["swap_capacity_drops"] >= 1.0
+        assert summ["recompute_resumes"] >= 1.0  # the evicted ones
+        assert summ["swap_resumes"] >= 1.0       # the retained one
+    finally:
+        eng.close()
+
+
+# -- preemption: the scheduler evicts pages, replay restores the stream ------
+
+
+def _preempt_run(devices8, tmp_path=None):
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    # ample-pool baseline: the same trace, nobody preempted
+    eng = _mk_engine(_cfg(), dataclasses.replace(
+        _ECFG, resume_policy="auto"), mesh)
+    try:
+        base, _ = _run(eng, _trace(tenants=("t0", "t1", "t2")))
+    finally:
+        eng.close()
+    # starved pool: 5 pages (one sink + two 2-page conversations) for
+    # three tenants — admission pressure MUST preempt
+    eng = _mk_engine(_cfg(), dataclasses.replace(
+        _ECFG, resume_policy="auto", num_pages=5), mesh)
+    rec = FlightRecorder()
+    try:
+        sched = Scheduler(eng, recorder=rec, preempt=True)
+        for r in _trace(tenants=("t0", "t1", "t2")):
+            sched.submit(r)
+        sched.run_until_idle()
+        toks = {rid: c.tokens for rid, c in sched.completions.items()}
+        summ = sched.summary()
+        reasons = {rid: c.finish_reason
+                   for rid, c in sched.completions.items()}
+        bundle = None
+        if tmp_path is not None:
+            bundle = sched.dump_bundle("test",
+                                       bundle_dir=str(tmp_path))
+    finally:
+        eng.close()
+    evs = [e for e in rec.to_dicts(rec.events())
+           if e["event"] == "preempt"]
+    return base, toks, summ, reasons, evs, bundle
+
+
+def test_preempt_replay_stream_parity(devices8, tmp_path):
+    """Under ``PagesExhausted`` pressure the scheduler preempts the
+    WFQ-largest tenant's pages and later replays the victim through
+    the fault-replay machinery: every stream (greedy and sampled)
+    stays bit-identical to the unstarved run, victims finish with
+    their natural reasons (never ``error``), preempt events carry the
+    full recorded candidate map, and the whole decision sequence
+    re-derives from the post-mortem bundle with zero mismatches —
+    while a tampered victim is flagged."""
+    base, toks, summ, reasons, evs, bundle = _preempt_run(
+        devices8, tmp_path)
+    assert toks == base
+    assert all(r in ("stop", "length", "eos") for r in reasons.values()), \
+        reasons
+    assert summ["preemptions"] >= 1.0
+    assert len(evs) == int(summ["preemptions"])
+    for e in evs:
+        assert e["candidates"] and e["tenant"] in e["candidates"]
+        assert e["service"] == e["candidates"][e["tenant"]]
+    # the bundle is the decision record: replay re-derives every
+    # victim from the recorded WFQ candidates
+    b = read_bundle(bundle)
+    out = replay_preemptions(b)
+    assert out is not None and "skipped" not in out
+    assert out["preemptions"] == len(evs)
+    assert out["mismatches"] == []
+    assert out["readmitted"] == out["preemptions"]
+    # tamper: a re-written victim must not re-derive
+    for e in b["events.jsonl"]:
+        if e.get("event") == "preempt":
+            e["tenant"] = "nobody"
+    bad = replay_preemptions(b)
+    assert bad["mismatches"], "tampered preempt victim not flagged"
+    # gate: a bundle from a non-host-swap engine has nothing to replay
+    b2 = read_bundle(bundle)
+    b2["config.json"]["engine"]["engine"]["host_swap"] = False
+    assert replay_preemptions(b2) is None
+
+
+# -- adapter paging: hundreds registered, a static pool resident -------------
+
+
+def test_adapter_paging_stream_parity(devices8):
+    """With the host tier on, ``register_adapter`` past the static
+    pool spills cold adapters' rows to host instead of refusing: a
+    pool of 2 usable rows serving 4 registered adapters emits the
+    SAME streams as an all-resident pool (same seeds), and the
+    spill/page-in counters show the LRU actually paged."""
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    seeds = [70, 71, 72, 73]
+    lora = dict(adapter_rank=4, adapter_alpha=8.0,
+                resume_policy="swap")
+
+    def run_pool(slots):
+        eng = _mk_engine(_cfg(), dataclasses.replace(
+            _ECFG, adapter_slots=slots, **lora), mesh)
+        try:
+            for s in seeds:
+                eng.register_adapter(seed=s)
+            toks, _ = _run(eng, _trace(n=8, adapters=len(seeds)))
+            stats = eng.adapter_paging_stats()
+        finally:
+            eng.close()
+        return toks, stats
+
+    resident, _ = run_pool(slots=len(seeds) + 1)  # everything fits
+    paged, stats = run_pool(slots=3)              # 2 usable rows
+    assert paged == resident
+    assert stats["registered"] == float(len(seeds))
+    assert stats["rows"] < stats["registered"] + 1
+    assert stats["spills_total"] >= 1.0
+    assert stats["pageins_total"] >= 1.0
+
+
+def test_adapter_register_hard_cap_without_host_tier(devices8):
+    """Without the host tier the static pool is still a hard cap —
+    the paging escape hatch must not silently change the contract for
+    engines that did not opt in."""
+    mesh = mx.build_mesh(tp=1, devices=devices8[:1])
+    eng = _mk_engine(_cfg(), dataclasses.replace(
+        _ECFG, host_swap=False, adapter_slots=2, adapter_rank=4,
+        adapter_alpha=8.0), mesh)
+    try:
+        eng.register_adapter(seed=70)
+        with pytest.raises(ValueError):
+            eng.register_adapter(seed=71)
+    finally:
+        eng.close()

@@ -971,7 +971,7 @@ def test_threefry_key_data_matches_prngkey():
             err_msg=f"seed {seed}")
 
 
-def test_warmup_compiles_everything_and_stays_flat(devices8):
+def test_warmup_compiles_everything_and_stays_flat(devices8):  # apex: noqa[TIER1-COST]: the warmup-compiles-everything contract IS the test subject (covers the idempotence re-call too)
     """``Engine.warmup()`` compiles every program — init/step/retire
     and ALL (bucket, k) admission variants — resets the slots, and a
     full varied serve cycle afterwards never adds a cache entry."""
@@ -983,10 +983,10 @@ def test_warmup_compiles_everything_and_stays_flat(devices8):
                               decode_chunk=4))
     assert eng.prompt_buckets == (8, 10)
     assert eng.admit_batch_sizes == (1, 2)
-    eng.warmup()  # apex: noqa[TIER1-COST]: the warmup-compiles-everything contract IS the test subject
+    eng.warmup()
     sizes = eng.compiled_cache_sizes()
     assert set(sizes.values()) == {1}, sizes
-    assert eng.warmup() is eng  # idempotent  # apex: noqa[TIER1-COST]: idempotence arm of the warmup contract
+    assert eng.warmup() is eng  # idempotent
     sched = Scheduler(eng, pipeline_depth=2)
     for r in _mixed_requests(6, 10, eos=13, seed0=840):
         sched.submit(r)

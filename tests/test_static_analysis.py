@@ -441,6 +441,52 @@ def test_page_table_static_clean_on_config_shapes(tmp_path):
 
 
 # --------------------------------------------------------------------------
+# HOST-TIER-STATIC
+# --------------------------------------------------------------------------
+
+
+def test_host_tier_static_fires_on_live_derived_shape(tmp_path):
+    res = _synth(tmp_path, {"pkg/mod.py": '''
+        import numpy as np
+
+        def park(self, act, payload):
+            # the swap-recompile class this rule exists for: host
+            # mirror geometry measured from the live conversation
+            host_buf = np.zeros(
+                (len(act.pages), self.page_size), np.float32)
+            self._swap_rows = np.full((payload.size,), 0, np.int32)
+            return host_buf
+    ''', "pkg/__init__.py": ""})
+    hits = [f for f in res.findings if f.rule == "HOST-TIER-STATIC"]
+    msgs = "\n".join(f.render() for f in hits)
+    assert len(hits) == 2, msgs
+    assert any("len(...)" in f.message and "host_buf" in f.message
+               for f in hits), msgs
+    assert any(".size" in f.message and "_swap_rows" in f.message
+               for f in hits), msgs
+
+
+def test_host_tier_static_clean_on_rung_shapes(tmp_path):
+    res = _synth(tmp_path, {"pkg/mod.py": '''
+        import numpy as np
+
+        def build(self, ecfg):
+            # rung-derived constants: the blessed spelling
+            rung = max(self.swap_rungs)
+            host_buf = np.zeros((rung, ecfg.page_size), np.float32)
+            spill_stage = np.empty((ecfg.lora_rank,), np.float32)
+            # host-buffer CONTENTS from live data are fine — buffers
+            # are data; only geometry is constrained
+            host_buf[:len(self.priv)] = self.priv
+            # non-host-named arrays may size from data (other rules)
+            buf = np.zeros((len(self.queue),), np.int32)
+            return host_buf, spill_stage, buf
+    ''', "pkg/__init__.py": ""})
+    assert "HOST-TIER-STATIC" not in _rules_of(res), \
+        "\n".join(f.render() for f in res.findings)
+
+
+# --------------------------------------------------------------------------
 # WARMUP-COVERAGE
 # --------------------------------------------------------------------------
 
