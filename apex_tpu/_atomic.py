@@ -1,0 +1,113 @@
+"""Same-directory-temp + ``os.replace`` atomic write helpers.
+
+Four subsystems grew the same crash-safe write idiom independently —
+checkpoints (``checkpoint._atomic_write``), post-mortem bundle
+directories (``telemetry/flightrec.write_bundle``), the fleet's
+incident manifests (through ``write_bundle``), and the native-library
+build (``_native._build``). This module is that idiom extracted once:
+write into a temp sibling on the SAME filesystem, then ``os.replace``
+onto the destination — a crash mid-write leaves the old file (or
+nothing), never a truncated artifact that parses as garbage. The
+serving write-ahead journal (``apex_tpu.serving.journal``) finalizes
+its compacted segments and manifest through the same helpers.
+
+Stdlib-only by contract: ``telemetry.flightrec`` (the laptop-side
+post-mortem reader) and ``serving.journal`` both import this with no
+jax installed. The DURABLE-WRITE lint rule flags bare ``open(.., "w")``
+writes into checkpoint/bundle/journal-named paths that bypass it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import tempfile
+from typing import Callable, Iterator
+
+#: process umask, probed once at import (os.umask can only be read by
+#: setting it — doing that per write would race other threads' file
+#: creation through a umask-0 window)
+_UMASK = os.umask(0)
+os.umask(_UMASK)
+
+
+def atomic_write(path: str, write_fn: Callable, *,
+                 text: bool = False) -> None:
+    """Run ``write_fn(file)`` against a same-directory temp file, then
+    ``os.replace`` it onto ``path``. Same-dir matters — ``os.replace``
+    is only atomic within one filesystem. The fd is owned (and closed
+    exactly once) by the ``with`` block, so a failing replace still
+    reports its own error and the temp file is removed. ``text=True``
+    opens the temp file in text mode (utf-8)."""
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(os.path.abspath(path)) or ".",
+        prefix=os.path.basename(path) + ".tmp.")
+    try:
+        # mkstemp creates 0600; restore the umask-derived mode a plain
+        # open() would have given, so artifacts stay readable by the
+        # same processes that could read them before the atomic switch
+        os.fchmod(fd, 0o666 & ~_UMASK)
+        if text:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                write_fn(f)
+        else:
+            with os.fdopen(fd, "wb") as f:
+                write_fn(f)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+@contextlib.contextmanager
+def atomic_path(path: str) -> Iterator[str]:
+    """Yield a same-directory temp PATH for an external writer (a
+    compiler, a subprocess) to populate, then ``os.replace`` it onto
+    ``path`` on clean exit. On an exception the temp file is removed
+    and nothing at ``path`` changes. The writer must actually create
+    the temp file — exiting without one is an error (an external tool
+    that silently produced nothing must not read as success)."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        yield tmp
+        if not os.path.exists(tmp):
+            raise FileNotFoundError(
+                f"atomic_path writer produced no file at {tmp}")
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+@contextlib.contextmanager
+def atomic_dir(path: str) -> Iterator[str]:
+    """Yield a fresh same-parent temp DIRECTORY to populate, then
+    ``os.replace`` it onto ``path`` on clean exit — a reader sees the
+    complete directory or no directory. On failure the temp tree is
+    removed recursively. Raises :class:`FileExistsError` up front when
+    ``path`` already exists (``os.replace`` cannot atomically swap a
+    non-empty directory; callers pick a fresh name — bundles and
+    compacted journals are immutable evidence either way)."""
+    path = os.path.abspath(path)
+    if os.path.exists(path):
+        raise FileExistsError(f"{path} already exists — atomic "
+                              f"directory writes need a fresh name")
+    parent = os.path.dirname(path)
+    os.makedirs(parent, exist_ok=True)
+    tmp = f"{path}.tmp{os.getpid()}"
+    os.makedirs(tmp)
+    try:
+        yield tmp
+        os.replace(tmp, path)
+    except BaseException:
+        # never leave temp droppings next to real artifacts
+        for root, dirs, names in os.walk(tmp, topdown=False):
+            for n in names:
+                os.unlink(os.path.join(root, n))
+            for d in dirs:
+                os.rmdir(os.path.join(root, d))
+        if os.path.isdir(tmp):
+            os.rmdir(tmp)
+        raise

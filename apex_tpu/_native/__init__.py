@@ -17,6 +17,8 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from apex_tpu import _atomic
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_HERE, "libapex_tpu_host.so")
 _SRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)),
@@ -30,22 +32,19 @@ _ABI_VERSION = 2
 def _build() -> bool:
     if not os.path.exists(_SRC):
         return False
-    # link to a private temp then atomically replace: a concurrent builder
-    # in another process never sees a half-written library, and a rebuild
-    # over an already-dlopen'ed .so swaps the inode instead of truncating
-    # the mapped file (the re-CDLL below then really loads the new build)
-    tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-pthread",
-           "-shared", "-o", tmp, _SRC]
+    # link to a private temp then atomically replace (_atomic.atomic_path):
+    # a concurrent builder in another process never sees a half-written
+    # library, and a rebuild over an already-dlopen'ed .so swaps the inode
+    # instead of truncating the mapped file (the re-CDLL below then really
+    # loads the new build)
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO)
+        with _atomic.atomic_path(_SO) as tmp:
+            subprocess.run(
+                ["g++", "-O3", "-std=c++17", "-fPIC", "-pthread",
+                 "-shared", "-o", tmp, _SRC],
+                check=True, capture_output=True, timeout=120)
         return True
     except Exception:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
         return False
 
 

@@ -10,6 +10,7 @@ from apex_tpu.analysis.rules.warmup_coverage import WarmupCoverageRule
 from apex_tpu.analysis.rules.abi_lockstep import AbiLockstepRule
 from apex_tpu.analysis.rules.metric_drift import MetricDriftRule
 from apex_tpu.analysis.rules.event_drift import EventDriftRule
+from apex_tpu.analysis.rules.durable_write import DurableWriteRule
 from apex_tpu.analysis.rules.citation import CitationRule
 from apex_tpu.analysis.rules.tier1_cost import Tier1CostRule
 
@@ -24,6 +25,7 @@ ALL_RULES = [
     AbiLockstepRule(),
     MetricDriftRule(),
     EventDriftRule(),
+    DurableWriteRule(),
     CitationRule(),
     Tier1CostRule(),
 ]

@@ -1,0 +1,133 @@
+"""DURABLE-WRITE: crash-safe artifacts never come from bare open(w).
+
+The repo has exactly two blessed ways to materialise a durability
+artifact: the shared atomic-write helpers (``apex_tpu._atomic`` —
+same-dir temp + ``os.replace``, extracted from the checkpoint/bundle/
+native-build sites that each grew the idiom independently) and the
+write-ahead journal's CRC-framed append path
+(``apex_tpu.serving.journal``). A bare ``open(path, "w")`` into a
+checkpoint/bundle/journal-named destination bypasses both, and the
+failure it re-introduces is precisely the one those paths exist to
+kill: a crash mid-write leaves a TRUNCATED file at the real
+destination — a checkpoint that half-parses, a bundle a post-mortem
+tool trusts, a journal segment whose torn tail now sits *before*
+records that were already durable. The write works in every test and
+loses data only on the crash it was supposed to survive, which is why
+this is a static rule and not a runtime check.
+
+Scope (narrow): calls to the ``open`` builtin in write mode (a mode
+string constant starting with ``w``/``x``) whose PATH argument subtree
+names a durable artifact — a string constant, identifier, attribute,
+or f-string piece matching checkpoint/ckpt/bundle/journal. Append
+mode is exempt (appending is the journal's own contract), as are the
+two blessed implementations themselves. Writes into an
+``atomic_dir``/``atomic_path`` temp target don't match — their path
+spells the temp name, not the artifact (that is the point).
+Suppress a true intermediate with ``# apex: noqa[DURABLE-WRITE]: why``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Tuple
+
+from apex_tpu.analysis.core import Finding, Project
+
+#: durable-artifact naming tokens — the vocabulary every crash-safe
+#: surface in the repo actually uses (checkpoint.py, flightrec
+#: bundles, serving/journal segments)
+_DURABLE_RE = re.compile(r"(?i)(checkpoint|ckpt|bundle|journal)")
+
+#: the blessed implementations: the atomic helpers themselves and the
+#: WAL, whose segment/manifest writes ARE the safe path being policed
+_EXEMPT_SUFFIXES = (
+    "apex_tpu/_atomic.py",
+    "apex_tpu/serving/journal.py",
+)
+
+
+def _mode_of(call: ast.Call) -> Optional[str]:
+    """The mode string constant of an ``open`` call, or None when
+    absent/dynamic (dynamic modes are out of scope — narrow rule)."""
+    mode: Optional[ast.AST] = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    else:
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return None
+
+
+def _path_arg(call: ast.Call) -> Optional[ast.AST]:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "file":
+            return kw.value
+    return None
+
+
+def _durable_token(path: ast.AST) -> Optional[str]:
+    """The first durable-artifact token named anywhere in the path
+    expression — string constants, identifiers, attributes, and
+    f-string text all count (``os.path.join(ckpt_dir, name)`` names
+    the artifact through the identifier)."""
+    for n in ast.walk(path):
+        text = None
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            text = n.value
+        elif isinstance(n, ast.Name):
+            text = n.id
+        elif isinstance(n, ast.Attribute):
+            text = n.attr
+        if text:
+            m = _DURABLE_RE.search(text)
+            if m:
+                return m.group(0)
+    return None
+
+
+class DurableWriteRule:
+    id = "DURABLE-WRITE"
+    summary = ("checkpoint/bundle/journal artifacts must go through "
+               "apex_tpu._atomic or the WAL append path — a bare "
+               "open(path, 'w') leaves a truncated artifact at the "
+               "destination on the one crash it needed to survive")
+    triggers: Tuple[str, ...] = ()
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for ctx in project.targets:
+            if ctx.tree is None:
+                continue
+            rel = ctx.rel.replace("\\", "/")
+            if rel.endswith(_EXEMPT_SUFFIXES):
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call) \
+                        or not isinstance(node.func, ast.Name) \
+                        or node.func.id != "open":
+                    continue
+                mode = _mode_of(node)
+                if mode is None or not mode.startswith(("w", "x")):
+                    continue
+                path = _path_arg(node)
+                if path is None:
+                    continue
+                token = _durable_token(path)
+                if token is None:
+                    continue
+                findings.append(Finding(
+                    self.id, ctx.rel, node.lineno,
+                    f"open(..., {mode!r}) writes a "
+                    f"{token.lower()}-named artifact directly — a "
+                    f"crash mid-write leaves a truncated file where "
+                    f"a reader expects a complete one; route it "
+                    f"through apex_tpu._atomic.atomic_write/"
+                    f"atomic_dir (or the journal's append path)",
+                    col=node.col_offset))
+        return findings

@@ -25,14 +25,13 @@ from __future__ import annotations
 import json
 import os
 import struct
-import tempfile
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from apex_tpu import _native
+from apex_tpu import _atomic, _native
 
 try:  # pragma: no cover - exercised when orbax is present
     import orbax.checkpoint as _ocp
@@ -49,36 +48,11 @@ def _path_key(path) -> str:
 #: .atck layout: magic, header-length u64, JSON header, blob, crc32 u32.
 _MAGIC = b"ATCK0001"
 
-#: process umask, probed once at import (os.umask can only be read by
-#: setting it — doing that per save would race other threads' file
-#: creation through a umask-0 window)
-_UMASK = os.umask(0)
-os.umask(_UMASK)
-
-
-def _atomic_write(path: str, write_fn) -> None:
-    """Run ``write_fn(file)`` against a same-directory temp file, then
-    ``os.replace`` it onto ``path``: a crash mid-write leaves the old
-    checkpoint (or nothing) at the destination, never a truncated file
-    that parses as garbage. Same-dir matters — ``os.replace`` is only
-    atomic within a filesystem. The fd is owned (and closed exactly
-    once) by the ``with`` block, so a failing replace still reports its
-    own error and the temp file is removed."""
-    fd, tmp = tempfile.mkstemp(
-        dir=os.path.dirname(os.path.abspath(path)) or ".",
-        prefix=os.path.basename(path) + ".tmp.")
-    try:
-        # mkstemp creates 0600; restore the umask-derived mode a plain
-        # open() would have given, so checkpoints stay readable by the
-        # same processes that could read them before the atomic switch
-        os.fchmod(fd, 0o666 & ~_UMASK)
-        with os.fdopen(fd, "wb") as f:
-            write_fn(f)
-        os.replace(tmp, path)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+#: the shared crash-safe write (apex_tpu._atomic): same-dir temp +
+#: ``os.replace``, so a crash mid-write leaves the old checkpoint (or
+#: nothing) at the destination, never a truncated file that parses as
+#: garbage
+_atomic_write = _atomic.atomic_write
 
 
 def save_checkpoint_bin(path: str, state: Any) -> str:

@@ -44,7 +44,9 @@ from apex_tpu.serving.request import (  # noqa: F401
 
 __all__ = [
     "request", "sampling", "engine", "scheduler", "resilience", "api",
-    "pages", "fleet", "tuner", "tenancy",
+    "pages", "fleet", "tuner", "tenancy", "journal",
+    "Journal", "JournalError", "RecoveryReport",
+    "recover_scheduler", "replay_into", "scan_journal",
     "TenancyConfig", "TenantBook", "TenantThrottled",
     "Request", "SamplingParams", "Completion", "StreamEvent",
     "StopMatcher",
@@ -87,6 +89,13 @@ _LAZY = {
     "TenancyConfig": "apex_tpu.serving.tenancy",
     "TenantBook": "apex_tpu.serving.tenancy",
     "TenantThrottled": "apex_tpu.serving.tenancy",
+    "journal": "apex_tpu.serving.journal",
+    "Journal": "apex_tpu.serving.journal",
+    "JournalError": "apex_tpu.serving.journal",
+    "RecoveryReport": "apex_tpu.serving.journal",
+    "recover_scheduler": "apex_tpu.serving.journal",
+    "replay_into": "apex_tpu.serving.journal",
+    "scan_journal": "apex_tpu.serving.journal",
     "fleet": "apex_tpu.serving.fleet",
     "Router": "apex_tpu.serving.fleet",
     "FleetConfig": "apex_tpu.serving.fleet",

@@ -853,16 +853,31 @@ class Router:
             self.telemetry.drains.inc()
         self._update_gauges()
 
-    def restart(self, index: int) -> None:
+    def restart(self, index: int,
+                journal_dir: Optional[str] = None) -> None:
         """Replace a terminally failed replica from the factory and
         re-admit it to rotation (its interrupted work already failed
-        over when it died)."""
+        over when it died).
+
+        ``journal_dir`` points at the dead replica's write-ahead
+        journal (``apex_tpu.serving.journal``): the replacement
+        replays its unfinished state — pooled prefixes and every
+        request the eviction hook never got to hand over (a SIGKILL'd
+        process evicts nothing), with their emitted prefixes intact —
+        so a whole-process replica death recovers instead of dropping
+        streams. Work that DID fail over was journaled finished
+        ("evicted") by the dying scheduler and is never resubmitted
+        twice; adapters re-register through the fleet's own ledger
+        either way, keeping ids aligned across siblings."""
         rep = self._replica(index)
         if rep.state != REPLICA_FAILED:
             raise ValueError(
                 f"replica {index} is {rep.state}, not failed — use "
                 f"drain({index}) for a rolling restart")
         self._replace(rep, "failed")
+        if journal_dir is not None:
+            from apex_tpu.serving import journal as journal_mod
+            journal_mod.replay_into(rep.sched, journal_dir)
         rep.reset_breaker()
         rep.cooldown = 0
         rep.state = REPLICA_LIVE

@@ -487,3 +487,207 @@ class ResilienceConfig:
         """Backoff before retry ``attempt`` (1-based)."""
         return self.backoff_base_s * (
             self.backoff_factor ** max(attempt - 1, 0))
+
+
+# -- crash drill (subprocess SIGKILL + journal recovery) ----------------------
+
+#: the drill child: a self-contained serving subprocess the parent can
+#: SIGKILL mid-stream. "run" serves a deterministic request trace
+#: (optionally journaled), printing one "TOKENS <n>" progress line per
+#: scheduler step — the parent's kill trigger; "recover" rebuilds via
+#: journal.recover_scheduler and serves to idle. Both end with one
+#: "DONE <json>" line carrying every request's final stream (the
+#: recover mode merges journal-finished requests with its own
+#: completions, so the parent compares complete traces). Kept as
+#: source, not a function, because the whole point is a separate
+#: process to kill -9.
+_DRILL_CHILD_SRC = '''\
+"""Crash-drill child — spawned by resilience.sigkill_drill."""
+import argparse
+import json
+import sys
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("mode", choices=["run", "recover"])
+    ap.add_argument("--journal", default=None)
+    ap.add_argument("--requests", type=int, default=3)
+    ap.add_argument("--max-tokens", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=7000)
+    args = ap.parse_args()
+
+    import jax
+    from apex_tpu import mesh as mx
+    from apex_tpu.models import gpt
+    from apex_tpu.serving import Request, SamplingParams
+    from apex_tpu.serving.engine import Engine, EngineConfig
+    from apex_tpu.serving.journal import (Journal, recover_scheduler,
+                                          replay_state, scan_journal)
+    from apex_tpu.serving.scheduler import Scheduler
+    from apex_tpu.transformer.testing import standalone_gpt_config
+
+    VOCAB = 96
+    cfg = standalone_gpt_config(vocab_size=VOCAB, seq_len=64)
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    mesh = mx.build_mesh(tp=1, devices=jax.devices()[:1])
+
+    def build():
+        return Engine(cfg, params, mesh,
+                      EngineConfig(slots=2, max_prompt_len=8,
+                                   max_seq_len=24, decode_chunk=2))
+
+    def reqs():
+        out = []
+        for i in range(args.requests):
+            p_len = 2 + (3 * i) % 6
+            prompt = [int(t) for t in jax.random.randint(
+                jax.random.PRNGKey(args.seed + i), (p_len,), 0, VOCAB)]
+            sp = (SamplingParams(temperature=0.9, top_k=7,
+                                 seed=args.seed + i)
+                  if i % 2 else SamplingParams())
+            out.append(Request(f"d{i}", prompt,
+                               max_tokens=args.max_tokens, sampling=sp))
+        return out
+
+    extra = {}
+    if args.mode == "run":
+        eng = build().warmup()
+        j = Journal(args.journal) if args.journal else None
+        sched = Scheduler(eng, journal=j)
+        for r in reqs():
+            sched.submit(r)
+        while not sched.idle():
+            sched.step()
+            # the parent's kill trigger: one progress line per step
+            print("TOKENS", sched._tokens_emitted, flush=True)
+    else:
+        t0 = time.monotonic()
+        sched, report = recover_scheduler(args.journal, build)
+        extra["recovery_ms"] = (time.monotonic() - t0) * 1e3
+        extra["report"] = report.as_dict()
+        # requests that finished BEFORE the crash live only in the
+        # journal now — merge them so DONE carries the full trace the
+        # client saw across both processes
+        state = replay_state(scan_journal(args.journal)[0])
+        for rid, rq in state.requests.items():
+            if rq["finished"]:
+                extra.setdefault("prior", {})[rid] = list(rq["emitted"])
+        while not sched.idle():
+            sched.step()
+        extra["journal_fsync_ms"] = sched.journal.fsync_s * 1e3
+    done = {rid: {"tokens": list(c.tokens), "reason": c.finish_reason}
+            for rid, c in sched.completions.items()}
+    print("DONE " + json.dumps({"completions": done, **extra}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
+'''
+
+
+def sigkill_drill(workdir: str, *, requests: int = 3,
+                  max_tokens: int = 10, kill_after_tokens: int = 6,
+                  seed: int = 7000, timeout_s: float = 900.0,
+                  python: Optional[str] = None) -> Dict[str, object]:
+    """The crash drill the journal's whole design is judged by: spawn
+    a serving subprocess journaling to ``workdir/journal``, ``kill
+    -9`` it once ``kill_after_tokens`` tokens have streamed, restart
+    from the journal in a fresh subprocess, and compare every
+    request's end-to-end stream against an uninterrupted reference
+    run. Returns::
+
+        {"parity": bool, "killed_at_tokens": int, "recovery_ms": ...,
+         "journal_fsync_ms": ..., "recovered_requests": int,
+         "reference": {rid: [tok, ...]}, "recovered": {rid: [...]}}
+
+    Children run on one forced-CPU device with the persistent compile
+    cache DISABLED (restoring cached executables in subprocess smokes
+    corrupts this runtime's heap — see tests/conftest.py), so each
+    child pays a cold compile: minutes, not seconds. Slow-marked
+    tests and ``bench.py --mode serve --crash`` are the callers."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    import apex_tpu
+
+    os.makedirs(workdir, exist_ok=True)
+    child = os.path.join(workdir, "drill_child.py")
+    with open(child, "w", encoding="utf-8") as f:
+        f.write(_DRILL_CHILD_SRC)
+    journal_dir = os.path.join(workdir, "journal")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(
+        apex_tpu.__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_COMPILATION_CACHE_DIR"] = ""     # empty = disabled
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    py = python or sys.executable
+    base = [py, child, "--requests", str(requests),
+            "--max-tokens", str(max_tokens), "--seed", str(seed)]
+
+    def _done_line(text: str) -> Dict[str, object]:
+        for line in text.splitlines():
+            if line.startswith("DONE "):
+                return _json.loads(line[5:])
+        raise RuntimeError(f"drill child printed no DONE line:\n{text}")
+
+    # 1) uninterrupted reference (no journal — also the A side of
+    #    "recovery changes nothing")
+    ref = subprocess.run(base + ["run"], env=env, capture_output=True,
+                         text=True, timeout=timeout_s)
+    if ref.returncode != 0:
+        raise RuntimeError(f"reference run failed:\n{ref.stderr}")
+    reference = {rid: c["tokens"]
+                 for rid, c in _done_line(ref.stdout)["completions"].items()}
+
+    # 2) victim: journaled, killed -9 mid-stream on the progress line
+    victim = subprocess.Popen(
+        base + ["run", "--journal", journal_dir], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    killed_at = -1
+    try:
+        assert victim.stdout is not None
+        for line in victim.stdout:
+            if line.startswith("TOKENS "):
+                n = int(line.split()[1])
+                if n >= kill_after_tokens:
+                    killed_at = n
+                    victim.kill()   # SIGKILL — no atexit, no flush
+                    break
+            elif line.startswith("DONE "):
+                break   # finished before the threshold — no kill
+    finally:
+        victim.wait(timeout=timeout_s)
+    if killed_at < 0:
+        raise RuntimeError(
+            f"victim finished before streaming {kill_after_tokens} "
+            f"tokens — lower kill_after_tokens or raise max_tokens")
+
+    # 3) recover from the journal in a fresh process
+    rec = subprocess.run(base + ["recover", "--journal", journal_dir],
+                         env=env, capture_output=True, text=True,
+                         timeout=timeout_s)
+    if rec.returncode != 0:
+        raise RuntimeError(f"recovery run failed:\n{rec.stderr}")
+    payload = _done_line(rec.stdout)
+    recovered = {rid: c["tokens"]
+                 for rid, c in payload["completions"].items()}
+    recovered.update(payload.get("prior", {}))
+    parity = recovered == reference
+    return {
+        "parity": parity,
+        "killed_at_tokens": killed_at,
+        "recovery_ms": payload.get("recovery_ms"),
+        "journal_fsync_ms": payload.get("journal_fsync_ms"),
+        "recovered_requests": int(
+            payload.get("report", {}).get("requests", 0)),
+        "reference": reference,
+        "recovered": recovered,
+    }

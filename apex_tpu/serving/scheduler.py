@@ -91,6 +91,7 @@ from typing import (
 )
 
 from apex_tpu import profiler
+from apex_tpu.serving import journal as journal_mod
 from apex_tpu.serving.engine import (
     Admission,
     ChunkedAdmission,
@@ -524,6 +525,36 @@ class _RegistryMetrics:
             labels=("objective", "state"))
         self.slo_quantile: Dict[Tuple[str, str], Any] = {}
         self.slo_children: Dict[str, Dict[str, Any]] = {}
+        # -- durable request journal (serving.journal) --------------------
+        # pre-created even without a journal (explicit zeros in
+        # scrapes, the ladder-counter convention); refreshed at the
+        # scheduler's fetch-boundary commit
+        self.journal_appends = registry.counter(
+            "serving_journal_appends_total",
+            "write-ahead journal records appended (submit/extend/"
+            "finish/park/resume/registrations)")
+        self.journal_rotations = registry.counter(
+            "serving_journal_rotations_total",
+            "journal segments sealed and rotated")
+        self.journal_compactions = registry.counter(
+            "serving_journal_compactions_total",
+            "journal compactions (finished requests dropped, live "
+            "state rewritten into one fresh segment)")
+        self.journal_fsync = registry.counter(
+            "serving_journal_fsync_seconds",
+            "wall seconds spent in journal fsync calls — the "
+            "durability tax the fsync policy prices")
+        self.journal_bytes = registry.gauge(
+            "serving_journal_bytes",
+            "write-ahead journal bytes on disk across all segments")
+        self.journal_lag = registry.gauge(
+            "serving_journal_lag_bytes",
+            "journal bytes appended since the last fsync — what a "
+            "crash right now could lose to the page cache")
+        self.journal_recovered = registry.counter(
+            "serving_journal_recovered_total",
+            "unfinished requests resubmitted from a journal during "
+            "crash recovery (replay_into/recover_scheduler)")
 
     def tenant(self, t: str) -> Dict[str, Any]:
         """Cached per-tenant metric children (created on first
@@ -716,7 +747,8 @@ class Scheduler:
                  request_log: int = 4096,
                  preempt: Optional[bool] = None,
                  on_evict: Optional[
-                     Callable[[List[EvictedRequest], str], None]] = None):
+                     Callable[[List[EvictedRequest], str], None]] = None,
+                 journal=None):
         if pipeline_depth < 1:
             raise ValueError(
                 f"pipeline_depth {pipeline_depth} must be >= 1 (1 = the "
@@ -813,6 +845,30 @@ class Scheduler:
         #: stream intact. None (the default) keeps the single-engine
         #: abort-with-error semantics unchanged.
         self.on_evict = on_evict
+        #: durable write-ahead request journal
+        #: (:class:`apex_tpu.serving.journal.Journal`): every
+        #: durable-relevant host decision — submits, emitted-prefix
+        #: extends at fetch boundaries, finishes, park/resume,
+        #: registrations — is appended so
+        #: :func:`~apex_tpu.serving.journal.recover_scheduler` can
+        #: continue every unfinished stream bit-identically after a
+        #: process death. None (the default) journals nothing and
+        #: leaves the hot path untouched.
+        self.journal = journal
+        #: per-request journaled stream length — the extend cursor
+        self._journal_len: Dict[str, int] = {}
+        self._journal_recovered = 0
+        #: last journal counters mirrored into the registry (the
+        #: commit refreshes deltas, so shared registries never
+        #: double-count)
+        self._j_seen = {"appends": 0, "rotations": 0,
+                        "compactions": 0, "fsync_s": 0.0}
+        if journal is not None and journal.seq == 0:
+            # a FRESH journal opens with the engine spec (describe()
+            # round-trip) so recovery can refuse an incompatible
+            # engine_factory; a recovered journal keeps its meta
+            self._jlog("meta", format=journal_mod.FORMAT_VERSION,
+                       engine_spec=journal_mod._engine_spec(engine))
         self._gate_state_seen: Optional[float] = None
         #: the ok → degraded → draining → failed state machine; wire
         #: ``MetricsServer(health=sched.health.healthz)`` to serve it
@@ -1129,6 +1185,7 @@ class Scheduler:
                     f"raise EngineConfig.num_pages or shrink the "
                     f"request")
         self._record_request(request, now)
+        self._journal_submit(request, now)
         if replay_prefix:
             # failover hand-off: everything another replica streamed
             # becomes this scheduler's last-known-good snapshot — the
@@ -1138,6 +1195,11 @@ class Scheduler:
             if len(replay_prefix) > len(st.tokens):
                 st.tokens = [int(t) for t in replay_prefix]
                 st.logprobs = list(replay_logprobs or [])
+            # journaled immediately (not at the next fetch boundary):
+            # the hand-off prefix is the client's already-seen stream
+            # — a crash before the first chunk must not forget it
+            self._journal_extend(request.request_id, st.tokens,
+                                 st.logprobs)
         # a tenant (re-)entering the backlog competes from "now": its
         # deficit counter clamps up to the minimum among the tenants
         # currently holding queued/active work — idle time is not
@@ -1311,12 +1373,28 @@ class Scheduler:
         post-mortem bundles next to the admissions that used it."""
         aid = self.engine.register_adapter(weights, name=name,
                                            seed=seed)
+        meta = self.engine._adapter_meta.get(aid, {})
         if self.recorder is not None:
-            meta = self.engine._adapter_meta.get(aid, {})
             self.recorder.record("adapter_register",
                                  meta.get("name"), aid,
                                  meta.get("seed"))
+        # journaled with its derivation seed: recovery re-registers by
+        # name (idempotent) and re-derives the exact weights; an
+        # explicit-weights registration journals seed=None and its
+        # requests are skipped at recovery (counted, never guessed)
+        self._jlog("adapter", name=meta.get("name"),
+                   seed=meta.get("seed"), rank=meta.get("rank"),
+                   adapter_id=aid)
         return aid
+
+    def register_prefix(self, tokens) -> int:
+        """Register a shared prompt-prefix template into the engine's
+        pool (:meth:`Engine.register_prefix`) and journal the token
+        list, so a crash-recovered scheduler repopulates the pool and
+        replayed admissions ride the same (page, split) hits."""
+        page = self.engine.register_prefix(tokens)
+        self._jlog("prefix", tokens=[int(t) for t in tokens])
+        return page
 
     def tenant_summary(self) -> Dict[str, Dict[str, float]]:
         """Per-tenant accounting: weight, submitted/admitted/shed/
@@ -1402,6 +1480,11 @@ class Scheduler:
             if pk is not None and pk.swap:
                 pk.swap = False
                 self._swap_capacity_drops += 1
+        # the snapshot just grown is the recompute-resume contract —
+        # journal it now plus the park marker, so a crash while parked
+        # recovers the conversation instead of forgetting it
+        self._journal_extend(rid, st.tokens, st.logprobs)
+        self._jlog("park", request_id=rid)
         if self.recorder is not None:
             self.recorder.record("page_swap_out", rid, slot, n_pages,
                                  self.engine.parked_bytes(rid))
@@ -1442,6 +1525,7 @@ class Scheduler:
                 self.engine.drop_parked(rid)
                 self._recompute_resumes += 1
                 self.queue.appendleft(act.request)
+                self._jlog("resume", request_id=rid, path="recompute")
                 if self.recorder is not None:
                     self.recorder.record("page_swap_in", rid, -1,
                                          n_pages, "recompute")
@@ -1479,6 +1563,7 @@ class Scheduler:
             self._parked.pop(rid)
             self.active[slot] = act
             self._swap_resumes += 1
+            self._jlog("resume", request_id=rid, path="swap")
             if self.recorder is not None:
                 self.recorder.record("page_swap_in", rid, slot,
                                      n_pages, "swap")
@@ -2083,6 +2168,10 @@ class Scheduler:
         # machine, and the rebuild-storm counter resets
         self._consecutive_rebuilds = 0
         self.health.record_progress()
+        # the fetch boundary is the journal's durability point: every
+        # token this chunk streamed is on disk (per fsync policy)
+        # before the next dispatch can build on it
+        self._journal_commit()
 
     # -- token emission (stop sequences, constraints, logprobs) -------------
 
@@ -2430,6 +2519,11 @@ class Scheduler:
                 # mid-replay: the pre-fault stream is the longest the
                 # client saw — never hand over a shrunk snapshot
                 tokens, lps = list(st.tokens), list(st.logprobs)
+            # the router owns these streams now: journaled finished
+            # ("evicted") so a crash-restart from THIS replica's
+            # journal never resubmits work the fleet already failed
+            # over — that would fork the client stream
+            self._journal_finish(request, tokens, lps, "evicted")
             self._req_records.pop(request.request_id, None)
             evicted.append(EvictedRequest(request, tokens, lps))
 
@@ -2458,7 +2552,106 @@ class Scheduler:
             self.telemetry.queue_depth.set(0)
             self.telemetry.active_slots.set(0)
             self.telemetry.inflight.set(0)
+        # the evict-finishes must be durable BEFORE the router
+        # resubmits the work elsewhere — a crash in between would
+        # otherwise recover requests another replica is now serving
+        self._journal_commit()
         self.on_evict(evicted, cause)
+
+    # -- durable request journal (serving.journal) ---------------------------
+
+    def _jlog(self, kind: str, **fields) -> None:
+        """Append one journal record (no-op without a journal) and
+        surface it in the flight recorder — journal growth is itself
+        a host decision a post-mortem wants on the timeline."""
+        j = self.journal
+        if j is None:
+            return
+        rot = j.rotations
+        seq = j.append(kind, **fields)
+        rec = self.recorder
+        if rec is not None:
+            rec.record("journal_append", seq, kind,
+                       j.last_append_bytes)
+            if j.rotations != rot and j.last_sealed is not None:
+                rec.record("journal_rotate", *j.last_sealed)
+
+    def _journal_submit(self, request: Request, now: float) -> None:
+        """Journal an accepted request — the replayable
+        ``_record_request`` row, with the absolute deadline converted
+        to REMAINING budget (a monotonic clock does not survive a
+        restart; recovery re-bases it)."""
+        if self.journal is None:
+            return
+        row = dict(self._req_records[request.request_id])
+        row.pop("arrival", None)
+        deadline = row.pop("deadline", None)
+        row["deadline_remaining"] = (
+            None if deadline is None else max(deadline - now, 0.0))
+        self._jlog("submit", **row)
+        self._journal_len[request.request_id] = 0
+
+    def _journal_extend(self, rid: str, tokens, logprobs) -> None:
+        """Journal the growth of one stream's emitted prefix since the
+        last extend. Absolute start offsets make replay idempotent —
+        the property compaction's crash-safety rests on. Unknown ids
+        (terminal-at-submit, pre-journal requests) are skipped."""
+        jl = self._journal_len.get(rid)
+        if jl is None or len(tokens) <= jl:
+            return
+        self._jlog("extend", request_id=rid, start=jl,
+                   tokens=[int(t) for t in tokens[jl:]],
+                   logprobs=[float(x) for x in logprobs[jl:]])
+        self._journal_len[rid] = len(tokens)
+
+    def _journal_commit(self) -> None:
+        """The fetch-boundary durability point: extend every live
+        stream (active slots AND replay snapshots — a preempted or
+        parked conversation's prefix lives in ``_replay``), then
+        fsync per the journal's policy, then let auto-compaction run.
+        Registry counters refresh here by delta, off the per-token
+        path."""
+        j = self.journal
+        if j is None:
+            return
+        for act in self.active.values():
+            self._journal_extend(act.request.request_id, act.tokens,
+                                 act.logprobs)
+        for rid, st in self._replay.items():
+            self._journal_extend(rid, st.tokens, st.logprobs)
+        j.commit()
+        j.maybe_compact()
+        tele = self.telemetry
+        if tele is not None:
+            seen = self._j_seen
+            for attr, handle in (
+                    ("appends", tele.journal_appends),
+                    ("rotations", tele.journal_rotations),
+                    ("compactions", tele.journal_compactions)):
+                d = getattr(j, attr) - seen[attr]
+                if d:
+                    handle.inc(d)
+                    seen[attr] = getattr(j, attr)
+            ds = j.fsync_s - seen["fsync_s"]
+            if ds > 0:
+                tele.journal_fsync.inc(ds)
+                seen["fsync_s"] = j.fsync_s
+            tele.journal_bytes.set(j.bytes_on_disk())
+            tele.journal_lag.set(j.lag_bytes)
+
+    def _journal_finish(self, request: Request, tokens, logprobs,
+                        reason: str) -> None:
+        """Journal a terminal outcome: the final extend (everything
+        the client was streamed) then the finish record, so recovery
+        never resubmits completed — or fleet-evicted — work."""
+        if self.journal is None:
+            return
+        rid = request.request_id
+        if rid not in self._journal_len:
+            return
+        self._journal_extend(rid, tokens, logprobs or [])
+        self._journal_len.pop(rid, None)
+        self._jlog("finish", request_id=rid, reason=reason)
 
     # -- flight recorder + post-mortem bundles -------------------------------
 
@@ -3208,6 +3401,7 @@ class Scheduler:
         if self.recorder is not None:
             self.recorder.record("finish", request.request_id, reason,
                                  len(tokens))
+        self._journal_finish(request, tokens, logprobs, reason)
         rrec = self._req_records.pop(request.request_id, None)
         if rrec is not None:
             # the replayable record graduates to the bounded
@@ -3315,6 +3509,14 @@ class Scheduler:
         if self.engine.chunked_prefill_enabled:
             out["chunked_admissions"] = float(self._chunked_admissions)
             out["chunked_chunks"] = float(self._chunked_chunks)
+        if self.journal is not None:
+            # the durability ledger: appended/synced volume, rotation/
+            # compaction churn, and requests this scheduler was
+            # recovered with (0 for a fresh start)
+            for k, v in self.journal.stats().items():
+                out[f"journal_{k}"] = v
+            out["journal_recovered_requests"] = float(
+                self._journal_recovered)
         tn = self._tuner
         if self._gate is not None or (tn is not None
                                       and "spec_k" in tn.knobs):

@@ -43,6 +43,7 @@ import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from apex_tpu import _atomic
 from apex_tpu.telemetry.ring import Ring
 
 #: the event vocabulary: name → positional field names of the args
@@ -102,6 +103,10 @@ EVENT_FIELDS: Dict[str, Tuple[str, ...]] = {
     "failover": ("replica", "cause", "requests"),
     "drain": ("replica", "phase"),
     "restart": ("replica", "cause"),
+    # -- durable request journal (serving.journal) ---------------------------
+    "journal_append": ("seq", "kind", "bytes"),
+    "journal_rotate": ("segment", "records", "bytes"),
+    "recover": ("requests", "adapters", "prefixes", "truncated_bytes"),
     # -- SLO observatory (telemetry.slo) -------------------------------------
     "slo_eval": ("objective", "fast_good", "fast_bad", "slow_good",
                  "slow_bad"),
@@ -209,39 +214,26 @@ def write_bundle(path: str, files: Dict[str, Any]) -> str:
     ``path``: each ``files`` entry becomes one file (``.jsonl`` values
     are lists of dicts written one JSON object per line, everything
     else is JSON), written into a same-filesystem temp directory and
-    ``os.replace``d into place — the checkpoint-write pattern, so a
-    reader either sees the complete bundle or no bundle. Raises if
+    ``os.replace``d into place (:func:`apex_tpu._atomic.atomic_dir` —
+    the shared checkpoint-write pattern), so a reader either sees the
+    complete bundle or no bundle. Raises if
     ``path`` already exists (bundles are immutable evidence; the
     caller picks a fresh name)."""
     path = os.path.abspath(path)
-    if os.path.exists(path):
+    try:
+        with _atomic.atomic_dir(path) as tmp:
+            for name, content in files.items():
+                with open(os.path.join(tmp, name), "w",
+                          encoding="utf-8") as f:
+                    if name.endswith(".jsonl"):
+                        f.write(_jsonl(content))
+                    else:
+                        json.dump(content, f, indent=1, sort_keys=True,
+                                  default=str)
+                        f.write("\n")
+    except FileExistsError:
         raise FileExistsError(f"bundle {path} already exists — bundles "
                               f"are immutable; pick a fresh name")
-    parent = os.path.dirname(path)
-    os.makedirs(parent, exist_ok=True)
-    tmp = f"{path}.tmp{os.getpid()}"
-    os.makedirs(tmp)
-    try:
-        for name, content in files.items():
-            with open(os.path.join(tmp, name), "w",
-                      encoding="utf-8") as f:
-                if name.endswith(".jsonl"):
-                    f.write(_jsonl(content))
-                else:
-                    json.dump(content, f, indent=1, sort_keys=True,
-                              default=str)
-                    f.write("\n")
-        os.replace(tmp, path)
-    except BaseException:
-        # never leave temp droppings next to real bundles
-        for root, dirs, names in os.walk(tmp, topdown=False):
-            for n in names:
-                os.unlink(os.path.join(root, n))
-            for d in dirs:
-                os.rmdir(os.path.join(root, d))
-        if os.path.isdir(tmp):
-            os.rmdir(tmp)
-        raise
     return path
 
 

@@ -37,8 +37,8 @@ BASELINE_TOKENS_PER_SEC = 58600.0
 
 #: stable trajectory keys for the BENCH_serve.json series (bumped per
 #: PR so the per-line provenance is plottable without git archaeology)
-BENCH_PR = 19
-BENCH_LABEL = "kv-oversubscription"
+BENCH_PR = 20
+BENCH_LABEL = "durable-journal"
 
 #: every BENCH_serve.json line must carry these, with these types —
 #: the provenance triple that makes the series plottable without git
@@ -625,6 +625,170 @@ def _api_wire_load(engine, reqs, inproc_tokens, vocab_size):
         "tokens": total,
         "token_drift": 0,
     }
+
+
+def crash_smoke():
+    """``--mode serve --crash``: the durable-journal A/B + recovery
+    drill — the SAME seeded burst trace run with the write-ahead
+    request journal on (``fsync="batch"``) vs off, paired per
+    interleaved round with the median wall ratio reported (the
+    durability tax must live inside the established noise band), plus
+    an in-process crash-at-the-fsync-boundary drill: run the journaled
+    side partway, drop the device state (``rebuild_slots`` — the
+    warm-restart regime, process alive but engine state gone), then
+    ``recover_scheduler`` from the journal and drain — every recovered
+    stream (greedy AND sampled) must be bit-identical to an
+    uninterrupted run, with zero recompiles. Reports
+    ``recovery_time_ms`` (scan + replay + resubmit, value-fetch
+    synced by the drained completions) and ``journal_fsync_ms`` (the
+    victim's total fsync stall). Appends the standard smoke line plus
+    the crash extras to BENCH_serve.json. One JSON line printed."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    from apex_tpu.serving import Request, SamplingParams
+    from apex_tpu.serving.engine import Engine, EngineConfig
+    from apex_tpu.serving.journal import Journal, recover_scheduler
+    from apex_tpu.serving.scheduler import Scheduler
+
+    cfg = gpt.GPTConfig(
+        vocab_size=1024, hidden_size=256, num_layers=4, num_heads=8,
+        seq_len=256, remat=False, compute_dtype=jnp.float32)
+    ecfg = EngineConfig(slots=4, max_prompt_len=16, max_seq_len=32,
+                        decode_chunk=2)
+    mesh = mx.build_mesh(tp=1, devices=jax.devices()[:1])
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    n = 12
+
+    def trace():
+        reqs = []
+        for i in range(n):
+            p_len = 1 + (7 * i + 3) % ecfg.max_prompt_len
+            prompt = [int(t) for t in jax.random.randint(
+                jax.random.PRNGKey(1200 + i), (p_len,), 0,
+                cfg.vocab_size)]
+            sp = (SamplingParams(temperature=0.9, top_k=40, seed=i)
+                  if i % 2 else SamplingParams())
+            reqs.append(Request(f"r{i}", prompt, max_tokens=8,
+                                sampling=sp))
+        return reqs
+
+    workdir = tempfile.mkdtemp(prefix="apex_crash_smoke_")
+    try:
+        with Engine(cfg, params, mesh, ecfg).warmup() as eng:
+            sen0 = eng.recompile_sentinel()
+
+            def run(journal_dir):
+                j = (Journal(journal_dir, fsync="batch")
+                     if journal_dir else None)
+                sched = Scheduler(eng, max_queue=2 * n, journal=j)
+                for r in trace():
+                    sched.submit(r)
+                t0 = _time.perf_counter()
+                sched.run_until_idle()
+                wall = _time.perf_counter() - t0
+                if j is not None:
+                    j.close()
+                toks = {rid: c.tokens for rid, c in
+                        sched.completions.items()}
+                return toks, wall, sched
+
+            # uninterrupted journal-free reference: the oracle both
+            # the A/B sides and every recovered stream must match
+            ref, _, _ = run(None)
+
+            # paired journal-on/off A/B: same engine, same trace,
+            # alternating side order, median per-round ratio
+            walls = {"on": [], "off": []}
+            ratios = []
+            fsync_ms = 0.0
+            for rnd in range(5):
+                round_wall = {}
+                for side in _ab_order(rnd, ("on", "off")):
+                    jd = (os.path.join(workdir, f"ab{rnd}")
+                          if side == "on" else None)
+                    toks, wall, sched = run(jd)
+                    assert toks == ref, f"crash ab {side} token drift"
+                    round_wall[side] = wall
+                    walls[side].append(wall)
+                    if side == "on":
+                        fsync_ms = max(
+                            fsync_ms,
+                            1e3 * sched.summary()["journal_fsync_s"])
+                        shutil.rmtree(jd)
+                ratios.append(round_wall["on"]
+                              / max(round_wall["off"], 1e-9))
+            overhead = _median(ratios)
+            assert 0.74 <= overhead <= 1.23, (
+                f"journal overhead ratio {overhead:.3f} outside the "
+                f"paired-A/B noise band (0.74-1.23) — the durability "
+                f"tax is real, price it in DESIGN.md")
+
+            # crash drill: journaled run partway, device state dropped
+            # at the fsync boundary, then recover from the journal
+            jd = os.path.join(workdir, "drill")
+            j = Journal(jd, fsync="batch")
+            victim = Scheduler(eng, max_queue=2 * n, journal=j)
+            for r in trace():
+                victim.submit(r)
+            for _ in range(4):
+                victim.step()
+            prior = {rid: c.tokens for rid, c in
+                     victim.completions.items()}
+            drill_fsync_ms = 1e3 * j.fsync_s
+            j.close()
+            eng.rebuild_slots()
+
+            t0 = _time.perf_counter()
+            sched2, report = recover_scheduler(
+                jd, lambda: eng, max_queue=2 * n)
+            recovery_ms = 1e3 * (_time.perf_counter() - t0)
+            sched2.run_until_idle()
+            sched2.journal.close()
+            merged = dict(prior)
+            merged.update({rid: c.tokens for rid, c in
+                           sched2.completions.items()})
+            drift = sorted(rid for rid in ref
+                           if merged.get(rid) != ref[rid])
+            assert not drift, f"crash recovery token drift: {drift}"
+            assert eng.recompile_sentinel() == sen0, \
+                "crash drill recompiled — recovery missed warmup"
+
+            line = {
+                "metric": "gpt_serve_crash",
+                "value": round(overhead, 3),
+                "unit": "x_journal_overhead",
+                "requests": n,
+                "journal_overhead_ratio": round(overhead, 3),
+                "journaled_tokens_per_sec": round(
+                    n * 8 / _median(walls["on"]), 1),
+                "unjournaled_tokens_per_sec": round(
+                    n * 8 / _median(walls["off"]), 1),
+                "journal_fsync_ms": round(max(fsync_ms,
+                                              drill_fsync_ms), 3),
+                "recovery_time_ms": round(recovery_ms, 2),
+                "recovered_requests": report.requests,
+                "completed_before_crash": len(prior),
+                "token_drift": 0,
+            }
+        smoke = _smoke_headline()
+        line["bench_out"] = _append_traj(
+            {"pr": BENCH_PR, "label": BENCH_LABEL, **smoke},
+            {
+                "pr": BENCH_PR,
+                "label": BENCH_LABEL,
+                "metric": line["metric"],
+                "journal_overhead_ratio": line["journal_overhead_ratio"],
+                "journaled_tokens_per_sec": line[
+                    "journaled_tokens_per_sec"],
+                "recovery_time_ms": line["recovery_time_ms"],
+                "journal_fsync_ms": line["journal_fsync_ms"],
+                "token_drift": 0,
+            })
+        print(json.dumps(line))
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
 
 
 def _ab_order(rnd, sides):
@@ -1869,6 +2033,13 @@ if __name__ == "__main__":
                     "replica-mid-burst drill vs a clean single "
                     "replica) — asserts recovery + zero token drift "
                     "and appends a fleet-router BENCH_serve.json line")
+    ap.add_argument("--crash", action="store_true",
+                    help="serve mode: run the durable-journal A/B "
+                    "(write-ahead request journal on vs off, paired "
+                    "rounds) + an in-process crash-and-recover drill "
+                    "— asserts the journal tax stays inside the noise "
+                    "band, recovered streams are bit-identical, and "
+                    "appends a durable-journal BENCH_serve.json line")
     ap.add_argument("--oversub", action="store_true",
                     help="serve mode: run the KV-oversubscription A/B "
                     "(idle-heavy trace over a host-swap engine vs the "
@@ -1884,6 +2055,8 @@ if __name__ == "__main__":
             fleet_smoke()
         elif args.oversub:
             oversub_smoke()
+        elif args.crash:
+            crash_smoke()
         else:
             serve(telemetry_out=args.telemetry_out, api=args.api)
     else:

@@ -136,6 +136,20 @@ exactly — or render its timeline with no jax installed::
 
   python -m apex_tpu.telemetry.replay incidents/bundle-0000-* \
       [--report]
+
+Durable serving (``apex_tpu.serving.journal``): ``--journal-dir DIR``
+arms the write-ahead request journal — every submit and every emitted
+token is durable at the step boundary, ``SIGTERM`` drains and seals
+the journal (a ``SIGKILL`` or power loss merely leaves a torn tail
+the next open repairs), and rerunning with the SAME dir resumes every
+unfinished stream exactly where it stopped, bit-identical to a run
+that was never interrupted::
+
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python examples/serve_gpt.py --num-requests 8 --journal-dir wal &
+  sleep 20 && kill -TERM %1; wait          # or kill -9: same recovery
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python examples/serve_gpt.py --num-requests 8 --journal-dir wal
 """
 
 import argparse
@@ -264,6 +278,15 @@ def main():
                     "post-mortem bundles here on fault/watchdog/alarm "
                     "(SIGUSR1 or GET /debug/bundle dump on demand; "
                     "python -m apex_tpu.telemetry.replay replays one)")
+    ap.add_argument("--journal-dir", metavar="DIR", default=None,
+                    help="arm the durable write-ahead request journal "
+                    "(apex_tpu.serving.journal): every submit and "
+                    "emitted token is made durable at the fetch "
+                    "boundary, SIGTERM drains + seals the journal, "
+                    "and rerunning with the SAME dir resumes every "
+                    "unfinished stream bit-identically (single "
+                    "replica only; fleets journal per replica via "
+                    "Router.restart(journal_dir=...))")
     ap.add_argument("--fault-plan", metavar="SPEC", default=None,
                     help="inject deterministic faults at the engine "
                     "seams: 'random:SEED[:N]' or a comma list of "
@@ -561,6 +584,12 @@ def main():
     # the trace instead of dying on backpressure at the default 256
     bundle_meta = ({"params": {"ckpt": args.ckpt}} if args.ckpt
                    else {"params": {"init_seed": 0}})
+    journaled_ids = set()
+    if args.journal_dir is not None and args.replicas > 1:
+        raise SystemExit(
+            "--journal-dir journals the single-replica path only; "
+            "fleets journal per replica and recover through "
+            "Router.restart(i, journal_dir=...)")
     if args.replicas > 1:
         from apex_tpu.serving.fleet import Router
         from apex_tpu.serving.resilience import ResilienceConfig
@@ -602,12 +631,20 @@ def main():
             sched.register_adapter(seed=100 + i)
         bundle_sched = replica_scheds[0]   # SIGUSR1 / /debug/bundle
     else:
+        journal = None
+        if args.journal_dir is not None:
+            from apex_tpu.serving.journal import Journal
+
+            # opening repair-scans: a torn tail from a crash is
+            # truncated at the last complete record before append
+            journal = Journal(args.journal_dir)
+            resume_seq = journal.seq
         sched = Scheduler(engine, max_queue=max(256, len(reqs)),
                           registry=registry, spans=spans,
                           pipeline_depth=args.pipeline_depth,
                           recorder=recorder, bundle_dir=args.bundle_dir,
                           tuner=tuner_cfg, tenancy=tenancy_cfg,
-                          slo=slo_cfg,
+                          slo=slo_cfg, journal=journal,
                           # params provenance: telemetry.replay rebuilds
                           # the model from a bundle with this
                           bundle_meta=bundle_meta)
@@ -615,6 +652,21 @@ def main():
             engine.register_prefix(t)
         for i in range(args.adapters):
             sched.register_adapter(seed=100 + i)
+        if journal is not None and resume_seq:
+            # warm restart: resubmit every unfinished journaled stream
+            # with its emitted prefix (it continues bit-identically),
+            # and keep finished ids out of this run's trace
+            from apex_tpu.serving.journal import (replay_into,
+                                                  replay_state,
+                                                  scan_journal)
+
+            journaled_ids = set(replay_state(
+                scan_journal(args.journal_dir)[0]).requests)
+            report = replay_into(sched, args.journal_dir)
+            print(f"journal: resumed {report.requests} unfinished "
+                  f"request(s) from {args.journal_dir} "
+                  f"({report.adapters} adapters, {report.prefixes} "
+                  f"prefixes replayed)")
         bundle_sched = sched
     if args.bundle_dir is not None:
         import signal
@@ -632,6 +684,23 @@ def main():
             signal.signal(signal.SIGUSR1, _dump_on_signal)
         print(f"black box armed: bundles -> {args.bundle_dir} "
               f"(SIGUSR1 dumps on demand)")
+    shutdown = {"requested": False}
+    if args.journal_dir is not None:
+        import signal
+
+        # graceful shutdown: the handler only sets a flag — the serve
+        # loop breaks at the next STEP boundary, where the journal's
+        # fetch-boundary commit has already made every emitted token
+        # durable (same policy as the SIGUSR1 handler: no real work
+        # inside a signal frame)
+        def _on_sigterm(*_):
+            shutdown["requested"] = True
+
+        if hasattr(signal, "SIGTERM"):
+            signal.signal(signal.SIGTERM, _on_sigterm)
+        print(f"durable journal armed: {args.journal_dir} (SIGTERM "
+              f"drains + seals; rerun with the same --journal-dir to "
+              f"resume unfinished streams)")
     if args.metrics_port is not None:
         from apex_tpu.telemetry import start_metrics_server
 
@@ -654,6 +723,8 @@ def main():
 
     throttled = []
     for r in reqs:
+        if r.request_id in journaled_ids:
+            continue  # resumed (or already finished) by the journal
         try:
             sched.submit(r)
         except TenantThrottled as e:
@@ -682,11 +753,35 @@ def main():
                  for k, v in engine.host_tier_stats().items()}))
             for rid in parked:
                 sched.resume(rid)
-    sched.run_until_idle()
+    if args.journal_dir is not None:
+        # step loop instead of run_until_idle so SIGTERM can break at
+        # a step boundary — everything emitted so far is already
+        # durable (the journal commits at every fetch boundary)
+        while not sched.idle() and not shutdown["requested"]:
+            sched.step()
+        if shutdown["requested"]:
+            live = (len(sched.active) + len(sched.queue)
+                    + len(sched.parked_requests))
+            sched.journal.close()
+            if args.bundle_dir is not None:
+                try:
+                    print(f"bundle: {sched.dump_bundle('sigterm')}")
+                except OSError as e:
+                    print(f"bundle dump failed: {e}")
+            print(f"sigterm: drained at a step boundary with "
+                  f"{live} stream(s) unfinished — journal sealed; "
+                  f"rerun with --journal-dir {args.journal_dir} "
+                  f"to resume them bit-identically")
+        else:
+            sched.journal.close()
+    else:
+        sched.run_until_idle()
     for r in reqs:
         if r.request_id in throttled:
             continue
-        c = sched.completions[r.request_id]
+        c = sched.completions.get(r.request_id)
+        if c is None:
+            continue  # interrupted by SIGTERM — journaled, resumable
         print(f"request {c.request_id} [{c.finish_reason}] "
               f"{list(r.prompt)} -> {c.tokens}")
     print("served " + json.dumps(
