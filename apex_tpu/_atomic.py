@@ -7,9 +7,14 @@ incident manifests (through ``write_bundle``), and the native-library
 build (``_native._build``). This module is that idiom extracted once:
 write into a temp sibling on the SAME filesystem, then ``os.replace``
 onto the destination — a crash mid-write leaves the old file (or
-nothing), never a truncated artifact that parses as garbage. The
-serving write-ahead journal (``apex_tpu.serving.journal``) finalizes
-its compacted segments and manifest through the same helpers.
+nothing), never a truncated artifact that parses as garbage.
+:func:`atomic_write` additionally fsyncs the temp file before the
+rename and the parent directory after it (:func:`fsync_dir`), so its
+contract holds across power loss, not just process death; the
+directory-yielding helpers fsync the rename but leave content
+durability to their writers. The serving write-ahead journal
+(``apex_tpu.serving.journal``) finalizes its compacted segments and
+manifest through the same helpers.
 
 Stdlib-only by contract: ``telemetry.flightrec`` (the laptop-side
 post-mortem reader) and ``serving.journal`` both import this with no
@@ -31,17 +36,41 @@ _UMASK = os.umask(0)
 os.umask(_UMASK)
 
 
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY fd so the renames/unlinks inside it survive
+    power loss, not just process death (a rename is metadata — without
+    this it can sit in the journal of a filesystem that already
+    persisted a later unlink). Best-effort: platforms/filesystems that
+    refuse directory fds (or fsync on them) degrade silently to the
+    process-crash guarantee, which ``os.replace`` alone provides."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def atomic_write(path: str, write_fn: Callable, *,
                  text: bool = False) -> None:
     """Run ``write_fn(file)`` against a same-directory temp file, then
     ``os.replace`` it onto ``path``. Same-dir matters — ``os.replace``
-    is only atomic within one filesystem. The fd is owned (and closed
-    exactly once) by the ``with`` block, so a failing replace still
-    reports its own error and the temp file is removed. ``text=True``
-    opens the temp file in text mode (utf-8)."""
+    is only atomic within one filesystem. The temp file's contents are
+    fsynced BEFORE the replace and the parent directory AFTER it, so
+    the complete-or-absent contract holds across power loss too — the
+    rename is never durable ahead of the data, and never less durable
+    than a later unlink (the ordering ``Journal.compact`` leans on).
+    The fd is owned (and closed exactly once) by the ``with`` block,
+    so a failing replace still reports its own error and the temp
+    file is removed. ``text=True`` opens the temp file in text mode
+    (utf-8)."""
+    parent = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(
-        dir=os.path.dirname(os.path.abspath(path)) or ".",
-        prefix=os.path.basename(path) + ".tmp.")
+        dir=parent, prefix=os.path.basename(path) + ".tmp.")
     try:
         # mkstemp creates 0600; restore the umask-derived mode a plain
         # open() would have given, so artifacts stay readable by the
@@ -50,10 +79,15 @@ def atomic_write(path: str, write_fn: Callable, *,
         if text:
             with os.fdopen(fd, "w", encoding="utf-8") as f:
                 write_fn(f)
+                f.flush()
+                os.fsync(f.fileno())
         else:
             with os.fdopen(fd, "wb") as f:
                 write_fn(f)
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, path)
+        fsync_dir(parent)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -75,6 +109,7 @@ def atomic_path(path: str) -> Iterator[str]:
             raise FileNotFoundError(
                 f"atomic_path writer produced no file at {tmp}")
         os.replace(tmp, path)
+        fsync_dir(os.path.dirname(os.path.abspath(path)) or ".")
     except BaseException:
         with contextlib.suppress(OSError):
             os.unlink(tmp)
@@ -101,6 +136,7 @@ def atomic_dir(path: str) -> Iterator[str]:
     try:
         yield tmp
         os.replace(tmp, path)
+        fsync_dir(parent)
     except BaseException:
         # never leave temp droppings next to real artifacts
         for root, dirs, names in os.walk(tmp, topdown=False):

@@ -259,6 +259,7 @@ class Journal:
         self.appends = 0
         self.rotations = 0
         self.compactions = 0
+        self.compaction_errors = 0
         self.fsyncs = 0
         self.fsync_s = 0.0
         self.last_append_bytes = 0
@@ -393,12 +394,23 @@ class Journal:
 
     def maybe_compact(self) -> bool:
         """Compact when the armed threshold of finished requests has
-        accumulated (no-op when ``compact_min_finished`` is None)."""
+        accumulated (no-op when ``compact_min_finished`` is None).
+        A failed rewrite (ENOSPC is likely precisely when compacting)
+        degrades to a counted error rather than raising: the tail
+        segment stays open for appends (see :meth:`compact`), so a
+        disk hiccup at a fetch boundary never becomes a serving
+        outage; the threshold re-arms after another
+        ``compact_min_finished`` finishes."""
         if (self.compact_min_finished is None
                 or self._finished_since_compact
                 < self.compact_min_finished):
             return False
-        self.compact()
+        try:
+            self.compact()
+        except OSError:
+            self.compaction_errors += 1
+            self._finished_since_compact = 0
+            return False
         return True
 
     def compact(self) -> Dict[str, int]:
@@ -407,9 +419,12 @@ class Journal:
         ``submit`` + merged full-prefix ``extend`` (+ ``park``) — and
         drop finished requests. The new segment is materialised
         through :func:`apex_tpu._atomic.atomic_write` (complete or
-        absent) BEFORE old segments are removed, and extends carry
-        absolute offsets, so a crash anywhere in between replays to
-        the same state."""
+        absent, fsynced along with its directory entry) BEFORE old
+        segments are removed, and extends carry absolute offsets, so
+        a crash anywhere in between replays to the same state. If the
+        rewrite itself fails (ENOSPC), the previous tail segment is
+        reopened for append and the error re-raised — a failed
+        compaction leaves a journal that still journals."""
         if self._f is None:
             raise JournalError("journal is closed")
         self._f.flush()
@@ -449,23 +464,43 @@ class Journal:
         def _write(f):
             for rec in out:
                 f.write(_frame(_encode(rec)))
-            f.flush()
-            os.fsync(f.fileno())
 
-        _atomic.atomic_write(new_path, _write)
-        for p in old:
-            os.unlink(p)
-        self._seq = max(self._seq, len(out))
-        self._segment_written = os.path.getsize(new_path)
-        self._segment_records = len(out)
-        self._bytes_other = 0
-        self._lag_bytes = 0
-        self._f = open(new_path, "ab")
-        self.compactions += 1
-        self._finished_since_compact = 0
-        self._write_manifest()
+        try:
+            # atomic_write fsyncs the segment AND its directory entry
+            # before returning, so the unlinks below can never outlive
+            # the new segment across a power loss
+            _atomic.atomic_write(new_path, _write)
+        except BaseException:
+            # rewrite failed mid-compaction: reopen the previous tail
+            # for append so the scheduler's _jlog keeps working — the
+            # old segments are all still intact
+            self._segment_index -= 1
+            self._f = open(old[-1], "ab")
+            self._segment_written = os.path.getsize(old[-1])
+            raise
+        removed = 0
+        try:
+            for p in old:
+                os.unlink(p)
+                removed += 1
+        finally:
+            # even a failed unlink leaves a valid journal (replay is
+            # idempotent over leftover old segments) — appends must
+            # continue on the compacted tail regardless
+            self._f = open(new_path, "ab")
+            self._seq = max(self._seq, len(out))
+            self._segment_written = os.path.getsize(new_path)
+            self._segment_records = len(out)
+            self._bytes_other = sum(
+                os.path.getsize(os.path.join(self.path, n))
+                for _, n in _segments(self.path)
+                if os.path.join(self.path, n) != new_path)
+            self._lag_bytes = 0
+            self.compactions += 1
+            self._finished_since_compact = 0
+            self._write_manifest()
         return {"records": len(out), "dropped_finished": dropped,
-                "segments_removed": len(old)}
+                "segments_removed": removed}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -479,6 +514,7 @@ class Journal:
             "fsync_s": self.fsync_s,
             "rotations": float(self.rotations),
             "compactions": float(self.compactions),
+            "compaction_errors": float(self.compaction_errors),
             "segments": float(len(self.segments())),
             "truncated_bytes": float(self.truncated_bytes),
         }
@@ -508,7 +544,7 @@ class Journal:
 _SUBMIT_FIELDS = (
     "order", "request_id", "prompt", "max_tokens", "temperature",
     "top_k", "top_p", "seed", "eos_token_id", "stop", "constrained",
-    "deadline_remaining", "tenant", "adapter",
+    "deadline_remaining", "tenant", "adapter", "adapter_name",
 )
 
 
@@ -554,9 +590,16 @@ def replay_state(records: List[Dict[str, Any]]) -> JournalState:
             st.meta = rec
         elif kind == "adapter":
             name = rec.get("name")
-            if name not in seen_adapters:
+            pos = seen_adapters.get(name)
+            if pos is None:
                 seen_adapters[name] = len(st.adapters)
                 st.adapters.append(rec)
+            else:
+                # a post-recovery re-registration of the same name
+                # carries the FRESH engine's id — keep the LATEST
+                # record (first-seen order preserved) so the id map
+                # matches the latest generation of submit records
+                st.adapters[pos] = rec
         elif kind == "prefix":
             key = tuple(int(t) for t in rec.get("tokens", ()))
             if key not in seen_prefixes:
@@ -612,7 +655,15 @@ class RecoveryReport:
     adapters: int = 0
     prefixes: int = 0
     skipped_constrained: int = 0
+    #: adapter REGISTRATIONS that could not replay (explicit weights,
+    #: ``seed: null`` — not re-derivable)
     skipped_adapters: int = 0
+    #: REQUESTS skipped because their journaled adapter id could not
+    #: be mapped onto the fresh engine (pinned to an unreplayable
+    #: adapter, or the registration record itself was lost to a torn
+    #: tail) — running them with guessed weights would violate the
+    #: bit-identical contract
+    skipped_adapter_requests: int = 0
     truncated_bytes: int = 0
     anomalies: int = 0
     wall_s: float = 0.0
@@ -643,7 +694,18 @@ def replay_into(scheduler, source, *,
     current clock from the journaled remaining budget. Constrained
     requests (opaque DFA — not serialisable) and requests pinned to an
     explicit-weights adapter (``seed: null`` — not re-derivable) are
-    skipped with counted stats."""
+    skipped with counted stats.
+
+    Engine adapter ids are assigned sequentially at registration, so
+    the fresh engine's ids need not match the journaled ones (any
+    skipped ``seed: null`` registration shifts every later id — and
+    across a SECOND recovery a re-registration can even reuse a dead
+    registration's old id): each request maps back to its adapter by
+    NAME (the stable, engine-deduped cross-recovery key its submit
+    record carries), falling back to a journaled-id → fresh-id map
+    for hand-built records, and a request whose adapter cannot be
+    mapped is skipped with a counted stat — never resubmitted against
+    whatever adapter happens to occupy the journaled row."""
     if isinstance(source, str):
         records, truncated_bytes = scan_journal(source)
     else:
@@ -651,14 +713,19 @@ def replay_into(scheduler, source, *,
     state = replay_state(records)
     report = RecoveryReport(truncated_bytes=truncated_bytes,
                             anomalies=state.anomalies)
-    dead_adapters = set()
+    adapter_ids = {0: 0}        # base weights map to base weights
+    adapter_names: Dict[str, int] = {}
     for ad in state.adapters:
         if ad.get("seed") is None:
             report.skipped_adapters += 1
-            dead_adapters.add(ad.get("adapter_id"))
             continue
-        scheduler.register_adapter(name=ad.get("name"),
-                                   seed=int(ad["seed"]))
+        aid = int(scheduler.register_adapter(name=ad.get("name"),
+                                             seed=int(ad["seed"])))
+        if ad.get("name") is not None:
+            adapter_names[ad["name"]] = aid
+        jid = ad.get("adapter_id")
+        if jid is not None:
+            adapter_ids[int(jid)] = aid
         report.adapters += 1
     for toks in state.prefixes:
         scheduler.register_prefix(toks)
@@ -669,8 +736,11 @@ def replay_into(scheduler, source, *,
         if rq.get("constrained"):
             report.skipped_constrained += 1
             continue
-        if rq.get("adapter") in dead_adapters:
-            report.skipped_adapters += 1
+        aname = rq.get("adapter_name")
+        adapter = (adapter_names.get(aname) if aname is not None
+                   else adapter_ids.get(int(rq.get("adapter") or 0)))
+        if adapter is None:
+            report.skipped_adapter_requests += 1
             continue
         remaining = rq.get("deadline_remaining")
         req = Request(
@@ -687,7 +757,7 @@ def replay_into(scheduler, source, *,
                       else now + float(remaining)),
             stop=rq.get("stop"),
             tenant=rq.get("tenant") or "default",
-            adapter=int(rq.get("adapter") or 0))
+            adapter=adapter)
         # an empty replay prefix is still a failover hand-off (list,
         # not None): the original submit already charged the tenant's
         # token budget — recovery must not double-bill or throttle it

@@ -1195,11 +1195,16 @@ class Scheduler:
             if len(replay_prefix) > len(st.tokens):
                 st.tokens = [int(t) for t in replay_prefix]
                 st.logprobs = list(replay_logprobs or [])
-            # journaled immediately (not at the next fetch boundary):
-            # the hand-off prefix is the client's already-seen stream
-            # — a crash before the first chunk must not forget it
+            # journaled AND committed immediately (not buffered until
+            # the next fetch boundary): the hand-off prefix is the
+            # client's already-seen stream — a crash before the first
+            # chunk must not forget it, so it gets durability to the
+            # fsync policy's level right here (batch/always fsync,
+            # none flushes to the page cache)
             self._journal_extend(request.request_id, st.tokens,
                                  st.logprobs)
+            if self.journal is not None:
+                self.journal.commit()
         # a tenant (re-)entering the backlog competes from "now": its
         # deficit counter clamps up to the minimum among the tenants
         # currently holding queued/active work — idle time is not
@@ -2588,6 +2593,14 @@ class Scheduler:
         deadline = row.pop("deadline", None)
         row["deadline_remaining"] = (
             None if deadline is None else max(deadline - now, 0.0))
+        if row.get("adapter"):
+            # the numeric id is generation-local (a recovered engine
+            # re-assigns ids sequentially and may reuse a skipped
+            # registration's); the NAME is the stable cross-recovery
+            # key replay maps the request back through
+            meta = self.engine._adapter_meta.get(
+                int(row["adapter"]), {})
+            row["adapter_name"] = meta.get("name")
         self._jlog("submit", **row)
         self._journal_len[request.request_id] = 0
 

@@ -27,6 +27,7 @@ from apex_tpu.serving.journal import (
     Journal,
     JournalError,
     recover_scheduler,
+    replay_into,
     replay_state,
     scan_journal,
 )
@@ -279,6 +280,156 @@ def test_auto_compaction_threshold(tmp_path):
     assert replay_state(scan_journal(jd)[0]).requests == {}
 
 
+def test_auto_compaction_failure_degrades_not_closes(tmp_path,
+                                                     monkeypatch):
+    """ENOSPC strikes exactly when compaction runs (it writes a whole
+    new segment). A failed rewrite must leave the journal open for
+    appends on its previous tail — not permanently 'closed' so every
+    later scheduler append raises JournalError — and maybe_compact
+    must degrade the failure to a counted stat instead of raising
+    into the fetch boundary."""
+    jd = str(tmp_path / "wal")
+    j = Journal(jd, compact_min_finished=1)
+    j.append("submit", request_id="r0", order=0, prompt=[1],
+             max_tokens=4)
+    j.append("finish", request_id="r0", reason="length")
+    j.append("submit", request_id="r1", order=1, prompt=[2],
+             max_tokens=4)
+
+    def no_space(path, write_fn, **kw):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(_atomic, "atomic_write", no_space)
+    assert j.maybe_compact() is False
+    assert j.compaction_errors == 1
+    # manual compact() re-raises, but still restores the tail first
+    j.append("finish", request_id="r0", reason="length")
+    with pytest.raises(OSError, match="No space"):
+        j.compact()
+    # the journal still journals: append, then recover the disk and
+    # compact for real
+    j.append("extend", request_id="r1", start=0, tokens=[7],
+             logprobs=[0.0])
+    monkeypatch.undo()
+    res = j.compact()
+    assert res["dropped_finished"] == 1
+    assert j.compactions == 1
+    j.append("finish", request_id="r1", reason="length")
+    j.close()
+    records, truncated = scan_journal(jd)
+    assert truncated == 0
+    st = replay_state(records)
+    assert set(st.requests) == {"r1"}
+    assert st.requests["r1"]["emitted"] == [7]
+    assert st.requests["r1"]["finished"] is True
+
+
+class _StubScheduler:
+    """The replay_into surface without an engine: hands out SEQUENTIAL
+    adapter ids (the real engine's allocation policy — the property
+    the id remap exists for) and records every resubmission."""
+
+    recorder = None
+    telemetry = None
+
+    def __init__(self):
+        self._journal_recovered = 0
+        self._next_adapter = 1
+        self.registered = []
+        self.submitted = []
+
+    def clock(self):
+        return 100.0
+
+    def register_adapter(self, weights=None, *, name=None, seed=None):
+        aid = self._next_adapter
+        self._next_adapter += 1
+        self.registered.append((name, seed, aid))
+        return aid
+
+    def register_prefix(self, tokens):
+        return 1
+
+    def submit(self, req, *, replay_prefix=None, replay_logprobs=None):
+        self.submitted.append(req)
+
+
+def test_replay_remaps_adapter_ids_across_skipped_registrations():
+    """Engine adapter ids are sequential and recovery skips
+    seed-null (explicit-weights) registrations, so every adapter
+    registered AFTER a skipped one lands on a SHIFTED id on the fresh
+    engine. Resubmitting with the journaled id would silently run the
+    request under the wrong adapter weights: replay must remap each
+    request's id through what register_adapter actually returned, and
+    skip (counted) any request whose id has no mapping."""
+    records = [
+        {"kind": "adapter", "name": "explicit", "seed": None,
+         "rank": 4, "adapter_id": 1},
+        {"kind": "adapter", "name": "seeded", "seed": 5,
+         "rank": 4, "adapter_id": 2},
+        {"kind": "submit", "request_id": "base", "order": 0,
+         "prompt": [1], "max_tokens": 4, "adapter": 0},
+        {"kind": "submit", "request_id": "shifted", "order": 1,
+         "prompt": [2], "max_tokens": 4, "adapter": 2},
+        {"kind": "submit", "request_id": "dead", "order": 2,
+         "prompt": [3], "max_tokens": 4, "adapter": 1},
+        {"kind": "submit", "request_id": "lost", "order": 3,
+         "prompt": [4], "max_tokens": 4, "adapter": 9},  # torn away
+    ]
+    sched = _StubScheduler()
+    report = replay_into(sched, records)
+    # only the seeded adapter re-registers — and the fresh engine
+    # hands it id 1, not its journaled id 2
+    assert [(n, s) for n, s, _ in sched.registered] == [("seeded", 5)]
+    assert {r.request_id: r.adapter for r in sched.submitted} == \
+        {"base": 0, "shifted": 1}
+    assert report.requests == 2
+    assert report.adapters == 1
+    assert report.skipped_adapters == 1          # 'explicit'
+    assert report.skipped_adapter_requests == 2  # 'dead' + 'lost'
+
+
+def test_replay_maps_adapters_by_name_across_double_recovery():
+    """A recovered scheduler journals its own re-registrations and
+    resubmissions into the SAME journal, so after a second crash the
+    log holds two generations of adapter ids — and the fresh
+    generation can even REUSE a dead explicit-weights registration's
+    old id. Submit records carry adapter_name precisely so replay
+    maps by the stable name and never crosses id generations."""
+    records = [
+        {"kind": "adapter", "name": "explicit", "seed": None,
+         "rank": 4, "adapter_id": 1},
+        {"kind": "adapter", "name": "adapter-seed-9", "seed": 9,
+         "rank": 4, "adapter_id": 2},
+        {"kind": "submit", "request_id": "pinned", "order": 0,
+         "prompt": [1], "max_tokens": 4, "adapter": 1,
+         "adapter_name": "explicit"},
+        {"kind": "submit", "request_id": "live", "order": 1,
+         "prompt": [2], "max_tokens": 4, "adapter": 2,
+         "adapter_name": "adapter-seed-9"},
+        # what recovery #1 appended: the seeded adapter re-registered
+        # at id 1 (the dead registration's old id!) and 'live'
+        # resubmitted under it
+        {"kind": "adapter", "name": "adapter-seed-9", "seed": 9,
+         "rank": 4, "adapter_id": 1},
+        {"kind": "submit", "request_id": "live", "order": 1,
+         "prompt": [2], "max_tokens": 4, "adapter": 1,
+         "adapter_name": "adapter-seed-9"},
+    ]
+    sched = _StubScheduler()
+    report = replay_into(sched, records)
+    # one registration per NAME, replayed once from its seed
+    assert [(n, s) for n, s, _ in sched.registered] == \
+        [("adapter-seed-9", 9)]
+    assert {r.request_id: r.adapter for r in sched.submitted} == \
+        {"live": 1}
+    # 'pinned' names the dead explicit adapter: skipped, even though
+    # its journaled id (1) is now occupied by the seeded adapter
+    assert report.skipped_adapters == 1
+    assert report.skipped_adapter_requests == 1
+    assert report.requests == 1
+
+
 def test_replay_state_counts_gap_anomalies(tmp_path):
     st = replay_state([
         {"kind": "submit", "request_id": "r0", "order": 0,
@@ -361,19 +512,28 @@ def test_recovery_replays_lora_adapters_onto_fresh_engine(model,
     """Recovery after TOTAL loss: the replacement engine starts with
     an empty adapter pool, and replay re-registers the journaled
     seeded adapter before resubmitting its requests — adapter streams
-    finish bit-identical to the uninterrupted run."""
+    finish bit-identical to the uninterrupted run. The pool mixes an
+    explicit-weights adapter (id 1, unreplayable) in FRONT of the
+    seeded one (id 2), so recovery must remap the seeded requests
+    onto the id the fresh engine assigns (1) — resubmitting the
+    journaled id would decode under the wrong row."""
     cfg, params, mesh = model
     jd = str(tmp_path / "wal")
     ecfg = EngineConfig(slots=2, max_prompt_len=8, max_seq_len=24,
-                        decode_chunk=2, adapter_slots=2)
+                        decode_chunk=2, adapter_slots=3)
 
     def build():
         return Engine(cfg, params, mesh, ecfg)
 
-    reqs = _reqs(4, seed0=8100, adapter=lambda i: i % 2)
+    explicit = gpt.init_lora_weights(cfg, ecfg.adapter_rank, 777)
+    # even requests ride base weights, odd ones the SEEDED adapter
+    # (journaled id 2 — shifted to 1 on the recovered engine)
+    reqs = _reqs(4, seed0=8100, adapter=lambda i: 2 * (i % 2))
     with build().warmup() as eng:
         ref_sched = Scheduler(eng)
-        assert ref_sched.register_adapter(seed=123) == 1
+        assert ref_sched.register_adapter(
+            explicit, name="explicit") == 1
+        assert ref_sched.register_adapter(seed=123) == 2
         for r in reqs:
             ref_sched.submit(r)
         ref = _drain(ref_sched)
@@ -381,6 +541,7 @@ def test_recovery_replays_lora_adapters_onto_fresh_engine(model,
     with build().warmup() as eng2:
         j = Journal(jd)
         victim = Scheduler(eng2, journal=j)
+        victim.register_adapter(explicit, name="explicit")
         victim.register_adapter(seed=123)
         for r in reqs:
             victim.submit(r)
@@ -392,7 +553,10 @@ def test_recovery_replays_lora_adapters_onto_fresh_engine(model,
 
     sched2, report = recover_scheduler(jd, lambda: build())
     try:
-        assert report.adapters == 1 and report.skipped_adapters == 0
+        assert report.adapters == 1            # the seeded one
+        assert report.skipped_adapters == 1    # 'explicit': seed=null
+        assert report.skipped_adapter_requests == 0
+        assert sched2.engine.adapters_registered == 1
         merged = dict(prior)
         merged.update(_drain(sched2))
         assert merged == ref, "adapter recovery drifted"
